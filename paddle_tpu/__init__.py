@@ -1,0 +1,66 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on jax/XLA/Pallas/pjit.
+
+Public surface mirrors the reference `paddle.*` namespace (python/paddle/
+__init__.py) so users of the reference can switch with a module rename.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core.tensor import Tensor, to_tensor
+from .core.dtype import (
+    bool_ as bool8, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, set_default_dtype,
+    get_default_dtype,
+)
+from .core.dispatch import no_grad, is_grad_enabled, set_grad_enabled
+
+from .ops import *  # noqa: F401,F403
+from .ops import random as _random_mod
+from .ops.random import seed, get_rng_state, set_rng_state
+from . import ops
+from . import autograd
+from .autograd import grad, PyLayer
+
+bool = bool8
+
+# Subpackages populated incrementally (nn, optimizer, io, amp, distributed,
+# jit, static, models, vision, metric, profiler) — imported lazily to keep
+# `import paddle_tpu` cheap.
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from .framework_io import save, load  # noqa: E402
+from .device import (  # noqa: E402
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_tpu,
+    CPUPlace, TPUPlace, CUDAPlace,
+)
+
+from .nn.layer.common import ParamAttr  # noqa: E402
+
+
+def disable_static(place=None):
+    """Eager mode is the default; kept for API parity."""
+
+
+def enable_static():
+    from . import static as static_mod
+    static_mod._enable()
+
+
+def in_dynamic_mode():
+    from . import static as static_mod
+    return not static_mod._static_enabled()
+
+
+def empty_cache():
+    """XLA manages HBM; nothing to free eagerly."""
+
+
+def synchronize():
+    import jax
+    jax.effects_barrier()

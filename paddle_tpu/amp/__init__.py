@@ -1,0 +1,197 @@
+"""Auto mixed precision (reference: python/paddle/amp/ — auto_cast
+auto_cast.py:703, decorate :787, GradScaler grad_scaler.py).
+
+TPU-native: bf16 is the native mixed-precision dtype (no loss scaling
+needed); fp16 + dynamic loss scaling is kept for parity. The cast policy is
+applied inside op dispatch via a thread-local AMP state consulted by
+`amp_autocast` wrappers on white-listed ops.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+# per-op lists (reference: amp white/black lists, amp/auto_cast.py)
+WHITE_LIST = {
+    "matmul", "linear", "conv", "conv_bias", "conv_transpose",
+    "conv_transpose_bias", "einsum", "sdpa", "sdpa_mask", "bmm", "mm",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "cross_entropy_w", "mse_loss",
+    "l1_loss", "norm", "sum", "mean", "cumsum", "logsumexp", "layer_norm",
+    "layer_norm_nowb", "batch_norm_train", "batch_norm_infer", "rms_norm",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.float16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp = _AmpState()
+
+
+def amp_state():
+    return _amp
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="float16", use_promote=True):
+    """Reference: paddle.amp.auto_cast (auto_cast.py:703)."""
+    prev = (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white, _amp.custom_black)
+    _amp.enabled = bool(enable)
+    _amp.dtype = dtypes.convert_dtype(dtype)
+    _amp.level = level
+    _amp.custom_white = set(custom_white_list or ())
+    _amp.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+         _amp.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def _should_cast(op_name):
+    if not _amp.enabled:
+        return None
+    name = op_name
+    if name in _amp.custom_black or name in BLACK_LIST:
+        return jnp.float32
+    if _amp.level == "O2":
+        return _amp.dtype
+    if name in _amp.custom_white or name in WHITE_LIST:
+        return _amp.dtype
+    return None
+
+
+from ..core.dispatch import set_amp_cast_hook
+
+set_amp_cast_hook(_should_cast)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None):
+    """Reference: paddle.amp.decorate (auto_cast.py:787). O2 casts parameters
+    to the AMP dtype (master weights live in optimizer fp32 state)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        d = dtypes.convert_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:345
+    `scale`, :578 `minimize`; check_finite_and_unscale kernel)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops.math import multiply
+        return multiply(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                p.grad._value = g
+                if not bool(jnp.all(jnp.isfinite(g))):
+                    found = True
+        self._found_inf = found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,10 @@
+"""Autograd user API (reference: python/paddle/autograd/)."""
+from ..core.dispatch import no_grad, is_grad_enabled, set_grad_enabled
+from .backward_engine import run_backward
+from .functional import grad, backward
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = [
+    "no_grad", "is_grad_enabled", "set_grad_enabled", "grad", "backward",
+    "PyLayer", "PyLayerContext",
+]

@@ -1,0 +1,142 @@
+"""Reverse-mode engine over the eager tape.
+
+Reference analog: `egr::RunBackward` (paddle/fluid/eager/backward.cc:105) —
+queue-driven reverse pass over GradNodes with in-degree bookkeeping and
+GradTensorHolder accumulation. Here each node's VJP is a cached jitted JAX
+function (core/dispatch.py), so backward is a sequence of compiled XLA
+executions; accumulation is a jnp add.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _drop_float0(g):
+    # jax.vjp emits float0 cotangents for integer primals; drop them.
+    if g is None:
+        return None
+    if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+        return None
+    return g
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    from ..core.tensor import Tensor
+    from ..core.dispatch import _get_fwd
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    node_cts = {}  # id(GradNode) -> (node, [cotangent | None] per output slot)
+    leaf_seeds = []
+
+    def seed(node, idx, ct):
+        entry = node_cts.get(id(node))
+        if entry is None:
+            entry = (node, [None] * node.n_outputs)
+            node_cts[id(node)] = entry
+        lst = entry[1]
+        lst[idx] = ct if lst[idx] is None else lst[idx] + ct
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires an explicit grad tensor"
+                )
+            ct = jnp.ones_like(t._value)
+        else:
+            ct = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is None:
+            if not t.stop_gradient:
+                leaf_seeds.append((t, ct))
+            continue
+        seed(t._grad_node, t._out_idx, ct)
+        roots.append(t._grad_node)
+
+    # Reverse-graph in-degree: number of consumer nodes that will contribute
+    # cotangents to each node before it may fire.
+    indeg = {}
+    nodes = {}
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if id(n) in nodes:
+            continue
+        nodes[id(n)] = n
+        for (pnode, _pidx, _t, needs) in n.input_metas:
+            if pnode is not None and needs:
+                indeg[id(pnode)] = indeg.get(id(pnode), 0) + 1
+                stack.append(pnode)
+
+    queue = [n for n in nodes.values() if indeg.get(id(n), 0) == 0]
+    processed = set()
+
+    while queue:
+        node = queue.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        entry = node_cts.pop(id(node), None)
+        if entry is None:
+            # Reachable node that never received a cotangent (its outputs were
+            # not on any path to the loss) — still must release its consumers'
+            # pending counts.
+            cts = None
+        else:
+            cts = entry[1]
+
+        in_grads = None
+        if cts is not None:
+            if any(c is None for c in cts):
+                out_shapes = getattr(node, "out_shapes", None)
+                if out_shapes is not None:
+                    shapes = out_shapes
+                else:
+                    fwd = _get_fwd(node.impl, node.statics_key, node.statics)
+                    shapes = jax.eval_shape(fwd, *node.input_arrays)
+                    if not isinstance(shapes, (tuple, list)):
+                        shapes = [shapes]
+                cts = [
+                    c if c is not None else jnp.zeros(s.shape, s.dtype)
+                    for c, s in zip(cts, shapes)
+                ]
+            in_grads = node.run_vjp(cts)
+
+        for i, meta in enumerate(node.input_metas):
+            pnode, pidx, in_tensor, needs = meta
+            if not needs:
+                continue
+            g = _drop_float0(in_grads[i]) if in_grads is not None else None
+
+            if g is not None and in_tensor is not None and in_tensor._hooks:
+                for h in in_tensor._hooks:
+                    if h is None:
+                        continue
+                    res = h(Tensor(g))
+                    if res is not None:
+                        g = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+
+            if pnode is None:
+                if g is not None and in_tensor is not None:
+                    if in_tensor.grad is None:
+                        in_tensor.grad = Tensor(g)
+                    else:
+                        in_tensor.grad._value = in_tensor.grad._value + g
+            else:
+                if g is not None:
+                    seed(pnode, pidx, g)
+                indeg[id(pnode)] -= 1
+                if indeg[id(pnode)] <= 0:
+                    queue.append(pnode)
+
+        if not retain_graph:
+            node.release()
+
+    for t, ct in leaf_seeds:
+        if t.grad is None:
+            t.grad = Tensor(ct)
+        else:
+            t.grad._value = t.grad._value + ct

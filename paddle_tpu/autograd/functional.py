@@ -1,0 +1,47 @@
+"""paddle.grad / paddle.autograd.backward equivalents.
+
+Reference: `egr::Backward`/`GeneralGrad` (paddle/fluid/eager/backward.cc:428)
+— grad(outputs, inputs) computes grads only for `inputs` without touching
+`.grad`. We run the tape engine into temporary accumulators.
+"""
+from __future__ import annotations
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    from .backward_engine import run_backward
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """Reference: paddle.grad (python/paddle/autograd/__init__.py → GeneralGrad)."""
+    from .backward_engine import run_backward
+    from ..core.tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    saved = [(t.grad, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.stop_gradient = False
+    retain = True if retain_graph is None else retain_graph
+    run_backward(list(outputs), grad_outputs, retain_graph=retain)
+    grads = []
+    for t, (old_grad, old_sg) in zip(inputs, saved):
+        g = t.grad
+        if g is None and not allow_unused:
+            import jax.numpy as jnp
+            g = Tensor(jnp.zeros_like(t._value))
+        grads.append(g)
+        t.grad = old_grad
+        t.stop_gradient = old_sg
+    return grads

@@ -1,0 +1,108 @@
+"""PyLayer — user-defined autograd function.
+
+Reference: paddle/fluid/eager/pylayer/ + python/paddle/autograd/py_layer.py.
+The forward runs eagerly; a synthetic GradNode routes cotangents through the
+user's backward().
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import is_grad_enabled, GradNode, no_grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose VJP is the user's backward()."""
+
+    def __init__(self, cls, ctx, input_metas, n_outputs, out_is_seq):
+        # bypass GradNode.__init__ jit plumbing
+        self.name = cls.__name__
+        self.impl = None
+        self.statics = {}
+        self.statics_key = ()
+        self.input_arrays = []
+        self.input_metas = input_metas
+        self.n_outputs = n_outputs
+        self.out_is_seq = out_is_seq
+        self._cls = cls
+        self._ctx = ctx
+        GradNode._counter[0] += 1
+        self._id = GradNode._counter[0]
+
+    def run_vjp(self, cotangents):
+        cts = [Tensor(c) for c in cotangents]
+        with no_grad():
+            if self.out_is_seq:
+                grads = self._cls.backward(self._ctx, *cts)
+            else:
+                grads = self._cls.backward(self._ctx, cts[0])
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+            elif isinstance(g, Tensor):
+                out.append(g._value)
+            else:
+                out.append(jnp.asarray(g))
+        return out
+
+    def release(self):
+        pass
+
+
+class PyLayer:
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        out_is_seq = isinstance(out, (tuple, list))
+        outs = list(out) if out_is_seq else [out]
+
+        any_grad = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if any_grad:
+            metas = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    needs = not a.stop_gradient
+                    metas.append((a._grad_node, a._out_idx, a, needs))
+            node = _PyLayerNode(cls, ctx, metas, len(outs), out_is_seq)
+            node.out_shapes = [
+                type("S", (), {"shape": tuple(t.shape), "dtype": t.dtype})()
+                if isinstance(t, Tensor) else None
+                for t in outs
+            ]
+            for i, t in enumerate(outs):
+                if isinstance(t, Tensor):
+                    t._grad_node = node
+                    t._out_idx = i
+                    t.stop_gradient = False
+        return out
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError

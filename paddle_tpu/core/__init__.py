@@ -1,0 +1,7 @@
+from .tensor import Tensor, to_tensor
+from .dtype import (
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype,
+    convert_dtype,
+)
+from .dispatch import no_grad, is_grad_enabled, set_grad_enabled

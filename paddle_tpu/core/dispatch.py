@@ -1,0 +1,245 @@
+"""Eager op dispatch with tape-based autograd over jitted JAX primitives.
+
+Design (TPU-native replacement for the reference's eager stack):
+
+The reference dispatches each eager op through a generated `*_ad_func` that
+records a GradNode on the tape and calls a phi kernel
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:251,
+paddle/fluid/eager/grad_node_info.h:197). Here every op is a *pure JAX
+function*; eager execution runs it under a cached `jax.jit` (one compilation
+per (op, static-args, shapes) — XLA is the kernel library). Autograd records a
+lightweight tape node holding the op's input arrays; the backward pass calls a
+cached jitted VJP (`jax.vjp` inside jit) so gradients are also compiled. The
+residual policy is "store inputs, recompute forward inside the VJP" — per-op
+rematerialization, which on TPU trades cheap FLOPs for HBM.
+
+The fully-jitted training path (paddle_tpu.jit) bypasses this tape entirely by
+tracing the whole step; this module is the define-by-run compatibility layer.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "apply",
+    "no_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "GradNode",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Context manager disabling tape recording (reference: paddle.no_grad)."""
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+# --------------------------------------------------------------------------
+# Cached jitted forward / vjp per (impl, static-args) pair.
+# --------------------------------------------------------------------------
+
+_jit_cache: dict = {}
+
+
+def _hashable(v):
+    if isinstance(v, (list,)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return (v.shape, str(v.dtype), v.tobytes())
+    return v
+
+
+def _get_fwd(impl, statics_key, statics):
+    key = ("fwd", impl, statics_key)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(partial(impl, **statics))
+        _jit_cache[key] = fn
+    return fn
+
+
+def _get_fwd_vjp(impl, statics_key, n_primals, statics):
+    """Jitted function: primals -> (out, residual-free). We don't keep the
+    closure; backward re-runs the forward inside the jitted VJP below."""
+    return _get_fwd(impl, statics_key, statics)
+
+
+def _vjp_callable(impl, statics, n_primals):
+    def run(primals, cotangent):
+        f = partial(impl, **statics)
+        out, vjp_fn = jax.vjp(f, *primals)
+        # Cotangents may arrive in a different float dtype than the output
+        # (mixed-precision tapes: an fp32 loss feeding a bf16 matmul). Cast to
+        # the output aval's dtype — XLA fuses the convert into the vjp.
+        cotangent = jax.tree_util.tree_map(
+            lambda c, o: jnp.asarray(c, o.dtype) if c.dtype != o.dtype else c,
+            cotangent, out)
+        return vjp_fn(cotangent)
+
+    return run
+
+
+def _get_vjp(impl, statics_key, n_primals, statics):
+    key = ("vjp", impl, statics_key, n_primals)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(_vjp_callable(impl, statics, n_primals))
+        _jit_cache[key] = fn
+    return fn
+
+
+# --------------------------------------------------------------------------
+# Tape
+# --------------------------------------------------------------------------
+
+
+class GradNode:
+    """A recorded op on the eager tape.
+
+    Reference analog: egr::GradNodeBase (grad_node_info.h:197). Holds the pure
+    impl + static args + input arrays; `run_vjp` computes input cotangents via
+    a cached jitted VJP.
+    """
+
+    __slots__ = (
+        "name",
+        "impl",
+        "statics",
+        "statics_key",
+        "input_arrays",
+        "input_metas",
+        "n_outputs",
+        "out_is_seq",
+        "_id",
+    )
+
+    _counter = [0]
+
+    def __init__(self, name, impl, statics, statics_key, input_arrays, input_metas, n_outputs, out_is_seq):
+        self.name = name
+        self.impl = impl
+        self.statics = statics
+        self.statics_key = statics_key
+        self.input_arrays = input_arrays
+        self.input_metas = input_metas  # list of (producer GradNode|None, out_idx, leaf Tensor|None, needs_grad)
+        self.n_outputs = n_outputs
+        self.out_is_seq = out_is_seq
+        GradNode._counter[0] += 1
+        self._id = GradNode._counter[0]
+
+    def run_vjp(self, cotangents):
+        """cotangents: list aligned with outputs (None entries filled with zeros)."""
+        if self.input_arrays is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{self.name}' a second time; "
+                "the saved tensors were already released. Call backward with "
+                "retain_graph=True to backward multiple times.")
+        if self.out_is_seq:
+            ct = tuple(cotangents)
+        else:
+            ct = cotangents[0]
+        vjp = _get_vjp(self.impl, self.statics_key, len(self.input_arrays), self.statics)
+        return vjp(tuple(self.input_arrays), ct)
+
+    def release(self):
+        self.input_arrays = None
+
+
+# AMP hook: set by paddle_tpu.amp at import; returns target dtype for an op
+# under the active autocast policy, or None (reference analog: the AMP cast
+# logic generated into every ad_func, eager_amp_auto_cast.h:64).
+_amp_cast_hook = None
+
+
+def set_amp_cast_hook(fn):
+    global _amp_cast_hook
+    _amp_cast_hook = fn
+
+
+def apply(name, impl, tensor_args, statics=None, out_wrapper=None):
+    """Dispatch one eager op.
+
+    Args:
+      name: op name (for debugging / profiling).
+      impl: pure function (array_args..., **statics) -> array | tuple of arrays.
+      tensor_args: sequence of Tensor (or raw array) positional operands.
+      statics: dict of non-traced keyword args (must be hashable-ish).
+      out_wrapper: optional callable mapping each output array -> Tensor
+        (defaults to Tensor construction).
+
+    Returns a Tensor or tuple of Tensors mirroring impl's output structure.
+    """
+    from .tensor import Tensor  # circular-safe
+
+    statics = statics or {}
+    statics_key = _hashable(statics)
+
+    cast_to = _amp_cast_hook(name) if _amp_cast_hook is not None else None
+
+    arrays = []
+    metas = []
+    any_grad = False
+    for t in tensor_args:
+        if isinstance(t, Tensor):
+            v = t._value
+            if cast_to is not None and v.dtype != cast_to and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(cast_to)
+            arrays.append(v)
+            needs = (not t.stop_gradient) and _state.grad_enabled
+            metas.append((t._grad_node, t._out_idx, t, needs))
+            any_grad = any_grad or needs
+        else:
+            arrays.append(t)
+            metas.append((None, 0, None, False))
+
+    fwd = _get_fwd(impl, statics_key, statics)
+    out = fwd(*arrays)
+
+    out_is_seq = isinstance(out, (tuple, list))
+    outs = list(out) if out_is_seq else [out]
+
+    node = None
+    if any_grad:
+        node = GradNode(name, impl, statics, statics_key, arrays, metas, len(outs), out_is_seq)
+
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=not any_grad)
+        if node is not None:
+            t._grad_node = node
+            t._out_idx = i
+        wrapped.append(t)
+
+    if out_is_seq:
+        return tuple(wrapped)
+    return wrapped[0]

@@ -1,0 +1,84 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference framework's dtype surface (paddle/phi/common/data_type.h and
+python/paddle dtype aliases) on top of numpy/jax dtypes. TPU-first: bfloat16 is a
+first-class dtype; float64 is supported but discouraged (TPU emulates it slowly).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (jnp dtype objects). These are the public `paddle_tpu.float32`
+# etc. aliases, matching the reference's `paddle.float32` surface.
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # convenience aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d):
+    """Set default floating dtype (reference: paddle.set_default_dtype,
+    python/paddle/framework/framework.py)."""
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(dtype):
+    """Normalize str/np.dtype/jnp dtype to a canonical numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return np.dtype(_STR_TO_DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.complexfloating)

@@ -1,0 +1,267 @@
+"""Eager Tensor facade over jax.Array.
+
+Reference analog: `paddle::Tensor` (paddle/phi/api/include/tensor.h:82) +
+`AutogradMeta` (paddle/fluid/eager/autograd_meta.h:61). One Python object
+bundles the immutable device buffer (a jax.Array, resident in TPU HBM via
+PJRT), autograd metadata (producer GradNode, accumulated .grad, hooks), and
+the mutable-tensor illusion: "in-place" APIs rebind `_value` to a fresh
+functional result, which is the TPU-idiomatic way to express mutation (XLA
+buffers are immutable; donation recovers the memory in jitted paths).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from .dispatch import is_grad_enabled
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_idx",
+        "name",
+        "persistable",
+        "_hooks",
+        "trainable",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = None
+        self.trainable = True
+
+    # -- meta ------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(self._value.size)
+
+    @property
+    def place(self):
+        dev = list(self._value.devices())[0]
+        return str(dev)
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.t(self)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return int(self._value.size)
+
+    def dim(self):
+        return self._value.ndim
+
+    # -- conversion ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self._value[args].item() if len(args) > 1 else np.asarray(self._value).flat[args[0]].item()
+        return np.asarray(self._value).item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __len__(self):
+        if self._value.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    def __deepcopy__(self, memo):
+        t = Tensor(self._value, stop_gradient=self.stop_gradient, name=self.name)
+        t.persistable = self.persistable
+        t.trainable = self.trainable
+        memo[id(self)] = t
+        return t
+
+    # -- autograd --------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd.backward_engine import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Register a gradient hook (reference: tensor hooks in
+        eager/grad_node_info.h). Returns a removable handle."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        tensor = self
+        idx = len(self._hooks) - 1
+
+        class _Handle:
+            def remove(self):
+                tensor._hooks[idx] = None
+
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self._out_idx = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .. import ops
+        return ops.assign(self)
+
+    # -- mutation (functional under the hood) ----------------------------
+    def _set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value)
+        return self
+
+    def set_value(self, value):
+        return self._set_value(value)
+
+    def copy_(self, other, blocking=True):
+        return self._set_value(other)
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    # -- dtype/device ----------------------------------------------------
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # device moves are XLA-managed; only dtype conversion is meaningful
+        for a in list(args) + list(kwargs.values()):
+            try:
+                d = dtypes.convert_dtype(a)
+            except (ValueError, TypeError):
+                continue
+            if d is not None:
+                return self.astype(d)
+        return self
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # -- indexing --------------------------------------------------------
+    def __getitem__(self, idx):
+        from .. import ops
+        return ops._getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from .. import ops
+        ops._setitem_inplace(self, idx, value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- operators (bound lazily to the ops registry) --------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self._value.dtype}{grad_info},\n"
+            f"       {np.asarray(self._value)!r})"
+        )
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """Reference: paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        v = data._value
+    else:
+        v = data
+    d = dtypes.convert_dtype(dtype)
+    if not isinstance(v, jax.Array):
+        v = np.asarray(v)
+        if d is None and v.dtype == np.float64:
+            v = v.astype(np.float32)  # match reference default fp32
+        if d is None and v.dtype == np.int64 and False:
+            pass
+        v = jnp.asarray(v, dtype=d)
+    elif d is not None and v.dtype != d:
+        v = v.astype(d)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _bind_method(name, fn):
+    """Attach an ops-registry function as a Tensor method."""
+    if getattr(Tensor, name, None) is None or name not in Tensor.__slots__:
+        try:
+            setattr(Tensor, name, fn)
+        except (AttributeError, TypeError):
+            pass

@@ -1,0 +1,117 @@
+"""Device management (reference: python/paddle/device/ + phi DeviceManager
+device_manager.h:134). On the TPU stack PJRT owns devices; this module maps
+the reference's Place/device-string surface onto jax.devices()."""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    def __init__(self, kind, index=0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self.kind, self.index) == (other.kind, other.index)
+
+
+class CPUPlace(Place):
+    def __init__(self, index=0):
+        super().__init__("cpu", index)
+
+
+class TPUPlace(Place):
+    def __init__(self, index=0):
+        super().__init__("tpu", index)
+
+
+class CUDAPlace(Place):
+    """Accepted for API parity; maps onto the default accelerator."""
+
+    def __init__(self, index=0):
+        super().__init__("gpu", index)
+
+
+class XPUPlace(Place):
+    def __init__(self, index=0):
+        super().__init__("xpu", index)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self, index=0):
+        super().__init__("cpu", index)
+
+
+_current_device = None
+
+
+def set_device(device: str):
+    """Reference: paddle.set_device. Accepts 'cpu', 'tpu', 'tpu:0', 'gpu:0'
+    (mapped to the default accelerator)."""
+    global _current_device
+    _current_device = device
+    return device
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+class cuda:
+    """Namespace parity for paddle.device.cuda — returns TPU stats."""
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        jax.effects_barrier()

@@ -1,0 +1,74 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:721,960).
+
+Pickle protocol-4 (large-tensor capable) over a numpy-converted object tree;
+Tensors round-trip as numpy arrays + meta. Distributed sharded checkpoints
+live in paddle_tpu.distributed.checkpoint."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient", "name")
+
+    def __init__(self, array, stop_gradient, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._value)
+        if arr.dtype == jnp.bfloat16:
+            # numpy can't pickle ml_dtypes cleanly across versions; stash as
+            # uint16 raw bits + marker
+            return ("__bf16__", _TensorPayload(arr.view(np.uint16), obj.stop_gradient, obj.name))
+        return _TensorPayload(arr, obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__bf16__":
+        p = obj[1]
+        arr = p.array.view(jnp.bfloat16)
+        if return_numpy:
+            return arr
+        t = Tensor(jnp.asarray(arr), stop_gradient=p.stop_gradient, name=p.name)
+        return t
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        return Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient,
+                      name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

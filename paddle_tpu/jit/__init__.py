@@ -1,0 +1,3 @@
+"""paddle_tpu.jit (reference: python/paddle/jit/)."""
+from .api import to_static, not_to_static, ignore_module, StaticFunction
+from .save_load import save, load, TranslatedLayer

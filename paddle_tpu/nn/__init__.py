@@ -1,0 +1,48 @@
+"""paddle_tpu.nn — mirrors python/paddle/nn/__init__.py surface."""
+from .layer.layers import Layer, Parameter
+from .layer.common import (
+    ParamAttr, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, CosineSimilarity, Bilinear, PixelShuffle,
+    PixelUnshuffle, Unfold, Fold,
+)
+from .layer.conv import (
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm,
+)
+from .layer.activation import (
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, Softsign, Tanhshrink,
+    LogSigmoid, Hardswish, Hardsigmoid, GELU, LeakyReLU, ELU, CELU, SELU,
+    PReLU, RReLU, Hardtanh, Hardshrink, Softshrink, Softplus, ThresholdedReLU,
+    Softmax, LogSoftmax, Maxout, GLU,
+)
+from .layer.container import Sequential, LayerList, ParameterList, LayerDict
+from .layer.pooling import (
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layer.loss import (
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, HuberLoss, MarginRankingLoss, CTCLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, SoftMarginLoss, PoissonNLLLoss,
+    MultiLabelSoftMarginLoss, HingeEmbeddingLoss,
+)
+from .layer.transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (
+    SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNN, BiRNN,
+    RNNBase,
+)
+from .clip import (
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
+    clip_grad_value_,
+)
+from . import functional
+from . import initializer

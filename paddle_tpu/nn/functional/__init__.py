@@ -1,0 +1,32 @@
+"""paddle_tpu.nn.functional — mirrors python/paddle/nn/functional/."""
+from .activation import *  # noqa: F401,F403
+from .common import (  # noqa: F401
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
+    label_smooth, interpolate, upsample, unfold, fold, pixel_shuffle,
+    pixel_unshuffle, cosine_similarity, normalize, bilinear, pad,
+)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+)
+from .norm import (  # noqa: F401
+    batch_norm, layer_norm, group_norm, instance_norm, local_response_norm,
+    rms_norm,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, mse_loss, l1_loss,
+    smooth_l1_loss, huber_loss, nll_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
+    hinge_embedding_loss, cosine_embedding_loss, triplet_margin_loss,
+    soft_margin_loss, poisson_nll_loss, multi_label_soft_margin_loss,
+    square_error_cost, log_loss, ctc_loss,
+)
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
+    sparse_attention,
+)

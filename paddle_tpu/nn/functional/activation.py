@@ -1,0 +1,207 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py;
+kernels phi/kernels/activation_kernel). All fuse into adjacent matmuls on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import apply, wrap, unary_op
+
+relu, relu_ = unary_op("relu", jax.nn.relu)
+relu6, _ = unary_op("relu6", jax.nn.relu6)
+sigmoid, sigmoid_ = unary_op("sigmoid", jax.nn.sigmoid)
+tanh, tanh_ = unary_op("tanh", jnp.tanh)
+silu, _ = unary_op("silu", jax.nn.silu)
+swish, _ = unary_op("swish", jax.nn.silu)
+mish, _ = unary_op("mish", jax.nn.mish)
+softsign, _ = unary_op("softsign", jax.nn.soft_sign)
+tanhshrink, _ = unary_op("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid, _ = unary_op("log_sigmoid", jax.nn.log_sigmoid)
+hardswish, _ = unary_op("hardswish", jax.nn.hard_swish)
+hardsigmoid, _ = unary_op("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+
+
+def _gelu_impl(x, *, approximate):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", _gelu_impl, (wrap(x),), {"approximate": bool(approximate)})
+
+
+def _leaky_relu_impl(x, *, negative_slope):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", _leaky_relu_impl, (wrap(x),),
+                 {"negative_slope": float(negative_slope)})
+
+
+def _elu_impl(x, *, alpha):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", _elu_impl, (wrap(x),), {"alpha": float(alpha)})
+
+
+def _celu_impl(x, *, alpha):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", _celu_impl, (wrap(x),), {"alpha": float(alpha)})
+
+
+def _selu_impl(x, *, scale, alpha):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", _selu_impl, (wrap(x),),
+                 {"scale": float(scale), "alpha": float(alpha)})
+
+
+def _prelu_impl(x, weight, *, data_format):
+    if weight.size == 1:
+        return jnp.where(x > 0, x, weight.reshape(()) * x)
+    # per-channel
+    if data_format == "NCHW":
+        shape = [1, -1] + [1] * (x.ndim - 2)
+    else:
+        shape = [1] * (x.ndim - 1) + [-1]
+    return jnp.where(x > 0, x, weight.reshape(shape) * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return apply("prelu", _prelu_impl, (wrap(x), wrap(weight)),
+                 {"data_format": data_format})
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2.0)
+    from ...ops import random as rnd
+    xx = wrap(x)
+    a = jax.random.uniform(rnd.next_key(), tuple(xx.shape), xx._value.dtype,
+                           minval=lower, maxval=upper)
+    return apply("rrelu_train", _rrelu_train_impl, (xx, wrap(a)))
+
+
+def _rrelu_train_impl(x, a):
+    return jnp.where(x >= 0, x, a * x)
+
+
+def _hardtanh_impl(x, *, min, max):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", _hardtanh_impl, (wrap(x),),
+                 {"min": float(min), "max": float(max)})
+
+
+def _hardshrink_impl(x, *, threshold):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", _hardshrink_impl, (wrap(x),),
+                 {"threshold": float(threshold)})
+
+
+def _softshrink_impl(x, *, threshold):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink", _softshrink_impl, (wrap(x),),
+                 {"threshold": float(threshold)})
+
+
+def _softplus_impl(x, *, beta, threshold):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus", _softplus_impl, (wrap(x),),
+                 {"beta": float(beta), "threshold": float(threshold)})
+
+
+def _thresholded_relu_impl(x, *, threshold, value):
+    return jnp.where(x > threshold, x, value)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu", _thresholded_relu_impl, (wrap(x),),
+                 {"threshold": float(threshold), "value": float(value)})
+
+
+def _softmax_impl(x, *, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    xx = wrap(x)
+    if dtype is not None:
+        from ...ops.creation import cast
+        xx = cast(xx, dtype)
+    return apply("softmax", _softmax_impl, (xx,), {"axis": int(axis)})
+
+
+softmax_ = softmax
+
+
+def _log_softmax_impl(x, *, axis):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    xx = wrap(x)
+    if dtype is not None:
+        from ...ops.creation import cast
+        xx = cast(xx, dtype)
+    return apply("log_softmax", _log_softmax_impl, (xx,), {"axis": int(axis)})
+
+
+def _gumbel_softmax_impl(x, g, *, temperature, hard, axis):
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+        y = onehot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops import random as rnd
+    xx = wrap(x)
+    g = jax.random.gumbel(rnd.next_key(), tuple(xx.shape), xx._value.dtype)
+    return apply("gumbel_softmax", _gumbel_softmax_impl, (xx, wrap(g)),
+                 {"temperature": float(temperature), "hard": bool(hard),
+                  "axis": int(axis)})
+
+
+def _maxout_impl(x, *, groups, axis):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply("maxout", _maxout_impl, (wrap(x),),
+                 {"groups": int(groups), "axis": int(axis)})
+
+
+def _glu_impl(x, *, axis):
+    return jax.nn.glu(x, axis=axis)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", _glu_impl, (wrap(x),), {"axis": int(axis)})

@@ -1,0 +1,111 @@
+"""Attention functionals (reference: python/paddle/nn/functional/
+flash_attention.py:146, scaled_dot_product_attention; CUDA kernels
+phi/kernels/fusion/gpu/flash_attn_kernel.cu).
+
+TPU-native: the default path is jax.nn.dot_product_attention (XLA fuses it
+well); the Pallas flash-attention kernel in paddle_tpu.ops.pallas is used on
+TPU for long sequences. Ring attention for context parallelism lives in
+paddle_tpu.distributed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import apply, wrap, Tensor
+
+
+def _sdpa_impl(q, k, v, *, causal, scale, has_mask):
+    # inputs [B, S, H, D] (reference flash_attention layout)
+    return jax.nn.dot_product_attention(
+        q, k, v, is_causal=causal, scale=scale)
+
+
+def _sdpa_mask_impl(q, k, v, mask, *, causal, scale):
+    return jax.nn.dot_product_attention(
+        q, k, v, bias=mask, is_causal=causal, scale=scale)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Layout [batch, seq, num_heads, head_dim], matching the reference
+    (nn/functional/flash_attention.py scaled_dot_product_attention)."""
+    q, k, v = wrap(query), wrap(key), wrap(value)
+    if dropout_p > 0.0 and training:
+        # dropout inside attention probs — rarely used for inference/bench;
+        # fall back to composed implementation
+        return _sdpa_dropout(q, k, v, attn_mask, dropout_p, is_causal)
+    if attn_mask is not None:
+        return apply("sdpa_mask", _sdpa_mask_impl, (q, k, v, wrap(attn_mask)),
+                     {"causal": bool(is_causal), "scale": None})
+    return apply("sdpa", _sdpa_impl, (q, k, v),
+                 {"causal": bool(is_causal), "scale": None, "has_mask": False})
+
+
+def _sdpa_dropout(q, k, v, attn_mask, dropout_p, is_causal):
+    from .common import dropout as _dropout
+    from ...ops.linalg import matmul
+    from .activation import softmax
+    d = q.shape[-1]
+    qt = q.transpose([0, 2, 1, 3])
+    kt = k.transpose([0, 2, 1, 3])
+    vt = v.transpose([0, 2, 1, 3])
+    scores = matmul(qt, kt, transpose_y=True) * (1.0 / (d ** 0.5))
+    if is_causal:
+        s = scores.shape[-1]
+        mask = Tensor(jnp.tril(jnp.ones((s, s), bool)))
+        scores = scores + Tensor(jnp.where(jnp.asarray(mask._value), 0.0, -1e30))
+    if attn_mask is not None:
+        scores = scores + wrap(attn_mask)
+    probs = softmax(scores, axis=-1)
+    probs = _dropout(probs, dropout_p, training=True)
+    out = matmul(probs, vt)
+    return out.transpose([0, 2, 1, 3])
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """Reference: F.flash_attention (flash_attention.py:146) — returns
+    (out, softmax_lse-like placeholder). On TPU lowers to the Pallas flash
+    kernel when available, else fused XLA attention."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen flash-attention parity (flash_attention.py:302): ragged batches
+    are expressed with cumulative seqlens; on TPU we segment-mask instead."""
+    q, k, v = wrap(query), wrap(key), wrap(value)
+    cu_q = wrap(cu_seqlens_q)
+    # build segment ids from cu_seqlens: tokens of sequence i in [cu[i], cu[i+1])
+    return apply("flash_attn_unpadded", _varlen_attn_impl,
+                 (q, k, v, cu_q, wrap(cu_seqlens_k)),
+                 {"scale": float(scale), "causal": bool(causal)}), None
+
+
+def _varlen_attn_impl(q, k, v, cu_q, cu_k, *, scale, causal):
+    # q: [total_q, H, D]; segment mask via searchsorted on cu_seqlens
+    tq = q.shape[0]
+    tk = k.shape[0]
+    seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right")
+    seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right")
+    mask = seg_q[:, None] == seg_k[None, :]
+    scores = jnp.einsum("qhd,khd->hqk", q, v * 0 + k) * scale
+    if causal:
+        pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q - 1)
+        pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k - 1)
+        mask = mask & (pos_q[:, None] >= pos_k[None, :])
+    scores = jnp.where(mask[None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", probs, v)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    raise NotImplementedError(
+        "sparse_attention: use paddle_tpu.ops.pallas block-sparse attention")

@@ -1,0 +1,304 @@
+"""Common nn functionals: linear, dropout, embedding, one_hot, interpolate,
+unfold, pixel_shuffle (reference: python/paddle/nn/functional/common.py,
+input.py, vision.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import apply, wrap, Tensor
+
+
+def _linear_impl(x, w, b):
+    y = jnp.matmul(x, w)
+    return y + b
+
+
+def _linear_nobias_impl(x, w):
+    return jnp.matmul(x, w)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Weight layout [in, out] matches the reference
+    (paddle.nn.Linear stores [in_features, out_features])."""
+    if bias is None:
+        return apply("linear", _linear_nobias_impl, (wrap(x), wrap(weight)))
+    return apply("linear", _linear_impl, (wrap(x), wrap(weight), wrap(bias)))
+
+
+def _dropout_impl(x, mask, *, scale):
+    return x * mask * scale
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Reference: nn.functional.dropout (common.py). RNG from the global
+    generator; under TP the caller should be inside the rng_tracker scope."""
+    xx = wrap(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops.math import scale as scale_op
+            return scale_op(xx, 1.0 - p)
+        return xx
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+        return zeros_like(xx)
+    from ...ops import random as rnd
+    shape = list(xx.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, tuple(shape))
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+    return apply("dropout", _dropout_impl,
+                 (xx, Tensor(keep.astype(xx._value.dtype))), {"scale": scale})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return wrap(x)
+    from ...ops import random as rnd
+    xx = wrap(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, tuple(xx.shape))
+    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * alpha_p * p
+    return apply("alpha_dropout", _alpha_dropout_impl,
+                 (xx, Tensor(keep)), {"alpha_p": alpha_p, "a": a, "b": b})
+
+
+def _alpha_dropout_impl(x, keep, *, alpha_p, a, b):
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+def _embedding_impl(w, ids, *, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None,
+              norm_type=2.0, scale_grad_by_freq=False, name=None):
+    """Reference: nn.functional.embedding (input.py). Gather on axis 0 — XLA
+    lowers to dynamic-gather, efficient on TPU."""
+    return apply("embedding", _embedding_impl, (wrap(weight), wrap(x)),
+                 {"padding_idx": None if padding_idx is None else int(padding_idx)})
+
+
+def _one_hot_impl(x, *, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot", _one_hot_impl, (wrap(x),),
+                 {"num_classes": int(num_classes)})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    ll = wrap(label)
+    if prior_dist is not None:
+        return apply("label_smooth_prior", _label_smooth_prior_impl,
+                     (ll, wrap(prior_dist)), {"epsilon": float(epsilon)})
+    return apply("label_smooth", _label_smooth_impl, (ll,),
+                 {"epsilon": float(epsilon)})
+
+
+def _label_smooth_impl(x, *, epsilon):
+    k = x.shape[-1]
+    return (1.0 - epsilon) * x + epsilon / k
+
+
+def _label_smooth_prior_impl(x, prior, *, epsilon):
+    return (1.0 - epsilon) * x + epsilon * prior
+
+
+def _interpolate_impl(x, *, size, mode, align_corners, data_format):
+    cl = data_format.endswith("C")
+    if not cl:
+        # to channels-last for jax.image
+        perm = [0] + list(range(2, x.ndim)) + [1]
+        x = jnp.transpose(x, perm)
+    spatial = x.shape[1:-1]
+    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+              "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    new_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    out = jax.image.resize(x, new_shape, method=method)
+    if not cl:
+        inv = [0, x.ndim - 1] + list(range(1, x.ndim - 1))
+        out = jnp.transpose(out, inv)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    xx = wrap(x)
+    n_spatial = xx.ndim - 2
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n_spatial
+        cur = xx.shape[2:] if not data_format.endswith("C") else xx.shape[1:-1]
+        size = [int(c * s) for c, s in zip(cur, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        size = [int(s.item() if isinstance(s, Tensor) else s) for s in size]
+    return apply("interpolate", _interpolate_impl, (xx,),
+                 {"size": tuple(size), "mode": mode,
+                  "align_corners": bool(align_corners), "data_format": data_format})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def _unfold_impl(x, *, kernel_sizes, strides, paddings, dilations):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = paddings[0], paddings[1], paddings[2], paddings[3]
+    dh, dw = dilations
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    out_h = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID",
+        rhs_dilation=(dh, dw), dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, out_h * out_w)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v, n=2):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+    p = paddings
+    if isinstance(p, int):
+        p = [p, p, p, p]
+    elif len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    return apply("unfold", _unfold_impl, (wrap(x),),
+                 {"kernel_sizes": pair(kernel_sizes), "strides": pair(strides),
+                  "paddings": tuple(p), "dilations": pair(dilations)})
+
+
+def _fold_impl(x, *, output_sizes, kernel_sizes, strides, paddings, dilations):
+    n, ckk, l = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    sh, sw = strides
+    dh, dw = dilations
+    ph0, pw0, ph1, pw1 = paddings
+    full_h, full_w = oh + ph0 + ph1, ow + pw0 + pw1
+    out_h = (full_h - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (full_w - (dw * (kw - 1) + 1)) // sw + 1
+    x = x.reshape(n, c, kh, kw, out_h, out_w)
+    out = jnp.zeros((n, c, full_h, full_w), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + out_h * sh:sh, wj:wj + out_w * sw:sw].add(
+                x[:, :, i, j])
+    return out[:, :, ph0:full_h - ph1, pw0:full_w - pw1]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    p = paddings
+    if isinstance(p, int):
+        p = [p, p, p, p]
+    elif len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    return apply("fold", _fold_impl, (wrap(x),),
+                 {"output_sizes": pair(output_sizes), "kernel_sizes": pair(kernel_sizes),
+                  "strides": pair(strides), "paddings": tuple(p),
+                  "dilations": pair(dilations)})
+
+
+def _pixel_shuffle_impl(x, *, upscale_factor, data_format):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply("pixel_shuffle", _pixel_shuffle_impl, (wrap(x),),
+                 {"upscale_factor": int(upscale_factor), "data_format": data_format})
+
+
+def _pixel_unshuffle_impl(x, *, downscale_factor, data_format):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply("pixel_unshuffle", _pixel_unshuffle_impl, (wrap(x),),
+                 {"downscale_factor": int(downscale_factor), "data_format": data_format})
+
+
+def _cosine_similarity_impl(x1, x2, *, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return apply("cosine_similarity", _cosine_similarity_impl,
+                 (wrap(x1), wrap(x2)), {"axis": int(axis), "eps": float(eps)})
+
+
+def _normalize_impl(x, *, p, axis, epsilon):
+    n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply("normalize", _normalize_impl, (wrap(x),),
+                 {"p": float(p), "axis": int(axis), "epsilon": float(epsilon)})
+
+
+def _bilinear_fn(x1, x2, w, b=None):
+    from ...ops.linalg import bilinear as _b
+    return _b(x1, x2, w, b)
+
+
+bilinear = _bilinear_fn
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None,
+        pad_from_left_axis=True):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)

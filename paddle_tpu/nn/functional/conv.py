@@ -1,0 +1,172 @@
+"""Convolution functionals over jax.lax.conv_general_dilated (reference:
+python/paddle/nn/functional/conv.py; kernels phi/kernels/gpudnn/conv_*).
+
+TPU note: XLA maps convs onto the MXU directly; NCHW in/out layouts are kept
+for API parity and XLA's layout assignment re-tiles internally.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import apply, wrap
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n and all(isinstance(x, int) for x in p):
+            return [(x, x) for x in p]
+        if len(p) == 2 * n:
+            return [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+        if all(isinstance(x, (list, tuple)) for x in p):
+            # paddle 4-d form [[0,0],[0,0],[h0,h1],[w0,w1]]
+            return [tuple(x) for x in p[-n:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_impl(x, w, *, stride, padding, dilation, groups, n_spatial, channel_last):
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n_spatial:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n_spatial:]
+    rhs_spec = "OI" + "DHW"[3 - n_spatial:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+
+
+def _conv_bias_impl(x, w, b, *, stride, padding, dilation, groups, n_spatial, channel_last):
+    out = _conv_impl(x, w, stride=stride, padding=padding, dilation=dilation,
+                     groups=groups, n_spatial=n_spatial, channel_last=channel_last)
+    if channel_last:
+        return out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+    return out + b.reshape((1, -1) + (1,) * n_spatial)
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n_spatial):
+    channel_last = data_format.endswith("C")
+    statics = {
+        "stride": _norm_tuple(stride, n_spatial),
+        "padding": _norm_padding(padding, n_spatial) if not isinstance(padding, str) else padding.upper(),
+        "dilation": _norm_tuple(dilation, n_spatial),
+        "groups": int(groups),
+        "n_spatial": n_spatial,
+        "channel_last": channel_last,
+    }
+    if isinstance(statics["padding"], list):
+        statics["padding"] = tuple(tuple(p) for p in statics["padding"])
+    if bias is None:
+        return apply("conv", _conv_impl, (wrap(x), wrap(weight)), statics)
+    return apply("conv_bias", _conv_bias_impl, (wrap(x), wrap(weight), wrap(bias)), statics)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 "NCW" if data_format == "NCL" else "NWC", 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose_impl(x, w, *, stride, padding, output_padding, dilation,
+                         groups, n_spatial, channel_last):
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n_spatial:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n_spatial:]
+    # paddle transpose-conv weight layout: [in, out//groups, *k]
+    rhs_spec = "IO" + "DHW"[3 - n_spatial:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    if isinstance(padding, str):
+        pad_cfg = padding
+    else:
+        # convert forward-conv padding to transpose padding per spatial dim
+        pad_cfg = []
+        for i, (lo, hi) in enumerate(padding):
+            k = (w.shape[2 + i] - 1) * dilation[i] + 1
+            pad_cfg.append((k - 1 - lo, k - 1 - hi + output_padding[i]))
+    return jax.lax.conv_transpose(
+        x, w, strides=stride,
+        padding=pad_cfg,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        transpose_kernel=False,
+        feature_group_count=groups,
+    )
+
+
+def _conv_transpose_bias_impl(x, w, b, **kw):
+    out = _conv_transpose_impl(x, w, **kw)
+    if kw["channel_last"]:
+        return out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+    return out + b.reshape((1, -1) + (1,) * kw["n_spatial"])
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, n_spatial, output_size=None):
+    channel_last = data_format.endswith("C")
+    statics = {
+        "stride": _norm_tuple(stride, n_spatial),
+        "padding": padding.upper() if isinstance(padding, str) else tuple(
+            tuple(p) for p in _norm_padding(padding, n_spatial)),
+        "output_padding": _norm_tuple(output_padding, n_spatial),
+        "dilation": _norm_tuple(dilation, n_spatial),
+        "groups": int(groups),
+        "n_spatial": n_spatial,
+        "channel_last": channel_last,
+    }
+    if bias is None:
+        return apply("conv_transpose", _conv_transpose_impl,
+                     (wrap(x), wrap(weight)), statics)
+    return apply("conv_transpose_bias", _conv_transpose_bias_impl,
+                 (wrap(x), wrap(weight), wrap(bias)), statics)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups,
+                           "NCW" if data_format == "NCL" else "NWC", 1)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3)

@@ -1,0 +1,202 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+kernels phi/kernels batch_norm/layer_norm/group_norm + spmd rule
+infermeta/spmd_rules/layer_norm.cc). All are pure-jnp compositions that XLA
+fuses; under data parallelism BatchNorm stats stay per-shard (SyncBatchNorm
+uses psum via the distributed package)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops._helpers import apply, wrap, Tensor
+
+
+def _bn_infer_impl(x, mean, var, w, b, *, epsilon, channel_axis):
+    shape = [1] * x.ndim
+    shape[channel_axis] = -1
+    inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * (inv.reshape(shape))
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    return out
+
+
+def _bn_train_impl(x, w, b, *, epsilon, channel_axis):
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[channel_axis] = -1
+    out = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Reference: F.batch_norm. In training mode updates running stats
+    in-place on the passed tensors (matching reference semantics)."""
+    xx = wrap(x)
+    channel_axis = 1 if not data_format.endswith("C") or data_format in ("NCHW", "NCL", "NCDHW") else xx.ndim - 1
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        channel_axis = xx.ndim - 1
+    use_stats = use_global_stats if use_global_stats is not None else not training
+    if use_stats:
+        return apply("batch_norm_infer", _bn_infer_impl,
+                     (xx, wrap(running_mean), wrap(running_var),
+                      wrap(weight) if weight is not None else Tensor(jnp.ones(xx.shape[channel_axis], xx._value.dtype)),
+                      wrap(bias) if bias is not None else Tensor(jnp.zeros(xx.shape[channel_axis], xx._value.dtype))),
+                     {"epsilon": float(epsilon), "channel_axis": channel_axis})
+    w = wrap(weight) if weight is not None else Tensor(jnp.ones(xx.shape[channel_axis], xx._value.dtype))
+    b = wrap(bias) if bias is not None else Tensor(jnp.zeros(xx.shape[channel_axis], xx._value.dtype))
+    out, mean, var = apply("batch_norm_train", _bn_train_impl, (xx, w, b),
+                           {"epsilon": float(epsilon), "channel_axis": channel_axis})
+    if running_mean is not None:
+        rm = wrap(running_mean)
+        n = xx.size // xx.shape[channel_axis]
+        unbiased = var._value * (n / max(n - 1, 1))
+        rm._value = rm._value * momentum + mean._value * (1 - momentum)
+        rv = wrap(running_var)
+        rv._value = rv._value * momentum + unbiased * (1 - momentum)
+    return out
+
+
+def _layer_norm_impl(x, w, b, *, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
+def _layer_norm_nowb_impl(x, *, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + epsilon)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    xx = wrap(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin_axis = xx.ndim - len(normalized_shape)
+    if weight is None and bias is None:
+        return apply("layer_norm_nowb", _layer_norm_nowb_impl, (xx,),
+                     {"epsilon": float(epsilon), "begin_axis": begin_axis})
+    w = wrap(weight) if weight is not None else Tensor(jnp.ones(tuple(normalized_shape), xx._value.dtype))
+    b = wrap(bias) if bias is not None else Tensor(jnp.zeros(tuple(normalized_shape), xx._value.dtype))
+    return apply("layer_norm", _layer_norm_impl, (xx, w, b),
+                 {"epsilon": float(epsilon), "begin_axis": begin_axis})
+
+
+def _rms_norm_impl(x, w, *, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes, keepdims=True)
+    out = (x.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(x.dtype)
+    return out * w
+
+
+def rms_norm(x, weight, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    """RMSNorm (LLaMA-family). Reference: fused_rms_norm in
+    phi/kernels/fusion; here a fused-by-XLA composition with fp32 accum."""
+    xx = wrap(x)
+    ba = begin_norm_axis % xx.ndim
+    return apply("rms_norm", _rms_norm_impl, (xx, wrap(weight)),
+                 {"epsilon": float(epsilon), "begin_axis": ba})
+
+
+def _group_norm_impl(x, w, b, *, num_groups, epsilon, channel_axis):
+    # reshape channel dim into (groups, C//groups), normalize per group
+    if channel_axis != 1:
+        x_m = jnp.moveaxis(x, channel_axis, 1)
+    else:
+        x_m = x
+    n, c = x_m.shape[0], x_m.shape[1]
+    rest = x_m.shape[2:]
+    g = num_groups
+    xg = x_m.reshape(n, g, c // g, *rest)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) / jnp.sqrt(var + epsilon)).reshape(x_m.shape)
+    shape = [1, -1] + [1] * (x_m.ndim - 2)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    if channel_axis != 1:
+        out = jnp.moveaxis(out, 1, channel_axis)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    xx = wrap(x)
+    channel_axis = 1 if not data_format.endswith("C") else xx.ndim - 1
+    c = xx.shape[channel_axis]
+    w = wrap(weight) if weight is not None else Tensor(jnp.ones(c, xx._value.dtype))
+    b = wrap(bias) if bias is not None else Tensor(jnp.zeros(c, xx._value.dtype))
+    return apply("group_norm", _group_norm_impl, (xx, w, b),
+                 {"num_groups": int(num_groups), "epsilon": float(epsilon),
+                  "channel_axis": channel_axis})
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    xx = wrap(x)
+    channel_axis = 1 if not data_format.endswith("C") else xx.ndim - 1
+    c = xx.shape[channel_axis]
+    w = wrap(weight) if weight is not None else Tensor(jnp.ones(c, xx._value.dtype))
+    b = wrap(bias) if bias is not None else Tensor(jnp.zeros(c, xx._value.dtype))
+    return apply("instance_norm", _instance_norm_impl, (xx, w, b),
+                 {"epsilon": float(eps), "channel_axis": channel_axis})
+
+
+def _instance_norm_impl(x, w, b, *, epsilon, channel_axis):
+    if channel_axis != 1:
+        x = jnp.moveaxis(x, channel_axis, 1)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    shape = [1, -1] + [1] * (x.ndim - 2)
+    out = out * w.reshape(shape) + b.reshape(shape)
+    if channel_axis != 1:
+        out = jnp.moveaxis(out, 1, channel_axis)
+    return out
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    xx = wrap(x)
+    return apply("lrn", _lrn_impl, (xx,),
+                 {"size": int(size), "alpha": float(alpha), "beta": float(beta),
+                  "k": float(k), "channel_last": data_format.endswith("C")})
+
+
+def _lrn_impl(x, *, size, alpha, beta, k, channel_last):
+    ca = x.ndim - 1 if channel_last else 1
+    sq = jnp.square(x)
+    c = x.shape[ca]
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[ca] = (half, size - half - 1)
+    sq_p = jnp.pad(sq, pads)
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        sl = [slice(None)] * x.ndim
+        sl[ca] = slice(i, i + c)
+        acc = acc + sq_p[tuple(sl)]
+    return x / jnp.power(k + alpha * acc, beta)

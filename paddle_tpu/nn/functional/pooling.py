@@ -1,0 +1,213 @@
+"""Pooling functionals via lax.reduce_window (reference:
+python/paddle/nn/functional/pooling.py; kernels phi/kernels/pool_kernel)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._helpers import apply, wrap
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else list(v) * n))[:n]
+    return (int(v),) * n
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return tuple((padding, padding) for _ in range(n))
+    p = list(padding)
+    if len(p) == n and all(isinstance(x, int) for x in p):
+        return tuple((x, x) for x in p)
+    if len(p) == 2 * n:
+        return tuple((p[2 * i], p[2 * i + 1]) for i in range(n))
+    return tuple(tuple(x) for x in p[-n:])
+
+
+def _window_dims(ks, n, channel_last):
+    if channel_last:
+        return (1,) + ks + (1,)
+    return (1, 1) + ks
+
+
+def _pool_impl(x, *, kind, kernel_size, stride, padding, n_spatial,
+               channel_last, ceil_mode, exclusive, count_include_pad):
+    wd = _window_dims(kernel_size, n_spatial, channel_last)
+    ws = _window_dims(stride, n_spatial, channel_last)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        full = ((0, 0), (0, 0)) + padding if not channel_last else ((0, 0),) + padding + ((0, 0),)
+        pad = full
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, wd, ws, pad)
+    # avg
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, wd, ws, pad)
+    if (exclusive or not count_include_pad) and not isinstance(pad, str):
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, wd, ws, pad)
+        return s / cnt
+    denom = np.prod(kernel_size)
+    return s / denom
+
+
+def _pool(kind, x, kernel_size, stride, padding, n_spatial, data_format,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    channel_last = data_format.endswith("C")
+    ks = _norm_tuple(kernel_size, n_spatial)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n_spatial)
+    return apply(f"{kind}_pool", _pool_impl, (wrap(x),), {
+        "kind": kind, "kernel_size": ks, "stride": st,
+        "padding": _pad_cfg(padding, n_spatial), "n_spatial": n_spatial,
+        "channel_last": channel_last, "ceil_mode": bool(ceil_mode),
+        "exclusive": bool(exclusive), "count_include_pad": bool(count_include_pad),
+    })
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool("max", x, kernel_size, stride, padding, 1,
+                "NCW" if data_format == "NCL" else "NWC", ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool("max", x, kernel_size, stride, padding, 2, data_format, ceil_mode)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool("max", x, kernel_size, stride, padding, 3, data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 1,
+                 "NCW" if data_format == "NCL" else "NWC", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 2, data_format,
+                 ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 3, data_format,
+                 ceil_mode, exclusive)
+
+
+def _max_pool_indices(x, kernel_size, stride, padding, data_format):
+    # indices for return_mask parity (flattened within each spatial map)
+    from ...ops._helpers import Tensor
+    xx = wrap(x)
+    n_spatial = xx.ndim - 2
+    ks = _norm_tuple(kernel_size, n_spatial)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n_spatial)
+    return apply("max_pool_idx", _max_pool_idx_impl, (xx,), {
+        "kernel_size": ks, "stride": st, "padding": _pad_cfg(padding, n_spatial),
+        "channel_last": data_format.endswith("C"), "n_spatial": n_spatial})
+
+
+def _max_pool_idx_impl(x, *, kernel_size, stride, padding, channel_last, n_spatial):
+    # encode flat index via reduce_window over (value, idx) pairs — use
+    # argmax trick: scale values and add fractional index (approximate parity)
+    spatial = x.shape[2:] if not channel_last else x.shape[1:-1]
+    flat = jnp.arange(np.prod(spatial)).reshape(spatial)
+    if channel_last:
+        flat = flat[None, ..., None]
+    else:
+        flat = flat[None, None]
+    flat = jnp.broadcast_to(flat, x.shape).astype(jnp.int64)
+    wd = _window_dims(kernel_size, n_spatial, channel_last)
+    ws = _window_dims(stride, n_spatial, channel_last)
+    pad = padding
+    if not isinstance(pad, str):
+        pad = ((0, 0), (0, 0)) + pad if not channel_last else ((0, 0),) + pad + ((0, 0),)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    init_v = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    _, idx = jax.lax.reduce_window((x, flat), (jnp.asarray(init_v, x.dtype), jnp.asarray(-1, jnp.int64)),
+                                   reducer, wd, ws, pad)
+    return idx
+
+
+def _adaptive_pool_impl(x, *, kind, output_size, channel_last, n_spatial):
+    spatial_axes = list(range(2, 2 + n_spatial)) if not channel_last else list(range(1, 1 + n_spatial))
+    out = x
+    for ax, osz in zip(spatial_axes, output_size):
+        isz = out.shape[ax]
+        if osz == 1:
+            out = (jnp.max if kind == "max" else jnp.mean)(out, axis=ax, keepdims=True)
+        elif isz % osz == 0:
+            k = isz // osz
+            new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+            out = out.reshape(new_shape)
+            out = (jnp.max if kind == "max" else jnp.mean)(out, axis=ax + 1)
+        else:
+            # general case: per-output-bin start/end windows
+            starts = [int(np.floor(i * isz / osz)) for i in range(osz)]
+            ends = [int(np.ceil((i + 1) * isz / osz)) for i in range(osz)]
+            slices = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(s, e)
+                red = (jnp.max if kind == "max" else jnp.mean)(out[tuple(sl)], axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+    return out
+
+
+def _adaptive(kind, x, output_size, data_format, n_spatial):
+    xx = wrap(x)
+    channel_last = data_format.endswith("C")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * n_spatial
+    output_size = tuple(
+        xx.shape[(2 + i) if not channel_last else (1 + i)] if o is None else int(o)
+        for i, o in enumerate(output_size))
+    return apply(f"adaptive_{kind}_pool", _adaptive_pool_impl, (xx,), {
+        "kind": kind, "output_size": output_size, "channel_last": channel_last,
+        "n_spatial": n_spatial})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive("avg", x, output_size, "NCW", 1)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive("avg", x, output_size, data_format, 2)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive("avg", x, output_size, data_format, 3)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive("max", x, output_size, "NCW", 1)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive("max", x, output_size, "NCHW", 2)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive("max", x, output_size, "NCDHW", 3)

@@ -1,0 +1,225 @@
+"""Norm layers (reference: python/paddle/nn/layer/norm.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .layers import Layer
+from .. import functional as F
+from ..initializer import Constant
+from ...core.tensor import Tensor
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = (self.create_parameter([num_features], attr=weight_attr,
+                                             default_initializer=Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter([num_features], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+        self._mean = self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self._variance = self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Under pjit/shard_map, batch stats are computed with a psum over the
+    data axis (reference: nn.SyncBatchNorm over NCCL allreduce). In eager
+    single-process mode it degrades to BatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            nb = SyncBatchNorm(layer._num_features, layer._momentum,
+                               layer._epsilon, data_format=layer._data_format)
+            nb.weight = layer.weight
+            nb.bias = layer.bias
+            nb._buffers = layer._buffers
+            return nb
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = (self.create_parameter(self._normalized_shape,
+                                             attr=weight_attr,
+                                             default_initializer=Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter(self._normalized_shape,
+                                           attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """LLaMA-style RMSNorm — the reference exposes this via incubate fused
+    ops (fused_rms_norm); first-class here."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(self._normalized_shape,
+                                            attr=weight_attr,
+                                            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (self.create_parameter([num_channels], attr=weight_attr,
+                                             default_initializer=Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter([num_channels], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (self.create_parameter([num_features], attr=weight_attr,
+                                             default_initializer=Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter([num_features], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon, data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._axis = axis
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[axis]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != axis:
+                w *= s
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter([h], default_initializer=Normal(0, 1))
+        self.weight_v = self.create_parameter([w], default_initializer=Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...ops.manipulation import reshape, moveaxis
+        from ...ops.linalg import matmul
+        import jax
+
+        w = weight
+        if self._axis != 0:
+            w = moveaxis(w, self._axis, 0)
+        h = w.shape[0]
+        mat = reshape(w, [h, -1])
+        u = self.weight_u._value
+        v = self.weight_v._value
+        m = mat._value
+        for _ in range(self._power_iters):
+            v = m.T @ u
+            v = v / (jnp.linalg.norm(v) + self._epsilon)
+            u = m @ v
+            u = u / (jnp.linalg.norm(u) + self._epsilon)
+        self.weight_u._value = u
+        self.weight_v._value = v
+        sigma = (u @ m @ v)
+        out = mat / Tensor(sigma)
+        out = reshape(out, list(w.shape))
+        if self._axis != 0:
+            out = moveaxis(out, 0, self._axis)
+        return out

@@ -1,0 +1,56 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+
+
+def _pool_layer(name, fn, n, extra_defaults=None):
+    class _Pool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+            super().__init__()
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.kwargs = kwargs
+
+        def forward(self, x):
+            return fn(x, self.kernel_size, self.stride, self.padding, **self.kwargs)
+
+        def extra_repr(self):
+            return f"kernel_size={self.kernel_size}, stride={self.stride}"
+
+    _Pool.__name__ = name
+    _Pool.__qualname__ = name
+    return _Pool
+
+
+MaxPool1D = _pool_layer("MaxPool1D", F.max_pool1d, 1)
+MaxPool2D = _pool_layer("MaxPool2D", F.max_pool2d, 2)
+MaxPool3D = _pool_layer("MaxPool3D", F.max_pool3d, 3)
+AvgPool1D = _pool_layer("AvgPool1D", F.avg_pool1d, 1)
+AvgPool2D = _pool_layer("AvgPool2D", F.avg_pool2d, 2)
+AvgPool3D = _pool_layer("AvgPool3D", F.avg_pool3d, 3)
+
+
+def _adaptive_layer(name, fn):
+    class _Pool(Layer):
+        def __init__(self, output_size, **kwargs):
+            super().__init__()
+            self.output_size = output_size
+            self.kwargs = {k: v for k, v in kwargs.items() if k not in ("return_mask", "name")}
+
+        def forward(self, x):
+            return fn(x, self.output_size, **self.kwargs)
+
+    _Pool.__name__ = name
+    _Pool.__qualname__ = name
+    return _Pool
+
+
+AdaptiveAvgPool1D = _adaptive_layer("AdaptiveAvgPool1D", F.adaptive_avg_pool1d)
+AdaptiveAvgPool2D = _adaptive_layer("AdaptiveAvgPool2D", F.adaptive_avg_pool2d)
+AdaptiveAvgPool3D = _adaptive_layer("AdaptiveAvgPool3D", F.adaptive_avg_pool3d)
+AdaptiveMaxPool1D = _adaptive_layer("AdaptiveMaxPool1D", F.adaptive_max_pool1d)
+AdaptiveMaxPool2D = _adaptive_layer("AdaptiveMaxPool2D", F.adaptive_max_pool2d)
+AdaptiveMaxPool3D = _adaptive_layer("AdaptiveMaxPool3D", F.adaptive_max_pool3d)

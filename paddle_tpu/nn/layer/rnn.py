@@ -1,0 +1,348 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py — SimpleRNN/LSTM/GRU).
+
+TPU-native: recurrence expressed as lax.scan inside a single jitted op, so XLA
+compiles one fused loop instead of per-step dispatch (the reference uses
+cuDNN RNN kernels; scan-over-matmul is the TPU idiom)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from ..initializer import Uniform
+from ...ops._helpers import apply, wrap, Tensor
+
+
+def _lstm_cell(carry, xw, wh, bh):
+    h, c = carry
+    gates = xw + h @ wh + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_cell(carry, xw, wh, bh):
+    h = carry
+    # paddle gate layout: r, z, c(candidate)
+    d = wh.shape[0]
+    xr, xz, xc = jnp.split(xw, 3, axis=-1)
+    hr, hz, hc = jnp.split(h @ wh + bh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    h = (1.0 - z) * c + z * h
+    return h, h
+
+
+def _simple_cell(carry, xw, wh, bh, activation):
+    h = carry
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h = act(xw + h @ wh + bh)
+    return h, h
+
+
+def _rnn_scan_impl(x, h0, c0, wi, wh, bi, bh, *, mode, reverse, activation):
+    # x: [B, T, I] (batch_first); weights: wi [I, G*H], wh [H, G*H]
+    xw = jnp.einsum("bti,ig->btg", x, wi) + bi
+    xw_t = jnp.swapaxes(xw, 0, 1)  # [T, B, G*H]
+    if reverse:
+        xw_t = jnp.flip(xw_t, 0)
+
+    if mode == "LSTM":
+        def step(carry, xwt):
+            return _lstm_cell(carry, xwt, wh, bh)
+        carry = (h0, c0)
+    elif mode == "GRU":
+        def step(carry, xwt):
+            return _gru_cell(carry, xwt, wh, bh)
+        carry = h0
+    else:
+        def step(carry, xwt):
+            return _simple_cell(carry, xwt, wh, bh, activation)
+        carry = h0
+
+    carry, ys = jax.lax.scan(step, carry, xw_t)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    out = jnp.swapaxes(ys, 0, 1)  # [B, T, H]
+    if mode == "LSTM":
+        return out, carry[0], carry[1]
+    return out, carry, carry
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        gate = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                sfx = f"_reverse" if d == 1 else ""
+                wi = self.create_parameter([in_sz, gate * hidden_size],
+                                           attr=weight_ih_attr,
+                                           default_initializer=Uniform(-std, std))
+                wh = self.create_parameter([hidden_size, gate * hidden_size],
+                                           attr=weight_hh_attr,
+                                           default_initializer=Uniform(-std, std))
+                bi = self.create_parameter([gate * hidden_size], attr=bias_ih_attr,
+                                           is_bias=True,
+                                           default_initializer=Uniform(-std, std))
+                bh = self.create_parameter([gate * hidden_size], attr=bias_hh_attr,
+                                           is_bias=True,
+                                           default_initializer=Uniform(-std, std))
+                self.add_parameter(f"weight_ih_l{layer}{sfx}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{sfx}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{sfx}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{sfx}", bh)
+
+    def _get(self, layer, d, kind):
+        sfx = "_reverse" if d == 1 else ""
+        return self._parameters[f"{kind}_l{layer}{sfx}"]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import transpose as _tr, concat, stack
+        from ...ops.creation import zeros
+        x = wrap(inputs)
+        if self.time_major:
+            x = _tr(x, [1, 0, 2])
+        b = x.shape[0]
+        num_dir = 2 if self.bidirectional else 1
+
+        if initial_states is None:
+            shape = [self.num_layers * num_dir, b, self.hidden_size]
+            h0 = zeros(shape, dtype=str(x.dtype))
+            c0 = zeros(shape, dtype=str(x.dtype))
+            if self.mode == "LSTM":
+                initial_states = (h0, c0)
+            else:
+                initial_states = h0
+        if self.mode == "LSTM":
+            h0_all, c0_all = initial_states
+        else:
+            h0_all, c0_all = initial_states, initial_states
+
+        out = x
+        last_h, last_c = [], []
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(num_dir):
+                idx = layer * num_dir + d
+                h0 = h0_all[idx]
+                c0 = c0_all[idx]
+                y, hT, cT = apply(
+                    f"rnn_{self.mode}", _rnn_scan_impl,
+                    (out, h0, c0,
+                     self._get(layer, d, "weight_ih"),
+                     self._get(layer, d, "weight_hh"),
+                     self._get(layer, d, "bias_ih"),
+                     self._get(layer, d, "bias_hh")),
+                    {"mode": self.mode, "reverse": d == 1,
+                     "activation": self.activation})
+                dir_outs.append(y)
+                last_h.append(hT)
+                last_c.append(cT)
+            out = dir_outs[0] if num_dir == 1 else concat(dir_outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                from .. import functional as Fn
+                out = Fn.dropout(out, self.dropout, training=self.training)
+        h_stack = stack(last_h, axis=0)
+        if self.time_major:
+            out = _tr(out, [1, 0, 2])
+        if self.mode == "LSTM":
+            return out, (h_stack, stack(last_c, axis=0))
+        return out, h_stack
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kwargs)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([input_size, 4 * hidden_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([hidden_size, 4 * hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops.creation import zeros
+        x = wrap(inputs)
+        if states is None:
+            h = zeros([x.shape[0], self.hidden_size], dtype=str(x.dtype))
+            c = zeros([x.shape[0], self.hidden_size], dtype=str(x.dtype))
+        else:
+            h, c = states
+        out = apply("lstm_cell", _lstm_cell_impl,
+                    (x, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+                     self.bias_hh))
+        h2, c2 = out
+        return h2, (h2, c2)
+
+
+def _lstm_cell_impl(x, h, c, wi, wh, bi, bh):
+    (h2, c2), _ = _lstm_cell((h, c), x @ wi + bi, wh, bh)
+    return h2, c2
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([input_size, 3 * hidden_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([hidden_size, 3 * hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops.creation import zeros
+        x = wrap(inputs)
+        if states is None:
+            states = zeros([x.shape[0], self.hidden_size], dtype=str(x.dtype))
+        h = states
+        out = apply("gru_cell", _gru_cell_impl,
+                    (x, h, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh))
+        return out, out
+
+
+def _gru_cell_impl(x, h, wi, wh, bi, bh):
+    h2, _ = _gru_cell(h, x @ wi + bi, wh, bh)
+    return h2
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([input_size, hidden_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops.creation import zeros
+        x = wrap(inputs)
+        if states is None:
+            states = zeros([x.shape[0], self.hidden_size], dtype=str(x.dtype))
+        out = apply("simple_rnn_cell", _simple_rnn_cell_impl,
+                    (x, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                     self.bias_hh), {"activation": self.activation})
+        return out, out
+
+
+def _simple_rnn_cell_impl(x, h, wi, wh, bi, bh, *, activation):
+    h2, _ = _simple_cell(h, x @ wi + bi, wh, bh, activation)
+    return h2
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over time (reference: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack
+        x = wrap(inputs)
+        axis = 0 if self.time_major else 1
+        T = x.shape[axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            xt = x[t] if self.time_major else x[:, t]
+            y, states = self.cell(xt, states)
+            outs[t] = y
+        out = stack(outs, axis=axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        o1, st1 = self.rnn_fw(inputs, s_fw)
+        o2, st2 = self.rnn_bw(inputs, s_bw)
+        return concat([o1, o2], axis=-1), (st1, st2)

@@ -1,0 +1,117 @@
+"""paddle_tpu.ops — aggregated functional op surface.
+
+Reference analog: the generated `paddle.*` tensor-op namespace driven by
+paddle/phi/api/yaml/ops.yaml. Importing this module also binds ops as Tensor
+methods and installs operator dunders (reference:
+python/paddle/base/dygraph/math_op_patch.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from . import random  # noqa: F401
+from .random import (  # noqa: F401
+    rand, randn, randint, randint_like, randperm, uniform, uniform_, normal,
+    normal_, gaussian, standard_normal, multinomial, bernoulli, bernoulli_,
+    poisson, binomial, seed, exponential_, rand_like, randn_like,
+)
+from .indexing import _getitem, _setitem_inplace  # noqa: F401
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import reduction as _reduction
+from . import linalg as _linalg
+from . import logic as _logic
+
+from ..core.tensor import Tensor
+from .math import pow as pow  # noqa
+from .math import abs as abs  # noqa
+from .math import round as round  # noqa
+from .reduction import sum as sum, max as max, min as min, all as all, any as any  # noqa
+
+
+# ---------------------------------------------------------------------------
+# Operator dunders (math_op_patch equivalent)
+# ---------------------------------------------------------------------------
+
+def _install_operators():
+    from .math import add, subtract, multiply, divide, floor_divide, mod, pow as _pow, neg
+    from .linalg import matmul
+    from .logic import (equal, not_equal, greater_than, greater_equal,
+                        less_than, less_equal, bitwise_and, bitwise_or,
+                        bitwise_xor, bitwise_not)
+
+    def swap(fn):
+        return lambda self, other: fn(Tensor(jnp.asarray(other)) if not isinstance(other, Tensor) else other, self)
+
+    Tensor.__add__ = lambda s, o: add(s, o)
+    Tensor.__radd__ = lambda s, o: add(s, o)
+    Tensor.__sub__ = lambda s, o: subtract(s, o)
+    Tensor.__rsub__ = swap(subtract)
+    Tensor.__mul__ = lambda s, o: multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: divide(s, o)
+    Tensor.__rtruediv__ = swap(divide)
+    Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
+    Tensor.__rfloordiv__ = swap(floor_divide)
+    Tensor.__mod__ = lambda s, o: mod(s, o)
+    Tensor.__rmod__ = swap(mod)
+    Tensor.__pow__ = lambda s, o: _pow(s, o)
+    Tensor.__rpow__ = swap(_pow)
+    Tensor.__matmul__ = lambda s, o: matmul(s, o)
+    Tensor.__rmatmul__ = swap(matmul)
+    Tensor.__neg__ = lambda s: neg(s)
+    Tensor.__abs__ = lambda s: _math.abs(s)
+    Tensor.__eq__ = lambda s, o: equal(s, o)
+    Tensor.__ne__ = lambda s, o: not_equal(s, o)
+    Tensor.__gt__ = lambda s, o: greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: greater_equal(s, o)
+    Tensor.__lt__ = lambda s, o: less_than(s, o)
+    Tensor.__le__ = lambda s, o: less_equal(s, o)
+    Tensor.__and__ = lambda s, o: bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: bitwise_xor(s, o)
+    Tensor.__invert__ = lambda s: bitwise_not(s)
+
+
+def _bind_tensor_methods():
+    """Attach ops as Tensor methods, mirroring the reference's monkey-patched
+    Tensor method surface."""
+    import types
+
+    skip = {"seed", "to_tensor", "is_tensor", "in_dynamic_mode"}
+    for mod in (_math, _creation, _manip, _reduction, _linalg, _logic):
+        for name in dir(mod):
+            if name.startswith("_") or name in skip:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(Tensor, name, None) is None:
+                setattr(Tensor, name, fn)
+    # random in-place / like methods
+    from . import random as _random
+    for name in ("uniform_", "normal_", "bernoulli_", "exponential_"):
+        setattr(Tensor, name, getattr(_random, name))
+    # aliases
+    Tensor.mm = _linalg.mm
+    Tensor.matmul = _linalg.matmul
+    Tensor.pow = _math.pow
+    Tensor.abs = _math.abs
+    Tensor.sum = _reduction.sum
+    Tensor.max = _reduction.max
+    Tensor.min = _reduction.min
+    Tensor.mean = _reduction.mean
+    Tensor.all = _reduction.all
+    Tensor.any = _reduction.any
+
+
+_install_operators()
+_bind_tensor_methods()

@@ -1,0 +1,86 @@
+"""Op-definition helpers: tiny codegen layer over core.dispatch.apply.
+
+Reference analog: the YAML op schema + generated API
+(paddle/phi/api/yaml/ops.yaml, generator/api_gen.py). Instead of YAML → C++,
+each op here is a stable top-level pure-JAX impl (so the per-op jit cache in
+core/dispatch.py keys on a fixed function object) plus a thin user-facing
+wrapper. Factories below stamp out the unary/binary long tail.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+__all__ = ["apply", "Tensor", "wrap", "unary_op", "binary_op", "norm_axis", "static_dtype"]
+
+
+def wrap(x):
+    """Coerce input to Tensor (scalars/ndarray/list accepted like the reference API)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x)
+
+
+def norm_axis(axis):
+    """Normalize axis arg to a hashable static."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy()
+    if isinstance(axis, np.ndarray):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def static_dtype(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return str(d) if d is not None else None
+
+
+def unary_op(name, jfn, doc=None):
+    """Factory for elementwise unary ops: returns (op, inplace_op)."""
+
+    def impl(x):
+        return jfn(x)
+
+    impl.__name__ = f"_{name}_impl"
+    impl.__qualname__ = impl.__name__
+
+    def op(x, name=None):
+        return apply(name or _n, impl, (wrap(x),))
+
+    _n = name
+    op.__name__ = name
+    op.__doc__ = doc or f"Elementwise {name} (XLA-fused)."
+
+    def op_(x, name=None):
+        out = op(x)
+        x._value = out._value
+        x._grad_node = out._grad_node
+        x._out_idx = out._out_idx
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    op_.__name__ = name + "_"
+    return op, op_
+
+
+def binary_op(name, jfn, doc=None):
+    def impl(x, y):
+        return jfn(x, y)
+
+    impl.__name__ = f"_{name}_impl"
+    impl.__qualname__ = impl.__name__
+
+    def op(x, y, name=None):
+        return apply(_n, impl, (wrap(x), y if not isinstance(y, (list, tuple)) else wrap(y)))
+
+    _n = name
+    op.__name__ = name
+    op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting (XLA-fused)."
+    return op

@@ -1,0 +1,227 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py; ops.yaml
+full/arange/eye/... kernels paddle/phi/kernels/cpu|gpu/full_kernel.cc etc.)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import apply, wrap, Tensor, static_dtype
+from ..core import dtype as dtypes
+from ..core.tensor import to_tensor  # re-export
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "meshgrid", "tril", "triu", "tril_indices",
+    "triu_indices", "assign", "clone", "complex", "polar", "cast",
+]
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, np.ndarray):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _resolve_dtype(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_tuple(shape), _resolve_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_tuple(shape), _resolve_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        # match reference: infer from python scalar type
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtypes.get_default_dtype()
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.full(_shape_tuple(shape), fill_value, _resolve_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def _zeros_like_impl(x, *, dtype):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply("zeros_like", _zeros_like_impl, (wrap(x),), {"dtype": static_dtype(dtype)})
+
+
+def _ones_like_impl(x, *, dtype):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply("ones_like", _ones_like_impl, (wrap(x),), {"dtype": static_dtype(dtype)})
+
+
+def _full_like_impl(x, *, fill_value, dtype):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return apply("full_like", _full_like_impl, (wrap(x),),
+                 {"fill_value": fill_value, "dtype": static_dtype(dtype)})
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (jnp.int64 if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+                 else dtypes.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               dtype=_resolve_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               base=_scalar(base), dtype=_resolve_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_resolve_dtype(dtype)))
+
+
+def _diag_impl(x, *, offset, padding_value):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply("diag", _diag_impl, (wrap(x),),
+                 {"offset": int(offset), "padding_value": padding_value})
+
+
+def _diagflat_impl(x, *, offset):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", _diagflat_impl, (wrap(x),), {"offset": int(offset)})
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = apply("meshgrid", _meshgrid_impl, tuple(wrap(a) for a in args))
+    return list(outs)
+
+
+def _meshgrid_impl(*xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def _tril_impl(x, *, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", _tril_impl, (wrap(x),), {"diagonal": int(diagonal)})
+
+
+def _triu_impl(x, *, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", _triu_impl, (wrap(x),), {"diagonal": int(diagonal)})
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(int(row), k=int(offset), m=int(col) if col else None)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(int(row), k=int(offset), m=int(col) if col else None)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def _assign_impl(x):
+    return x + 0 if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x)
+
+
+def assign(x, output=None):
+    out = apply("assign", _assign_impl, (wrap(x),))
+    if output is not None:
+        output._value = out._value
+        output._grad_node = out._grad_node
+        output._out_idx = out._out_idx
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+clone = assign
+
+
+def _complex_impl(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def complex(real, imag, name=None):
+    return apply("complex", _complex_impl, (wrap(real), wrap(imag)))
+
+
+def _polar_impl(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def polar(abs, angle, name=None):
+    return apply("polar", _polar_impl, (wrap(abs), wrap(angle)))
+
+
+def _cast_impl(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return apply("cast", _cast_impl, (wrap(x),), {"dtype": static_dtype(dtype)})

@@ -1,0 +1,109 @@
+"""Tensor __getitem__/__setitem__ with autograd.
+
+Reference analog: the getitem/setitem paths in
+paddle/fluid/pybind/eager_method.cc + set_value op. Index expressions are
+decomposed into a static template (slices/ints/None/Ellipsis — part of the jit
+cache key) plus dynamic tensor indices (traced args, so advanced indexing with
+changing index *values* does not recompile)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import apply, wrap, Tensor
+
+
+_TENSOR_SLOT = "__T__"
+
+
+def _canonicalize(idx):
+    """Split idx into (template, tensor_args). Template is hashable."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    template = []
+    tensors = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            tensors.append(it)
+            template.append(_TENSOR_SLOT)
+        elif isinstance(it, (np.ndarray, list)):
+            arr = np.asarray(it)
+            if arr.dtype == object:
+                raise TypeError("ragged index")
+            tensors.append(Tensor(jnp.asarray(arr)))
+            template.append(_TENSOR_SLOT)
+        elif isinstance(it, slice):
+            template.append(("slice",
+                             None if it.start is None else int(it.start),
+                             None if it.stop is None else int(it.stop),
+                             None if it.step is None else int(it.step)))
+        elif it is None:
+            template.append(("none",))
+        elif it is Ellipsis:
+            template.append(("ellipsis",))
+        elif isinstance(it, (int, np.integer)):
+            template.append(("int", int(it)))
+        elif isinstance(it, (bool, np.bool_)):
+            template.append(("bool", bool(it)))
+        else:
+            raise TypeError(f"Unsupported index type: {type(it)}")
+    return tuple(template), tensors
+
+
+def _rebuild(template, arrays):
+    out = []
+    ai = 0
+    for t in template:
+        if t == _TENSOR_SLOT:
+            out.append(arrays[ai])
+            ai += 1
+        elif t[0] == "slice":
+            out.append(slice(t[1], t[2], t[3]))
+        elif t[0] == "none":
+            out.append(None)
+        elif t[0] == "ellipsis":
+            out.append(Ellipsis)
+        elif t[0] == "int":
+            out.append(t[1])
+        elif t[0] == "bool":
+            out.append(t[1])
+    return tuple(out)
+
+
+def _getitem_impl(x, *index_arrays, template):
+    return x[_rebuild(template, index_arrays)]
+
+
+def _getitem(x, idx):
+    template, tensors = _canonicalize(idx)
+    # boolean-mask indexing produces dynamic shapes → host path (eager only)
+    if any(isinstance(t, Tensor) and t.dtype == jnp.bool_ for t in tensors):
+        arr = np.asarray(x._value)
+        nidx = _rebuild(template, [np.asarray(t._value) for t in tensors])
+        return Tensor(jnp.asarray(arr[nidx]))
+    return apply("getitem", _getitem_impl, tuple([x] + tensors),
+                 {"template": template})
+
+
+def _setitem_impl(x, v, *index_arrays, template):
+    return x.at[_rebuild(template, index_arrays)].set(v)
+
+
+def _setitem_inplace(x, idx, value):
+    template, tensors = _canonicalize(idx)
+    v = wrap(value) if isinstance(value, (Tensor, int, float, np.ndarray, list, jnp.ndarray)) else wrap(value)
+    if any(isinstance(t, Tensor) and t.dtype == jnp.bool_ for t in tensors):
+        # boolean mask set — functional where() when mask covers full shape
+        arr = np.asarray(x._value).copy()
+        nidx = _rebuild(template, [np.asarray(t._value) for t in tensors])
+        arr[nidx] = np.asarray(v._value)
+        x._value = jnp.asarray(arr)
+        x._grad_node = None
+        return x
+    out = apply("setitem", _setitem_impl, tuple([x, v] + tensors),
+                {"template": template})
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x

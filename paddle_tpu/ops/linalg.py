@@ -1,0 +1,366 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py; kernels
+phi/kernels matmul/cholesky/svd/...). All matmuls hit the MXU; linalg
+decompositions lower to XLA's native routines."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import apply, wrap, Tensor, norm_axis
+
+
+def _matmul_impl(x, y, *, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -2, -1) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -2, -1) if y.ndim >= 2 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply("matmul", _matmul_impl, (wrap(x), wrap(y)),
+                 {"transpose_x": bool(transpose_x), "transpose_y": bool(transpose_y)})
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def _dot_impl(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return apply("dot", _dot_impl, (wrap(x), wrap(y)))
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def _dist_impl(x, y, *, p):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+def dist(x, y, p=2, name=None):
+    return apply("dist", _dist_impl, (wrap(x), wrap(y)), {"p": float(p)})
+
+
+def _norm_impl(x, *, p, axis, keepdim):
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if axis is None:
+        return jnp.linalg.norm(x.reshape(-1), ord=p)
+    if isinstance(axis, tuple) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p if p != "fro" else "fro", axis=axis, keepdims=keepdim)
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if (axis is None or (isinstance(axis, (list, tuple)) and len(axis) == 2)) else 2.0
+    ax = norm_axis(axis)
+    if isinstance(p, str):
+        pp = p
+    else:
+        pp = float(p)
+    return apply("norm", _norm_impl, (wrap(x),),
+                 {"p": pp, "axis": ax, "keepdim": bool(keepdim)})
+
+
+def _cross_impl(x, y, *, axis):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        # reference default: first axis of size 3
+        xx = wrap(x)
+        axis = next(i for i, s in enumerate(xx.shape) if s == 3)
+    return apply("cross", _cross_impl, (wrap(x), wrap(y)), {"axis": int(axis)})
+
+
+def _histogramdd_stub():
+    pass
+
+
+def _cholesky_impl(x, *, upper):
+    L = jnp.linalg.cholesky(x)
+    if upper:
+        return jnp.swapaxes(L, -2, -1)
+    return L
+
+
+def cholesky(x, upper=False, name=None):
+    return apply("cholesky", _cholesky_impl, (wrap(x),), {"upper": bool(upper)})
+
+
+def _cholesky_solve_impl(x, y, *, upper):
+    L = jnp.swapaxes(y, -2, -1) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -2, -1), z, lower=False)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply("cholesky_solve", _cholesky_solve_impl, (wrap(x), wrap(y)),
+                 {"upper": bool(upper)})
+
+
+def _inverse_impl(x):
+    return jnp.linalg.inv(x)
+
+
+def inverse(x, name=None):
+    return apply("inverse", _inverse_impl, (wrap(x),))
+
+
+inv = inverse
+
+
+def _pinv_impl(x, *, rcond, hermitian):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", _pinv_impl, (wrap(x),),
+                 {"rcond": float(rcond), "hermitian": bool(hermitian)})
+
+
+def _solve_impl(x, y):
+    if y.ndim == x.ndim - 1:
+        return jnp.linalg.solve(x, y[..., None])[..., 0]
+    return jnp.linalg.solve(x, y)
+
+
+def solve(x, y, name=None):
+    return apply("solve", _solve_impl, (wrap(x), wrap(y)))
+
+
+def _triangular_solve_impl(x, y, *, upper, transpose, unitriangular):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply("triangular_solve", _triangular_solve_impl, (wrap(x), wrap(y)),
+                 {"upper": bool(upper), "transpose": bool(transpose),
+                  "unitriangular": bool(unitriangular)})
+
+
+def _lu_impl(x, *, pivot):
+    lu, piv = jax.scipy.linalg.lu_factor(x)
+    return lu, (piv + 1).astype(jnp.int32)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_t, piv = apply("lu", _lu_impl, (wrap(x),), {"pivot": bool(pivot)})
+    if get_infos:
+        from .creation import zeros
+        return lu_t, piv, zeros([1], dtype="int32")
+    return lu_t, piv
+
+
+def _qr_impl(x, *, mode):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply("qr", _qr_impl, (wrap(x),), {"mode": mode})
+    return out
+
+
+def _svd_impl(x, *, full_matrices):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", _svd_impl, (wrap(x),), {"full_matrices": bool(full_matrices)})
+
+
+def _eig_impl(x):
+    return jnp.linalg.eig(x)
+
+
+def eig(x, name=None):
+    # CPU-only in XLA; fall back to host numpy on accelerators (same class of
+    # restriction as reference's CPU-only eig kernel).
+    arr = np.asarray(wrap(x)._value)
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def _eigh_impl(x, *, UPLO):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", _eigh_impl, (wrap(x),), {"UPLO": UPLO})
+
+
+def _eigvalsh_impl(x, *, UPLO):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", _eigvalsh_impl, (wrap(x),), {"UPLO": UPLO})
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(wrap(x)._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def _matrix_power_impl(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", _matrix_power_impl, (wrap(x),), {"n": int(n)})
+
+
+def _matrix_rank_impl(x, *, tol, hermitian):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank", _matrix_rank_impl, (wrap(x),),
+                 {"tol": tol, "hermitian": bool(hermitian)})
+
+
+def _det_impl(x):
+    return jnp.linalg.det(x)
+
+
+def det(x, name=None):
+    return apply("det", _det_impl, (wrap(x),))
+
+
+def _slogdet_impl(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def slogdet(x, name=None):
+    return apply("slogdet", _slogdet_impl, (wrap(x),))
+
+
+def _lstsq_impl(x, y, *, rcond):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return apply("lstsq", _lstsq_impl, (wrap(x), wrap(y)), {"rcond": rcond})
+
+
+def _multi_dot_impl(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", _multi_dot_impl, tuple(wrap(t) for t in x))
+
+
+def _corrcoef_impl(x, *, rowvar):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", _corrcoef_impl, (wrap(x),), {"rowvar": bool(rowvar)})
+
+
+def _cov_impl(x, *, rowvar, ddof):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply("cov", _cov_impl, (wrap(x),),
+                 {"rowvar": bool(rowvar), "ddof": 1 if ddof else 0})
+
+
+def _householder_product_impl(x, tau):
+    return jax.scipy.linalg.expm(jnp.zeros_like(x)) if False else _hh(x, tau)
+
+
+def _hh(a, tau):
+    m, n = a.shape[-2], a.shape[-1]
+    eye = jnp.eye(m, dtype=a.dtype)
+    q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m)[:, None] > i, a[..., :, i:i + 1], 0.0)
+        v = v.at[..., i, 0].set(1.0)
+        t = tau[..., i]
+        h = jnp.eye(m, dtype=a.dtype) - t[..., None, None] * (v @ jnp.swapaxes(v, -2, -1))
+        return q @ h
+
+    q = jax.lax.fori_loop(0, tau.shape[-1], body, q)
+    return q[..., :, :n]
+
+
+def householder_product(x, tau, name=None):
+    return apply("householder_product", _hh, (wrap(x), wrap(tau)))
+
+
+def _einsum_cache():
+    pass
+
+
+def einsum(equation, *operands):
+    ops_t = tuple(wrap(o) for o in operands)
+    return apply("einsum", _einsum_impl, ops_t, {"equation": equation})
+
+
+def _einsum_impl(*xs, equation):
+    return jnp.einsum(equation, *xs)
+
+
+def _tensordot_impl(x, y, *, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return apply("tensordot", _tensordot_impl, (wrap(x), wrap(y)), {"axes": axes})
+
+
+def _matrix_exp_impl(x):
+    return jax.scipy.linalg.expm(x)
+
+
+def matrix_exp(x, name=None):
+    return apply("matrix_exp", _matrix_exp_impl, (wrap(x),))
+
+
+def _bilinear_impl(x1, x2, w, b):
+    # x1:[N,d1] x2:[N,d2] w:[out,d1,d2]
+    out = jnp.einsum("nd,ode,ne->no", x1, w, x2)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    args = (wrap(x1), wrap(x2), wrap(weight))
+    if bias is not None:
+        return apply("bilinear", _bilinear_impl, args + (wrap(bias),))
+    return apply("bilinear_nobias", _bilinear_nobias_impl, args)
+
+
+def _bilinear_nobias_impl(x1, x2, w):
+    return jnp.einsum("nd,ode,ne->no", x1, w, x2)

@@ -1,0 +1,67 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import apply, wrap, binary_op, unary_op, Tensor
+
+equal = binary_op("equal", jnp.equal)
+not_equal = binary_op("not_equal", jnp.not_equal)
+greater_than = binary_op("greater_than", jnp.greater)
+greater_equal = binary_op("greater_equal", jnp.greater_equal)
+less_than = binary_op("less_than", jnp.less)
+less_equal = binary_op("less_equal", jnp.less_equal)
+logical_and = binary_op("logical_and", jnp.logical_and)
+logical_or = binary_op("logical_or", jnp.logical_or)
+logical_xor = binary_op("logical_xor", jnp.logical_xor)
+logical_not, _ = unary_op("logical_not", jnp.logical_not)
+bitwise_and = binary_op("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary_op("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary_op("bitwise_xor", jnp.bitwise_xor)
+bitwise_not, _ = unary_op("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = binary_op("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = binary_op("bitwise_right_shift", jnp.right_shift)
+
+
+def _isclose_impl(x, y, *, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose", _isclose_impl, (wrap(x), wrap(y)),
+                 {"rtol": float(rtol), "atol": float(atol),
+                  "equal_nan": bool(equal_nan)})
+
+
+def _allclose_impl(x, y, *, rtol, atol, equal_nan):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose", _allclose_impl, (wrap(x), wrap(y)),
+                 {"rtol": float(rtol), "atol": float(atol),
+                  "equal_nan": bool(equal_nan)})
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", _equal_all_impl, (wrap(x), wrap(y)))
+
+
+def _equal_all_impl(x, y):
+    if x.shape != y.shape:
+        return jnp.asarray(False)
+    return jnp.all(x == y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(wrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in_dynamic_mode():
+    from ..jit.api import _in_to_static
+    return not _in_to_static()

@@ -1,0 +1,590 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py;
+kernels phi/kernels reshape/transpose/concat/...). Views are free under XLA —
+reshape/transpose/slice lower to metadata-only HLO where possible."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import apply, wrap, Tensor, norm_axis
+
+
+def _int_list(v):
+    if isinstance(v, Tensor):
+        v = v.numpy()
+    if isinstance(v, np.ndarray):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x._value if isinstance(x, Tensor) else x) for x in v)
+
+
+# ---- reshape family --------------------------------------------------------
+
+def _reshape_impl(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return apply("reshape", _reshape_impl, (wrap(x),), {"shape": _int_list(shape)})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._grad_node, x._out_idx, x.stop_gradient = out._value, out._grad_node, out._out_idx, out.stop_gradient
+    return x
+
+
+view = reshape
+
+
+def _flatten_impl(x, *, start_axis, stop_axis):
+    shape = x.shape
+    sa = start_axis % x.ndim if x.ndim else 0
+    so = stop_axis % x.ndim if x.ndim else 0
+    new_shape = shape[:sa] + (-1,) + shape[so + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return apply("flatten", _flatten_impl, (wrap(x),),
+                 {"start_axis": int(start_axis), "stop_axis": int(stop_axis)})
+
+
+def _squeeze_impl(x, *, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a % x.ndim for a in axis)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    return apply("squeeze", _squeeze_impl, (wrap(x),),
+                 {"axis": None if axis is None else _int_list(axis)})
+
+
+def _unsqueeze_impl(x, *, axis):
+    out = x
+    for a in sorted(axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def unsqueeze(x, axis, name=None):
+    return apply("unsqueeze", _unsqueeze_impl, (wrap(x),), {"axis": _int_list(axis)})
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._grad_node, x._out_idx, x.stop_gradient = out._value, out._grad_node, out._out_idx, out.stop_gradient
+    return x
+
+
+# ---- transpose family ------------------------------------------------------
+
+def _transpose_impl(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", _transpose_impl, (wrap(x),), {"perm": _int_list(perm)})
+
+
+def _t_impl(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -2, -1)
+
+
+def t(x, name=None):
+    return apply("t", _t_impl, (wrap(x),))
+
+
+def _moveaxis_impl(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", _moveaxis_impl, (wrap(x),),
+                 {"source": _int_list(source), "destination": _int_list(destination)})
+
+
+def _swapaxes_impl(x, *, a, b):
+    return jnp.swapaxes(x, a, b)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", _swapaxes_impl, (wrap(x),), {"a": int(axis0), "b": int(axis1)})
+
+
+transpose_ = None
+
+# ---- concat/stack/split ----------------------------------------------------
+
+
+def _make_concat_impl():
+    cache = {}
+
+    def get(axis):
+        fn = cache.get(axis)
+        if fn is None:
+            def impl(*xs, _ax=axis):
+                return jnp.concatenate(xs, axis=_ax)
+            impl.__name__ = f"_concat_impl_{axis}"
+            cache[axis] = impl
+            fn = impl
+        return fn
+
+    return get
+
+
+def _concat_impl(*xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply("concat", _concat_impl, tuple(wrap(t) for t in x), {"axis": int(axis)})
+
+
+def _stack_impl(*xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", _stack_impl, tuple(wrap(t) for t in x), {"axis": int(axis)})
+
+
+def _split_impl(x, *, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # sections is a tuple of sizes, possibly with one -1
+    sizes = list(sections)
+    total = x.shape[axis]
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = total - known
+    offsets = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        sec = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections)
+    else:
+        sec = int(num_or_sections)
+    return list(apply("split", _split_impl, (wrap(x),), {"sections": sec, "axis": int(axis)}))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def _unbind_impl(x, *, axis):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+def unbind(input, axis=0):
+    return list(apply("unbind", _unbind_impl, (wrap(input),), {"axis": int(axis)}))
+
+
+def _unstack_like_impl(x, *, axis, num):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+# ---- tile/expand/broadcast -------------------------------------------------
+
+def _tile_impl(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return apply("tile", _tile_impl, (wrap(x),), {"repeat_times": _int_list(repeat_times)})
+
+
+def _expand_impl(x, *, shape):
+    shape = list(shape)
+    # -1 means keep dim
+    xshape = [1] * (len(shape) - x.ndim) + list(x.shape)
+    tgt = [xs if s == -1 else s for s, xs in zip(shape, xshape)]
+    return jnp.broadcast_to(x.reshape(xshape), tgt)
+
+
+def expand(x, shape, name=None):
+    return apply("expand", _expand_impl, (wrap(x),), {"shape": _int_list(shape)})
+
+
+def _expand_as_impl(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", _expand_as_impl, (wrap(x), wrap(y)))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None):
+    return list(apply("broadcast_tensors", _broadcast_tensors_impl,
+                      tuple(wrap(t) for t in input)))
+
+
+def _broadcast_tensors_impl(*xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def _repeat_interleave_impl(x, *, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply("repeat_interleave_t", _repeat_interleave_t_impl,
+                     (wrap(x), repeats),
+                     {"axis": axis, "total": int(repeats.numpy().sum())})
+    return apply("repeat_interleave", _repeat_interleave_impl, (wrap(x),),
+                 {"repeats": int(repeats), "axis": axis})
+
+
+def _repeat_interleave_t_impl(x, repeats, *, axis, total):
+    return jnp.repeat(x, repeats, axis=axis, total_repeat_length=total)
+
+
+# ---- flip/roll/rot90 -------------------------------------------------------
+
+def _flip_impl(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return apply("flip", _flip_impl, (wrap(x),), {"axis": norm_axis(axis)})
+
+
+def _roll_impl(x, *, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", _roll_impl, (wrap(x),),
+                 {"shifts": norm_axis(shifts), "axis": norm_axis(axis)})
+
+
+def _rot90_impl(x, *, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", _rot90_impl, (wrap(x),), {"k": int(k), "axes": tuple(axes)})
+
+
+# ---- gather/scatter --------------------------------------------------------
+
+def _gather_impl(x, index, *, axis):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply("gather", _gather_impl, (wrap(x), wrap(index)), {"axis": int(axis)})
+
+
+def _gather_nd_impl(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return apply("gather_nd", _gather_nd_impl, (wrap(x), wrap(index)))
+
+
+def _take_along_axis_impl(x, indices, *, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply("take_along_axis", _take_along_axis_impl,
+                 (wrap(arr), wrap(indices)), {"axis": int(axis)})
+
+
+def _put_along_axis_impl(x, indices, values, *, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    idx = [jnp.broadcast_to(jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)]), indices.shape)
+           for d, s in enumerate(x.shape)]
+    idx[axis] = indices
+    flat_idx = tuple(i.reshape(-1) for i in idx)
+    v = jnp.broadcast_to(values, indices.shape).reshape(-1)
+    if reduce == "add":
+        return x.at[flat_idx].add(v)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[flat_idx].multiply(v)
+    raise ValueError(reduce)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):
+    return apply("put_along_axis", _put_along_axis_impl,
+                 (wrap(arr), wrap(indices), wrap(values)),
+                 {"axis": int(axis), "reduce": reduce})
+
+
+def _scatter_impl(x, index, updates, *, overwrite):
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Reference: paddle.scatter — row-wise scatter on axis 0."""
+    return apply("scatter", _scatter_impl, (wrap(x), wrap(index), wrap(updates)),
+                 {"overwrite": bool(overwrite)})
+
+
+def _scatter_nd_add_impl(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply("scatter_nd_add", _scatter_nd_add_impl,
+                 (wrap(x), wrap(index), wrap(updates)))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype if isinstance(updates, Tensor) else None)
+    return scatter_nd_add(z, index, updates)
+
+
+def _index_select_impl(x, index, *, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", _index_select_impl, (wrap(x), wrap(index)),
+                 {"axis": int(axis)})
+
+
+def _index_add_impl(x, index, value, *, axis):
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply("index_add", _index_add_impl, (wrap(x), wrap(index), wrap(value)),
+                 {"axis": int(axis)})
+
+
+def _index_put_impl(x, value, *indices, accumulate):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    return apply("index_put", _index_put_impl,
+                 tuple([wrap(x), wrap(value)] + [wrap(i) for i in indices]),
+                 {"accumulate": bool(accumulate)})
+
+
+def _masked_select_impl(x, mask):
+    # dynamic output size — not jit-friendly; eager-only op (reference
+    # masked_select has the same data-dependence).
+    return x[mask]
+
+
+def masked_select(x, mask, name=None):
+    xx, mm = wrap(x), wrap(mask)
+    out = np.asarray(xx._value)[np.asarray(mm._value)]
+    return Tensor(jnp.asarray(out))
+
+
+def _masked_fill_impl(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply("masked_fill", _masked_fill_impl,
+                 (wrap(x), wrap(mask), wrap(value) if isinstance(value, Tensor) else wrap(jnp.asarray(value))))
+
+
+def _where_impl(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", _where_impl, (wrap(condition), wrap(x), wrap(y)))
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape — eager/host op, like reference nonzero
+    arr = np.asarray(wrap(x)._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None])) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+# ---- pad/slice -------------------------------------------------------------
+
+def _pad_nd_impl(x, *, pad, mode, value, data_format):
+    # pad given as flat list (reference layout: last-dim-first pairs when len<ndim*2)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # pad applies to trailing spatial dims per data_format (NCHW/NHWC style)
+        cfg = [(0, 0)] * nd
+        n_spatial = len(pad) // 2
+        if data_format and data_format.endswith("C"):  # channels-last
+            dims = list(range(1, 1 + n_spatial))
+        else:
+            dims = list(range(nd - n_spatial, nd))
+        for i, d in enumerate(dims):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None, pad_from_left_axis=True):
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    # Normalise reference semantics: for len(pad)==2*ndim paddle pads from
+    # the first axis; our flat layout above matches.
+    nd_guess = None
+    return apply("pad", _pad_nd_impl, (wrap(x),),
+                 {"pad": tuple(int(p) for p in pad), "mode": mode,
+                  "value": float(value), "data_format": data_format or "NCHW"})
+
+
+def _slice_impl(x, *, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(input, axes, starts, ends):
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return apply("slice", _slice_impl, (wrap(input),),
+                 {"axes": tuple(int(a) for a in axes), "starts": tuple(starts),
+                  "ends": tuple(ends)})
+
+
+def _strided_slice_impl(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return apply("strided_slice", _strided_slice_impl, (wrap(x),),
+                 {"axes": tuple(axes), "starts": tuple(starts),
+                  "ends": tuple(ends), "strides": tuple(strides)})
+
+
+def _crop_impl(x, *, shape, offsets):
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    xx = wrap(x)
+    shape = _int_list(shape) if shape is not None else tuple(xx.shape)
+    shape = tuple(xs if s == -1 else s for s, xs in zip(shape, xx.shape))
+    offsets = _int_list(offsets) if offsets is not None else tuple([0] * xx.ndim)
+    return apply("crop", _crop_impl, (xx,), {"shape": shape, "offsets": offsets})
+
+
+# ---- misc ------------------------------------------------------------------
+
+def _as_strided_like(x):
+    return x
+
+
+def _diagonal_impl(x, *, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", _diagonal_impl, (wrap(x),),
+                 {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
+
+
+def _diag_embed_impl(x, *, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    # move the two new dims into place
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+    # insert
+    order = []
+    src = {d1: nd - 2, d2: nd - 1}
+    pi = 0
+    for d in range(nd):
+        if d in src:
+            order.append(src[d])
+        else:
+            order.append(perm[pi]); pi += 1
+    return jnp.transpose(out, order)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return apply("diag_embed", _diag_embed_impl, (wrap(input),),
+                 {"offset": int(offset), "dim1": int(dim1), "dim2": int(dim2)})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def impl(x, *, index_num, nshards, shard_id, ignore_value):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        inside = (x >= lo) & (x < hi)
+        return jnp.where(inside, x - lo, ignore_value)
+    impl.__name__ = "_shard_index_impl"
+    return apply("shard_index", _shard_index_static, (wrap(input),),
+                 {"index_num": index_num, "nshards": nshards,
+                  "shard_id": shard_id, "ignore_value": ignore_value})
+
+
+def _shard_index_static(x, *, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)

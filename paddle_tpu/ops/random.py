@@ -1,0 +1,196 @@
+"""Random ops (reference: python/paddle/tensor/random.py; phi/core/generator.cc).
+
+TPU-native RNG: a global threefry/Philox key with split-per-call, matching the
+reference's global Generator semantics (`paddle.seed`). Per-parallel-axis
+deterministic RNG lives in distributed.fleet.rng_tracker (reference
+fleet/layers/mpu/random.py:34).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, wrap, apply
+from ..core import dtype as dtypes
+
+
+class _GlobalGenerator(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+
+
+_gen = _GlobalGenerator()
+
+
+def seed(s: int):
+    """Reference: paddle.seed."""
+    _gen.key = jax.random.PRNGKey(int(s))
+    return _gen
+
+
+def get_rng_state():
+    return _gen.key
+
+
+def set_rng_state(state):
+    _gen.key = state
+
+
+class _TraceKeys(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_trace_keys = _TraceKeys()
+
+
+def push_trace_key(key):
+    """Inside a to_static trace, RNG derives from a traced key argument so
+    each compiled call gets fresh randomness (dropout etc.)."""
+    _trace_keys.stack.append(key)
+
+
+def pop_trace_key():
+    _trace_keys.stack.pop()
+
+
+def next_key():
+    if _trace_keys.stack:
+        k = _trace_keys.stack[-1]
+        k, sub = jax.random.split(k)
+        _trace_keys.stack[-1] = k
+        return sub
+    _gen.key, sub = jax.random.split(_gen.key)
+    return sub
+
+
+def _resolve(dtype):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else dtypes.get_default_dtype()
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape_tuple(shape), _resolve(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape_tuple(shape), _resolve(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                                  minval=float(min), maxval=float(max))
+    return x
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape_tuple(shape), _resolve(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = wrap(mean)._value if isinstance(mean, Tensor) else mean
+        s = wrap(std)._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, "shape") else (),
+            s.shape if hasattr(s, "shape") else ())
+        return Tensor(jax.random.normal(next_key(), shp) * s + m)
+    shp = _shape_tuple(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(next_key(), shp) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape_tuple(shape), _resolve(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape_tuple(shape), int(low), int(high),
+                                     dtype=dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    xx = wrap(x)
+    return randint(low, high, tuple(xx.shape), dtype or str(xx.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(dtypes.convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    xx = wrap(x)
+    return Tensor(jax.random.permutation(next_key(), xx._value, axis=axis, independent=False))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xx = wrap(x)
+    logits = jnp.log(jnp.maximum(xx._value, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) if logits.ndim == 1 else (num_samples, logits.shape[0]))
+        if logits.ndim > 1:
+            out = out.T
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: gumbel top-k
+    g = jax.random.gumbel(next_key(), logits.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    xx = wrap(x)
+    return Tensor(jax.random.bernoulli(next_key(), xx._value).astype(xx.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    xx = wrap(x)
+    return Tensor(jax.random.poisson(next_key(), xx._value).astype(xx.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = wrap(count)._value
+    p = wrap(prob)._value
+    return Tensor(jax.random.binomial(next_key(), c, p).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = jax.random.exponential(next_key(), tuple(x.shape), x.dtype) / lam
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    xx = wrap(x)
+    return rand(tuple(xx.shape), dtype or xx.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    xx = wrap(x)
+    return randn(tuple(xx.shape), dtype or xx.dtype)

@@ -1,0 +1,295 @@
+"""Reduction / search / sort ops (reference: python/paddle/tensor/math.py,
+search.py, stat.py; kernels phi/kernels reduce_*, arg_min_max, top_k)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import apply, wrap, Tensor, norm_axis, static_dtype
+
+
+def _make_reduce(name, jfn, has_dtype=False):
+    if has_dtype:
+        def impl(x, *, axis, keepdim, dtype):
+            return jfn(x, axis=axis, keepdims=keepdim, dtype=dtype)
+    else:
+        def impl(x, *, axis, keepdim):
+            return jfn(x, axis=axis, keepdims=keepdim)
+    impl.__name__ = f"_{name}_impl"
+
+    if has_dtype:
+        def op(x, axis=None, dtype=None, keepdim=False, name=None):
+            return apply(_n, impl, (wrap(x),),
+                         {"axis": norm_axis(axis), "keepdim": bool(keepdim),
+                          "dtype": static_dtype(dtype)})
+    else:
+        def op(x, axis=None, keepdim=False, name=None):
+            return apply(_n, impl, (wrap(x),),
+                         {"axis": norm_axis(axis), "keepdim": bool(keepdim)})
+    _n = name
+    op.__name__ = name
+    return op
+
+
+sum = _make_reduce("sum", jnp.sum, has_dtype=True)
+mean = _make_reduce("mean", jnp.mean)
+prod = _make_reduce("prod", jnp.prod, has_dtype=True)
+max = _make_reduce("max", jnp.max)
+min = _make_reduce("min", jnp.min)
+amax = _make_reduce("amax", jnp.max)
+amin = _make_reduce("amin", jnp.min)
+all = _make_reduce("all", jnp.all)
+any = _make_reduce("any", jnp.any)
+nansum = _make_reduce("nansum", jnp.nansum, has_dtype=True)
+nanmean = _make_reduce("nanmean", jnp.nanmean)
+
+
+def _std_impl(x, *, axis, keepdim, unbiased):
+    return jnp.std(x, axis=axis, keepdims=keepdim, ddof=1 if unbiased else 0)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std", _std_impl, (wrap(x),),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim),
+                  "unbiased": bool(unbiased)})
+
+
+def _var_impl(x, *, axis, keepdim, unbiased):
+    return jnp.var(x, axis=axis, keepdims=keepdim, ddof=1 if unbiased else 0)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var", _var_impl, (wrap(x),),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim),
+                  "unbiased": bool(unbiased)})
+
+
+def _median_impl(x, *, axis, keepdim):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply("median", _median_impl, (wrap(x),),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim)})
+
+
+def _nanmedian_impl(x, *, axis, keepdim):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply("nanmedian", _nanmedian_impl, (wrap(x),),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim)})
+
+
+def _quantile_impl(x, q, *, axis, keepdim, interpolation):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim, method=interpolation)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply("quantile", _quantile_impl, (wrap(x), wrap(q)),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim),
+                  "interpolation": interpolation})
+
+
+def _logsumexp_impl(x, *, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply("logsumexp", _logsumexp_impl, (wrap(x),),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim)})
+
+
+def _count_nonzero_impl(x, *, axis, keepdim):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero", _count_nonzero_impl, (wrap(x),),
+                 {"axis": norm_axis(axis), "keepdim": bool(keepdim)})
+
+
+def _argmax_impl(x, *, axis, keepdim, dtype):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply("argmax", _argmax_impl, (wrap(x),),
+                 {"axis": None if axis is None else int(axis),
+                  "keepdim": bool(keepdim), "dtype": static_dtype(dtype)})
+
+
+def _argmin_impl(x, *, axis, keepdim, dtype):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply("argmin", _argmin_impl, (wrap(x),),
+                 {"axis": None if axis is None else int(axis),
+                  "keepdim": bool(keepdim), "dtype": static_dtype(dtype)})
+
+
+def _sort_impl(x, *, axis, descending, stable):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply("sort", _sort_impl, (wrap(x),),
+                 {"axis": int(axis), "descending": bool(descending),
+                  "stable": bool(stable)})
+
+
+def _argsort_impl(x, *, axis, descending, stable):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply("argsort", _argsort_impl, (wrap(x),),
+                 {"axis": int(axis), "descending": bool(descending),
+                  "stable": bool(stable)})
+
+
+def _topk_impl(x, *, k, axis, largest, sorted):
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    if axis is None:
+        axis = -1
+    return apply("topk", _topk_impl, (wrap(x),),
+                 {"k": int(k), "axis": int(axis), "largest": bool(largest),
+                  "sorted": bool(sorted)})
+
+
+def _kthvalue_impl(x, *, k, axis, keepdim):
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    nv, ni = jax.lax.top_k(-xm, k)
+    v, i = -nv[..., -1], ni[..., -1].astype(jnp.int64)
+    if keepdim:
+        v = jnp.expand_dims(v, ax)
+        i = jnp.expand_dims(i, ax)
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return apply("kthvalue", _kthvalue_impl, (wrap(x),),
+                 {"k": int(k), "axis": int(axis), "keepdim": bool(keepdim)})
+
+
+def _mode_impl(x, *, axis, keepdim):
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    s = jnp.sort(xm, axis=-1)
+    n = s.shape[-1]
+    # run-length: count occurrences of each sorted value
+    eq = s[..., :, None] == s[..., None, :]
+    counts = eq.sum(-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+    idx = jnp.argmax(xm == vals[..., None], axis=-1).astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return apply("mode", _mode_impl, (wrap(x),),
+                 {"axis": int(axis), "keepdim": bool(keepdim)})
+
+
+def _searchsorted_impl(sorted_sequence, values, *, out_int32, right):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]),
+        ).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return apply("searchsorted", _searchsorted_impl,
+                 (wrap(sorted_sequence), wrap(values)),
+                 {"out_int32": bool(out_int32), "right": bool(right)})
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def _histogram_impl(x, *, bins, min, max):
+    h, _ = jnp.histogram(x, bins=bins, range=(min, max) if (min != 0 or max != 0) else None)
+    return h.astype(jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    return apply("histogram", _histogram_impl, (wrap(input),),
+                 {"bins": int(bins), "min": float(min), "max": float(max)})
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import builtins
+    xx = wrap(x)
+    length = int(np.asarray(xx._value).max()) + 1 if xx.size else 0
+    length = builtins.max(length, int(minlength), 1)
+    w = wrap(weights)._value if weights is not None else None
+    return Tensor(jnp.bincount(xx._value, weights=w, length=length))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape — host-side eager op (reference unique is also
+    # data-dependent; under jit use jnp.unique with size=).
+    arr = np.asarray(wrap(x)._value)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(wrap(x)._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    keep = np.ones(arr.shape[ax], dtype=bool)
+    if arr.shape[ax] > 1:
+        a = np.moveaxis(arr, ax, 0)
+        neq = np.any(a[1:] != a[:-1], axis=tuple(range(1, a.ndim))) if a.ndim > 1 else a[1:] != a[:-1]
+        keep[1:] = neq
+    out = np.compress(keep, arr, axis=ax)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[ax]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)

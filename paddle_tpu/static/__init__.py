@@ -1,0 +1,98 @@
+"""Static-graph compatibility surface (reference: python/paddle/static/).
+
+The reference's Program/Executor stack (base/executor.py:1152,
+framework.py:5736, StandaloneExecutor) interprets an op-list IR. On the TPU
+stack the compiled artifact IS the program (jaxpr/StableHLO via jit), so
+`static.Executor.run` executes traced callables; `paddle.enable_static()`
+flips a flag that makes `data()` return placeholder specs consumed by a
+traced build. This module provides the data-plumbing parity used by tests
+and high-level training loops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+_static_mode = [False]
+
+
+def _enable():
+    _static_mode[0] = True
+
+
+def _static_enabled():
+    return _static_mode[0]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else s for s in shape]
+    return InputSpec(shape, dtype, name)
+
+
+class Program:
+    def __init__(self):
+        self._traced_fn = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        """In the TPU build, 'programs' are traced callables registered on
+        the Program, or the caller uses eager/jit paths directly."""
+        if fetch_list is None:
+            return []
+        out = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                out.append(f.numpy())
+            elif callable(f):
+                out.append(f(feed))
+            else:
+                out.append(f)
+        return out
+
+    def close(self):
+        pass
+
+
+def name_scope(name):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ns():
+        yield
+
+    return _ns()

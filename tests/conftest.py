@@ -1,0 +1,17 @@
+"""Test config: run on a virtual 8-device CPU mesh (the reference tests
+distributed logic with single-host multi-process CPU/Gloo, SURVEY.md §4; we
+use XLA's host-platform device-count flag instead)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Tight numeric comparisons vs numpy references (TPU prod keeps the default
+# bf16-friendly matmul precision).
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,167 @@
+"""Autograd engine tests (reference analog: check_grad in
+test/legacy_test/op_test.py:2963 — numeric vs analytic gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    for i in range(x.size):
+        xp = x.copy().reshape(-1)
+        xm = x.copy().reshape(-1)
+        xp[i] += eps
+        xm[i] -= eps
+        fp = fn(xp.reshape(x.shape))
+        fm = fn(xm.reshape(x.shape))
+        g.reshape(-1)[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def test_simple_backward():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulate():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2.0
+    b = a + x          # x used twice
+    loss = (b * b).sum()
+    loss.backward()
+    # b = 3x, loss = 9 x^2, dloss/dx = 18x
+    np.testing.assert_allclose(x.grad.numpy(), [18.0, 36.0])
+
+
+def test_matmul_grad_matches_numeric():
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    ta = pt.to_tensor(a, stop_gradient=False)
+    tb = pt.to_tensor(b, stop_gradient=False)
+    loss = pt.matmul(ta, tb).sum()
+    loss.backward()
+
+    def f_a(x):
+        return (x @ b).sum()
+
+    np.testing.assert_allclose(ta.grad.numpy(), numeric_grad(f_a, a),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_broadcast_grad():
+    x = pt.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    loss = (x + b).sum()
+    loss.backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3, 3, 3, 3])
+
+
+def test_stop_gradient():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = pt.to_tensor([2.0], stop_gradient=True)
+    loss = (x * y).sum()
+    loss.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 3.0
+    assert y._grad_node is None
+
+
+def test_detach():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * 3.0
+    assert z._grad_node is None
+
+
+def test_grad_api():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = pt.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                     stop_gradient=False)
+    parts = pt.split(x, 3, axis=1)
+    loss = (parts[0] * 1.0 + parts[2] * 2.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 0, 2], [1, 0, 2]])
+
+
+def test_backward_accumulates():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_tensor_hook():
+    x = pt.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 10.0
+
+    y = x * 2.0
+    y.register_hook(lambda g: g)  # non-modifying hook on intermediate? -> on leaf:
+    x.register_hook(hook)
+    y.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_pylayer():
+    class Double(pt.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2.0
+
+    x = pt.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_softmax_cross_entropy_grad():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4])
+    t = pt.to_tensor(logits, stop_gradient=False)
+    loss = pt.nn.functional.cross_entropy(t, pt.to_tensor(labels))
+    loss.backward()
+
+    def f(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return -np.log(p[np.arange(4), labels]).mean()
+
+    np.testing.assert_allclose(t.grad.numpy(), numeric_grad(f, logits),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_setitem_grad():
+    x = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2.0
+    y[1] = 0.0
+    loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])

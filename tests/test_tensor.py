@@ -1,0 +1,163 @@
+"""Core tensor + op tests (reference analog: test/legacy_test OpTest checks,
+op_test.py:420 — numpy-reference comparison)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_basics():
+    t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_fp32_conversion():
+    t = pt.to_tensor(np.zeros((3,), dtype=np.float64))
+    assert str(t.dtype) == "float32"
+
+
+def test_arithmetic_operators():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9], rtol=1e-5)
+    np.testing.assert_allclose((2 - a).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+
+
+def test_matmul():
+    a = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy())
+    np.testing.assert_allclose(
+        pt.matmul(a, a, transpose_y=True).numpy(), a.numpy() @ a.numpy().T)
+
+
+def test_indexing():
+    x = pt.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(x[0].numpy(), x.numpy()[0])
+    np.testing.assert_allclose(x[:, 1].numpy(), x.numpy()[:, 1])
+    np.testing.assert_allclose(x[..., -1].numpy(), x.numpy()[..., -1])
+    idx = pt.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(x[:, idx].numpy(), x.numpy()[:, [0, 2]])
+
+
+def test_setitem():
+    x = pt.zeros([3, 3])
+    x[1] = 5.0
+    assert x.numpy()[1].tolist() == [5, 5, 5]
+    x[0, 0] = 7.0
+    assert x.numpy()[0, 0] == 7
+
+
+def test_reductions_match_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4, 5).astype(np.float32)
+    t = pt.to_tensor(a)
+    np.testing.assert_allclose(pt.sum(t).numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(pt.mean(t, axis=1).numpy(), a.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(pt.max(t, axis=[0, 2]).numpy(), a.max((0, 2)))
+    np.testing.assert_allclose(pt.std(t).numpy(), a.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        pt.logsumexp(t, axis=-1).numpy(),
+        np.log(np.exp(a).sum(-1)), rtol=1e-4)
+
+
+def test_manipulation():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = pt.to_tensor(a)
+    assert pt.reshape(t, [4, 6]).shape == [4, 6]
+    assert pt.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert pt.squeeze(pt.unsqueeze(t, [0]), [0]).shape == [2, 3, 4]
+    c = pt.concat([t, t], axis=1)
+    assert c.shape == [2, 6, 4]
+    parts = pt.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts2 = pt.split(t, [1, -1], axis=1)
+    assert parts2[1].shape == [2, 2, 4]
+    np.testing.assert_allclose(pt.flip(t, [0]).numpy(), a[::-1])
+    assert pt.tile(t, [2, 1, 1]).shape == [4, 3, 4]
+
+
+def test_gather_scatter():
+    x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = pt.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(pt.gather(x, idx).numpy(), x.numpy()[[0, 2]])
+    upd = pt.ones([2, 3])
+    out = pt.scatter(x, idx, upd)
+    ref = x.numpy().copy()
+    ref[[0, 2]] = 1.0
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_topk_argsort():
+    a = np.random.RandomState(1).randn(5, 7).astype(np.float32)
+    t = pt.to_tensor(a)
+    v, i = pt.topk(t, 3, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :3], rtol=1e-6)
+    s = pt.argsort(t, axis=1)
+    np.testing.assert_allclose(s.numpy(), np.argsort(a, 1, kind="stable"))
+
+
+def test_where_nonzero():
+    a = np.array([[1.0, -1.0], [-2.0, 3.0]], dtype=np.float32)
+    t = pt.to_tensor(a)
+    out = pt.where(t > 0, t, pt.zeros_like(t))
+    np.testing.assert_allclose(out.numpy(), np.where(a > 0, a, 0))
+    nz = pt.nonzero(t > 0)
+    assert nz.numpy().tolist() == [[0, 0], [1, 1]]
+
+
+def test_cast_astype():
+    t = pt.to_tensor([1.5, 2.5])
+    i = t.astype("int32")
+    assert str(i.dtype) == "int32"
+    assert i.numpy().tolist() == [1, 2]
+
+
+def test_einsum():
+    a = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    b = np.random.RandomState(3).randn(3, 4).astype(np.float32)
+    out = pt.einsum("ij,jk->ik", pt.to_tensor(a), pt.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg():
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    t = pt.to_tensor(spd)
+    np.testing.assert_allclose(
+        pt.inverse(t).numpy() @ spd, np.eye(3), atol=1e-4)
+    L = pt.cholesky(t).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(pt.ops.det(t).numpy(), np.linalg.det(spd), rtol=1e-4)
+
+
+def test_inplace_ops():
+    t = pt.to_tensor([1.0, 4.0, 9.0])
+    t.sqrt_()
+    np.testing.assert_allclose(t.numpy(), [1, 2, 3], rtol=1e-6)
+
+
+def test_random_determinism():
+    pt.seed(42)
+    a = pt.randn([4, 4]).numpy()
+    pt.seed(42)
+    b = pt.randn([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_save_load(tmp_path):
+    obj = {"w": pt.randn([3, 3]), "step": 7, "nested": [pt.ones([2])]}
+    p = str(tmp_path / "ckpt.pdparams")
+    pt.save(obj, p)
+    loaded = pt.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), obj["w"].numpy())
+    assert loaded["step"] == 7
+    np.testing.assert_allclose(loaded["nested"][0].numpy(), [1, 1])
