@@ -1,0 +1,10 @@
+"""Model zoo (reference: python/paddle/vision/models + PaddleNLP zoo shapes
+named in BASELINE.md)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, gpt, CONFIGS as GPT_CONFIGS,
+    flops_per_token,
+)
+from .resnet import (  # noqa: F401
+    ResNet, BasicBlock, BottleneckBlock,
+    resnet18, resnet34, resnet50, resnet101, resnet152,
+)

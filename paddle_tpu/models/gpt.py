@@ -1,0 +1,270 @@
+"""Flagship decoder-only transformer LM (GPT/ERNIE/LLaMA-class).
+
+Reference analogs: PaddleNLP GPT/LLaMA model zoo driven by the reference's
+nn stack (python/paddle/nn/layer/transformer.py provides the generic
+blocks; the fused path uses phi fusion kernels, e.g.
+paddle/phi/kernels/fusion/gpu/flash_attn_kernel.cu and fused rope).
+
+TPU-native design:
+- One dense MXU-friendly stack: big [hidden, 3*hidden] fused QKV matmuls,
+  bf16-ready, static shapes, no data-dependent control flow — the whole
+  forward traces to a single XLA program.
+- Parameter names follow a stable `layers.<i>.<block>.<w>` scheme so the
+  distributed engine (paddle_tpu.distributed) can apply Megatron-style
+  tensor-parallel sharding rules by name pattern (column-shard qkv/mlp-in,
+  row-shard proj/mlp-out, vocab-shard embedding).
+- Rotary or learned positions; pre-LN; GELU or SwiGLU MLP — covers the
+  GPT-3-1.3B and LLaMA-2 configs of BASELINE.md (configs 4, 5).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import nn
+from .. import ops
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 0            # 0 → = num_heads (MHA); >0 → GQA
+    intermediate_size: int = 0       # 0 → 4*hidden (gelu) or 8/3*hidden (swiglu)
+    max_position_embeddings: int = 1024
+    rope: bool = False               # rotary (LLaMA) vs learned positions (GPT)
+    rope_theta: float = 10000.0
+    swiglu: bool = False             # LLaMA MLP
+    rms_norm: bool = False           # LLaMA norm
+    tie_word_embeddings: bool = True
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_kv_heads == 0:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size == 0:
+            if self.swiglu:
+                # LLaMA sizing: 2/3 * 4h rounded to multiple of 128 (lane width)
+                self.intermediate_size = int(
+                    128 * math.ceil(8 * self.hidden_size / 3 / 128))
+            else:
+                self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# Named configs matching BASELINE.md workloads.
+CONFIGS = {
+    # test-size
+    "gpt_tiny": dict(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=128),
+    # ERNIE-3.0-base / BERT-base class decoder (north-star tokens/sec shape)
+    "gpt_base": dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_position_embeddings=1024),
+    # BASELINE config 4: GPT-3 1.3B
+    "gpt3_1p3b": dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                      num_heads=32, max_position_embeddings=2048),
+    # BASELINE config 5: LLaMA-2-7B
+    "llama2_7b": dict(vocab_size=32000, hidden_size=4096, num_layers=32,
+                      num_heads=32, intermediate_size=11008,
+                      max_position_embeddings=4096, rope=True, swiglu=True,
+                      rms_norm=True, tie_word_embeddings=False),
+}
+
+
+def _normal_attr(std):
+    return nn.ParamAttr(initializer=nn.initializer.Normal(0.0, std))
+
+
+def _make_norm(cfg):
+    if cfg.rms_norm:
+        return nn.RMSNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+    return nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+
+class GPTAttention(nn.Layer):
+    """Fused-QKV causal self-attention (flash-attention path)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, hd = cfg.hidden_size, cfg.head_dim
+        q_out = cfg.num_heads * hd
+        kv_out = cfg.num_kv_heads * hd
+        std = cfg.initializer_range
+        bias = not cfg.rms_norm  # LLaMA-style stacks drop biases
+        self.qkv_proj = nn.Linear(h, q_out + 2 * kv_out,
+                                  weight_attr=_normal_attr(std),
+                                  bias_attr=None if bias else False)
+        self.out_proj = nn.Linear(q_out, h,
+                                  weight_attr=_normal_attr(
+                                      std / math.sqrt(2 * cfg.num_layers)),
+                                  bias_attr=None if bias else False)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, position_ids=None):
+        cfg = self.cfg
+        b = x.shape[0]
+        s = x.shape[1]
+        hd = cfg.head_dim
+        qkv = self.qkv_proj(x)
+        q_sz = cfg.num_heads * hd
+        kv_sz = cfg.num_kv_heads * hd
+        q, k, v = ops.split(qkv, [q_sz, kv_sz, kv_sz], axis=-1)
+        q = ops.reshape(q, [b, s, cfg.num_heads, hd])
+        k = ops.reshape(k, [b, s, cfg.num_kv_heads, hd])
+        v = ops.reshape(v, [b, s, cfg.num_kv_heads, hd])
+        if cfg.rope:
+            q, k = F.apply_rotary_pos_emb(q, k, position_ids,
+                                          theta=cfg.rope_theta)
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        out, _ = F.flash_attention(q, k, v, dropout=cfg.dropout, causal=True,
+                                   training=self.training)
+        out = ops.reshape(out, [b, s, q_sz])
+        return self.dropout(self.out_proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        std = cfg.initializer_range
+        bias = not cfg.rms_norm
+        self.swiglu = cfg.swiglu
+        if cfg.swiglu:
+            # fused gate+up as one column-shardable matmul
+            self.gate_up_proj = nn.Linear(h, 2 * m,
+                                          weight_attr=_normal_attr(std),
+                                          bias_attr=False)
+        else:
+            self.up_proj = nn.Linear(h, m, weight_attr=_normal_attr(std),
+                                     bias_attr=None if bias else False)
+        self.down_proj = nn.Linear(m, h,
+                                   weight_attr=_normal_attr(
+                                       std / math.sqrt(2 * cfg.num_layers)),
+                                   bias_attr=None if bias else False)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        if self.swiglu:
+            gu = self.gate_up_proj(x)
+            gate, up = ops.chunk(gu, 2, axis=-1)
+            x = F.silu(gate) * up
+        else:
+            x = F.gelu(self.up_proj(x), approximate=True)
+        return self.dropout(self.down_proj(x))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = _make_norm(cfg)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = _make_norm(cfg)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x, position_ids=None):
+        x = x + self.attn(self.ln_1(x), position_ids)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    """Decoder-only LM trunk: embeddings + N blocks + final norm."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        std = cfg.initializer_range
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=_normal_attr(std))
+        if not cfg.rope:
+            self.wpe = nn.Embedding(cfg.max_position_embeddings,
+                                    cfg.hidden_size,
+                                    weight_attr=_normal_attr(std))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.layers = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = _make_norm(cfg)
+
+    def forward(self, input_ids, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.expand(
+                ops.unsqueeze(ops.arange(s, dtype="int32"), 0),
+                [input_ids.shape[0], s])
+        x = self.wte(input_ids)
+        if not self.cfg.rope:
+            x = x + self.wpe(position_ids)
+        x = self.drop(x)
+        for blk in self.layers:
+            x = blk(x, position_ids)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head on the trunk; `forward` returns logits, `loss` the next-token
+    cross entropy (labels shifted internally)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.transformer = GPTModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     weight_attr=_normal_attr(
+                                         cfg.initializer_range),
+                                     bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.transformer(input_ids, position_ids)
+        if self.lm_head is None:
+            return ops.matmul(hidden, self.transformer.wte.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
+    def loss(self, input_ids, labels=None, position_ids=None):
+        """Causal LM loss. labels defaults to input_ids (shift happens here)."""
+        if labels is None:
+            labels = input_ids
+        logits = self.forward(input_ids, position_ids)
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            ops.reshape(shift_logits, [-1, self.cfg.vocab_size]),
+            ops.reshape(shift_labels, [-1]),
+            reduction="mean")
+
+
+def gpt(name="gpt_base", **overrides):
+    d = dict(CONFIGS[name])
+    d.update(overrides)
+    return GPTForCausalLM(GPTConfig(**d))
+
+
+def flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (fwd+bwd ≈ 6*N + attention term) for
+    MFU accounting (BASELINE.md north-star)."""
+    n_params = (
+        cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_word_embeddings else 2)
+        + cfg.num_layers * (
+            cfg.hidden_size * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+            + cfg.num_heads * cfg.head_dim * cfg.hidden_size
+            + cfg.hidden_size * cfg.intermediate_size * (3 if cfg.swiglu else 2)))
+    attn = 12 * cfg.num_layers * cfg.hidden_size * seq_len
+    return 6.0 * n_params + attn

@@ -28,5 +28,5 @@ from .loss import (  # noqa: F401
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
-    sparse_attention,
+    sparse_attention, apply_rotary_pos_emb,
 )

@@ -109,3 +109,32 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
     raise NotImplementedError(
         "sparse_attention: use paddle_tpu.ops.pallas block-sparse attention")
+
+
+def _rope_impl(q, k, pos, *, theta):
+    # q [B,S,Hq,D], k [B,S,Hk,D], pos [B,S] int. Half-split rotation (LLaMA
+    # convention; reference fused kernel: phi/kernels/fusion/gpu/
+    # fused_rope_kernel.cu). All trig is computed in fp32 then cast back.
+    d = q.shape[-1]
+    half = d // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = pos.astype(jnp.float32)[..., None] * inv_freq  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        xf1 = x1.astype(jnp.float32)
+        xf2 = x2.astype(jnp.float32)
+        r1 = xf1 * cos - xf2 * sin
+        r2 = xf2 * cos + xf1 * sin
+        return jnp.concatenate([r1, r2], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def apply_rotary_pos_emb(q, k, position_ids, theta=10000.0):
+    """Rotary position embedding on [B,S,H,D] q/k (reference:
+    paddle.incubate.nn.functional.fused_rotary_position_embedding)."""
+    return apply("rope", _rope_impl, (wrap(q), wrap(k), wrap(position_ids)),
+                 {"theta": float(theta)})
