@@ -1,0 +1,86 @@
+"""Benchmark gate: flagship GPT (ERNIE-3.0-base-class) pretrain step
+throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+The reference publishes no in-tree numbers (BASELINE.md) — `vs_baseline` is
+measured against an MFU-derived NCCL/GPU-class target: the north-star asks
+for >=40% MFU; we report our measured MFU fraction relative to that target
+(vs_baseline = our_MFU / 0.40), so >1.0 beats the reference target.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.gpt import GPTConfig, CONFIGS, flops_per_token
+
+    # one-chip bench (the driver runs on a single real TPU chip)
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" or "TPU" in str(dev.device_kind)
+
+    name = os.environ.get("BENCH_MODEL", "gpt_base")
+    seq_len = int(os.environ.get("BENCH_SEQLEN", "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
+    if not on_tpu:  # CPU smoke: shrink
+        name = os.environ.get("BENCH_MODEL", "gpt_tiny")
+        seq_len = min(seq_len, 128)
+
+    paddle.seed(0)
+    model = gpt(name, max_position_embeddings=max(
+        seq_len, CONFIGS[name].get("max_position_embeddings", seq_len)))
+    cfg = model.cfg
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    mesh = dist.build_mesh(dp=-1, devices=jax.devices()[:1])
+    eng = dist.parallelize(model, opt, mesh=mesh,
+                           compute_dtype="bfloat16" if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype("int32"))
+
+    # warmup (compile); host readback is the only reliable fence through
+    # the PJRT relay (block_until_ready can return at enqueue time)
+    float(eng.train_batch(ids))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = eng.train_batch(ids)
+    final_loss = float(loss)  # device->host readback fences the whole chain
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq_len * steps
+    tps = tokens / dt
+
+    flops_tok = flops_per_token(cfg, seq_len)
+    # v5e peak bf16: 197 TFLOP/s; CPU has no meaningful peak — report 0 MFU
+    peak = 197e12 if on_tpu else float("inf")
+    mfu = tps * flops_tok / peak
+    vs_baseline = mfu / 0.40 if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": f"{name} pretrain tokens/sec/chip (seq={seq_len}, bs={batch}, bf16)",
+        "value": round(tps, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
+                  "steps": steps, "platform": dev.platform},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

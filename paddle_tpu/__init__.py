@@ -41,6 +41,9 @@ from .device import (  # noqa: E402
 )
 
 from .nn.layer.common import ParamAttr  # noqa: E402
+from . import distributed  # noqa: E402
+from . import models  # noqa: E402
+from .distributed.data_parallel import DataParallel  # noqa: E402
 
 
 def disable_static(place=None):

@@ -1,0 +1,33 @@
+"""paddle_tpu.distributed — hybrid-parallel training over TPU meshes.
+
+Mirrors the reference surface (python/paddle/distributed/, SURVEY.md §2.4-2.5)
+re-designed for the TPU execution model: mesh axes replace process groups,
+GSPMD-compiled collectives replace NCCL calls, and one jitted train step
+replaces the eager reducer/sharding/pipeline wrapper stack.
+"""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, build_mesh, get_mesh,
+    set_hybrid_communicate_group, get_hybrid_communicate_group, AXES,
+)
+from .parallel_mode import ParallelMode  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, all_reduce, all_gather, broadcast,
+    reduce_scatter, all_to_all, scatter, barrier, get_group,
+)
+from .data_parallel import DataParallel  # noqa: F401
+from .engine import ShardedTrainStep, parallelize  # noqa: F401
+from .sharding_spec import (  # noqa: F401
+    shard_params, shard_constraint, spec_for_param, DEFAULT_TP_RULES,
+)
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from . import functional  # noqa: F401
+from . import fleet  # noqa: F401

@@ -1,0 +1,77 @@
+"""Eager DataParallel wrapper.
+
+Reference: `paddle.DataParallel` (python/paddle/distributed/parallel.py:202)
++ EagerReducer bucketed allreduce (collective/reducer.cc). TPU-native: no
+reducer exists — parameters are placed *replicated* over the data axes and
+inputs arrive batch-sharded; every eager jitted op then runs SPMD and the
+backward tape's compiled VJPs produce already-reduced (replicated) parameter
+grads. `no_sync` is accepted for parity (grad sync is part of the compiled
+program, and grad accumulation over micro-batches composes the same way).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import topology as topo_mod
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        hcg = topo_mod.get_hybrid_communicate_group()
+        if hcg is None:
+            hcg = topo_mod.HybridCommunicateGroup(
+                mesh=topo_mod.build_mesh(dp=-1))
+            topo_mod.set_hybrid_communicate_group(hcg)
+        self.mesh = hcg.mesh
+        # replicate params across all axes (pure DP)
+        for _, p in layers.named_parameters():
+            p._value = jax.device_put(
+                p._value, NamedSharding(self.mesh, P(*([None] * p.ndim))))
+        for _, b in layers.named_buffers():
+            if isinstance(b, Tensor):
+                b._value = jax.device_put(
+                    b._value, NamedSharding(self.mesh, P(*([None] * b.ndim))))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*self.scatter(inputs), **kwargs)
+
+    def scatter(self, inputs):
+        """Shard batch dim over the data axes (the DataLoader feed step of
+        the reference's per-rank processes)."""
+        out = []
+        for x in inputs:
+            if isinstance(x, Tensor) and x.ndim > 0 and \
+                    x.shape[0] % (self.mesh.shape["dp"] * self.mesh.shape["sharding"]) == 0:
+                spec = [("dp", "sharding")] + [None] * (x.ndim - 1)
+                out.append(Tensor(jax.device_put(
+                    x._value, NamedSharding(self.mesh, P(*spec))),
+                    stop_gradient=x.stop_gradient))
+            else:
+                out.append(x)
+        return out
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

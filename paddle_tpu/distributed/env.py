@@ -1,0 +1,91 @@
+"""Distributed environment bootstrap.
+
+Reference analog: `paddle.distributed.init_parallel_env`
+(python/paddle/distributed/parallel.py:943) which builds a TCPStore +
+ProcessGroupNCCL per rank. TPU-native: one *controller process per host*
+drives all local chips through PJRT; multi-host jobs bootstrap through
+jax.distributed's coordination service (the TCPStore equivalent) and then
+every collective is compiled into XLA programs over ICI/DCN — there are no
+explicit process groups to create.
+
+Rank/world-size semantics: `get_rank`/`get_world_size` report *process*
+(host) coordinates, matching the launcher's view; device-level parallelism
+coordinates live on the hybrid topology (topology.py) over the global device
+mesh.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv (parallel.py)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def device_count(self):
+        return jax.device_count()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+
+def init_parallel_env():
+    """Initialize multi-host coordination if launcher env is present.
+
+    The launcher (paddle_tpu.distributed.launch) sets
+    PADDLE_TPU_COORDINATOR / PADDLE_TPU_NUM_PROCESSES / PADDLE_TPU_PROCESS_ID
+    (≈ reference PADDLE_TRAINER_* env, parallel.py:943). Single-host runs
+    need no bootstrap: all chips are already addressable via PJRT.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    # Check env BEFORE any jax call: jax.distributed.initialize must run
+    # before the XLA backend initializes (probing process_count() would
+    # initialize it and make multi-host bootstrap impossible).
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["PADDLE_TPU_NUM_PROCESSES"]),
+            process_id=int(os.environ["PADDLE_TPU_PROCESS_ID"]),
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()

@@ -1,0 +1,86 @@
+"""Fleet facade.
+
+Reference: `paddle.distributed.fleet` — `fleet.init` (fleet/fleet.py:167),
+`DistributedStrategy` (fleet/base/distributed_strategy.py:175),
+`distributed_model` (fleet/model.py:32), `distributed_optimizer`
+(hybrid_parallel_optimizer.py:254).
+
+TPU-native: init builds the hybrid Mesh from `hybrid_configs`;
+distributed_model/distributed_optimizer return the pieces the jitted
+engine path uses (or a thin eager DataParallel for pure-DP eager use).
+"""
+from __future__ import annotations
+
+from ..env import init_parallel_env, get_rank, get_world_size
+from ..topology import (
+    HybridCommunicateGroup, CommunicateTopology,
+    set_hybrid_communicate_group, get_hybrid_communicate_group, build_mesh,
+)
+from ..engine import ShardedTrainStep, parallelize
+from ..data_parallel import DataParallel
+from ..random import get_rng_state_tracker, model_parallel_random_seed
+from .distributed_strategy import DistributedStrategy
+
+_fleet_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """Reference: fleet.init (fleet/fleet.py:167)."""
+    strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    hcg = HybridCommunicateGroup(strategy=strategy)
+    set_hybrid_communicate_group(hcg)
+    _fleet_state.update(strategy=strategy, hcg=hcg, initialized=True)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group_():
+    return _fleet_state["hcg"]
+
+
+# surface parity: fleet.get_hybrid_communicate_group()
+def _get_hcg():
+    return _fleet_state["hcg"] or get_hybrid_communicate_group()
+
+
+get_hybrid_communicate_group = _get_hcg
+
+
+def distributed_model(model):
+    """Reference: fleet/model.py:32 — picks the wrapper by parallel mode.
+    On the mesh build, TP/sharding placement happens via sharding specs
+    (sharding_spec.shard_params is applied by ShardedTrainStep /
+    parallelize); the eager wrapper is only needed for pure data parallel."""
+    hcg = _get_hcg()
+    if hcg is None:
+        raise RuntimeError("call fleet.init first")
+    from ..sharding_spec import shard_params
+    if hcg.get_model_parallel_world_size() > 1 or \
+            hcg.get_sharding_parallel_world_size() > 1:
+        stage = (_fleet_state["strategy"].hybrid_configs
+                 .get("sharding_stage", 1)
+                 if _fleet_state["strategy"] else 1)
+        shard_params(model, hcg.mesh,
+                     sharding_stage=stage
+                     if hcg.get_sharding_parallel_world_size() > 1 else 0)
+        return model
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference: fleet.distributed_optimizer → HybridParallelOptimizer.
+    The jitted engine handles cross-axis grad sync/clip inside the compiled
+    step, so the optimizer passes through unchanged."""
+    return optimizer
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()

@@ -1,0 +1,33 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py:175 —
+a protobuf of per-feature sub-configs). Plain attrs here; consumed by
+fleet.init (hybrid_configs → mesh degrees) and the engine (amp/sharding/
+recompute knobs)."""
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": -1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sep_degree": 1,
+            "sharding_degree": 1,
+            "sharding_stage": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                            "custom_white_list": [], "custom_black_list": []}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.fuse_all_reduce_ops = True  # XLA always fuses; parity knob
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"

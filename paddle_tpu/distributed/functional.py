@@ -1,0 +1,113 @@
+"""In-program collective primitives + layer functionalization.
+
+The reference's static-graph collective surface is 110 `c_*` ops
+(paddle/fluid/operators/collective/). On TPU those are the XLA HLO
+collectives; this module gives them Paddle-flavored names for use inside
+shard_map/pjit-traced code, plus `functionalize`, which turns an eager
+nn.Layer into a pure JAX function over its parameter/buffer pytrees (the
+building block of the parallel train-step engine)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad
+
+# ---------------------------------------------------------------------------
+# Collective primitives (usable inside shard_map bodies).
+# ---------------------------------------------------------------------------
+
+psum = jax.lax.psum
+pmax = jax.lax.pmax
+pmin = jax.lax.pmin
+pmean = jax.lax.pmean
+ppermute = jax.lax.ppermute
+axis_index = jax.lax.axis_index
+psum_scatter = jax.lax.psum_scatter
+
+
+def all_gather_axis(x, axis_name, *, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all_axis(x, axis_name, split_axis, concat_axis, *, tiled=True):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
+def ring_permute(x, axis_name, shift=1):
+    """Rotate shards around the axis ring (ppermute on the ICI torus)."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+# ---------------------------------------------------------------------------
+# Layer functionalization.
+# ---------------------------------------------------------------------------
+
+
+def param_tree(layer):
+    """OrderedDict name -> Parameter (trainables), name -> buffer Tensors."""
+    params = OrderedDict()
+    for name, p in layer.named_parameters():
+        params[name] = p
+    buffers = OrderedDict()
+    for name, b in layer.named_buffers():
+        if isinstance(b, Tensor):
+            buffers[name] = b
+    return params, buffers
+
+
+def functionalize(layer, method=None):
+    """Return (apply_fn, params, buffers).
+
+    apply_fn(param_vals: dict, buffer_vals: dict, *args, **kwargs)
+        -> (outputs_pytree_of_arrays, new_buffer_vals)
+
+    It is pure and jax-traceable: it temporarily swaps the given values into
+    the live Layer objects, runs the Python forward (all ops trace through
+    the jnp impls since inputs are tracers), and restores. RNG inside (e.g.
+    dropout) must be provided by the caller pushing a trace key
+    (ops.random.push_trace_key) — the engine does this.
+    """
+    params, buffers = param_tree(layer)
+    fn = method if method is not None else layer.forward
+    # a bound method named string
+    if isinstance(method, str):
+        fn = getattr(layer, method)
+
+    def apply_fn(param_vals, buffer_vals, *args, **kwargs):
+        holders = list(params.items()) + list(buffers.items())
+        saved = [(h, h._value, h._grad_node, h._out_idx) for _, h in holders]
+        try:
+            for name, p in params.items():
+                p._value = param_vals[name]
+                p._grad_node = None
+            for name, b in buffers.items():
+                b._value = buffer_vals[name]
+                b._grad_node = None
+            with no_grad():
+                out = _to_arrays(fn(*args, **kwargs))
+            new_buf = {name: b._value for name, b in buffers.items()}
+            return out, new_buf
+        finally:
+            for (_, h), (h2, v, n, oi) in zip(holders, saved):
+                h._value = v
+                h._grad_node = n
+                h._out_idx = oi
+
+    return apply_fn, params, buffers
+
+
+def _to_arrays(obj):
+    if isinstance(obj, Tensor):
+        return obj._value
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_arrays(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_arrays(v) for k, v in obj.items()}
+    return obj

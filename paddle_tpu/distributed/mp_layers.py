@@ -1,0 +1,129 @@
+"""Tensor-(model-)parallel layers.
+
+Reference analog: fleet/layers/mpu/mp_layers.py — `VocabParallelEmbedding`
+(:47), `ColumnParallelLinear` (:333), `RowParallelLinear` (:540),
+`ParallelCrossEntropy` (:741), with hand-written identity/allreduce/
+split-concat comm ops (mpu/mp_ops.py).
+
+TPU-native redesign: each layer stores the FULL logical weight and attaches
+a `dist_spec` (PartitionSpec over the 'mp' mesh axis). When fleet/the engine
+places parameters (sharding_spec.shard_params / device_put), the weight
+physically shards across the mp ring; the forward is ordinary dense math
+plus sharding *constraints* — GSPMD inserts exactly the all-reduce /
+all-gather the reference codes by hand, fused into the surrounding matmuls.
+No special backward is needed: differentiating through a constraint yields
+the dual collective (identity↔psum), the same pairing mp_ops.py implements
+manually.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from .. import ops
+from .sharding_spec import shard_constraint
+
+
+class ColumnParallelLinear(nn.Layer):
+    """y = x @ W[:, shard] (+b). Weight [in, out] column-sharded over mp."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.linear.weight.dist_spec = P(None, "mp")
+        self.linear.weight.is_distributed = True
+        if self.linear.bias is not None:
+            self.linear.bias.dist_spec = P("mp")
+            self.linear.bias.is_distributed = True
+        self.gather_output = gather_output
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        # replicate input along mp (the reference's _c_identity), compute,
+        # leave output mp-sharded on the feature dim unless gather_output.
+        y = self.linear(x)
+        ndim = y.ndim
+        if self.gather_output:
+            y = shard_constraint(y, *([None] * ndim))
+        else:
+            y = shard_constraint(y, *([None] * (ndim - 1) + ["mp"]))
+        return y
+
+
+class RowParallelLinear(nn.Layer):
+    """y = sum_over_shards(x_shard @ W[shard, :]) (+b). Weight [in, out]
+    row-sharded; input expected feature-sharded when input_is_parallel."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.linear.weight.dist_spec = P("mp", None)
+        self.linear.weight.is_distributed = True
+        self.input_is_parallel = input_is_parallel
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_constraint(x, *([None] * (x.ndim - 1) + ["mp"]))
+        y = self.linear(x)
+        # contraction over the sharded dim leaves a partial sum; constraining
+        # the output replicated forces the psum (reference: mp_allreduce).
+        return shard_constraint(y, *([None] * y.ndim))
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Embedding with the vocab dim sharded over mp (mp_layers.py:47)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.embedding = nn.Embedding(num_embeddings, embedding_dim,
+                                      weight_attr=weight_attr)
+        self.embedding.weight.dist_spec = P("mp", None)
+        self.embedding.weight.is_distributed = True
+
+    @property
+    def weight(self):
+        return self.embedding.weight
+
+    def forward(self, x):
+        y = self.embedding(x)
+        return shard_constraint(y, *([None] * y.ndim))
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Cross entropy over mp-sharded vocab logits (mp_layers.py:741). The
+    log-sum-exp over the sharded class dim compiles to an mp psum."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        input = shard_constraint(
+            input, *([None] * (input.ndim - 1) + ["mp"]))
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

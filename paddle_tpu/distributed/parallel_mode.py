@@ -1,0 +1,9 @@
+"""Reference: ParallelMode enum (fleet/base/topology.py:40)."""
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4

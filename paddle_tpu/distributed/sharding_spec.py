@@ -1,0 +1,122 @@
+"""Parameter/activation sharding specs and rules.
+
+Reference analog: the SPMD rules + TensorDistAttr machinery
+(paddle/phi/infermeta/spmd_rules/, paddle/phi/core/distributed/auto_parallel/
+dist_attr.h) that annotate every tensor with a placements vector. On TPU the
+propagation engine is GSPMD inside XLA; our job is only to pin the *sources*:
+parameter shardings (by layer type or by name pattern) and batch shardings.
+GSPMD then inserts the collectives the reference's reshard functions
+implement by hand.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+
+# Megatron-style tensor-parallel rules for transformer parameter names
+# (matches paddle_tpu.models.gpt naming; users can pass their own table).
+# column-parallel: output dim sharded; row-parallel: input dim sharded;
+# vocab-parallel embedding: row (vocab) dim sharded.
+DEFAULT_TP_RULES = [
+    (r".*\b(qkv_proj|gate_up_proj|up_proj|q_proj|k_proj|v_proj|gate_proj|fc1)\.weight$", P(None, "mp")),
+    (r".*\b(qkv_proj|gate_up_proj|up_proj|q_proj|k_proj|v_proj|gate_proj|fc1)\.bias$", P("mp")),
+    (r".*\b(out_proj|down_proj|o_proj|fc2)\.weight$", P("mp", None)),
+    (r".*\b(wte|embed_tokens|word_embeddings)\.weight$", P("mp", None)),
+    (r".*\blm_head\.weight$", P(None, "mp")),
+]
+
+
+def spec_for_param(name, param, rules=None, *, sharding_stage=0,
+                   mesh=None):
+    """Compute the NamedSharding spec for one parameter.
+
+    Priority: explicit `param.dist_spec` (set by mp_layers) > name-pattern
+    rules > replicated. If sharding_stage == 3, additionally shard the
+    largest still-unsharded dim over the 'sharding' axis (ZeRO-3 param
+    sharding ≈ GroupShardedStage3, group_sharded_stage3.py:85)."""
+    spec = getattr(param, "dist_spec", None)
+    if spec is None and rules:
+        for pat, s in rules:
+            if re.match(pat, name):
+                spec = s
+                break
+    entries = list(spec) if spec is not None else [None] * param.ndim
+    while len(entries) < param.ndim:
+        entries.append(None)
+    if sharding_stage >= 3 and mesh is not None and mesh.shape.get("sharding", 1) > 1:
+        n_shard = mesh.shape["sharding"]
+        # biggest free dim divisible by the axis size
+        cand = sorted(
+            (i for i, e in enumerate(entries) if e is None),
+            key=lambda i: -param.shape[i])
+        for i in cand:
+            if param.shape[i] % n_shard == 0:
+                entries[i] = "sharding"
+                break
+    return P(*entries)
+
+
+def opt_state_spec(param_spec, param_shape, mesh, *, sharding_stage=0):
+    """Sharding for per-param optimizer slots (ZeRO stage >= 1 shards them
+    over the sharding axis — reference DygraphShardingOptimizer
+    dygraph_sharding_optimizer.py:48 / stage2 group_sharded_optimizer_stage2
+    .py:53)."""
+    entries = list(param_spec)
+    while len(entries) < len(param_shape):
+        entries.append(None)
+    if sharding_stage >= 1 and mesh is not None and mesh.shape.get("sharding", 1) > 1:
+        n_shard = mesh.shape["sharding"]
+        if not any(e == "sharding" or (isinstance(e, tuple) and "sharding" in e)
+                   for e in entries):
+            cand = sorted(
+                (i for i, e in enumerate(entries) if e is None),
+                key=lambda i: -param_shape[i])
+            for i in cand:
+                if param_shape[i] % n_shard == 0:
+                    entries[i] = "sharding"
+                    break
+    return P(*entries)
+
+
+def shard_params(layer, mesh, rules=None, *, sharding_stage=0):
+    """Eagerly place every parameter/buffer of `layer` on the mesh with its
+    computed sharding (device_put — this is the moment memory actually
+    distributes, ≈ TensorParallel wrapper broadcasting/splitting params,
+    meta_parallel/tensor_parallel.py)."""
+    rules = DEFAULT_TP_RULES if rules is None else rules
+    specs = {}
+    for name, p in layer.named_parameters():
+        spec = spec_for_param(name, p, rules, sharding_stage=sharding_stage,
+                              mesh=mesh)
+        specs[name] = spec
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    for name, b in layer.named_buffers():
+        if isinstance(b, Tensor):
+            b._value = jax.device_put(
+                b._value, NamedSharding(mesh, P(*([None] * b.ndim))))
+    return specs
+
+
+def shard_constraint(x, *entries):
+    """with_sharding_constraint usable on eager Tensors inside traced code;
+    outside a trace it's an eager device_put when a mesh is active (the
+    reshard of auto_parallel/api.py:282)."""
+    from . import topology as topo_mod
+    mesh = topo_mod.get_mesh()
+    if mesh is None:
+        return x
+    spec = P(*entries)
+    if isinstance(x, Tensor):
+        v = x._value
+        if isinstance(v, jax.core.Tracer):
+            return Tensor(jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, spec)))
+        return Tensor(jax.device_put(v, NamedSharding(mesh, spec)))
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.device_put(x, NamedSharding(mesh, spec))

@@ -1,0 +1,225 @@
+"""Hybrid-parallel topology over a jax.sharding.Mesh.
+
+Reference analog: `CommunicateTopology` / `HybridCommunicateGroup`
+(python/paddle/distributed/fleet/base/topology.py:61,174): an N-D rank grid
+over axes ["data","pipe","sharding","sep","model"], with a comm group
+(NCCL communicator) built per axis slice.
+
+TPU-native redesign: the grid IS a `jax.sharding.Mesh` with named axes.
+There are no comm groups to construct — a "group" is a mesh axis name, and
+collectives along it are compiled by XLA onto the ICI torus. Axis order is
+chosen so that the most communication-intensive axes ("mp", then "sep") are
+innermost/minor, which maps them onto the shortest ICI rings; "dp" and "pp"
+take the outer (possibly DCN-spanning) dimensions.
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+# Mesh axis names, outermost → innermost.
+AXES = ("dp", "pp", "sharding", "sep", "mp")
+# Reference naming (topology.py:64) → ours.
+_REF_TO_AXIS = {
+    "data": "dp", "pipe": "pp", "sharding": "sharding",
+    "sep": "sep", "model": "mp",
+}
+
+
+class CommunicateTopology:
+    """N-D coordinate bookkeeping (reference: topology.py:61). Kept for API
+    parity; coordinates index *devices* of the global mesh."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        names = hybrid_group_names or ["data", "pipe", "sharding", "sep", "model"]
+        dims = dims or [1] * len(names)
+        self._parallel_names = list(names)
+        self._dims = list(dims)
+        self.coordinate = OrderedDict(zip(names, dims))
+        self._world = int(np.prod(dims))
+        self._rank2coord = {}
+        self._coord2rank = {}
+        for r in range(self._world):
+            c = np.unravel_index(r, dims)
+            self._rank2coord[r] = tuple(int(x) for x in c)
+            self._coord2rank[tuple(int(x) for x in c)] = r
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self.coordinate[axis_name]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All global ranks whose coordinate along axis_name == index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._rank2coord.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups, one per slice along axis_name."""
+        axis = self._parallel_names.index(axis_name)
+        groups = {}
+        for r, c in self._rank2coord.items():
+            key = c[:axis] + c[axis + 1:]
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None):
+    """Create the hybrid Mesh. Degrees with value -1 absorb the remaining
+    devices (dp by convention, matching fleet's auto dp_degree)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    degrees = {"dp": dp, "pp": pp, "sharding": sharding, "sep": sep, "mp": mp}
+    fixed = int(np.prod([d for d in degrees.values() if d > 0]))
+    for k, v in degrees.items():
+        if v in (0, -1, None):
+            degrees[k] = n // fixed
+            break
+    total = int(np.prod(list(degrees.values())))
+    if total < n:
+        devices = devices[:total]  # explicit degrees may use a device subset
+    elif total > n:
+        raise ValueError(
+            f"mesh degrees {degrees} require {total} devices, have {n}")
+    shape = [degrees[a] for a in AXES]
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:174. Owns the Mesh; per-axis 'groups' are the
+    mesh axes themselves. World sizes/ranks answer device-level coordinates
+    for the first addressable device (per-shard code inside shard_map gets
+    its own coordinates from jax.lax.axis_index)."""
+
+    def __init__(self, topology=None, *, strategy=None, mesh=None):
+        if mesh is not None:
+            self._mesh = mesh
+        elif topology is not None:
+            dims = {_REF_TO_AXIS[n]: topology.get_dim(n)
+                    for n in topology.get_hybrid_group_names()}
+            self._mesh = build_mesh(**dims)
+        else:
+            cfg = (strategy.hybrid_configs if strategy is not None else {})
+            self._mesh = build_mesh(
+                dp=cfg.get("dp_degree", -1),
+                pp=cfg.get("pp_degree", 1),
+                sharding=cfg.get("sharding_degree", 1),
+                sep=cfg.get("sep_degree", 1),
+                mp=cfg.get("mp_degree", 1),
+            )
+        self._topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"],
+            [self._mesh.shape[a] for a in AXES])
+        self.global_rank = 0
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def axis_size(self, axis):
+        return self._mesh.shape[axis]
+
+    # -- parity surface (topology.py:250-400) ---------------------------
+    def get_parallel_mode(self):
+        from .parallel_mode import ParallelMode
+        if self.axis_size("pp") > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self.axis_size("mp") > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self.axis_size("sep") > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        if self.axis_size("sharding") > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def get_data_parallel_world_size(self):
+        return self.axis_size("dp")
+
+    def get_model_parallel_world_size(self):
+        return self.axis_size("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self.axis_size("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self.axis_size("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self.axis_size("sep")
+
+    def _axis_group(self, axis):
+        from .collective import Group
+        return Group(self._mesh, axis)
+
+    def get_data_parallel_group(self):
+        return self._axis_group("dp")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
+    # data-parallel coordinate of the current *process* — single-controller
+    # processes see rank 0; per-device ranks exist only inside shard_map.
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+
+_global_hcg = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _global_hcg
+    _global_hcg = hcg
+
+
+def get_hybrid_communicate_group():
+    return _global_hcg
+
+
+def get_mesh():
+    """Active hybrid mesh, or None when fleet/auto-parallel is not set up."""
+    return _global_hcg.mesh if _global_hcg is not None else None

@@ -1,0 +1,167 @@
+"""Pipeline-ready GPT: stacked (scan-over-layers) parameters.
+
+Reference analog: GPTForPretrainingPipe-style models built from
+`PipelineLayer` LayerDesc lists (fleet/meta_parallel/pp_layers.py:237).
+
+TPU-native redesign: instead of materializing one module per layer and
+partitioning modules across ranks, ALL transformer blocks share one set of
+parameter arrays with a leading layer dim [L, ...]:
+- pp=1: the forward is a `lax.scan` over L — O(1) compile time in depth.
+- pp>1: the leading dim is sharded over the 'pp' mesh axis and the forward
+  runs the compiled GPipe rotation (distributed.pipeline.spmd_pipeline)
+  with `ppermute` hops on the ICI ring.
+- Tensor-parallel composes: the per-layer weight dims carry 'mp' specs.
+Embeddings / final norm / tied lm-head live outside the pipelined region,
+replicated over pp (sharded over mp), exactly like the reference's shared
+embedding layers (SharedLayerDesc pp_layers.py:76).
+
+The whole loss is ONE tape op in eager mode and traces cleanly under the
+parallel engine.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..distributed import topology as topo_mod
+from ..distributed.pipeline import spmd_pipeline, microbatch, unmicrobatch
+from .gpt import GPTConfig, CONFIGS
+
+
+def _block_fn(x, lp, *, num_heads, eps):
+    """One pre-LN transformer block over per-layer params lp (dict of
+    arrays WITHOUT the layer dim)."""
+    b, s, h = x.shape
+    hd = h // num_heads
+
+    def ln(v, w, bias):
+        mu = v.mean(-1, keepdims=True)
+        var = ((v - mu) ** 2).mean(-1, keepdims=True)
+        return (v - mu) * jax.lax.rsqrt(var + eps) * w + bias
+
+    y = ln(x, lp["ln1_w"], lp["ln1_b"])
+    qkv = y @ lp["qkv_w"] + lp["qkv_b"]
+    # [Q|K|V] block layout — same as gpt.py's qkv_proj, so checkpoints can
+    # move between the per-layer and stacked models
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, num_heads, hd)
+    k = k.reshape(b, s, num_heads, hd)
+    v = v.reshape(b, s, num_heads, hd)
+    att = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    x = x + att.reshape(b, s, h) @ lp["out_w"] + lp["out_b"]
+    y = ln(x, lp["ln2_w"], lp["ln2_b"])
+    y = jax.nn.gelu(y @ lp["up_w"] + lp["up_b"], approximate=True)
+    x = x + y @ lp["down_w"] + lp["down_b"]
+    return x
+
+
+def _stage_fn(stage_params, x, *, num_heads, eps):
+    """Run this stage's K stacked layers (leading dim) via scan."""
+
+    def body(carry, lp):
+        return _block_fn(carry, lp, num_heads=num_heads, eps=eps), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+class GPTForCausalLMPipe(nn.Layer):
+    """Stacked-parameter causal LM; pipeline-parallel when mesh pp > 1."""
+
+    def __init__(self, cfg: GPTConfig, num_microbatches=1):
+        super().__init__()
+        self.cfg = cfg
+        self.num_microbatches = num_microbatches
+        std = cfg.initializer_range
+        L, H, V = cfg.num_layers, cfg.hidden_size, cfg.vocab_size
+        I = cfg.intermediate_size
+
+        def mk(shape, scale, spec):
+            p = self.create_parameter(
+                list(shape),
+                default_initializer=nn.initializer.Normal(0.0, scale))
+            p.dist_spec = P(*spec)
+            return p
+
+        self.wte = mk((V, H), std, ("mp", None))
+        self.wpe = mk((cfg.max_position_embeddings, H), std, (None, None))
+        # stacked block params — layer dim first, sharded over pp
+        pp = "pp"
+        self.qkv_w = mk((L, H, 3 * H), std, (pp, None, "mp"))
+        self.qkv_b = mk((L, 3 * H), 0.0, (pp, "mp"))
+        self.out_w = mk((L, H, H), std / math.sqrt(2 * L), (pp, "mp", None))
+        self.out_b = mk((L, H), 0.0, (pp, None))
+        self.up_w = mk((L, H, I), std, (pp, None, "mp"))
+        self.up_b = mk((L, I), 0.0, (pp, "mp"))
+        self.down_w = mk((L, I, H), std / math.sqrt(2 * L), (pp, "mp", None))
+        self.down_b = mk((L, H), 0.0, (pp, None))
+        self.ln1_w = mk((L, H), 0.0, (pp, None))
+        self.ln1_w._value = jnp.ones((L, H), jnp.float32)
+        self.ln1_b = mk((L, H), 0.0, (pp, None))
+        self.ln2_w = mk((L, H), 0.0, (pp, None))
+        self.ln2_w._value = jnp.ones((L, H), jnp.float32)
+        self.ln2_b = mk((L, H), 0.0, (pp, None))
+        self.lnf_w = mk((H,), 0.0, (None,))
+        self.lnf_w._value = jnp.ones((H,), jnp.float32)
+        self.lnf_b = mk((H,), 0.0, (None,))
+
+        self._stack_names = ["qkv_w", "qkv_b", "out_w", "out_b", "up_w",
+                             "up_b", "down_w", "down_b", "ln1_w", "ln1_b",
+                             "ln2_w", "ln2_b"]
+        # stable bound-method reference: the dispatch jit cache is keyed by
+        # callable identity, and `self._impl` would mint a fresh bound method
+        # (→ recompile) on every access
+        self._impl_fn = self._impl
+
+    def _impl(self, ids, labels, wte, wpe, lnf_w, lnf_b, *stack,
+              num_microbatches=1, mesh=None):
+        cfg = self.cfg
+        stack_params = dict(zip(self._stack_names, stack))
+        b, s = ids.shape
+        x = wte[ids] + wpe[:s][None]
+        stage = partial(_stage_fn, num_heads=cfg.num_heads,
+                        eps=cfg.layer_norm_epsilon)
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            xs = microbatch(x, num_microbatches)
+            out = spmd_pipeline(stage, stack_params, xs, mesh=mesh)
+            x = unmicrobatch(out)
+        else:
+            x = _stage_fn(stack_params, x,
+                          num_heads=cfg.num_heads,
+                          eps=cfg.layer_norm_epsilon)
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        x = (x - mu) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon) * lnf_w + lnf_b
+        logits = x @ wte.T
+        logits = logits[:, :-1].reshape(-1, cfg.vocab_size)
+        tgt = labels[:, 1:].reshape(-1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[:, None], axis=-1)
+        return nll.mean()
+
+    def loss(self, input_ids, labels=None):
+        if labels is None:
+            labels = input_ids
+        mesh = topo_mod.get_mesh()
+        args = [input_ids, labels, self.wte, self.wpe, self.lnf_w, self.lnf_b]
+        args += [getattr(self, n) for n in self._stack_names]
+        return apply("gpt_pipe_loss", self._impl_fn, args,
+                     {"num_microbatches": self.num_microbatches,
+                      "mesh": mesh})
+
+    def forward(self, input_ids):
+        return self.loss(input_ids)
+
+
+def gpt_pipe(name="gpt_tiny", num_microbatches=1, **overrides):
+    d = dict(CONFIGS[name])
+    d.update(overrides)
+    return GPTForCausalLMPipe(GPTConfig(**d), num_microbatches=num_microbatches)

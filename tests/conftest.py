@@ -3,7 +3,10 @@ distributed logic with single-host multi-process CPU/Gloo, SURVEY.md §4; we
 use XLA's host-platform device-count flag instead)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the machine environment pins JAX_PLATFORMS to
+# the real TPU tunnel, but unit tests must run on the virtual 8-device CPU
+# mesh for multi-chip coverage without multi-chip hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -14,4 +17,5 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
