@@ -1,0 +1,190 @@
+"""Context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+The reference has NO ring attention / Ulysses (SURVEY.md §5 "long-context":
+it only offers Megatron-style sequence parallel around TP blocks,
+fleet/utils/sequence_parallel_utils.py:230, and the `sep` hybrid-topology
+axis with model-level sequence splitting, fleet/base/topology.py:64,184).
+This module is the TPU-native long-context answer that *exceeds* the
+reference: sequence shards live on the `sep` mesh axis and
+
+- **ring attention** streams K/V blocks around the ICI ring with
+  `jax.lax.ppermute`, combining per-block partial attention with the
+  online-softmax (flash) recurrence, so peak memory is O(S_local) and the
+  ppermute overlaps with the block matmuls;
+- **Ulysses attention** trades sequence sharding for head sharding with two
+  `all_to_all`s, running dense flash attention on full sequences per head
+  group.
+
+Both run inside `jax.shard_map` regions nested in the engine's single jitted
+train step, composing with dp/sharding batch split and mp head split.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "context_parallel_attention",
+    "context_parallel_guard",
+    "active_context_parallel",
+]
+
+
+# ---------------------------------------------------------------------------
+# Local (inside-shard_map) bodies. q/k/v: [batch, seq_local, heads, head_dim].
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+    """Flash-style streaming attention over K/V blocks rotating on the ring.
+
+    Device p starts with its own K/V block; after t rotations it holds the
+    block originally owned by (p - t) mod n. Per block: masked scores →
+    online-softmax update of (o, m, l); K/V then hop one step around the
+    `axis_name` ring (ppermute — XLA maps this onto neighbouring ICI links).
+    """
+    n = jax.lax.psum(1, axis_name)
+    p = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [b,h,sq,d]
+    q_pos = p * s_loc + jnp.arange(s_loc)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_update(acc, k_blk, v_blk, src):
+        o, m, l = acc
+        kf = k_blk.astype(jnp.float32).transpose(0, 2, 1, 3)
+        vf = v_blk.astype(jnp.float32).transpose(0, 2, 1, 3)
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            s_ = jnp.where(mask, s_, -1e30)
+        m_new = jnp.maximum(m, s_.max(-1))
+        p_ = jnp.exp(s_ - m_new[..., None])
+        if causal:
+            p_ = p_ * mask  # robust when a whole row is masked (m_new=-1e30)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p_.sum(-1)
+        o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p_, vf)
+        return o, m_new, l
+
+    def body(t, carry):
+        acc, k_blk, v_blk = carry
+        # rotate first (n-1 hops total: the local t=0 block was consumed
+        # before the loop), then consume the block that arrived
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        acc = block_update(acc, k_blk, v_blk, (p - t) % n)
+        return acc, k_blk, v_blk
+
+    acc = (jnp.zeros((b, h, s_loc, d), jnp.float32),
+           jnp.full((b, h, s_loc), -1e30, jnp.float32),
+           jnp.zeros((b, h, s_loc), jnp.float32))
+    acc = block_update(acc, k, v, p)
+    (o, m, l), _, _ = jax.lax.fori_loop(1, n, body, (acc, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _ulysses_attention_local(q, k, v, *, axis_name, causal, scale):
+    """All-to-all head/sequence exchange: [b, S/n, h, d] -> [b, S, h/n, d],
+    dense flash attention on the full sequence per head group, then the
+    inverse exchange. One all_to_all pair per tensor — O(S·h·d/n) bytes on
+    ICI, independent of S² (the attention itself never crosses chips)."""
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    q = a2a(q, split_axis=2, concat_axis=1)
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    out = jax.nn.dot_product_attention(q, k, v, is_causal=causal, scale=scale)
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+# ---------------------------------------------------------------------------
+# shard_map wrappers.
+# ---------------------------------------------------------------------------
+
+
+def _cp_spec(mesh, seq_axis, batch_axes, head_axis):
+    batch = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
+    head = head_axis if (head_axis in mesh.shape and mesh.shape[head_axis] > 1) else None
+    return P(batch if batch else None, seq_axis, head, None)
+
+
+def context_parallel_attention(q, k, v, mesh, *, mode="ring", seq_axis="sep",
+                               causal=True, scale=None,
+                               batch_axes=("dp", "sharding"), head_axis="mp"):
+    """Sequence-sharded self-attention over `seq_axis` of `mesh`.
+
+    q/k/v: [batch, seq, heads, head_dim] global arrays (or tracers inside a
+    jit using `mesh`); seq must divide by mesh.shape[seq_axis]; with
+    mode="ulysses", local heads must also divide by it.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mode == "ring":
+        body = partial(_ring_attention_local, axis_name=seq_axis,
+                       causal=causal, scale=scale)
+    elif mode == "ulysses":
+        body = partial(_ulysses_attention_local, axis_name=seq_axis,
+                       causal=causal, scale=scale)
+    else:
+        raise ValueError(f"unknown context-parallel mode {mode!r}")
+    spec = _cp_spec(mesh, seq_axis, batch_axes, head_axis)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, mesh, *, seq_axis="sep", causal=True, scale=None,
+                   batch_axes=("dp", "sharding"), head_axis="mp"):
+    """Ring attention (ppermute K/V rotation + online softmax)."""
+    return context_parallel_attention(
+        q, k, v, mesh, mode="ring", seq_axis=seq_axis, causal=causal,
+        scale=scale, batch_axes=batch_axes, head_axis=head_axis)
+
+
+def ulysses_attention(q, k, v, mesh, *, seq_axis="sep", causal=True,
+                      scale=None, batch_axes=("dp", "sharding"),
+                      head_axis="mp"):
+    """Ulysses all-to-all sequence/head-parallel attention."""
+    return context_parallel_attention(
+        q, k, v, mesh, mode="ulysses", seq_axis=seq_axis, causal=causal,
+        scale=scale, batch_axes=batch_axes, head_axis=head_axis)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time routing state: the engine enables this around its traced loss so
+# model-level `F.scaled_dot_product_attention` calls transparently become
+# context-parallel when the mesh has a sep axis > 1.
+# ---------------------------------------------------------------------------
+
+
+class _CPState(threading.local):
+    def __init__(self):
+        self.config = None  # (mesh, mode, seq_axis)
+
+
+_cp_state = _CPState()
+
+
+def active_context_parallel():
+    """(mesh, mode, seq_axis) if a context_parallel_guard is active."""
+    return _cp_state.config
+
+
+@contextlib.contextmanager
+def context_parallel_guard(mesh, mode="ring", seq_axis="sep"):
+    prev = _cp_state.config
+    _cp_state.config = (mesh, mode, seq_axis)
+    try:
+        yield
+    finally:
+        _cp_state.config = prev

@@ -68,7 +68,7 @@ class ShardedTrainStep:
 
     def __init__(self, model, optimizer, loss_fn=None, hcg=None,
                  sharding_stage=0, rules=None, compute_dtype=None,
-                 batch_spec=None, donate=True):
+                 batch_spec=None, donate=True, context_parallel="ring"):
         self.model = model
         self.optimizer = optimizer
         self.hcg = hcg or topo_mod.get_hybrid_communicate_group()
@@ -82,6 +82,9 @@ class ShardedTrainStep:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self.donate = donate
+        # context-parallel attention over the sep axis ("ring" | "ulysses" |
+        # None); model-level sdpa calls reroute inside the traced step.
+        self.context_parallel = context_parallel
 
         if loss_fn is None:
             if not hasattr(model, "loss"):
@@ -138,6 +141,15 @@ class ShardedTrainStep:
         self._step_count = 0
 
     # ------------------------------------------------------------------
+    def _cp_guard(self):
+        """Context manager enabling context-parallel attention during trace
+        (no-op when sep == 1 or context_parallel=None)."""
+        import contextlib
+        if not self.context_parallel or self.mesh.shape["sep"] <= 1:
+            return contextlib.nullcontext()
+        from .context_parallel import context_parallel_guard
+        return context_parallel_guard(self.mesh, mode=self.context_parallel)
+
     def _build_step(self, batch_avals):
         mesh = self.mesh
         apply_fn = self._apply
@@ -145,14 +157,17 @@ class ShardedTrainStep:
         clip = getattr(opt, "_grad_clip", None)
         compute_dtype = self.compute_dtype
 
+        cp_guard = self._cp_guard
+
         def loss_of(params, buffers, batch, key):
             if compute_dtype is not None:
                 params = {n: (v.astype(compute_dtype) if _is_float(v) else v)
                           for n, v in params.items()}
             rng_mod.push_trace_key(key)
             try:
-                loss, new_buf = apply_fn(params, buffers, *[
-                    Tensor(b) for b in batch])
+                with cp_guard():
+                    loss, new_buf = apply_fn(params, buffers, *[
+                        Tensor(b) for b in batch])
             finally:
                 rng_mod.pop_trace_key()
             return loss, new_buf
@@ -241,14 +256,17 @@ class ShardedTrainStep:
             apply_fn = self._apply
             compute_dtype = self.compute_dtype
 
+            cp_guard = self._cp_guard
+
             def ev(params, buffers, batch, key):
                 if compute_dtype is not None:
                     params = {n: (v.astype(compute_dtype) if _is_float(v)
                                   else v) for n, v in params.items()}
                 rng_mod.push_trace_key(key)
                 try:
-                    loss, _ = apply_fn(params, buffers,
-                                       *[Tensor(b) for b in batch])
+                    with cp_guard():
+                        loss, _ = apply_fn(params, buffers,
+                                           *[Tensor(b) for b in batch])
                 finally:
                     rng_mod.pop_trace_key()
                 return loss
@@ -267,7 +285,8 @@ class ShardedTrainStep:
 
 
 def parallelize(model, optimizer=None, loss_fn=None, *, mesh=None,
-                sharding_stage=0, rules=None, compute_dtype=None):
+                sharding_stage=0, rules=None, compute_dtype=None,
+                context_parallel="ring"):
     """High-level entry (≈ dist.parallelize / fleet.distributed_model +
     distributed_optimizer in one): returns a ShardedTrainStep."""
     hcg = None
@@ -276,4 +295,5 @@ def parallelize(model, optimizer=None, loss_fn=None, *, mesh=None,
         topo_mod.set_hybrid_communicate_group(hcg)
     return ShardedTrainStep(model, optimizer, loss_fn=loss_fn, hcg=hcg,
                             sharding_stage=sharding_stage, rules=rules,
-                            compute_dtype=compute_dtype)
+                            compute_dtype=compute_dtype,
+                            context_parallel=context_parallel)

@@ -26,12 +26,36 @@ def _sdpa_mask_impl(q, k, v, mask, *, causal, scale):
         q, k, v, bias=mask, is_causal=causal, scale=scale)
 
 
+def _sdpa_cp_impl(q, k, v, *, mesh, mode, seq_axis, causal):
+    from ...distributed.context_parallel import context_parallel_attention
+    return context_parallel_attention(q, k, v, mesh, mode=mode,
+                                      seq_axis=seq_axis, causal=causal)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """Layout [batch, seq, num_heads, head_dim], matching the reference
     (nn/functional/flash_attention.py scaled_dot_product_attention)."""
     q, k, v = wrap(query), wrap(key), wrap(value)
+    from ...distributed.context_parallel import active_context_parallel
+    cp = active_context_parallel()
+    if cp is not None and cp[0].shape.get(cp[2], 1) > 1:
+        mesh, mode, seq_axis = cp
+        if dropout_p > 0.0 and training:
+            raise NotImplementedError(
+                "context-parallel attention (sep-axis "
+                f"{mode}) does not support attention-probability dropout; "
+                "set attention dropout to 0 (residual/hidden dropout is "
+                "unaffected) or disable context_parallel")
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "context-parallel attention supports only causal/full "
+                "masks; arbitrary attn_mask would be silently wrong under "
+                "sequence sharding — pass is_causal instead")
+        return apply("sdpa_cp", _sdpa_cp_impl, (q, k, v),
+                     {"mesh": mesh, "mode": mode, "seq_axis": seq_axis,
+                      "causal": bool(is_causal)})
     if dropout_p > 0.0 and training:
         # dropout inside attention probs — rarely used for inference/bench;
         # fall back to composed implementation
