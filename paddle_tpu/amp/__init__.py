@@ -19,7 +19,8 @@ from ..core import dtype as dtypes
 # per-op lists (reference: amp white/black lists, amp/auto_cast.py)
 WHITE_LIST = {
     "matmul", "linear", "conv", "conv_bias", "conv_transpose",
-    "conv_transpose_bias", "einsum", "sdpa", "sdpa_mask", "bmm", "mm",
+    "conv_transpose_bias", "einsum", "sdpa", "sdpa_mask", "sdpa_cp", "bmm",
+    "mm",
 }
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",

@@ -78,18 +78,20 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
 
     def body(t, carry):
         acc, k_blk, v_blk = carry
-        # rotate first (n-1 hops total: the local t=0 block was consumed
-        # before the loop), then consume the block that arrived
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        # send the current block onward BEFORE consuming it: the ppermute
+        # has no data dependency on the block matmuls, so XLA can overlap
+        # the ICI hop with compute; n-1 hops total (the last arrival is
+        # consumed after the loop)
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         acc = block_update(acc, k_blk, v_blk, (p - t) % n)
-        return acc, k_blk, v_blk
+        return acc, k_nxt, v_nxt
 
     acc = (jnp.zeros((b, h, s_loc, d), jnp.float32),
            jnp.full((b, h, s_loc), -1e30, jnp.float32),
            jnp.zeros((b, h, s_loc), jnp.float32))
-    acc = block_update(acc, k, v, p)
-    (o, m, l), _, _ = jax.lax.fori_loop(1, n, body, (acc, k, v))
+    acc, k_last, v_last = jax.lax.fori_loop(0, n - 1, body, (acc, k, v))
+    o, m, l = block_update(acc, k_last, v_last, (p - (n - 1)) % n)
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

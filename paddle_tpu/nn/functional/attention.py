@@ -15,8 +15,23 @@ import jax.numpy as jnp
 from ...ops._helpers import apply, wrap, Tensor
 
 
+import os
+
+_PALLAS_FLASH = os.environ.get("PADDLE_TPU_FLASH", "1") != "0"
+
+
 def _sdpa_impl(q, k, v, *, causal, scale, has_mask):
     # inputs [B, S, H, D] (reference flash_attention layout)
+    if _PALLAS_FLASH and jax.default_backend() == "tpu":
+        from ...ops.pallas import flash_attention as pallas_flash
+        from ...ops.pallas import flash_attention_supported
+        # kernel serves self-attention only: cross-attention / KV-cache
+        # decode / GQA shapes fall back to XLA fused attention
+        if (q.shape == k.shape == v.shape
+                and flash_attention_supported(q.shape, causal)):
+            # tuned v5e kernel: ~6-14x over XLA fused attention forward
+            return pallas_flash(q, k, v, causal=causal, scale=scale,
+                                interpret=False)
     return jax.nn.dot_product_attention(
         q, k, v, is_causal=causal, scale=scale)
 
@@ -40,7 +55,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q, k, v = wrap(query), wrap(key), wrap(value)
     from ...distributed.context_parallel import active_context_parallel
     cp = active_context_parallel()
-    if cp is not None and cp[0].shape.get(cp[2], 1) > 1:
+    if (cp is not None and cp[0].shape.get(cp[2], 1) > 1
+            and q.shape == k.shape == v.shape):
+        # (cross-attention / cache-decode shapes fall through to the dense
+        # paths — ring/Ulysses assume sequence-sharded self-attention)
         mesh, mode, seq_axis = cp
         if dropout_p > 0.0 and training:
             raise NotImplementedError(

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the fusion/ equivalents of the reference's
+hand-written CUDA kernels (paddle/phi/kernels/fusion/, SURVEY.md §2.2).
+
+XLA already fuses the elementwise long tail; Pallas is reserved for the ops
+where schedule control wins: flash attention (forward + FlashAttention-2
+backward), and (future) MoE dispatch / quantized matmul.
+"""
+from .flash_attention import flash_attention, flash_attention_supported  # noqa: F401

@@ -1,0 +1,322 @@
+"""Flash attention as Pallas TPU kernels.
+
+Reference analog: the CUDA flash-attention kernels
+(paddle/phi/kernels/fusion/gpu/flash_attn_kernel.cu, surfaced as
+python/paddle/nn/functional/flash_attention.py:146). TPU-native redesign:
+three Pallas kernels (fwd, dq, dkv) implementing the FlashAttention-2
+recurrence with fp32 accumulators in VMEM:
+
+- forward streams K/V blocks from VMEM against one query block per grid
+  step, maintaining the online-softmax (m, l, o) state; saves the final
+  logsumexp row statistics for the backward;
+- backward follows FA-2: delta = rowsum(do * o) precomputed outside; one
+  kernel accumulates dq over K blocks, a second accumulates (dk, dv) over
+  Q blocks — no atomics, each output is owned by exactly one grid step.
+
+Layouts: public API is [batch, seq, heads, head_dim] (reference layout);
+kernels run on [batch*heads, seq, head_dim]. Causal masking uses global
+row/col indices, so the kernels also serve sliding blocks. On non-TPU
+backends the same kernels run under `interpret=True` (tests), but callers
+should prefer XLA's fused attention there.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# tuned on v5e: 512-square blocks beat 128 by 3-4x (fewer grid steps, the
+# MXU stays fed from VMEM); sequence lengths below 512 use one block
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _ceil_to(x, m):
+    return (x + m - 1) // m * m
+
+
+def flash_attention_supported(q_shape, causal=True):
+    """Whether the Pallas kernel handles this problem (else caller falls
+    back to XLA fused attention)."""
+    b, s, h, d = q_shape
+    # the kernels stage whole K/V (and Q/dO in the backward) per head in
+    # VMEM (~16 MB/core): cap s*d so 4 full [s, d] bf16 tensors + block
+    # scratch stay within budget; beyond this, use ring attention over sep
+    return s >= 128 and s % 128 == 0 and d <= 256 and s * d <= (1 << 20)
+
+
+def pick_block(s):
+    """Largest tuned block size dividing s."""
+    for blk in (512, 256, 128):
+        if s % blk == 0:
+            return blk
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k):
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    bq, d = q.shape
+    s_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_lo = qi * bq
+
+    o = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+
+    def body(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1, keepdims=True)
+        o = o * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o, m_new, l
+
+    if causal:
+        # dynamic upper bound: only blocks intersecting the causal band
+        hi = jax.lax.div(q_lo + bq + block_k - 1, block_k)
+        hi = jnp.minimum(hi, s_k // block_k)
+    else:
+        hi = s_k // block_k
+    o, m, l = jax.lax.fori_loop(0, hi, body, (o, m, l))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)                   # [bq, 1]
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    nq = s // block_q
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            # lse rides as [bh, s, 1] — Mosaic block rules want the last two
+            # dims (sublane, lane) aligned; lane==1 equals the array dim
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward (FlashAttention-2)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_k):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                   # [bq, 1]
+    delta = delta_ref[0]
+    bq, d = q.shape
+    s_k = k_ref.shape[1]
+    q_lo = pl.program_id(1) * bq
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = q_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        hi = jax.lax.div(q_lo + bq + block_k - 1, block_k)
+        hi = jnp.minimum(hi, s_k // block_k)
+    else:
+        hi = s_k // block_k
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, scale, causal, block_q):
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    s_q = q_ref.shape[1]
+    k_lo = pl.program_id(1) * bk
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [bq, 1]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_blk)                       # [bq, bk]
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            cols = k_lo + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            p = jnp.where(rows >= cols, p, 0.0)
+        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk) * scale
+        dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # Q blocks strictly above this K block's diagonal see only masked
+        # entries: start at the first Q block whose rows reach k_lo
+        lo = jax.lax.div(k_lo, block_q)
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(
+        lo, s_q // block_q, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, *, scale, causal, block_q, block_k,
+         interpret):
+    bh, s, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, s, 1]
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+                        memory_space=pltpu.VMEM)
+    row_blk = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    row_full = pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, s // block_q),
+        in_specs=[qspec, full, full, qspec, row_blk, row_blk],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bh, s // block_k),
+        in_specs=[full, kspec, kspec, full, row_full, row_full],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper, [B, S, H, D] public layout
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _bwd(q, k, v, out, lse, do, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
+                    block_k=None, interpret=None):
+    """Flash attention on [batch, seq, heads, head_dim] arrays.
+
+    Differentiable (FlashAttention-2 backward). `interpret=None` auto-picks
+    interpreter mode off-TPU so the same kernels run in CPU tests.
+    """
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = block_q or min(DEFAULT_BLOCK_Q, pick_block(s))
+    block_k = block_k or min(DEFAULT_BLOCK_K, pick_block(s))
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must divide block sizes "
+                         f"({block_q}, {block_k})")
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
