@@ -29,6 +29,9 @@ from .mp_layers import (  # noqa: F401
 from .random import (  # noqa: F401
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
 )
+from .moe import (  # noqa: F401
+    MoELayer, NaiveGate, GShardGate, SwitchGate, global_scatter, global_gather,
+)
 from .context_parallel import (  # noqa: F401
     ring_attention, ulysses_attention, context_parallel_attention,
     context_parallel_guard, active_context_parallel,

@@ -20,7 +20,7 @@ import os
 _PALLAS_FLASH = os.environ.get("PADDLE_TPU_FLASH", "1") != "0"
 
 
-def _sdpa_impl(q, k, v, *, causal, scale, has_mask):
+def _sdpa_impl(q, k, v, *, causal, scale):
     # inputs [B, S, H, D] (reference flash_attention layout)
     if _PALLAS_FLASH and jax.default_backend() == "tpu":
         from ...ops.pallas import flash_attention as pallas_flash
@@ -82,7 +82,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         return apply("sdpa_mask", _sdpa_mask_impl, (q, k, v, wrap(attn_mask)),
                      {"causal": bool(is_causal), "scale": None})
     return apply("sdpa", _sdpa_impl, (q, k, v),
-                 {"causal": bool(is_causal), "scale": None, "has_mask": False})
+                 {"causal": bool(is_causal), "scale": None})
 
 
 def _sdpa_dropout(q, k, v, attn_mask, dropout_p, is_causal):

@@ -55,7 +55,9 @@ def pick_block(s):
     for blk in (512, 256, 128):
         if s % blk == 0:
             return blk
-    return s
+    raise ValueError(
+        f"flash_attention needs seq_len divisible by 128, got {s}; "
+        "pad the sequence or use scaled_dot_product_attention")
 
 
 # ---------------------------------------------------------------------------

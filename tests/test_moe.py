@@ -1,0 +1,109 @@
+"""MoE layer + expert parallelism tests.
+
+Reference test analog: test/collective/fleet moe tests +
+incubate/distributed/models/moe unit coverage — routing correctness, balance
+loss, gradient flow, and expert-parallel execution (here: 8-device CPU mesh
+instead of multi-process NCCL).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.moe import MoELayer, SwitchGate, _topk_gating
+
+
+def _np_expert_ffn(x, layer, e):
+    w1 = np.asarray(layer.w1.numpy())[e]
+    b1 = np.asarray(layer.b1.numpy())[e]
+    w2 = np.asarray(layer.w2.numpy())[e]
+    b2 = np.asarray(layer.b2.numpy())[e]
+    h = np.maximum(x @ w1 + b1, 0.0)
+    return h @ w2 + b2
+
+
+def test_switch_top1_matches_manual_routing():
+    paddle.seed(0)
+    S, M, H, E = 16, 8, 16, 4
+    layer = MoELayer(M, H, E, gate=SwitchGate(), capacity_factor=8.0,
+                     act="relu")
+    x = paddle.randn([S, M])
+    y = layer(x)
+    xs = x.numpy()
+    logits = xs @ layer.gate_weight.numpy()
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    expect = np.zeros((S, M), np.float32)
+    for s in range(S):
+        e = int(np.argmax(probs[s]))
+        expect[s] = probs[s, e] * _np_expert_ffn(xs[s], layer, e)
+    np.testing.assert_allclose(y.numpy(), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_gshard_top2_combine_and_aux_loss():
+    paddle.seed(1)
+    layer = MoELayer(8, 16, 4, gate="gshard", capacity_factor=2.0)
+    x = paddle.randn([3, 10, 8])
+    y = layer(x)
+    assert y.shape == [3, 10, 8]
+    aux = float(layer.aux_loss.numpy())
+    # balance loss for E experts is minimized at 1.0 * loss_weight scale
+    assert aux > 0.0
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_capacity_drops_overflow_tokens():
+    # identical tokens all route to one expert; capacity 4 keeps only 4
+    gates = jnp.tile(jnp.asarray([[0.9, 0.1]], jnp.float32), (8, 1))
+    combine, dispatch, _ = _topk_gating(gates, 1, 4)
+    kept = np.asarray(jnp.sum(dispatch[:, 0, :], axis=-1))
+    assert kept.sum() == 4  # first 4 tokens kept, rest dropped
+
+
+def test_moe_backward_flows_to_gate_and_experts():
+    paddle.seed(2)
+    layer = MoELayer(8, 16, 4, gate="gshard", capacity_factor=4.0)
+    x = paddle.randn([16, 8])
+    x.stop_gradient = False
+    y = layer(x)
+    loss = (y * y).mean() + layer.aux_loss
+    loss.backward()
+    for name, p in layer.named_parameters():
+        assert p.grad is not None, name
+        assert np.isfinite(p.grad.numpy()).all(), name
+    assert x.grad is not None
+
+
+def test_expert_parallel_matches_single_device():
+    paddle.seed(3)
+    S, M, H, E = 32, 8, 16, 8
+    layer = MoELayer(M, H, E, gate="switch", capacity_factor=8.0,
+                     act="relu", expert_axis="mp")
+    x = paddle.randn([S, M])
+    y_ref = layer(x).numpy()
+
+    mesh = dist.build_mesh(mp=8)
+    hcg = dist.HybridCommunicateGroup(mesh=mesh)
+    dist.set_hybrid_communicate_group(hcg)
+    try:
+        dist.shard_params(layer, mesh)
+        y_ep = layer(x).numpy()
+        np.testing.assert_allclose(y_ep, y_ref, rtol=1e-4, atol=1e-4)
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_global_scatter_roundtrip():
+    mesh = dist.build_mesh(mp=8)
+    hcg = dist.HybridCommunicateGroup(mesh=mesh)
+    dist.set_hybrid_communicate_group(hcg)
+    try:
+        x = paddle.to_tensor(
+            np.arange(64 * 8, dtype=np.float32).reshape(64, 8))
+        y = dist.global_scatter(x, axis="mp")
+        z = dist.global_gather(y, axis="mp")
+        np.testing.assert_allclose(z.numpy(), x.numpy())
+    finally:
+        dist.set_hybrid_communicate_group(None)
