@@ -29,6 +29,10 @@ class Tensor:
         "persistable",
         "_hooks",
         "trainable",
+        # DTensor annotations (distributed.auto_parallel): pending-Partial
+        # mesh axes and the owning ProcessMesh
+        "_partial_axes",
+        "process_mesh",
         "__weakref__",
     )
 

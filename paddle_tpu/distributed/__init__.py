@@ -29,6 +29,12 @@ from .mp_layers import (  # noqa: F401
 from .random import (  # noqa: F401
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
 )
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
+    reshard, shard_layer, get_placements,
+)
+from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .moe import (  # noqa: F401
     MoELayer, NaiveGate, GShardGate, SwitchGate, global_scatter, global_gather,
 )
@@ -38,3 +44,6 @@ from .context_parallel import (  # noqa: F401
 )
 from . import functional  # noqa: F401
 from . import fleet  # noqa: F401
+from .fleet.recompute import (  # noqa: F401
+    recompute, recompute_sequential, GradientMergeOptimizer,
+)

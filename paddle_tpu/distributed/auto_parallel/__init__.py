@@ -1,0 +1,22 @@
+"""Auto-parallel (DTensor) API.
+
+Reference analog: python/paddle/distributed/auto_parallel/ — `ProcessMesh`
+(process_mesh.py:71), `shard_tensor`/`dtensor_from_fn`/`reshard`/
+`shard_layer` (api.py:118,248,282,381), placements (placement_type.py:
+Shard/Replicate/Partial), backed by C++ `DistTensor` + `TensorDistAttr`
++ per-op SPMD rules (phi/infermeta/spmd_rules/) + hand-written reshard
+functions (phi/core/distributed/auto_parallel/reshard/).
+
+TPU-native redesign: a DTensor IS a jax.Array with a NamedSharding — the
+placements vector maps 1:1 onto a PartitionSpec over the ProcessMesh's
+jax Mesh. SPMD propagation (the reference's per-op InferSpmd) is done by
+GSPMD inside XLA; resharding (the reference's r_to_s/s_to_r/p_to_r rule
+zoo) is a device_put / with_sharding_constraint — XLA emits the
+collective-permute/all-gather/reduce-scatter. Only `Partial` needs real
+code here (eager psum on reshard-to-replicate), because jax has no eager
+partial placement.
+"""
+from .api import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
+    reshard, shard_layer, get_placements, placements_to_spec,
+)

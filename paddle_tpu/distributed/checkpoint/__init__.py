@@ -1,0 +1,18 @@
+"""Distributed sharded checkpoint with resharding-on-load.
+
+Reference analog: python/paddle/distributed/checkpoint/ —
+`save_state_dict` (save_state_dict.py:104) writes per-rank shard files plus
+a global `Metadata` of `LocalTensorMetadata(global_offset, local_shape)`
+(metadata.py); `load_state_dict` (load_state_dict.py:365) computes the
+overlap between saved chunks and the target placements and moves exactly
+the overlapping bytes (resharding restore, load_state_dict.py:230-322).
+
+TPU-native redesign: shards are the `addressable_shards` of sharded
+jax.Arrays (replicated copies deduplicated by index); restore builds each
+target device's block straight from the overlapping saved chunks via
+`jax.make_array_from_callback`, so the global tensor is never materialized
+on one host and the saved mesh never needs to match the loading mesh.
+"""
+from .api import (  # noqa: F401
+    save_state_dict, load_state_dict, LocalTensorMetadata, Metadata,
+)

@@ -1,0 +1,242 @@
+"""save_state_dict / load_state_dict implementation.
+
+Layout of a checkpoint directory:
+  metadata_<p>.json   one per writing process p: for every tensor, the list
+                      of chunks it wrote — global_offset, local_shape,
+                      dtype, and the (file, key) that stores the bytes
+  data_<p>.npz        that process's chunk payloads
+
+Single-controller runs produce p=0 only; multi-host SPMD runs produce one
+pair per process on a shared filesystem (the reference writes per-rank
+files the same way, save_state_dict.py:104).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "LocalTensorMetadata",
+           "Metadata"]
+
+
+@dataclass
+class LocalTensorMetadata:
+    """One saved chunk (reference: metadata.py LocalTensorMetadata)."""
+    global_offset: tuple
+    local_shape: tuple
+    dtype: str
+    file: str
+    key: str
+
+
+@dataclass
+class Metadata:
+    """Global view: tensor name -> chunk list + global shape."""
+    state_dict_metadata: dict = field(default_factory=dict)
+    global_shapes: dict = field(default_factory=dict)
+
+
+def _flat_items(state_dict, prefix=""):
+    for k, v in state_dict.items():
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            yield from _flat_items(v, name)
+        elif v is None:
+            continue
+        else:
+            yield name, v
+
+
+def _as_array(v):
+    if isinstance(v, Tensor):
+        return v._value
+    return jax.numpy.asarray(v)
+
+
+def _norm_index(index, shape):
+    """Normalize a device index (tuple of slices) to offsets + shape."""
+    off, shp = [], []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        off.append(start)
+        shp.append(stop - start)
+    return tuple(off), tuple(shp)
+
+
+def _unique_local_chunks(val):
+    """(offset, shape) -> np.ndarray for the shards this process OWNS:
+    replicated copies are deduplicated globally by giving each distinct
+    chunk to the process holding its lowest-id device, so a pod writes each
+    byte exactly once (the reference dedups the same way via its
+    dedup_tensor pass in save_state_dict.py)."""
+    me = jax.process_index()
+    owner = {}
+    try:
+        index_map = val.sharding.devices_indices_map(val.shape)
+        for dev, index in index_map.items():
+            key = _norm_index(index, val.shape)
+            prev = owner.get(key)
+            if prev is None or dev.id < prev.id:
+                owner[key] = dev
+    except Exception:
+        owner = None  # unusual shardings: fall back to per-process dedup
+    out = {}
+    for sh in val.addressable_shards:
+        key = _norm_index(sh.index, val.shape)
+        if owner is not None and owner[key].process_index != me:
+            continue
+        if key not in out:
+            out[key] = np.asarray(sh.data)
+    return out
+
+
+def save_state_dict(state_dict, path, *, async_save=False):
+    """Write every process's owned shards + metadata (reference:
+    save_state_dict.py:104). Blocking by default; async_save=True snapshots
+    all tensor bytes to host synchronously (so a following optimizer step
+    cannot tear the checkpoint) and returns a started threading.Thread that
+    does the file IO — join it before relying on the files (≈ the
+    reference's async checkpoint path)."""
+    items = list(_flat_items(state_dict))
+    p = jax.process_index()
+    payload, meta, shapes = {}, {}, {}
+    fname = f"data_{p}.npz"
+    for name, v in items:
+        val = _as_array(v)
+        shapes[name] = list(val.shape)
+        chunks = []
+        for i, ((off, shp), arr) in enumerate(
+                sorted(_unique_local_chunks(val).items())):
+            key = f"{name}##%d" % i
+            payload[key] = arr
+            chunks.append({
+                "global_offset": list(off), "local_shape": list(shp),
+                "dtype": str(arr.dtype), "file": fname, "key": key,
+            })
+        meta[name] = chunks
+
+    def _write():
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, fname), **payload)
+        with open(os.path.join(path, f"metadata_{p}.json"), "w") as f:
+            json.dump({"state_dict_metadata": meta,
+                       "global_shapes": shapes}, f)
+
+    if async_save:
+        t = threading.Thread(target=_write, daemon=True)
+        t.start()
+        return t
+    _write()
+
+
+def _read_metadata(path):
+    meta = Metadata()
+    files = sorted(f for f in os.listdir(path)
+                   if f.startswith("metadata_") and f.endswith(".json"))
+    if not files:
+        raise FileNotFoundError(f"no checkpoint metadata under {path!r}")
+    seen = set()
+    for f in files:
+        with open(os.path.join(path, f)) as fh:
+            d = json.load(fh)
+        for name, chunks in d["state_dict_metadata"].items():
+            for c in chunks:
+                # two processes of a pod may both address a replicated
+                # shard; keep one copy so chunks stay disjoint boxes
+                dedup = (name, tuple(c["global_offset"]),
+                         tuple(c["local_shape"]))
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                meta.state_dict_metadata.setdefault(name, []).append(
+                    LocalTensorMetadata(
+                        tuple(c["global_offset"]), tuple(c["local_shape"]),
+                        c["dtype"], c["file"], c["key"]))
+        meta.global_shapes.update(d["global_shapes"])
+    return meta
+
+
+def _overlap(dst_off, dst_shp, src_off, src_shp):
+    """Intersection of two boxes; returns (dst_slices, src_slices) or None."""
+    dst_sl, src_sl = [], []
+    for do, ds, so, ss in zip(dst_off, dst_shp, src_off, src_shp):
+        lo = max(do, so)
+        hi = min(do + ds, so + ss)
+        if hi <= lo:
+            return None
+        dst_sl.append(slice(lo - do, hi - do))
+        src_sl.append(slice(lo - so, hi - so))
+    return tuple(dst_sl), tuple(src_sl)
+
+
+def load_state_dict(state_dict, path, *, strict=True):
+    """Fill `state_dict`'s tensors in-place from a checkpoint, resharding
+    chunks onto each tensor's current sharding (reference:
+    load_state_dict.py:365; overlap math :230-322).
+
+    Every target device block is assembled only from the saved chunks that
+    intersect it, then handed to jax.make_array_from_callback with the
+    target sharding — no host ever holds a full global tensor it doesn't
+    already shard."""
+    meta = _read_metadata(path)
+    npz_cache = {}
+
+    def _chunk_bytes(c: LocalTensorMetadata):
+        z = npz_cache.get(c.file)
+        if z is None:
+            z = np.load(os.path.join(path, c.file))
+            npz_cache[c.file] = z
+        return z[c.key]
+
+    missing = []
+    for name, v in _flat_items(state_dict):
+        chunks = meta.state_dict_metadata.get(name)
+        if not chunks:
+            missing.append(name)
+            continue
+        if not isinstance(v, Tensor):
+            raise TypeError(f"load target {name!r} must be a Tensor")
+        val = v._value
+        saved_shape = tuple(meta.global_shapes[name])
+        if tuple(val.shape) != saved_shape:
+            raise ValueError(
+                f"shape mismatch for {name!r}: checkpoint {saved_shape}, "
+                f"target {tuple(val.shape)}")
+        sharding = val.sharding
+        dtype = val.dtype
+
+        def cb(index, *, _chunks=chunks, _shape=saved_shape, _dtype=dtype):
+            off, shp = _norm_index(index, _shape)
+            block = None
+            filled = 0
+            for c in _chunks:
+                ov = _overlap(off, shp, c.global_offset, c.local_shape)
+                if ov is None:
+                    continue
+                if block is None:
+                    block = np.zeros(shp, dtype=np.dtype(str(_dtype)))
+                dst_sl, src_sl = ov
+                piece = _chunk_bytes(c)[src_sl]
+                block[dst_sl] = piece
+                filled += piece.size
+            if block is None or filled < int(np.prod(shp)):
+                raise ValueError(
+                    "checkpoint chunks do not cover the requested block "
+                    f"(offset {off}, shape {shp}) — incomplete checkpoint?")
+            return block.astype(np.dtype(str(_dtype)), copy=False)
+
+        arr = jax.make_array_from_callback(saved_shape, sharding, cb)
+        v._value = arr
+    if strict and missing:
+        raise KeyError(
+            f"checkpoint at {path!r} is missing tensors: {missing[:8]}"
+            + ("..." if len(missing) > 8 else ""))
+    return state_dict

@@ -20,6 +20,9 @@ from ..engine import ShardedTrainStep, parallelize
 from ..data_parallel import DataParallel
 from ..random import get_rng_state_tracker, model_parallel_random_seed
 from .distributed_strategy import DistributedStrategy
+from .recompute import (
+    recompute, recompute_sequential, GradientMergeOptimizer,
+)
 
 _fleet_state = {"strategy": None, "hcg": None, "initialized": False}
 

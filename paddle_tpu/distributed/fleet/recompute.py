@@ -1,0 +1,200 @@
+"""Activation recompute (gradient checkpointing) + gradient merge.
+
+Reference analog: `RecomputeFunction` / `recompute()`
+(python/paddle/distributed/fleet/recompute/recompute.py:108,404) — a PyLayer
+that saves only segment inputs + RNG state, replaying the forward inside
+backward; and the gradient-merge meta optimizer
+(fleet/meta_optimizers/gradient_merge_optimizer.py).
+
+TPU-native redesign: under `to_static`/the parallel engine everything is one
+jax trace, so recompute is literally `jax.checkpoint` — XLA rematerializes
+the segment in the backward pass, trading MXU FLOPs for HBM. In eager mode a
+recomputed Layer segment becomes ONE tape node (inputs-only residuals, jitted
+VJP reruns the forward), which is exactly the reference's PyLayer contract.
+RNG replay (the reference saves/restores CUDA RNG state) falls out of JAX's
+functional PRNG: the segment derives its dropout keys from an explicit key
+that is identical in replay.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...ops import random as rnd
+
+__all__ = ["recompute", "recompute_sequential", "GradientMergeOptimizer"]
+
+
+def _impl_for(layer: Layer, method=None):
+    """One cached pure impl per (layer, method): primals are
+    [rng_key, *param_vals, *buffer_vals, *inputs] so parameter gradients
+    flow through the tape node, fresh dropout keys are drawn per call (the
+    reference saves/replays RNG state per segment, recompute.py:108), and
+    buffer updates (BN running stats) are returned and written back."""
+    from ..functional import functionalize
+
+    cache = layer.__dict__.setdefault("_recompute_impl_cache", {})
+    ckey = method or "forward"
+    entry = cache.get(ckey)
+    if entry is not None:
+        return entry
+
+    apply_fn, params, buffers = functionalize(layer, method=method)
+    pnames, bnames = list(params), list(buffers)
+    np_, nb_ = len(pnames), len(bnames)
+    meta = {"treedef": None}
+
+    def impl(key, *vals):
+        def seg(key, *xs):
+            rnd.push_trace_key(key)
+            try:
+                out, new_buf = apply_fn(
+                    dict(zip(pnames, xs[:np_])),
+                    dict(zip(bnames, xs[np_:np_ + nb_])),
+                    *[Tensor(x) for x in xs[np_ + nb_:]])
+            finally:
+                rnd.pop_trace_key()
+            leaves, treedef = jax.tree_util.tree_flatten(out)
+            meta["treedef"] = treedef  # static: set at trace time
+            return tuple(leaves) + tuple(new_buf[n] for n in bnames)
+
+        return jax.checkpoint(seg)(key, *vals)
+
+    impl.__name__ = f"_recompute_{type(layer).__name__}"
+    entry = (impl, params, buffers, meta)
+    cache[ckey] = entry
+    return entry
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` without storing its intermediate activations;
+    the backward pass recomputes them (reference: recompute.py:404).
+
+    `function` should be a Layer or a bound method of one (the reference's
+    dominant usage, e.g. `recompute(self.block, x)`); its parameters get
+    gradients through the recomputed segment. Inside `to_static`/engine
+    traces, arbitrary callables work too (pure jax.checkpoint)."""
+    kwargs.pop("preserve_rng_state", None)
+    kwargs.pop("use_reentrant", None)
+    if kwargs:
+        raise TypeError(f"unsupported kwargs for recompute: {list(kwargs)}")
+
+    from ...jit.api import _in_to_static
+    if _in_to_static():
+        # whole step is one jax trace: closed-over tracers (params) are
+        # differentiated by the outer grad, so any callable is fine
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+
+        def seg(*xs):
+            outs = function(*[Tensor(x) if not isinstance(x, Tensor) else x
+                              for x in xs])
+            return outs._value if isinstance(outs, Tensor) else \
+                jax.tree_util.tree_map(
+                    lambda o: o._value if isinstance(o, Tensor) else o, outs)
+
+        out = jax.checkpoint(seg)(*vals)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    layer = None
+    method = None
+    if isinstance(function, Layer):
+        layer = function
+    elif isinstance(getattr(function, "__self__", None), Layer):
+        layer = function.__self__
+        method = function.__name__
+    if layer is None:
+        # plain eager callable: run through the tape (per-op inputs-only
+        # residuals already bound activation memory); no single-node fusion
+        return function(*args)
+
+    impl, params, buffers, meta = _impl_for(layer, method)
+    in_tensors = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+    primals = (rnd.next_key(),) + tuple(params.values()) + \
+        tuple(buffers.values()) + tuple(in_tensors)
+    outs = apply(f"recompute_{type(layer).__name__}", impl, primals)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    nb_ = len(buffers)
+    out_leaves = outs[:len(outs) - nb_]
+    for b, new in zip(buffers.values(), outs[len(outs) - nb_:]):
+        b._value = new._value
+    res = jax.tree_util.tree_unflatten(meta["treedef"], out_leaves)
+    return res
+
+
+def recompute_sequential(ctx, functions, *args):
+    """Recompute a Sequential in segments (reference:
+    recompute_sequential / recompute_hybrid entry). ctx: {"segments": k}."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    n = len(funcs)
+    seg_size = max(1, (n + segments - 1) // segments)
+    out = args
+
+    class _Seg(Layer):
+        def __init__(self, mods):
+            super().__init__()
+            for i, m in enumerate(mods):
+                self.add_sublayer(str(i), m)
+            self._mods = mods
+
+        def forward(self, *xs):
+            for m in self._mods:
+                xs = m(*xs) if isinstance(xs, tuple) else m(xs)
+                if not isinstance(xs, tuple):
+                    xs = (xs,)
+            return xs if len(xs) > 1 else xs[0]
+
+    for s in range(0, n, seg_size):
+        seg = _Seg(funcs[s:s + seg_size])
+        res = recompute(seg, *out)
+        out = res if isinstance(res, tuple) else (res,)
+    return out if len(out) > 1 else out[0]
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation wrapper (reference:
+    fleet/meta_optimizers/gradient_merge_optimizer.py — accumulate grads
+    for k_steps, then apply once). Eager tape grads already accumulate
+    across backward() calls; this wrapper steps the inner optimizer every
+    k-th call and averages if requested."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._count = 0
+
+    @property
+    def _parameter_list(self):
+        return getattr(self.inner_opt, "_parameter_list", [])
+
+    def step(self):
+        self._count += 1
+        if self._count % self.k_steps != 0:
+            return False
+        if self.avg and self.k_steps > 1:
+            from ...ops.math import scale
+            for p in self._parameter_list:
+                if p.grad is not None:
+                    p.grad = scale(p.grad, 1.0 / self.k_steps)
+        self.inner_opt.step()
+        return True
+
+    def clear_grad(self, set_to_zero=False):
+        # only clear after an actual apply (mid-accumulation grads persist)
+        if self._count % self.k_steps == 0:
+            self.inner_opt.clear_grad(set_to_zero) if _accepts_arg(
+                self.inner_opt.clear_grad) else self.inner_opt.clear_grad()
+
+    def __getattr__(self, name):
+        return getattr(self.inner_opt, name)
+
+
+def _accepts_arg(fn):
+    import inspect
+    try:
+        return len(inspect.signature(fn).parameters) > 0
+    except (TypeError, ValueError):
+        return False

@@ -71,15 +71,42 @@ class StaticFunction:
     # -- holder discovery -------------------------------------------------
     def _holders(self):
         """Parameters + buffers whose values are inputs (and possibly
-        outputs, for in-place buffer updates) of the traced program."""
-        if self._layer is None:
-            return []
-        out = []
-        for _, p in self._layer.named_parameters():
-            out.append(p)
-        for _, b in self._layer.named_buffers():
-            if isinstance(b, Tensor):
-                out.append(b)
+        outputs, for in-place buffer updates) of the traced program.
+
+        For a bare function, closed-over Layers/Tensors in its closure cells
+        are discovered too (the reference's dy2static reaches them through
+        the live Python frame the same way), so `@to_static` on a closure
+        over a model still routes gradients to its parameters."""
+        sources = []
+        if self._layer is not None:
+            sources.append(self._layer)
+        else:
+            fn = self._function
+            for cell in (getattr(fn, "__closure__", None) or ()):
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    continue
+                if isinstance(v, Tensor) or (
+                        not isinstance(v, type)
+                        and hasattr(v, "named_parameters")):
+                    sources.append(v)
+        out, seen = [], set()
+
+        def add(t):
+            if id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+
+        for src in sources:
+            if isinstance(src, Tensor):
+                add(src)
+                continue
+            for _, p in src.named_parameters():
+                add(p)
+            for _, b in src.named_buffers():
+                if isinstance(b, Tensor):
+                    add(b)
         return out
 
     def _sig(self, arg_tensors, kwargs_static, training):
