@@ -120,10 +120,13 @@ class ProcessMesh:
         self._ids = arr
         self._dim_names = list(dim_names)
         devices = devices if devices is not None else jax.devices()
-        if arr.size > len(devices):
+        ids = arr.reshape(-1).tolist()
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"mesh has duplicate process ids: {sorted(ids)}")
+        if ids and (min(ids) < 0 or max(ids) >= len(devices)):
             raise ValueError(
-                f"mesh uses {arr.size} processes, only {len(devices)} "
-                "devices available")
+                f"mesh process ids span [{min(ids)}, {max(ids)}], but only "
+                f"{len(devices)} devices are available")
         dev_arr = np.empty(arr.shape, dtype=object)
         for idx in np.ndindex(arr.shape):
             dev_arr[idx] = devices[int(arr[idx])]

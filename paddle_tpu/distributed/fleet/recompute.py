@@ -123,6 +123,28 @@ def recompute(function, *args, **kwargs):
     return res
 
 
+class _Seg(Layer):
+    """A contiguous recompute segment of a Sequential."""
+
+    def __init__(self, mods):
+        super().__init__()
+        for i, m in enumerate(mods):
+            self.add_sublayer(str(i), m)
+        self._mods = mods
+
+    def forward(self, *xs):
+        for m in self._mods:
+            xs = m(*xs) if isinstance(xs, tuple) else m(xs)
+            if not isinstance(xs, tuple):
+                xs = (xs,)
+        return xs if len(xs) > 1 else xs[0]
+
+
+# segment layers are cached per (member identity, split): a fresh _Seg per
+# call would miss the per-layer impl cache and retrace/compile every step
+_seg_cache = {}
+
+
 def recompute_sequential(ctx, functions, *args):
     """Recompute a Sequential in segments (reference:
     recompute_sequential / recompute_hybrid entry). ctx: {"segments": k}."""
@@ -130,24 +152,13 @@ def recompute_sequential(ctx, functions, *args):
     funcs = list(functions)
     n = len(funcs)
     seg_size = max(1, (n + segments - 1) // segments)
+    key = (tuple(id(f) for f in funcs), seg_size)
+    segs = _seg_cache.get(key)
+    if segs is None:
+        segs = [_Seg(funcs[s:s + seg_size]) for s in range(0, n, seg_size)]
+        _seg_cache[key] = segs
     out = args
-
-    class _Seg(Layer):
-        def __init__(self, mods):
-            super().__init__()
-            for i, m in enumerate(mods):
-                self.add_sublayer(str(i), m)
-            self._mods = mods
-
-        def forward(self, *xs):
-            for m in self._mods:
-                xs = m(*xs) if isinstance(xs, tuple) else m(xs)
-                if not isinstance(xs, tuple):
-                    xs = (xs,)
-            return xs if len(xs) > 1 else xs[0]
-
-    for s in range(0, n, seg_size):
-        seg = _Seg(funcs[s:s + seg_size])
+    for seg in segs:
         res = recompute(seg, *out)
         out = res if isinstance(res, tuple) else (res,)
     return out if len(out) > 1 else out[0]

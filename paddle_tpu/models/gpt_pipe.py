@@ -32,7 +32,8 @@ from .. import nn
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
 from ..distributed import topology as topo_mod
-from ..distributed.pipeline import spmd_pipeline, microbatch, unmicrobatch
+from ..distributed.pipeline import (spmd_pipeline, spmd_pipeline_1f1b,
+                                    microbatch, unmicrobatch)
 from .gpt import GPTConfig, CONFIGS
 
 
@@ -76,10 +77,22 @@ def _stage_fn(stage_params, x, *, num_heads, eps):
 class GPTForCausalLMPipe(nn.Layer):
     """Stacked-parameter causal LM; pipeline-parallel when mesh pp > 1."""
 
-    def __init__(self, cfg: GPTConfig, num_microbatches=1):
+    def __init__(self, cfg: GPTConfig, num_microbatches=1,
+                 pipeline_schedule="gpipe", num_virtual_stages=1):
+        """pipeline_schedule: 'gpipe' (fill-drain scan, AD backward; with
+        num_virtual_stages>1 the circular/interleaved VPP variant,
+        reference pipeline_parallel.py:906) or '1f1b' (single-program
+        interleaved forward/backward with bounded activation memory,
+        reference forward_backward_pipeline pipeline_parallel.py:440)."""
         super().__init__()
+        if pipeline_schedule == "1f1b" and num_virtual_stages != 1:
+            raise ValueError(
+                "num_virtual_stages > 1 (interleaved) is only supported "
+                "with pipeline_schedule='gpipe' (circular schedule)")
         self.cfg = cfg
         self.num_microbatches = num_microbatches
+        self.pipeline_schedule = pipeline_schedule
+        self.num_virtual_stages = num_virtual_stages
         std = cfg.initializer_range
         L, H, V = cfg.num_layers, cfg.hidden_size, cfg.vocab_size
         I = cfg.intermediate_size
@@ -122,16 +135,37 @@ class GPTForCausalLMPipe(nn.Layer):
         self._impl_fn = self._impl
 
     def _impl(self, ids, labels, wte, wpe, lnf_w, lnf_b, *stack,
-              num_microbatches=1, mesh=None):
+              num_microbatches=1, mesh=None, schedule="gpipe",
+              num_virtual=1):
         cfg = self.cfg
         stack_params = dict(zip(self._stack_names, stack))
         b, s = ids.shape
         x = wte[ids] + wpe[:s][None]
         stage = partial(_stage_fn, num_heads=cfg.num_heads,
                         eps=cfg.layer_norm_epsilon)
+        if (mesh is not None and mesh.shape.get("pp", 1) > 1
+                and schedule == "1f1b"):
+            # loss head (final LN + tied-logit CE) runs on the last stage
+            # inside the 1F1B program, per microbatch
+            def head_fn(hp, y, lbl):
+                lnf_w_, lnf_b_, wte_ = hp
+                mu = y.mean(-1, keepdims=True)
+                var = ((y - mu) ** 2).mean(-1, keepdims=True)
+                h = (y - mu) * jax.lax.rsqrt(
+                    var + cfg.layer_norm_epsilon) * lnf_w_ + lnf_b_
+                logits = (h @ wte_.T)[:, :-1].reshape(-1, cfg.vocab_size)
+                tgt = lbl[:, 1:].reshape(-1)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                return -jnp.take_along_axis(logp, tgt[:, None], -1).mean()
+
+            return spmd_pipeline_1f1b(
+                stage, head_fn, stack_params, (lnf_w, lnf_b, wte),
+                microbatch(x, num_microbatches),
+                microbatch(labels, num_microbatches), mesh=mesh)
         if mesh is not None and mesh.shape.get("pp", 1) > 1:
             xs = microbatch(x, num_microbatches)
-            out = spmd_pipeline(stage, stack_params, xs, mesh=mesh)
+            out = spmd_pipeline(stage, stack_params, xs, mesh=mesh,
+                                num_virtual=num_virtual)
             x = unmicrobatch(out)
         else:
             x = _stage_fn(stack_params, x,
@@ -155,13 +189,18 @@ class GPTForCausalLMPipe(nn.Layer):
         args += [getattr(self, n) for n in self._stack_names]
         return apply("gpt_pipe_loss", self._impl_fn, args,
                      {"num_microbatches": self.num_microbatches,
-                      "mesh": mesh})
+                      "mesh": mesh, "schedule": self.pipeline_schedule,
+                      "num_virtual": self.num_virtual_stages})
 
     def forward(self, input_ids):
         return self.loss(input_ids)
 
 
-def gpt_pipe(name="gpt_tiny", num_microbatches=1, **overrides):
+def gpt_pipe(name="gpt_tiny", num_microbatches=1, pipeline_schedule="gpipe",
+             num_virtual_stages=1, **overrides):
     d = dict(CONFIGS[name])
     d.update(overrides)
-    return GPTForCausalLMPipe(GPTConfig(**d), num_microbatches=num_microbatches)
+    return GPTForCausalLMPipe(GPTConfig(**d),
+                              num_microbatches=num_microbatches,
+                              pipeline_schedule=pipeline_schedule,
+                              num_virtual_stages=num_virtual_stages)
