@@ -7,7 +7,9 @@ replaces the eager reducer/sharding/pipeline wrapper stack.
 """
 from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+    get_store,
 )
+from .store import TCPStore, Watchdog, create_master_store  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, build_mesh, get_mesh,
     set_hybrid_communicate_group, get_hybrid_communicate_group, AXES,

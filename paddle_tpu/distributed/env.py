@@ -20,6 +20,7 @@ import os
 import jax
 
 _initialized = False
+_global_store = None
 
 
 class ParallelEnv:
@@ -71,8 +72,28 @@ def init_parallel_env():
             num_processes=int(os.environ["PADDLE_TPU_NUM_PROCESSES"]),
             process_id=int(os.environ["PADDLE_TPU_PROCESS_ID"]),
         )
+    # Framework control plane (native TCPStore): rendezvous KV + barriers +
+    # liveness heartbeats, orthogonal to the XLA data plane. The launcher
+    # sets PADDLE_TPU_MASTER to the rank-0-hosted store (reference:
+    # create_or_get_global_tcp_store, parallel.py:1099).
+    master = os.environ.get("PADDLE_TPU_MASTER")
+    if master:
+        from .store import TCPStore
+
+        global _global_store
+        host, _, port = master.rpartition(":")
+        rank = int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0"))
+        world = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+        _global_store = TCPStore(host or "127.0.0.1", int(port),
+                                 is_master=rank == 0, world_size=world)
+        _global_store.start_heartbeat(f"rank{rank}")
     _initialized = True
     return ParallelEnv()
+
+
+def get_store():
+    """The job-global coordination store, or None outside launched jobs."""
+    return _global_store
 
 
 def is_initialized():

@@ -17,6 +17,8 @@ that is identical in replay.
 """
 from __future__ import annotations
 
+import weakref
+
 import jax
 
 from ...core.dispatch import apply
@@ -141,8 +143,11 @@ class _Seg(Layer):
 
 
 # segment layers are cached per (member identity, split): a fresh _Seg per
-# call would miss the per-layer impl cache and retrace/compile every step
-_seg_cache = {}
+# call would miss the per-layer impl cache and retrace/compile every step.
+# The cache is anchored to the first member via weak keys so dropping a
+# model releases its segments (and their params) instead of pinning them.
+_seg_cache = weakref.WeakKeyDictionary()
+_seg_cache_fallback = {}  # members that cannot be weak-referenced
 
 
 def recompute_sequential(ctx, functions, *args):
@@ -153,10 +158,14 @@ def recompute_sequential(ctx, functions, *args):
     n = len(funcs)
     seg_size = max(1, (n + segments - 1) // segments)
     key = (tuple(id(f) for f in funcs), seg_size)
-    segs = _seg_cache.get(key)
+    try:
+        per_anchor = _seg_cache.setdefault(funcs[0], {})
+    except TypeError:
+        per_anchor = _seg_cache_fallback
+    segs = per_anchor.get(key)
     if segs is None:
         segs = [_Seg(funcs[s:s + seg_size]) for s in range(0, n, seg_size)]
-        _seg_cache[key] = segs
+        per_anchor[key] = segs
     out = args
     for seg in segs:
         res = recompute(seg, *out)

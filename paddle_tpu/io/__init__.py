@@ -343,3 +343,8 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+from .native_feeder import (  # noqa: F401,E402
+    FixedRecordDataset, NativeRecordLoader, write_records,
+)

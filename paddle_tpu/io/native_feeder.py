@@ -1,0 +1,115 @@
+"""Native high-throughput input pipeline over fixed-size binary records.
+
+Reference analog: the C++ `DataFeed`/`Dataset` ingest used by PS/trainer
+workloads (fluid/framework/data_feed.cc; `InMemoryDataset` python surface)
+— file parsing and batch assembly happen in native threads, not Python.
+Here the hot case is pre-tokenized LM data: shard files of back-to-back
+[record_shape] arrays (e.g. int32[seq_len]); native readers slice, shuffle
+and pack them into batch buffers that Python merely wraps and ships to the
+chip.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..native import build_and_load
+
+
+def _lib():
+    lib = build_and_load("data_feeder")
+    if not getattr(lib, "_ptf_ready", False):
+        lib.ptf_start.restype = ctypes.c_void_p
+        lib.ptf_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int64]
+        lib.ptf_next.restype = ctypes.c_int64
+        lib.ptf_next.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.c_int64]
+        lib.ptf_free_batch.argtypes = [ctypes.c_char_p]
+        lib.ptf_stop.argtypes = [ctypes.c_void_p]
+        lib._ptf_ready = True
+    return lib
+
+
+class FixedRecordDataset:
+    """Describes shard files of densely-packed fixed-shape records."""
+
+    def __init__(self, paths, record_shape, dtype="int32"):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        self.paths = [os.fspath(p) for p in paths]
+        for p in self.paths:
+            if not os.path.exists(p):
+                raise FileNotFoundError(p)
+        self.record_shape = tuple(int(d) for d in record_shape)
+        self.dtype = np.dtype(dtype)
+        self.record_bytes = int(np.prod(self.record_shape)) * \
+            self.dtype.itemsize
+
+    def num_records(self):
+        return sum(os.path.getsize(p) for p in self.paths) \
+            // self.record_bytes
+
+
+class NativeRecordLoader:
+    """Iterate batches assembled by the native feeder.
+
+    Yields numpy arrays [batch_size, *record_shape] (the trailing partial
+    batch is shorter unless drop_last). One epoch per iteration pass;
+    re-iterating restarts the readers (reshuffled with seed+epoch).
+    """
+
+    def __init__(self, dataset: FixedRecordDataset, batch_size,
+                 shuffle=False, drop_last=False, num_threads=4, seed=0,
+                 prefetch_batches=8, timeout=120.0):
+        self.ds = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = bool(shuffle)
+        self.drop_last = bool(drop_last)
+        self.num_threads = int(num_threads)
+        self.seed = int(seed)
+        self.prefetch = int(prefetch_batches)
+        self.timeout_ms = int(timeout * 1000)
+        self._epoch = 0
+
+    def __len__(self):
+        n = self.ds.num_records()
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        lib = _lib()
+        h = lib.ptf_start(
+            "\n".join(self.ds.paths).encode(), self.ds.record_bytes,
+            self.batch_size, self.num_threads,
+            self.seed + self._epoch, int(self.shuffle),
+            int(self.drop_last), self.prefetch)
+        if not h:
+            raise RuntimeError("native feeder failed to start")
+        self._epoch += 1
+        try:
+            while True:
+                out = ctypes.c_char_p()
+                size = lib.ptf_next(h, ctypes.byref(out), self.timeout_ms)
+                if size == -1:
+                    break
+                if size == -2:
+                    raise TimeoutError("native feeder stalled")
+                nrec = size // self.ds.record_bytes
+                arr = np.frombuffer(
+                    ctypes.string_at(out, size), dtype=self.ds.dtype
+                ).reshape((nrec,) + self.ds.record_shape)
+                lib.ptf_free_batch(out)
+                yield arr
+        finally:
+            lib.ptf_stop(h)
+
+
+def write_records(path, array):
+    """Write a [N, *record_shape] array as a packed shard file."""
+    np.ascontiguousarray(array).tofile(path)

@@ -64,6 +64,18 @@ def _block_fn(x, lp, *, num_heads, eps):
     return x
 
 
+def _loss_head(lnf_w, lnf_b, wte, y, labels, *, eps, vocab_size):
+    """Final LN + tied-logit next-token CE — the single loss head shared by
+    the serial, GPipe-tail and 1F1B (per-microbatch) paths."""
+    mu = y.mean(-1, keepdims=True)
+    var = ((y - mu) ** 2).mean(-1, keepdims=True)
+    h = (y - mu) * jax.lax.rsqrt(var + eps) * lnf_w + lnf_b
+    logits = (h @ wte.T)[:, :-1].reshape(-1, vocab_size)
+    tgt = labels[:, 1:].reshape(-1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, tgt[:, None], axis=-1).mean()
+
+
 def _stage_fn(stage_params, x, *, num_heads, eps):
     """Run this stage's K stacked layers (leading dim) via scan."""
 
@@ -145,18 +157,13 @@ class GPTForCausalLMPipe(nn.Layer):
                         eps=cfg.layer_norm_epsilon)
         if (mesh is not None and mesh.shape.get("pp", 1) > 1
                 and schedule == "1f1b"):
-            # loss head (final LN + tied-logit CE) runs on the last stage
-            # inside the 1F1B program, per microbatch
+            # loss head runs on the last stage inside the 1F1B program,
+            # per microbatch
             def head_fn(hp, y, lbl):
                 lnf_w_, lnf_b_, wte_ = hp
-                mu = y.mean(-1, keepdims=True)
-                var = ((y - mu) ** 2).mean(-1, keepdims=True)
-                h = (y - mu) * jax.lax.rsqrt(
-                    var + cfg.layer_norm_epsilon) * lnf_w_ + lnf_b_
-                logits = (h @ wte_.T)[:, :-1].reshape(-1, cfg.vocab_size)
-                tgt = lbl[:, 1:].reshape(-1)
-                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-                return -jnp.take_along_axis(logp, tgt[:, None], -1).mean()
+                return _loss_head(lnf_w_, lnf_b_, wte_, y, lbl,
+                                  eps=cfg.layer_norm_epsilon,
+                                  vocab_size=cfg.vocab_size)
 
             return spmd_pipeline_1f1b(
                 stage, head_fn, stack_params, (lnf_w, lnf_b, wte),
@@ -171,15 +178,9 @@ class GPTForCausalLMPipe(nn.Layer):
             x = _stage_fn(stack_params, x,
                           num_heads=cfg.num_heads,
                           eps=cfg.layer_norm_epsilon)
-        mu = x.mean(-1, keepdims=True)
-        var = ((x - mu) ** 2).mean(-1, keepdims=True)
-        x = (x - mu) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon) * lnf_w + lnf_b
-        logits = x @ wte.T
-        logits = logits[:, :-1].reshape(-1, cfg.vocab_size)
-        tgt = labels[:, 1:].reshape(-1)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, tgt[:, None], axis=-1)
-        return nll.mean()
+        return _loss_head(lnf_w, lnf_b, wte, x, labels,
+                          eps=cfg.layer_norm_epsilon,
+                          vocab_size=cfg.vocab_size)
 
     def loss(self, input_ids, labels=None):
         if labels is None:

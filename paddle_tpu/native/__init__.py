@@ -1,0 +1,45 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded via ctypes (no pybind11 in this environment).
+
+Reference analog: Paddle ships its control plane (TCPStore, watchdog, data
+feeders) as C++ inside libpaddle; here each component is a small shared
+library compiled at first use and cached next to the source (keyed by a
+source hash, so edits rebuild automatically)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_lock = threading.Lock()
+_libs = {}
+
+
+def build_and_load(name: str, extra_flags=()) -> ctypes.CDLL:
+    """Compile native/<name>.cc to a cached .so and dlopen it."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        src = os.path.join(_DIR, name + ".cc")
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        os.makedirs(_BUILD, exist_ok=True)
+        so = os.path.join(_BUILD, f"lib{name}-{tag}.so")
+        if not os.path.exists(so):
+            tmp = so + f".tmp{os.getpid()}"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", "-o", tmp, src, *extra_flags]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    f"native build of {name} failed:\n{e.stderr}") from e
+            os.replace(tmp, so)  # atomic vs concurrent builders
+        lib = ctypes.CDLL(so)
+        _libs[name] = lib
+        return lib

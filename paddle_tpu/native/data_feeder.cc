@@ -1,0 +1,226 @@
+// Native data feeder: multi-threaded file readers + batch assembly.
+//
+// Reference analog: Paddle's C++ `DataFeed`/`Dataset` ingest pipeline
+// (fluid/framework/data_feed.cc, data_set.cc) that parses training files
+// and assembles batches in worker threads, feeding trainers without
+// touching Python. TPU-native role: host-side input pipeline that keeps
+// the one controller process's Python thread free while batches of
+// fixed-size records (e.g. pre-tokenized [seq_len] int32 sequences) are
+// read, shuffled and packed off-GIL; Python pops ready batches and ships
+// them to the chip.
+//
+// Design: N reader threads pull file shards from a work queue, slice them
+// into records, optionally shuffle within a read block, and push packed
+// batch buffers into a bounded ring; `ptf_next` blocks until a batch (or
+// end-of-epoch). C ABI for ctypes.
+
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  char* data;
+  int64_t size;
+};
+
+class Feeder {
+ public:
+  Feeder(std::vector<std::string> paths, int64_t record_bytes,
+         int64_t batch_size, int threads, uint64_t seed, bool shuffle,
+         bool drop_last, int64_t queue_capacity)
+      : paths_(std::move(paths)),
+        record_bytes_(record_bytes),
+        batch_size_(batch_size),
+        shuffle_(shuffle),
+        drop_last_(drop_last),
+        capacity_(queue_capacity),
+        seed_(seed) {
+    if (shuffle_) {
+      std::mt19937_64 rng(seed_);
+      std::shuffle(paths_.begin(), paths_.end(), rng);
+    }
+    next_path_.store(0);
+    live_readers_.store(threads);
+    for (int i = 0; i < threads; i++)
+      readers_.emplace_back([this, i] { ReadLoop(i); });
+  }
+
+  ~Feeder() { Stop(); }
+
+  void Stop() {
+    stop_.store(true);
+    cv_pop_.notify_all();
+    cv_push_.notify_all();
+    for (auto& t : readers_)
+      if (t.joinable()) t.join();
+    readers_.clear();
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& b : queue_) free(b.data);
+    queue_.clear();
+    if (partial_.data) {
+      free(partial_.data);
+      partial_ = Batch{nullptr, 0};
+    }
+  }
+
+  // Returns >0 size, -1 end of data, -2 timeout.
+  int64_t Next(char** out, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool ok = cv_pop_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms), [this] {
+          return !queue_.empty() || stop_.load() ||
+                 (live_readers_.load() == 0 && queue_.empty());
+        });
+    if (!ok) return -2;
+    if (!queue_.empty()) {
+      Batch b = queue_.front();
+      queue_.pop_front();
+      cv_push_.notify_one();
+      *out = b.data;
+      return b.size;
+    }
+    return -1;  // drained and all readers finished (or stopped)
+  }
+
+ private:
+  void ReadLoop(int tid) {
+    std::mt19937_64 rng(seed_ + 0x9e3779b97f4a7c15ull * (tid + 1));
+    std::vector<char> carry;  // partial record/batch spill between files
+    while (!stop_.load()) {
+      size_t idx = next_path_.fetch_add(1);
+      if (idx >= paths_.size()) break;
+      FILE* f = fopen(paths_[idx].c_str(), "rb");
+      if (!f) continue;
+      // read the whole shard in large blocks, slice into records
+      const size_t kBlock = size_t(4) << 20;
+      std::vector<char> buf;
+      buf.reserve(kBlock + carry.size());
+      buf = std::move(carry);
+      carry.clear();
+      for (;;) {
+        size_t off = buf.size();
+        buf.resize(off + kBlock);
+        size_t got = fread(buf.data() + off, 1, kBlock, f);
+        buf.resize(off + got);
+        bool eof = got < kBlock;
+        size_t usable = buf.size() - buf.size() % record_bytes_;
+        if (eof || usable >= kBlock) {
+          EmitRecords(buf.data(), usable / record_bytes_, &rng);
+          std::vector<char> rest(buf.begin() + usable, buf.end());
+          buf = std::move(rest);
+        }
+        if (eof) break;
+        if (stop_.load()) break;
+      }
+      carry = std::move(buf);  // partial record crosses file boundary
+      fclose(f);
+    }
+    if (live_readers_.fetch_sub(1) == 1) {
+      // last reader out: flush the partial batch unless drop_last
+      std::lock_guard<std::mutex> g(mu_);
+      if (!drop_last_ && partial_.size > 0) {
+        queue_.push_back(partial_);
+        partial_ = Batch{nullptr, 0};
+      } else if (partial_.data) {
+        free(partial_.data);
+        partial_ = Batch{nullptr, 0};
+      }
+    }
+    cv_pop_.notify_all();
+  }
+
+  // Pack n records (contiguous at p) into batches; shuffle record order
+  // within this block first (block-local shuffle ≈ reference Dataset's
+  // shuffle window).
+  void EmitRecords(const char* p, size_t n, std::mt19937_64* rng) {
+    std::vector<uint32_t> order(n);
+    for (size_t i = 0; i < n; i++) order[i] = static_cast<uint32_t>(i);
+    if (shuffle_) std::shuffle(order.begin(), order.end(), *rng);
+    const int64_t bbytes = batch_size_ * record_bytes_;
+    size_t i = 0;
+    while (i < n && !stop_.load()) {
+      std::unique_lock<std::mutex> lk(mu_);  // one acquisition per batch
+      if (!partial_.data) {
+        partial_.data = static_cast<char*>(malloc(bbytes));
+        partial_.size = 0;
+      }
+      while (i < n && partial_.size < bbytes) {
+        memcpy(partial_.data + partial_.size, p + order[i] * record_bytes_,
+               record_bytes_);
+        partial_.size += record_bytes_;
+        i++;
+      }
+      if (partial_.size == bbytes) {
+        cv_push_.wait(lk, [this] {
+          return queue_.size() < static_cast<size_t>(capacity_) ||
+                 stop_.load();
+        });
+        if (stop_.load()) return;
+        queue_.push_back(partial_);
+        partial_ = Batch{nullptr, 0};
+        cv_pop_.notify_one();
+      }
+    }
+  }
+
+  std::vector<std::string> paths_;
+  const int64_t record_bytes_, batch_size_;
+  const bool shuffle_, drop_last_;
+  const int64_t capacity_;
+  const uint64_t seed_;
+  std::vector<std::thread> readers_;
+  std::atomic<size_t> next_path_;
+  std::atomic<int> live_readers_;
+  std::atomic<bool> stop_{false};
+  std::mutex mu_;
+  std::condition_variable cv_pop_, cv_push_;
+  std::deque<Batch> queue_;
+  Batch partial_{nullptr, 0};
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptf_start(const char* paths_joined, int64_t record_bytes,
+                int64_t batch_size, int threads, uint64_t seed, int shuffle,
+                int drop_last, int64_t queue_capacity) {
+  std::vector<std::string> paths;
+  const char* p = paths_joined;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    if (!nl) {
+      paths.emplace_back(p);
+      break;
+    }
+    if (nl != p) paths.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  if (paths.empty() || record_bytes <= 0 || batch_size <= 0) return nullptr;
+  return new Feeder(std::move(paths), record_bytes, batch_size,
+                    std::max(1, threads), seed, shuffle != 0, drop_last != 0,
+                    std::max<int64_t>(1, queue_capacity));
+}
+
+int64_t ptf_next(void* h, char** out, int64_t timeout_ms) {
+  return static_cast<Feeder*>(h)->Next(out, timeout_ms);
+}
+
+void ptf_free_batch(char* p) { free(p); }
+
+void ptf_stop(void* h) { delete static_cast<Feeder*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,138 @@
+"""Coordination store + watchdog tests (reference:
+test_tcp_store.cc self-test; here against the native poll-loop daemon)."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import (TCPStore, Watchdog,
+                                          create_master_store)
+
+
+@pytest.fixture()
+def store():
+    s = create_master_store(world_size=1)
+    yield s
+    s.close()
+
+
+def test_set_get_add_delete(store):
+    store.set("a", b"hello")
+    assert store.get_nowait("a") == b"hello"
+    assert store.get("a") == b"hello"
+    assert store.get_nowait("missing") is None
+    assert store.add("ctr", 5) == 5
+    assert store.add("ctr", 2) == 7
+    assert store.get_nowait("ctr") == b"7"
+    assert store.delete_key("a")
+    assert not store.delete_key("a")
+    assert store.get_nowait("a") is None
+
+
+def test_binary_values_and_keys_listing(store):
+    blob = bytes(range(256)) * 10
+    store.set("/ws/r0", blob)
+    store.set("/ws/r1", b"x")
+    store.set("/other", b"y")
+    assert store.get_nowait("/ws/r0") == blob
+    assert sorted(store.keys("/ws/")) == ["/ws/r0", "/ws/r1"]
+
+
+def test_wait_blocks_until_set(store):
+    got = {}
+
+    def waiter():
+        got["v"] = store2.wait("later", timeout=10)
+
+    store2 = TCPStore(port=store.port)  # second client connection
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    store.set("later", b"now")
+    t.join(timeout=5)
+    assert got["v"] == b"now"
+    store2.close()
+
+
+def test_wait_timeout(store):
+    with pytest.raises(TimeoutError):
+        store.wait("never", timeout=0.3)
+
+
+def test_barrier_across_clients(store):
+    world = 4
+    clients = [TCPStore(port=store.port, world_size=world)
+               for _ in range(world)]
+    arrived = []
+
+    def enter(i):
+        clients[i].barrier("b1", timeout=10)
+        arrived.append(i)
+
+    threads = [threading.Thread(target=enter, args=(i,))
+               for i in range(world - 1)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    assert arrived == []  # nobody released before the last arrival
+    clients[world - 1].barrier("b1", timeout=10)
+    for t in threads:
+        t.join(timeout=5)
+    assert sorted(arrived) == list(range(world - 1))
+    # barrier is reusable (epoch rolls over)
+    for c in clients:
+        threading.Thread(target=c.barrier, args=("b1",)).start()
+    for c in clients:
+        c.close()
+
+
+def test_heartbeat_and_watchdog(store):
+    worker = TCPStore(port=store.port)
+    worker.start_heartbeat("rank1", interval=0.05)
+    time.sleep(0.2)
+    age = store.heartbeat_age("rank1")
+    assert age is not None and age < 1.0
+    failures = []
+    dog = Watchdog(store, ttl=0.3, interval=0.05,
+                   on_failure=lambda dead: failures.extend(dead))
+    assert dog.members() == ["rank1"]
+    assert dog.check() == []  # alive
+    worker.stop_heartbeat()
+    worker.close()
+    deadline = time.time() + 5
+    dog.start()
+    while not failures and time.time() < deadline:
+        time.sleep(0.05)
+    dog.stop()
+    assert failures == ["rank1"]
+
+
+def _rank_main(port, rank, world, q):
+    s = TCPStore(port=port, world_size=world, timeout=20)
+    s.set(f"/rdzv/{rank}", str(rank))
+    s.barrier("boot")
+    peers = sorted(int(s.get(f"/rdzv/{r}")) for r in range(world))
+    q.put((rank, peers))
+    s.close()
+
+
+def test_multiprocess_rendezvous(store):
+    """Real multi-process bootstrap: N processes rendezvous through the
+    store like ranks joining a job (reference: test strategy §4 —
+    single-host multi-process)."""
+    import multiprocessing as mp
+
+    world = 4
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rank_main,
+                         args=(store.port, r, world, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=30) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=10)
+    assert sorted(r for r, _ in results) == list(range(world))
+    for _, peers in results:
+        assert peers == list(range(world))
