@@ -67,3 +67,33 @@ def empty_cache():
 def synchronize():
     import jax
     jax.effects_barrier()
+
+
+_LAZY_SUBMODULES = ("profiler", "metric", "vision", "hapi", "distribution",
+                    "sparse", "quantization", "fft", "signal", "linalg",
+                    "text", "audio", "onnx", "static")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            # PEP 562: attribute probes (hasattr etc.) expect AttributeError
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}") from e
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi import Model
+
+        globals()["Model"] = Model
+        return Model
+    if name == "summary":
+        from .hapi import summary
+
+        globals()["summary"] = summary
+        return summary
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

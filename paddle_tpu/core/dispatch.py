@@ -186,7 +186,30 @@ def set_amp_cast_hook(fn):
     _amp_cast_hook = fn
 
 
+# Profiler hook: set by paddle_tpu.profiler while recording; maps op name ->
+# a span object with begin()/end() (reference analog: the RecordEvent
+# emitted inside every generated ad_func).
+_profile_hook = None
+
+
+def set_profile_hook(fn):
+    global _profile_hook
+    _profile_hook = fn
+
+
 def apply(name, impl, tensor_args, statics=None, out_wrapper=None):
+    hook = _profile_hook  # single read: may be unset concurrently by stop()
+    if hook is None:
+        return _apply(name, impl, tensor_args, statics, out_wrapper)
+    ev = hook(name)
+    ev.begin()
+    try:
+        return _apply(name, impl, tensor_args, statics, out_wrapper)
+    finally:
+        ev.end()
+
+
+def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
     """Dispatch one eager op.
 
     Args:

@@ -17,8 +17,6 @@ that is identical in replay.
 """
 from __future__ import annotations
 
-import weakref
-
 import jax
 
 from ...core.dispatch import apply
@@ -142,12 +140,14 @@ class _Seg(Layer):
         return xs if len(xs) > 1 else xs[0]
 
 
-# segment layers are cached per (member identity, split): a fresh _Seg per
+# Segment layers are cached per (member identity, split): a fresh _Seg per
 # call would miss the per-layer impl cache and retrace/compile every step.
-# The cache is anchored to the first member via weak keys so dropping a
-# model releases its segments (and their params) instead of pinning them.
-_seg_cache = weakref.WeakKeyDictionary()
-_seg_cache_fallback = {}  # members that cannot be weak-referenced
+# The cache lives ON the first member object itself, so its lifetime is the
+# model's lifetime — dropping the model drops the segments with it (the
+# member<->_Seg reference cycle is ordinary GC work). A global registry
+# (weak or strong) cannot do this: the segments strongly reference their
+# members, which would pin a weak key forever.
+_seg_cache_fallback = {}  # anchors without a __dict__ (rare plain callables)
 
 
 def recompute_sequential(ctx, functions, *args):
@@ -159,8 +159,10 @@ def recompute_sequential(ctx, functions, *args):
     seg_size = max(1, (n + segments - 1) // segments)
     key = (tuple(id(f) for f in funcs), seg_size)
     try:
-        per_anchor = _seg_cache.setdefault(funcs[0], {})
-    except TypeError:
+        # bypass Layer.__setattr__: this is bookkeeping, not a sublayer
+        per_anchor = funcs[0].__dict__.setdefault(
+            "_recompute_seg_cache", {})
+    except AttributeError:
         per_anchor = _seg_cache_fallback
     segs = per_anchor.get(key)
     if segs is None:

@@ -1,0 +1,7 @@
+"""paddle_tpu.hapi — high-level Model API (reference: python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping,
+)

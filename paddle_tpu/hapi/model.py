@@ -1,0 +1,265 @@
+"""paddle.Model — high-level train/eval/predict loops.
+
+Reference analog: python/paddle/hapi/model.py (`Model.fit` :1054,
+prepare/evaluate/predict/save/load, train_batch/eval_batch). TPU-native:
+the loop stays in Python but every batch step runs through the eager tape
+(or, when the user wraps the network with paddle_tpu.jit.to_static, one
+compiled program per shape); callbacks/metrics accumulate on host.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import framework_io
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import CallbackList, History, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_tensor_list(data):
+    if data is None:
+        return []
+    if isinstance(data, (list, tuple)):
+        return [d if isinstance(d, Tensor) else Tensor(np.asarray(d))
+                for d in data]
+    return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+
+
+def _mean_loss(loss):
+    if isinstance(loss, (list, tuple)):
+        total = loss[0]
+        for l in loss[1:]:
+            total = total + l
+        return total
+    return loss
+
+
+class Model:
+    """Wraps a Layer with fit/evaluate/predict (reference model.py:1054)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._save_dir = None
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, **kwargs):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            ms = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+            for m in ms:
+                if not isinstance(m, Metric):
+                    raise TypeError(f"metric {m!r} is not a Metric")
+            self._metrics = list(ms)
+
+    # -- single-batch ops (reference train_batch/eval_batch) ---------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = _to_tensor_list(inputs)
+        lbs = _to_tensor_list(labels)
+        outs = self.network(*ins)
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        if self._loss is not None:
+            loss = _mean_loss(self._loss(*(list(outs_list) + lbs)))
+        else:
+            loss = _mean_loss(outs)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs_list, lbs)
+        return ([float(loss)], metrics) if metrics else [float(loss)]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = _to_tensor_list(inputs)
+        lbs = _to_tensor_list(labels)
+        outs = self.network(*ins)
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        losses = []
+        if self._loss is not None:
+            losses = [float(_mean_loss(self._loss(*(list(outs_list) + lbs))))]
+        metrics = self._update_metrics(outs_list, lbs)
+        return (losses, metrics) if metrics else losses
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outs = self.network(*_to_tensor_list(inputs))
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [o.numpy() for o in outs_list]
+
+    def _update_metrics(self, outs, labels):
+        res = []
+        for m in self._metrics:
+            pre = m.compute(outs[0], *labels)
+            pre = pre if isinstance(pre, (list, tuple)) else [pre]
+            m.update(*pre)
+            res.append(m.accumulate())
+        return res
+
+    def _metric_logs(self, logs):
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, str):
+                names, vals = [names], [vals]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    # -- loaders -----------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        """(inputs, labels) from a loader batch: last element is the label
+        (reference convention for (image, label) datasets)."""
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, shuffle=True, num_workers=0, callbacks=None):
+        assert train_data is not None, "train_data is required"
+        self._save_dir = save_dir
+        loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbs = [History(), ProgBarLogger(log_freq, verbose)]
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs += list(callbacks or [])
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cblist = CallbackList(cbs, model=self,
+                              params={"epochs": epochs, "steps": steps,
+                                      "verbose": verbose})
+        self.stop_training = False
+        cblist.call("on_train_begin", {})
+        history = cbs[0]
+        for epoch in range(epochs):
+            cblist.call("on_epoch_begin", epoch, {})
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cblist.call("on_train_batch_begin", step, {})
+                ins, lbs = self._split_batch(batch)
+                res = self.train_batch(ins, lbs or None)
+                losses = res[0] if isinstance(res, tuple) else res
+                logs = self._metric_logs({"loss": losses[0]})
+                cblist.call("on_train_batch_end", step, logs)
+                if self.stop_training:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cblist)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cblist.call("on_epoch_end", epoch, logs)
+            if self.stop_training:
+                break
+        cblist.call("on_train_end", {})
+        return history.history
+
+    def _run_eval(self, loader, cblist):
+        for m in self._metrics:
+            m.reset()
+        cblist.call("on_eval_begin", {})
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            cblist.call("on_eval_batch_begin", step, {})
+            ins, lbs = self._split_batch(batch)
+            res = self.eval_batch(ins, lbs or None)
+            ls = res[0] if isinstance(res, tuple) else res
+            if ls:
+                losses.append(ls[0])
+            logs = self._metric_logs(
+                {"loss": float(np.mean(losses))} if losses else {})
+            cblist.call("on_eval_batch_end", step, logs)
+        cblist.call("on_eval_end", logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cblist = CallbackList(
+            [ProgBarLogger(log_freq, verbose)] + list(callbacks or []),
+            model=self, params={"verbose": verbose})
+        return self._run_eval(loader, cblist)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        cblist = CallbackList(list(callbacks or []), model=self)
+        cblist.call("on_predict_begin", {})
+        outputs = []
+        for step, batch in enumerate(loader):
+            ins, _ = self._split_batch(batch)
+            cblist.call("on_predict_batch_begin", step, {})
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cblist.call("on_predict_batch_end", step, {})
+        cblist.call("on_predict_end", {})
+        # regroup: list over outputs, each a list over batches
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    # -- persistence (reference: model.py save/load) -----------------------
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework_io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        params = framework_io.load(path + ".pdparams")
+        if skip_mismatch:
+            current = self.network.state_dict()
+            params = {k: v for k, v in params.items()
+                      if k in current and
+                      tuple(np.asarray(v).shape) == tuple(current[k].shape)}
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(framework_io.load(opt_path))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,75 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py) —
+layer-by-layer table of output shapes and parameter counts via forward
+hooks on a dry run."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def _num_params(layer):
+    return sum(int(np.prod(p.shape)) for p in
+               layer.parameters(include_sublayers=False))
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print and return {'total_params': N, 'trainable_params': N}."""
+    rows = []
+    hooks = []
+
+    def mk_hook(name, layer):
+        def hook(lyr, ins, out):
+            o = out[0] if isinstance(out, (list, tuple)) else out
+            shape = list(o.shape) if hasattr(o, "shape") else []
+            rows.append((f"{type(layer).__name__}-{len(rows) + 1}",
+                         name, shape, _num_params(layer)))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if next(iter(sub.sublayers()), None) is None:  # leaves only
+            hooks.append(sub.register_forward_post_hook(mk_hook(name, sub)))
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("either input_size or input is required")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        input = [Tensor(np.zeros([d if d is not None and d > 0 else 1
+                                  for d in s],
+                                 dtype=np.dtype(dt or "float32")))
+                 for s, dt in zip(sizes, dts)]
+    elif not isinstance(input, (list, tuple)):
+        input = [input]
+
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        with no_grad():
+            net(*input)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    w = max([len(r[0]) for r in rows] + [12]) + 2
+    lines = [f"{'Layer (type)':<{w}} {'Output Shape':<24} {'Param #':>12}",
+             "-" * (w + 38)]
+    for cls_name, _, shape, n in rows:
+        lines.append(f"{cls_name:<{w}} {str(shape):<24} {n:>12,}")
+    lines += ["-" * (w + 38),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

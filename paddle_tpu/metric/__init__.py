@@ -1,0 +1,189 @@
+"""paddle_tpu.metric — streaming evaluation metrics.
+
+Reference analog: python/paddle/metric/metrics.py (Metric base with
+update/accumulate/reset/name, Accuracy, Precision, Recall, Auc). Metrics
+accumulate on host in numpy — they sit outside the jitted step, exactly
+like the reference keeps them outside the Program.
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _to_numpy(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base metric (reference: metrics.py Metric)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, pred, label, *args):
+        """Optional pre-processing run on device outputs; default passthrough
+        (reference: Metric.compute)."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = _to_numpy(pred)
+        label = _to_numpy(label)
+        idx = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:  # one-hot or [N, 1] label
+            if label.shape[-1] == pred.shape[-1] and pred.shape[-1] > 1:
+                label = label.argmax(-1)
+            else:
+                label = label.squeeze(-1)
+        correct = (idx == label[..., None]).astype(np.float32)
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_numpy(correct)
+        n = correct[..., 0].size
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].sum()
+            self.count[i] += n
+        res = self.total / np.maximum(self.count, 1)
+        return res[0] if len(self.topk) == 1 else res
+
+    def accumulate(self):
+        res = self.total / np.maximum(self.count, 1)
+        return float(res[0]) if len(self.topk) == 1 else [float(r) for r in res]
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision over probability predictions (reference:
+    metrics.py Precision — label 0/1, pred thresholded at 0.5)."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_numpy(preds)).astype(np.int64).reshape(-1)
+        labels = _to_numpy(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (reference: metrics.py Recall)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_to_numpy(preds)).astype(np.int64).reshape(-1)
+        labels = _to_numpy(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via thresholded histogram accumulation (reference:
+    metrics.py Auc — same bucketed trapezoid estimate)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.num_thresholds = int(num_thresholds)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]  # P(class=1), reference convention
+        preds = preds.reshape(-1)
+        labels = _to_numpy(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels != 1], 1)
+
+    def accumulate(self):
+        # sweep thresholds high->low accumulating TP/FP counts
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        # anchor at (0, 0): without it the first trapezoid is dropped and a
+        # single-bucket distribution degenerates to 0 instead of 0.5
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name

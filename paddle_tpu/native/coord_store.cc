@@ -336,6 +336,8 @@ class StoreClient {
   // each call opens its own request/response exchange on one persistent
   // connection; a mutex serializes callers (heartbeat thread + user thread)
   bool Connect(const std::string& host, int port, int64_t timeout_ms) {
+    host_ = host;
+    port_ = port;
     // resolve hostnames too (masters are usually named hosts, not IPs)
     addrinfo hints{}, *res = nullptr;
     hints.ai_family = AF_INET;
@@ -436,16 +438,24 @@ class StoreClient {
   }
 
   // ---- heartbeat publisher (the watchdog's write side) ----
+  // Runs on its OWN connection: the main connection's mutex is held for
+  // the full duration of a parked Wait/barrier, and a rank sitting at a
+  // barrier must keep heartbeating or the watchdog declares it dead.
   void StartHeartbeat(const std::string& key, int64_t interval_ms) {
     StopHeartbeat();
     hb_run_.store(true);
-    hb_thread_ = std::thread([this, key, interval_ms] {
+    std::string host = host_;
+    int port = port_;
+    hb_thread_ = std::thread([this, key, interval_ms, host, port] {
+      StoreClient hb;
+      bool connected = hb.Connect(host, port, 5000);
       while (hb_run_.load()) {
-        Set(key, std::to_string(now_ms()));
+        if (connected) hb.Set(key, std::to_string(now_ms()));
         std::unique_lock<std::mutex> lk(hb_mu_);
         hb_cv_.wait_for(lk, std::chrono::milliseconds(interval_ms),
                         [this] { return !hb_run_.load(); });
       }
+      hb.Close();
     });
   }
 
@@ -457,6 +467,8 @@ class StoreClient {
 
  private:
   int fd_ = -1;
+  std::string host_;
+  int port_ = 0;
   std::mutex mu_;
   std::thread hb_thread_;
   std::atomic<bool> hb_run_{false};

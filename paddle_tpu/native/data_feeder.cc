@@ -169,9 +169,13 @@ class Feeder {
                  stop_.load();
         });
         if (stop_.load()) return;
-        queue_.push_back(partial_);
-        partial_ = Batch{nullptr, 0};
-        cv_pop_.notify_one();
+        // another reader may have pushed this batch while we waited on a
+        // full queue — only push if the full partial is still in place
+        if (partial_.data && partial_.size == bbytes) {
+          queue_.push_back(partial_);
+          partial_ = Batch{nullptr, 0};
+          cv_pop_.notify_one();
+        }
       }
     }
   }

@@ -1,0 +1,183 @@
+// Host event recorder: per-thread span buffers with nanosecond timestamps.
+//
+// Reference analog: `HostTracer`/`HostEventRecorder` (fluid/platform/
+// profiler/host_tracer.h:26 — RecordEvent instrumentation writing into a
+// thread-local ring buffer, merged and exported by ChromeTracingLogger).
+// TPU-native role: host-side op/py spans that sit alongside XLA's own
+// XPlane device traces; this records the Python-dispatch half cheaply
+// (two ctypes calls per span) without holding the GIL in the recorder.
+//
+// Design: interned name ids; spans pushed to thread-local vectors behind a
+// registry mutex only at thread-buffer creation; dump serializes everything
+// to chrome-trace JSON.
+
+#include <stdint.h>
+#include <string.h>
+
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Span {
+  uint32_t name_id;
+  int64_t t0_ns;
+  int64_t t1_ns;
+};
+
+struct ThreadBuf {
+  uint64_t tid;
+  std::vector<Span> spans;
+  std::vector<std::pair<uint32_t, int64_t>> stack;  // open spans
+};
+
+std::mutex g_mu;
+std::vector<ThreadBuf*> g_bufs;
+std::unordered_map<std::string, uint32_t> g_name_ids;
+std::vector<std::string> g_names;
+std::atomic<bool> g_enabled{false};
+std::atomic<uint64_t> g_next_tid{0};
+
+ThreadBuf* tls() {
+  thread_local ThreadBuf* buf = [] {
+    auto* b = new ThreadBuf();
+    b->tid = g_next_tid.fetch_add(1);
+    std::lock_guard<std::mutex> g(g_mu);
+    g_bufs.push_back(b);
+    return b;
+  }();
+  return buf;
+}
+
+uint32_t intern(const char* name) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_name_ids.find(name);
+  if (it != g_name_ids.end()) return it->second;
+  uint32_t id = static_cast<uint32_t>(g_names.size());
+  g_names.emplace_back(name);
+  g_name_ids.emplace(name, id);
+  return id;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pht_enable() { g_enabled.store(true, std::memory_order_relaxed); }
+void pht_disable() { g_enabled.store(false, std::memory_order_relaxed); }
+int pht_enabled() { return g_enabled.load(std::memory_order_relaxed); }
+
+void pht_clear() {
+  std::lock_guard<std::mutex> g(g_mu);
+  for (auto* b : g_bufs) {
+    b->spans.clear();
+    b->stack.clear();
+  }
+}
+
+// Returns an interned id usable with pht_begin_id (amortizes interning).
+uint32_t pht_name_id(const char* name) { return intern(name); }
+
+void pht_begin_id(uint32_t name_id) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  tls()->stack.emplace_back(name_id, now_ns());
+}
+
+void pht_begin(const char* name) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  pht_begin_id(intern(name));
+}
+
+void pht_end() {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = tls();
+  if (b->stack.empty()) return;
+  auto open = b->stack.back();
+  b->stack.pop_back();
+  b->spans.push_back(Span{open.first, open.second, now_ns()});
+}
+
+// One-shot complete span (begin+end supplied by caller, ns).
+void pht_span(const char* name, int64_t t0_ns, int64_t t1_ns) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  tls()->spans.push_back(Span{intern(name), t0_ns, t1_ns});
+}
+
+int64_t pht_now_ns() { return now_ns(); }
+
+// Serialize all spans as chrome-trace "X" events (JSON array body).
+// Caller frees with pht_free.
+char* pht_dump_json(int pid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::ostringstream os;
+  os << "[";
+  bool first = true;
+  for (auto* b : g_bufs) {
+    for (auto& s : b->spans) {
+      if (!first) os << ",";
+      first = false;
+      const std::string& nm = g_names[s.name_id];
+      std::string esc;
+      esc.reserve(nm.size());
+      for (char c : nm) {
+        if (c == '"' || c == '\\') esc.push_back('\\');
+        esc.push_back(c);
+      }
+      os << "{\"ph\":\"X\",\"pid\":" << pid << ",\"tid\":" << b->tid
+         << ",\"name\":\"" << esc << "\",\"ts\":" << s.t0_ns / 1000.0
+         << ",\"dur\":" << (s.t1_ns - s.t0_ns) / 1000.0 << "}";
+    }
+  }
+  os << "]";
+  std::string out = os.str();
+  char* p = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(p, out.data(), out.size() + 1);
+  return p;
+}
+
+// Binary dump: per span (tid u64, name_id u32, t0 i64, t1 i64); returns
+// count, fills *out (caller frees). Names via pht_get_name.
+int64_t pht_dump_raw(char** out) {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t n = 0;
+  for (auto* b : g_bufs) n += static_cast<int64_t>(b->spans.size());
+  const size_t rec = 8 + 4 + 8 + 8;
+  char* p = static_cast<char*>(malloc(static_cast<size_t>(n) * rec));
+  char* q = p;
+  for (auto* b : g_bufs) {
+    for (auto& s : b->spans) {
+      memcpy(q, &b->tid, 8);
+      memcpy(q + 8, &s.name_id, 4);
+      memcpy(q + 12, &s.t0_ns, 8);
+      memcpy(q + 20, &s.t1_ns, 8);
+      q += rec;
+    }
+  }
+  *out = p;
+  return n;
+}
+
+// malloc'd copy (free with pht_free): interior string pointers are not
+// stable across concurrent interning
+char* pht_get_name(uint32_t id) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::string nm = id < g_names.size() ? g_names[id] : std::string();
+  char* p = static_cast<char*>(malloc(nm.size() + 1));
+  memcpy(p, nm.c_str(), nm.size() + 1);
+  return p;
+}
+
+void pht_free(char* p) { free(p); }
+
+}  // extern "C"

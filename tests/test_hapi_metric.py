@@ -1,0 +1,157 @@
+"""hapi Model + metric tests (reference: test/legacy_test/test_model.py,
+test_metrics.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import Model, EarlyStopping
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+
+
+# ---- metrics --------------------------------------------------------------
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1], [0.1, 0.2, 0.7]])
+    label = np.array([1, 1, 2])
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 3) < 1e-6   # rows 0,2 correct at top1
+    assert abs(top2 - 3 / 3) < 1e-6   # row 1's label is 2nd-best
+    assert m.name() == ["acc_top1", "acc_top2"]
+    m.reset()
+    assert m.accumulate() == [0.0, 0.0]
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6, 0.1])
+    labels = np.array([1, 0, 1, 1, 0])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    # thresholded preds: [1,1,0,1,0] -> tp=2 fp=1 fn=1
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+
+def test_auc_against_sklearn_formula():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(2000)
+    labels = (rng.rand(2000) < scores).astype(np.int64)  # correlated
+    m = Auc()
+    m.update(scores, labels)
+    got = m.accumulate()
+    # exact rank-based AUC
+    order = np.argsort(scores)
+    ranks = np.empty(2000)
+    ranks[order] = np.arange(1, 2001)
+    n_pos = labels.sum()
+    n_neg = 2000 - n_pos
+    exact = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / \
+        (n_pos * n_neg)
+    assert abs(got - exact) < 5e-3
+
+
+# ---- Model ---------------------------------------------------------------
+
+class _XorSet(Dataset):
+    """Learnable 2-class problem."""
+
+    def __init__(self, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 2).astype(np.float32)
+        self.y = ((self.x[:, 0] * self.x[:, 1]) > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 32),
+                         nn.Tanh(), nn.Linear(32, 2))
+
+
+def _prepared_model():
+    net = _net()
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return model
+
+
+def test_fit_evaluate_predict(tmp_path):
+    model = _prepared_model()
+    hist = model.fit(_XorSet(512), _XorSet(64, seed=1), batch_size=32,
+                     epochs=8, verbose=0)
+    assert "loss" in hist and len(hist["loss"]) == 8
+    assert hist["loss"][-1] < hist["loss"][0]
+    ev = model.evaluate(_XorSet(64, seed=2), batch_size=32, verbose=0)
+    assert ev["acc"] > 0.8
+    preds = model.predict(_XorSet(16, seed=3), batch_size=8,
+                          stack_outputs=True)
+    assert preds[0].shape == (16, 2)
+
+
+def test_model_save_load(tmp_path):
+    model = _prepared_model()
+    model.fit(_XorSet(128), batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = _prepared_model()
+    model2.load(path)
+    x = np.ones((4, 2), np.float32)
+    np.testing.assert_allclose(model.predict_batch([x])[0],
+                               model2.predict_batch([x])[0], rtol=1e-6)
+
+
+def test_early_stopping_stops():
+    model = _prepared_model()
+    es = EarlyStopping(monitor="loss", patience=1, min_delta=100.0,
+                       save_best_model=False)  # impossible improvement
+    hist = model.fit(_XorSet(64), _XorSet(32, seed=1), batch_size=32,
+                     epochs=10, verbose=0, callbacks=[es])
+    assert len(hist["loss"]) < 10  # stopped early
+
+
+def test_summary_counts_params():
+    net = _net()
+    info = paddle.summary(net, input_size=(1, 2))
+    want = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert info["total_params"] == want
+    assert info["trainable_params"] == want
+
+
+def test_auc_single_bucket_is_chance_level():
+    m = Auc()
+    m.update(np.ones(10), np.array([1, 0] * 5))
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_model_load_skip_mismatch(tmp_path):
+    model = _prepared_model()
+    path = str(tmp_path / "m")
+    model.save(path)
+
+    net2 = nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 32),
+                         nn.Tanh(), nn.Linear(32, 5))  # different head
+    m2 = Model(net2)
+    m2.prepare()
+    with pytest.raises(ValueError):
+        m2.load(path)
+    m2.load(path, skip_mismatch=True)  # loads the compatible prefix
+    w1 = model.network[0].weight.numpy()
+    np.testing.assert_allclose(net2[0].weight.numpy(), w1)

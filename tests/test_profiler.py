@@ -1,0 +1,105 @@
+"""Profiler tests (reference: test/legacy_test profiler tests +
+make_scheduler state machine, profiler/profiler.py:117)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, make_scheduler,
+                                 export_chrome_tracing, benchmark)
+
+
+def test_make_scheduler_state_machine():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                           skip_first=2)
+    states = [sched(i) for i in range(12)]
+    S = ProfilerState
+    assert states == [
+        S.CLOSED, S.CLOSED,                      # skip_first
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # cycle 1
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # cycle 2
+        S.CLOSED, S.CLOSED,                      # repeat exhausted
+    ]
+
+
+def test_record_event_spans_and_export(tmp_path):
+    prof = Profiler(targets={ProfilerTarget.CPU})
+    prof.start()
+    with RecordEvent("outer"):
+        with RecordEvent("inner"):
+            pass
+    prof.stop()
+    evs = prof.events()
+    names = [n for _, n, _, _ in evs]
+    assert "outer" in names and "inner" in names
+    by = {n: (t0, t1) for _, n, t0, t1 in evs}
+    # nesting: inner contained in outer
+    assert by["outer"][0] <= by["inner"][0] <= by["inner"][1] <= by["outer"][1]
+
+    path = str(tmp_path / "trace.json")
+    prof.export_chrome_tracing(path)
+    data = json.load(open(path))
+    assert {e["name"] for e in data["traceEvents"]} >= {"outer", "inner"}
+
+
+def test_ops_are_spanned_and_summary_runs():
+    prof = Profiler(targets={ProfilerTarget.CPU})
+    prof.start()
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    y = (x @ x).sum()
+    prof.stop()
+    names = {n for _, n, _, _ in prof.events()}
+    assert any(n.startswith("op::") for n in names)
+    out = prof.summary()
+    assert "calls" in out
+    # hook removed after stop: new ops record nothing
+    n_before = len(prof.events())
+    _ = x + x
+    assert len(prof.events()) == n_before
+
+
+def test_scheduler_driven_profiling_and_handler(tmp_path):
+    fired = []
+    prof = Profiler(
+        scheduler=make_scheduler(closed=1, ready=1, record=2, repeat=1),
+        on_trace_ready=lambda p: fired.append(p.step_num),
+        targets={ProfilerTarget.CPU})
+    prof.start()
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    for _ in range(6):
+        _ = x * 2
+        prof.step()
+    prof.stop()
+    assert fired == [4]  # handler fires when leaving RECORD_AND_RETURN
+
+
+def test_benchmark_timer():
+    bm = benchmark()
+    bm.begin()
+    for _ in range(5):
+        bm.step(num_samples=32)
+    rep = bm.end()
+    assert rep["steps"] == 5
+    assert rep["ips"] > 0
+    assert rep["steps_per_sec"] > 0
+
+
+def test_back_to_back_cycles_clear_buffer(tmp_path):
+    """Traces must not accumulate across record cycles (closed=0, ready=0)."""
+    traces = []
+    prof = Profiler(
+        scheduler=make_scheduler(closed=0, ready=0, record=2, repeat=2),
+        on_trace_ready=lambda p: traces.append(len(p.events())),
+        targets={ProfilerTarget.CPU})
+    prof.start()
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    for _ in range(4):
+        _ = x * 2
+        prof.step()
+    prof.stop()
+    assert len(traces) == 2
+    # cycle 2's trace only contains cycle 2's spans (~same count as cycle 1)
+    assert traces[1] <= traces[0] + 1
