@@ -84,8 +84,10 @@ def init_parallel_env():
         host, _, port = master.rpartition(":")
         rank = int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0"))
         world = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+        # the launcher controller on node 0 hosts the daemon; every worker
+        # (rank 0 included) is a client
         _global_store = TCPStore(host or "127.0.0.1", int(port),
-                                 is_master=rank == 0, world_size=world)
+                                 world_size=world)
         _global_store.start_heartbeat(f"rank{rank}")
     _initialized = True
     return ParallelEnv()

@@ -1,0 +1,111 @@
+"""Elastic membership manager.
+
+Reference analog: `ElasticManager` (fleet/elastic/manager.py:126) — etcd
+node registration with TTL leases + heartbeat threads (:251-264), peer-set
+watching, scale in/out detection, and trainer relaunch with rewritten
+endpoints. TPU-native: the native coordination store replaces etcd; leases
+are heartbeat keys with server-side receipt ages; relaunch itself is the
+launcher's elastic loop (launch/controller.py) — this manager provides the
+membership/decision layer.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..store import TCPStore, Watchdog
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, job_id="default", rank=0,
+                 np_target=1, ttl=10.0, interval=1.0):
+        self.store = store
+        self.job_id = job_id
+        self.rank = int(rank)
+        self.np_target = int(np_target)  # desired world size
+        self.ttl = float(ttl)
+        self.interval = float(interval)
+        self._member = f"{job_id}/node{rank}"
+        self._watchdog = Watchdog(store, ttl=ttl, interval=interval)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._status = ElasticStatus.HOLD
+        self._thread = None
+
+    # -- membership --------------------------------------------------------
+    def register(self):
+        """Join the job: publish endpoint + start the heartbeat lease
+        (reference: manager.py register + lease keepalive)."""
+        self.store.set(f"/elastic/{self.job_id}/node/{self.rank}",
+                       str(self.rank))
+        self.store.start_heartbeat(self._member, interval=self.interval)
+
+    def deregister(self):
+        self.store.stop_heartbeat()
+        self.store.delete_key(f"/elastic/{self.job_id}/node/{self.rank}")
+
+    def alive_members(self):
+        """Node names with fresh heartbeats."""
+        out = []
+        for m in self._watchdog.members():
+            if not m.startswith(f"{self.job_id}/"):
+                continue
+            age = self.store.heartbeat_age(m)
+            if age is not None and age <= self.ttl:
+                out.append(m)
+        return sorted(out)
+
+    # -- scale detection ---------------------------------------------------
+    def check(self):
+        """One sweep: HOLD while converging, RESTART on scale in/out."""
+        n = len(self.alive_members())
+        if n == self.np_target:
+            return ElasticStatus.HOLD
+        return ElasticStatus.RESTART
+
+    def watch(self, on_change=None):
+        """Background watch; calls on_change(status, alive) on transitions
+        out of HOLD (reference: manager.py watch loop)."""
+
+        def loop():
+            last = None
+            while not self._stop.wait(self.interval):
+                st = self.check()
+                with self._lock:
+                    self._status = st
+                if st != ElasticStatus.HOLD and st != last and \
+                        on_change is not None:
+                    on_change(st, self.alive_members())
+                last = st
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def status(self):
+        with self._lock:
+            return self._status
+
+    def wait_for_world(self, timeout=60.0):
+        """Block until np_target members are alive (job convergence)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.alive_members()) == self.np_target:
+                return True
+            time.sleep(self.interval / 2)
+        return False
+
+    def exit(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._watchdog.stop()

@@ -1,0 +1,17 @@
+"""paddle_tpu.distributed.launch — multi-process job launcher.
+
+Reference analog: `python -m paddle.distributed.launch` (launch/main.py:20)
+with its Context -> Controller pipeline (controllers/collective.py:272
+spawning per-rank processes), `HTTPMaster`/`ETCDMaster` rendezvous
+(controllers/master.py:73/186), the log watcher (watcher.py), and elastic
+relaunch (fleet/elastic/manager.py:126).
+
+TPU-native redesign: one controller process per HOST drives all local
+chips through PJRT, so `--nproc_per_node` defaults to 1 (the reference
+spawns one proc per GPU). Rendezvous rides the native coordination store
+(rank0-hosted TCPStore): node ranks come from an atomic counter, the
+world address list is published as KV entries, and liveness is heartbeat
+keys that an elastic controller watches to trigger relaunch.
+"""
+from .context import Context, parse_args  # noqa: F401
+from .controller import Controller, main  # noqa: F401

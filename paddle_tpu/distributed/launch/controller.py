@@ -1,0 +1,187 @@
+"""Launch controller: rendezvous, process spawn, log watch, elastic loop.
+
+Reference analog: controllers/collective.py (CollectiveController.build_pod
++ _get_entrypoint spawning per-rank procs with PADDLE_TRAINER_* env),
+controllers/master.py rendezvous, watcher.py log aggregation, and
+fleet/elastic/manager.py's relaunch-on-failure loop.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from .context import Context, free_port
+
+
+class Proc:
+    def __init__(self, rank, popen, log_path=None):
+        self.rank = rank
+        self.popen = popen
+        self.log_path = log_path
+
+
+class Controller:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.args = ctx.args
+        self.procs: list[Proc] = []
+        self._store = None
+        self._shutdown = threading.Event()
+
+    # -- rendezvous --------------------------------------------------------
+    def rendezvous(self):
+        """Determine (node_rank, master addr), hosting the store on node 0.
+
+        Single-node default: host a store on a free port locally.
+        Multi-node: --master required; node ranks from an atomic counter
+        (reference: master.py sync_peers)."""
+        from ..store import TCPStore
+
+        args = self.args
+        if args.master is None:
+            if args.nnodes != 1:
+                raise SystemExit("--master host:port is required for "
+                                 "multi-node jobs")
+            port = free_port()
+            self.master = f"127.0.0.1:{port}"
+            self._store = TCPStore("127.0.0.1", port, is_master=True,
+                                   world_size=args.nnodes)
+            self.node_rank = 0
+            return
+        host, _, port = args.master.rpartition(":")
+        is_host = args.rank in (0, -1) and args.nnodes == 1
+        if args.rank == 0 or is_host:
+            self._store = TCPStore(host, int(port), is_master=True,
+                                   world_size=args.nnodes)
+        else:
+            self._store = TCPStore(host, int(port), world_size=args.nnodes)
+        self.master = args.master
+        if args.rank >= 0:
+            self.node_rank = args.rank
+        else:
+            self.node_rank = self._store.add(
+                f"/rdzv/{args.job_id}/nodes", 1) - 1
+
+    # -- spawn -------------------------------------------------------------
+    def _env_for(self, local_rank, restart_epoch=0):
+        args = self.args
+        world = args.nnodes * args.nproc_per_node
+        rank = self.node_rank * args.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update({
+            # framework env (consumed by init_parallel_env, env.py)
+            "PADDLE_TPU_MASTER": self.master,
+            "PADDLE_TPU_PROCESS_ID": str(rank),
+            "PADDLE_TPU_NUM_PROCESSES": str(world),
+            # reference-parity env (PADDLE_TRAINER_*, parallel.py:943)
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_RESTART_EPOCH": str(restart_epoch),
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        if world > 1:
+            # jax.distributed coordinator (data plane) on master host,
+            # distinct port from the KV store
+            mhost, _, mport = self.master.rpartition(":")
+            env["PADDLE_TPU_COORDINATOR"] = \
+                f"{mhost}:{int(mport) + 1}"
+        if args.devices:
+            env["CUDA_VISIBLE_DEVICES"] = args.devices
+            env["TPU_VISIBLE_DEVICES"] = args.devices
+        return env
+
+    def spawn(self, restart_epoch=0):
+        args = self.args
+        self.procs = []
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+        for lr in range(args.nproc_per_node):
+            cmd = [sys.executable, args.training_script,
+                   *args.training_script_args]
+            if args.training_script == "-m":
+                cmd = [sys.executable, "-m", *args.training_script_args]
+            log_path = None
+            stdout = stderr = None
+            if args.log_dir:
+                rank = self.node_rank * args.nproc_per_node + lr
+                log_path = os.path.join(args.log_dir,
+                                        f"worker.{rank}.log")
+                f = open(log_path, "ab")
+                stdout, stderr = f, subprocess.STDOUT
+            p = subprocess.Popen(cmd, env=self._env_for(lr, restart_epoch),
+                                 stdout=stdout, stderr=stderr)
+            self.procs.append(Proc(lr, p, log_path))
+
+    def terminate(self, sig=signal.SIGTERM, grace=10.0):
+        for pr in self.procs:
+            if pr.popen.poll() is None:
+                try:
+                    pr.popen.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + grace
+        for pr in self.procs:
+            left = max(0.1, deadline - time.time())
+            try:
+                pr.popen.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                pr.popen.kill()
+
+    # -- supervision -------------------------------------------------------
+    def watch(self):
+        """Block until all workers exit; fail fast on the first nonzero
+        exit (reference: watcher/pod watch loop). Returns exit code."""
+        while True:
+            alive = 0
+            for pr in self.procs:
+                rc = pr.popen.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    print(f"worker rank {pr.rank} failed with code {rc}",
+                          file=sys.stderr)
+                    self.terminate()
+                    return rc
+            if alive == 0:
+                return 0
+            time.sleep(0.2)
+
+    def run(self):
+        self.rendezvous()
+        args = self.args
+        restarts = 0
+        while True:
+            self.spawn(restart_epoch=restarts)
+            rc = self.watch()
+            if rc == 0:
+                return 0
+            if not args.elastic or restarts >= args.max_restarts:
+                return rc
+            restarts += 1
+            print(f"elastic: relaunching workers "
+                  f"(attempt {restarts}/{args.max_restarts})",
+                  file=sys.stderr)
+
+    def close(self):
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+
+
+def main(argv=None):
+    from .context import parse_args
+
+    args = parse_args(argv)
+    ctl = Controller(Context(args))
+    try:
+        return ctl.run()
+    except KeyboardInterrupt:
+        ctl.terminate()
+        return 130
+    finally:
+        ctl.close()

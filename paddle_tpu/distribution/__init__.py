@@ -1,0 +1,37 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Reference analog: python/paddle/distribution/ (Distribution base
+distribution.py, Normal, Uniform, Bernoulli, Beta, Categorical, Dirichlet,
+Multinomial, Gamma, Exponential, Laplace, LogNormal, Gumbel, Geometric,
+Cauchy, StudentT, Binomial, Poisson, TransformedDistribution, transform.py,
+Independent, kl.py registry).
+
+TPU-native: samplers draw jax.random bits through the framework RNG
+(ops.random.next_key, honoring paddle.seed and traced-mode keys); densities
+are pure jnp math on Tensor values, so log_prob/entropy trace and
+differentiate under jit/grad like every other op.
+"""
+from .distribution import Distribution  # noqa: F401
+from .normal import Normal, LogNormal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .bernoulli import Bernoulli, Geometric  # noqa: F401
+from .categorical import Categorical, Multinomial  # noqa: F401
+from .gamma import Gamma, Beta, Dirichlet, Exponential, Chi2  # noqa: F401
+from .location_scale import Laplace, Gumbel, Cauchy, StudentT  # noqa: F401
+from .transformed import (  # noqa: F401
+    Transform, AffineTransform, ExpTransform, SigmoidTransform,
+    TanhTransform, PowerTransform, ChainTransform, AbsTransform,
+    SoftmaxTransform, StickBreakingTransform, TransformedDistribution,
+)
+from .independent import Independent  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Uniform", "Bernoulli",
+    "Geometric", "Categorical", "Multinomial", "Gamma", "Beta", "Dirichlet",
+    "Exponential", "Chi2", "Laplace", "Gumbel", "Cauchy", "StudentT",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "ChainTransform", "AbsTransform",
+    "SoftmaxTransform", "StickBreakingTransform", "TransformedDistribution",
+    "Independent", "kl_divergence", "register_kl",
+]

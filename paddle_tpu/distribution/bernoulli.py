@@ -1,0 +1,74 @@
+"""Bernoulli / Geometric (reference: distribution/bernoulli.py,
+geometric.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _broadcast_all
+
+_EPS = 1e-7
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            (self.probs,) = _broadcast_all(probs)
+            self.logits = jnp.log(self.probs + _EPS) - \
+                jnp.log1p(-self.probs + _EPS)
+        else:
+            (self.logits,) = _broadcast_all(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(batch_shape=self.probs.shape)
+
+    def _sample(self, key, shape):
+        shp = tuple(shape) + self.probs.shape
+        return jax.random.bernoulli(key, self.probs, shp).astype(
+            self.probs.dtype)
+
+    _rsample = _sample  # no reparameterization; kept for API parity
+
+    def _log_prob(self, value):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def _entropy(self):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    def _mean(self):
+        return self.probs
+
+    def _variance(self):
+        return self.probs * (1 - self.probs)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p for k = 0, 1, ... (reference geometric.py)."""
+
+    def __init__(self, probs):
+        (self.probs,) = _broadcast_all(probs)
+        super().__init__(batch_shape=self.probs.shape)
+
+    def _sample(self, key, shape):
+        shp = tuple(shape) + self.probs.shape
+        u = jax.random.uniform(key, shp, self.probs.dtype, minval=_EPS)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    _rsample = _sample
+
+    def _log_prob(self, value):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        return value * jnp.log1p(-p) + jnp.log(p)
+
+    def _entropy(self):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+
+    def _mean(self):
+        return (1 - self.probs) / self.probs
+
+    def _variance(self):
+        return (1 - self.probs) / self.probs ** 2

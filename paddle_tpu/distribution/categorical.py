@@ -1,0 +1,93 @@
+"""Categorical / Multinomial (reference: distribution/categorical.py,
+multinomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _value
+
+_EPS = 1e-9
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = _value(logits)
+            self.logits = self.logits - jax.scipy.special.logsumexp(
+                self.logits, axis=-1, keepdims=True)
+        else:
+            p = _value(probs)
+            p = p / p.sum(-1, keepdims=True)
+            self.logits = jnp.log(p + _EPS)
+        self.probs = jnp.exp(self.logits)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def n_categories(self):
+        return self.logits.shape[-1]
+
+    def _sample(self, key, shape):
+        shp = tuple(shape) + self.batch_shape
+        return jax.random.categorical(key, self.logits, shape=shp).astype(
+            jnp.int32)
+
+    _rsample = _sample
+
+    def _log_prob(self, value):
+        idx = value.astype(jnp.int32)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(self.logits, idx.shape + (self.n_categories,)),
+            idx[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        return -(self.probs * self.logits).sum(-1)
+
+    def _mean(self):
+        return (self.probs *
+                jnp.arange(self.n_categories, dtype=self.probs.dtype)).sum(-1)
+
+    def _variance(self):
+        k = jnp.arange(self.n_categories, dtype=self.probs.dtype)
+        m = self._mean()
+        return (self.probs * k ** 2).sum(-1) - m ** 2
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _value(probs)
+        self.probs = p / p.sum(-1, keepdims=True)
+        self.logits = jnp.log(self.probs + _EPS)
+        super().__init__(batch_shape=self.probs.shape[:-1],
+                         event_shape=self.probs.shape[-1:])
+
+    def _sample(self, key, shape):
+        shp = tuple(shape) + self.batch_shape
+        draws = jax.random.categorical(
+            key, self.logits, axis=-1,
+            shape=(self.total_count,) + shp)
+        onehot = jax.nn.one_hot(draws, self.probs.shape[-1],
+                                dtype=self.probs.dtype)
+        return onehot.sum(0)
+
+    _rsample = _sample
+
+    def _log_prob(self, value):
+        lgamma = jax.scipy.special.gammaln
+        n = jnp.asarray(self.total_count, self.probs.dtype)
+        return (lgamma(n + 1) - lgamma(value + 1).sum(-1)
+                + (value * self.logits).sum(-1))
+
+    def _mean(self):
+        return self.total_count * self.probs
+
+    def _variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def _entropy(self):
+        # no closed form; Monte-Carlo-free bound not in reference either —
+        # use the sum of categorical entropies scaled (reference raises too)
+        raise NotImplementedError("Multinomial entropy has no closed form")

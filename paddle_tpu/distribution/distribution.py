@@ -1,0 +1,96 @@
+"""Distribution base (reference: distribution/distribution.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import random as rnd
+
+
+def _value(x):
+    if isinstance(x, Tensor):
+        return x._value
+    a = np.asarray(x)
+    if a.dtype.kind in "iub":  # parameters given as python ints
+        a = a.astype(np.float32)
+    return jnp.asarray(a)
+
+
+def _wrap(v):
+    return Tensor(v)
+
+
+def _broadcast_all(*vals):
+    arrs = [_value(v) for v in vals]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [jnp.broadcast_to(a, shape) for a in arrs]
+
+
+class Distribution:
+    """Base API: sample/rsample, log_prob/prob, entropy, mean/variance,
+    kl_divergence (reference distribution.py:40)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    # subclasses implement _sample(key, shape) / _rsample(key, shape)
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        return _wrap(self._sample(key, tuple(shape)))
+
+    def rsample(self, shape=()):
+        key = rnd.next_key()
+        return _wrap(self._rsample(key, tuple(shape)))
+
+    def _sample(self, key, shape):
+        return self._rsample(key, shape)
+
+    def _rsample(self, key, shape):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        return _wrap(self._log_prob(_value(value)))
+
+    def prob(self, value):
+        return _wrap(jnp.exp(self._log_prob(_value(value))))
+
+    def _log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        return _wrap(self._entropy())
+
+    def _entropy(self):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        return _wrap(self._mean())
+
+    @property
+    def variance(self):
+        return _wrap(self._variance())
+
+    def _mean(self):
+        raise NotImplementedError
+
+    def _variance(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape

@@ -1,0 +1,120 @@
+"""Gamma-family: Gamma, Beta, Dirichlet, Exponential, Chi2 (reference:
+distribution/gamma.py, beta.py, dirichlet.py, exponential.py, chi2.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _broadcast_all, _value
+
+
+class Gamma(Distribution):
+    """Shape/rate parameterization (reference gamma.py: concentration,
+    rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration, self.rate = _broadcast_all(concentration, rate)
+        super().__init__(batch_shape=self.concentration.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.concentration.shape
+        # jax.random.gamma is reparameterized (implicit diff)
+        return jax.random.gamma(
+            key, jnp.broadcast_to(self.concentration, shp)) / self.rate
+
+    def _log_prob(self, value):
+        a, b = self.concentration, self.rate
+        return (a * jnp.log(b) + (a - 1) * jnp.log(value) - b * value
+                - jax.scipy.special.gammaln(a))
+
+    def _entropy(self):
+        a, b = self.concentration, self.rate
+        return (a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                + (1 - a) * jax.scipy.special.digamma(a))
+
+    def _mean(self):
+        return self.concentration / self.rate
+
+    def _variance(self):
+        return self.concentration / self.rate ** 2
+
+
+class Exponential(Gamma):
+    def __init__(self, rate):
+        (rate,) = _broadcast_all(rate)
+        super().__init__(jnp.ones_like(rate), rate)
+
+
+class Chi2(Gamma):
+    def __init__(self, df):
+        (df,) = _broadcast_all(df)
+        super().__init__(df / 2, jnp.full_like(df, 0.5))
+        self.df = df
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha, self.beta = _broadcast_all(alpha, beta)
+        super().__init__(batch_shape=self.alpha.shape)
+
+    def _rsample(self, key, shape):
+        k1, k2 = jax.random.split(key)
+        shp = tuple(shape) + self.alpha.shape
+        ga = jax.random.gamma(k1, jnp.broadcast_to(self.alpha, shp))
+        gb = jax.random.gamma(k2, jnp.broadcast_to(self.beta, shp))
+        return ga / (ga + gb)
+
+    def _log_prob(self, value):
+        a, b = self.alpha, self.beta
+        return ((a - 1) * jnp.log(value) + (b - 1) * jnp.log1p(-value)
+                - jax.scipy.special.betaln(a, b))
+
+    def _entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        return (jax.scipy.special.betaln(a, b)
+                - (a - 1) * dg(a) - (b - 1) * dg(b)
+                + (a + b - 2) * dg(a + b))
+
+    def _mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def _variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _value(concentration)
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.concentration.shape
+        g = jax.random.gamma(key, jnp.broadcast_to(self.concentration, shp))
+        return g / g.sum(-1, keepdims=True)
+
+    def _log_prob(self, value):
+        a = self.concentration
+        lognorm = (jax.scipy.special.gammaln(a).sum(-1)
+                   - jax.scipy.special.gammaln(a.sum(-1)))
+        return ((a - 1) * jnp.log(value)).sum(-1) - lognorm
+
+    def _entropy(self):
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        dg = jax.scipy.special.digamma
+        lognorm = (jax.scipy.special.gammaln(a).sum(-1)
+                   - jax.scipy.special.gammaln(a0))
+        return (lognorm + (a0 - k) * dg(a0) - ((a - 1) * dg(a)).sum(-1))
+
+    def _mean(self):
+        return self.concentration / self.concentration.sum(-1, keepdims=True)
+
+    def _variance(self):
+        a = self.concentration
+        a0 = a.sum(-1, keepdims=True)
+        m = a / a0
+        return m * (1 - m) / (a0 + 1)

@@ -1,0 +1,43 @@
+"""Independent (reference: distribution/independent.py) — reinterprets
+trailing batch dims of a base distribution as event dims."""
+from __future__ import annotations
+
+from .distribution import Distribution
+
+
+class Independent(Distribution):
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError(
+                f"cannot reinterpret {self.rank} dims of batch shape "
+                f"{base.batch_shape}")
+        cut = len(base.batch_shape) - self.rank
+        super().__init__(
+            batch_shape=base.batch_shape[:cut],
+            event_shape=base.batch_shape[cut:] + base.event_shape)
+
+    def _rsample(self, key, shape):
+        return self.base._rsample(key, shape)
+
+    def _sample(self, key, shape):
+        return self.base._sample(key, shape)
+
+    def _log_prob(self, value):
+        lp = self.base._log_prob(value)
+        if self.rank == 0:
+            return lp
+        return lp.sum(tuple(range(-self.rank, 0)))
+
+    def _entropy(self):
+        ent = self.base._entropy()
+        if self.rank == 0:
+            return ent
+        return ent.sum(tuple(range(-self.rank, 0)))
+
+    def _mean(self):
+        return self.base._mean()
+
+    def _variance(self):
+        return self.base._variance()

@@ -1,0 +1,131 @@
+"""KL divergence registry (reference: distribution/kl.py —
+register_kl dispatch table + closed forms; kl_divergence falls back to the
+pair's most specific registered rule)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+from .normal import Normal
+from .uniform import Uniform
+from .bernoulli import Bernoulli, Geometric
+from .categorical import Categorical
+from .gamma import Gamma, Beta, Dirichlet
+from .location_scale import Laplace
+from .independent import Independent
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def _dispatch(p, q):
+    matches = []
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            matches.append((pc, qc, fn))
+    if not matches:
+        raise NotImplementedError(
+            f"no KL(p || q) rule for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    # most specific match (reference: total order by subclass depth)
+    matches.sort(key=lambda m: (len(m[0].__mro__) + len(m[1].__mro__)),
+                 reverse=True)
+    return matches[0][2]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _wrap(_dispatch(p, q)(p, q))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    # infinite when p's support is not inside q's
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    return jnp.where((q.low <= p.low) & (p.high <= q.high), result, jnp.inf)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = 1e-7
+    pp = jnp.clip(p.probs, eps, 1 - eps)
+    qp = jnp.clip(q.probs, eps, 1 - eps)
+    return pp * (jnp.log(pp) - jnp.log(qp)) + \
+        (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return (p.probs * (p.logits - q.logits)).sum(-1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    dg = jax.scipy.special.digamma
+    lg = jax.scipy.special.gammaln
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return ((a1 - a2) * dg(a1) - lg(a1) + lg(a2)
+            + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 - b1) / b1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    dg = jax.scipy.special.digamma
+    bl = jax.scipy.special.betaln
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return (bl(a2, b2) - bl(a1, b1)
+            + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+            + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    lg = jax.scipy.special.gammaln
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1, keepdims=True)
+    return (lg(a0[..., 0]) - lg(a).sum(-1)
+            - lg(b.sum(-1)) + lg(b).sum(-1)
+            + ((a - b) * (dg(a) - dg(a0))).sum(-1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    # log(s2/s1) + (s1 exp(-|Δμ|/s1) + |Δμ|)/s2 - 1
+    abs_diff = jnp.abs(p.loc - q.loc)
+    return (jnp.log(q.scale) - jnp.log(p.scale)
+            + (p.scale * jnp.exp(-abs_diff / p.scale) + abs_diff) / q.scale
+            - 1)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    eps = 1e-7
+    pp = jnp.clip(p.probs, eps, 1 - eps)
+    qp = jnp.clip(q.probs, eps, 1 - eps)
+    return (jnp.log(pp) - jnp.log(qp)
+            + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p.rank != q.rank:
+        raise NotImplementedError("mismatched reinterpreted ranks")
+    inner = _dispatch(p.base, q.base)(p.base, q.base)
+    if p.rank == 0:
+        return inner
+    return inner.sum(tuple(range(-p.rank, 0)))

@@ -1,0 +1,122 @@
+"""Location-scale family: Laplace, Gumbel, Cauchy, StudentT (reference:
+distribution/laplace.py, gumbel.py, cauchy.py, student_t.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _broadcast_all
+
+_EULER = 0.5772156649015329
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.loc.shape
+        u = jax.random.uniform(key, shp, self.loc.dtype, minval=-0.5 + 1e-7,
+                               maxval=0.5)
+        return self.loc - self.scale * jnp.sign(u) * jnp.log1p(
+            -2 * jnp.abs(u))
+
+    def _log_prob(self, value):
+        return -jnp.abs(value - self.loc) / self.scale - \
+            jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return 1 + jnp.log(2 * self.scale)
+
+    def _mean(self):
+        return self.loc
+
+    def _variance(self):
+        return 2 * self.scale ** 2
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.loc.shape
+        return self.loc + self.scale * jax.random.gumbel(key, shp,
+                                                         self.loc.dtype)
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.log(self.scale) + 1 + _EULER
+
+    def _mean(self):
+        return self.loc + self.scale * _EULER
+
+    def _variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.loc.shape
+        return self.loc + self.scale * jax.random.cauchy(key, shp,
+                                                         self.loc.dtype)
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def _entropy(self):
+        return jnp.log(4 * math.pi * self.scale)
+
+    def _mean(self):
+        return jnp.full_like(self.loc, jnp.nan)  # undefined
+
+    def _variance(self):
+        return jnp.full_like(self.loc, jnp.nan)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df, self.loc, self.scale = _broadcast_all(df, loc, scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.loc.shape
+        t = jax.random.t(key, jnp.broadcast_to(self.df, shp), shp,
+                         self.loc.dtype)
+        return self.loc + self.scale * t
+
+    def _log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        df = self.df
+        lg = jax.scipy.special.gammaln
+        return (lg((df + 1) / 2) - lg(df / 2)
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+                - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+    def _entropy(self):
+        df = self.df
+        dg = jax.scipy.special.digamma
+        lg = jax.scipy.special.gammaln
+        return ((df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+                + 0.5 * jnp.log(df) + jax.scipy.special.betaln(
+                    df / 2, jnp.full_like(df, 0.5)) + jnp.log(self.scale))
+
+    def _mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    def _variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return jnp.where(self.df > 2, v,
+                         jnp.where(self.df > 1, jnp.inf, jnp.nan))

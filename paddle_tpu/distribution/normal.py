@@ -1,0 +1,71 @@
+"""Normal / LogNormal (reference: distribution/normal.py, lognormal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _broadcast_all
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.loc.shape
+        eps = jax.random.normal(key, shp, self.loc.dtype)
+        return self.loc + self.scale * eps
+
+    def _log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def _entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def _mean(self):
+        return self.loc
+
+    def _variance(self):
+        return self.scale ** 2
+
+    def cdf(self, value):
+        from .distribution import _value, _wrap
+
+        v = _value(value)
+        return _wrap(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, q):
+        from .distribution import _value, _wrap
+
+        v = _value(q)
+        return _wrap(self.loc + self.scale * math.sqrt(2)
+                     * jax.scipy.special.erfinv(2 * v - 1))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    def _rsample(self, key, shape):
+        return jnp.exp(self._base._rsample(key, shape))
+
+    def _log_prob(self, value):
+        return self._base._log_prob(jnp.log(value)) - jnp.log(value)
+
+    def _entropy(self):
+        return self._base._entropy() + self.loc
+
+    def _mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    def _variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)

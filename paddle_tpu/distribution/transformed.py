@@ -1,0 +1,220 @@
+"""Transforms and TransformedDistribution (reference:
+distribution/transform.py — Transform with forward/inverse/
+forward_log_det_jacobian, Affine/Exp/Sigmoid/Tanh/Power/Abs/Softmax/
+StickBreaking/Chain, and transformed_distribution.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _value, _wrap
+
+
+class Transform:
+    """Bijector base. Subclasses define _forward, _inverse,
+    _forward_log_det_jacobian (per-element; event_dims summed by the
+    TransformedDistribution)."""
+
+    event_dims = 0  # how many trailing dims one transform event consumes
+
+    def forward(self, x):
+        return _wrap(self._forward(_value(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_value(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_value(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(-self._forward_log_det_jacobian(
+            self._inverse(_value(y))))
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _value(loc)
+        self.scale = _value(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _value(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    event_dims = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("softmax is not a bijection")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> open simplex Δ^K (reference transform.py
+    StickBreakingTransform)."""
+
+    event_dims = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+        cum = jnp.cumprod(1 - z, -1)
+        cumpad = jnp.concatenate([jnp.ones_like(z[..., :1]), cum], -1)
+        return zpad * cumpad
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = 1 - jnp.cumsum(y[..., :-1], -1)
+        shifted = jnp.concatenate([jnp.ones_like(y[..., :1]),
+                                   cum[..., :-1]], -1)
+        z = y[..., :-1] / shifted
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        # dy_i/dz_i = prod_{j<i}(1-z_j) (triangular jacobian) and
+        # dz_i/dx_i = sigmoid'(x_i - offset) = exp(-sp(-xo) - sp(xo))
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        cum = jnp.cumprod(1 - z, -1)
+        cumpad = jnp.concatenate([jnp.ones_like(z[..., :1]),
+                                  cum[..., :-1]], -1)
+        return (-jax.nn.softplus(-xo) - jax.nn.softplus(xo)
+                + jnp.log(cumpad)).sum(-1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self.event_dims = max((t.event_dims for t in self.transforms),
+                              default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through transforms (reference:
+    transformed_distribution.py): log_prob(y) = base.log_prob(f^-1(y)) -
+    log|det J_f|(f^-1(y))."""
+
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(transforms) \
+            if len(transforms) != 1 else transforms[0]
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def _rsample(self, key, shape):
+        return self.transform._forward(self.base._rsample(key, shape))
+
+    def _sample(self, key, shape):
+        return self.transform._forward(self.base._sample(key, shape))
+
+    def _log_prob(self, value):
+        x = self.transform._inverse(value)
+        # scalar transforms (event_dims=0) return per-element jacobians,
+        # matching per-element base log-probs; event transforms (e.g.
+        # stick-breaking) return jacobians already reduced over the event
+        # dim, matching event-reduced base log-probs — shapes line up in
+        # both cases
+        ldj = self.transform._forward_log_det_jacobian(x)
+        return self.base._log_prob(x) - ldj

@@ -1,0 +1,32 @@
+"""Uniform (reference: distribution/uniform.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _broadcast_all
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low, self.high = _broadcast_all(low, high)
+        super().__init__(batch_shape=self.low.shape)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.low.shape
+        u = jax.random.uniform(key, shp, self.low.dtype)
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.log(self.high - self.low)
+
+    def _mean(self):
+        return (self.low + self.high) / 2
+
+    def _variance(self):
+        return (self.high - self.low) ** 2 / 12

@@ -135,13 +135,20 @@ class Model:
                               num_workers=num_workers)
         return data  # any iterable of batches
 
-    @staticmethod
-    def _split_batch(batch):
-        """(inputs, labels) from a loader batch: last element is the label
+    def _split_batch(self, batch):
+        """(inputs, labels) from a loader batch. When the Model was built
+        with inputs=/labels= specs (reference InputSpec lists), their arity
+        drives the split; otherwise the last element is the label
         (reference convention for (image, label) datasets)."""
-        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        if not isinstance(batch, (list, tuple)):
+            return [batch], []
+        if self._inputs is not None:
+            n_in = len(self._inputs) \
+                if isinstance(self._inputs, (list, tuple)) else 1
+            return list(batch[:n_in]), list(batch[n_in:])
+        if len(batch) >= 2:
             return list(batch[:-1]), [batch[-1]]
-        return [batch], []
+        return list(batch), []
 
     # -- loops -------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
@@ -150,6 +157,11 @@ class Model:
         assert train_data is not None, "train_data is required"
         self._save_dir = save_dir
         loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        if epochs > 1 and iter(loader) is loader:
+            raise ValueError(
+                "train_data is a one-shot iterator and cannot be "
+                "re-iterated for multiple epochs; pass a Dataset, "
+                "DataLoader, or re-iterable of batches")
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
         cbs = [History(), ProgBarLogger(log_freq, verbose)]
         if save_dir:

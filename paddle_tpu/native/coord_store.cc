@@ -448,9 +448,14 @@ class StoreClient {
     int port = port_;
     hb_thread_ = std::thread([this, key, interval_ms, host, port] {
       StoreClient hb;
-      bool connected = hb.Connect(host, port, 5000);
+      bool connected = false;
       while (hb_run_.load()) {
-        if (connected) hb.Set(key, std::to_string(now_ms()));
+        if (!connected) connected = hb.Connect(host, port, 2000);
+        if (connected && hb.Set(key, std::to_string(now_ms())) != 0) {
+          // connection broke: reconnect on the next beat
+          hb.Close();
+          connected = false;
+        }
         std::unique_lock<std::mutex> lk(hb_mu_);
         hb_cv_.wait_for(lk, std::chrono::milliseconds(interval_ms),
                         [this] { return !hb_run_.load(); });

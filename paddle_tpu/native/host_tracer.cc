@@ -16,6 +16,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <iomanip>
 #include <mutex>
 #include <sstream>
 #include <string>
@@ -38,6 +39,7 @@ struct Span {
 
 struct ThreadBuf {
   uint64_t tid;
+  std::mutex mu;  // owner thread writes, dump/clear read — must exclude
   std::vector<Span> spans;
   std::vector<std::pair<uint32_t, int64_t>> stack;  // open spans
 };
@@ -81,6 +83,7 @@ int pht_enabled() { return g_enabled.load(std::memory_order_relaxed); }
 void pht_clear() {
   std::lock_guard<std::mutex> g(g_mu);
   for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> gb(b->mu);
     b->spans.clear();
     b->stack.clear();
   }
@@ -91,7 +94,9 @@ uint32_t pht_name_id(const char* name) { return intern(name); }
 
 void pht_begin_id(uint32_t name_id) {
   if (!g_enabled.load(std::memory_order_relaxed)) return;
-  tls()->stack.emplace_back(name_id, now_ns());
+  auto* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);  // uncontended fast path
+  b->stack.emplace_back(name_id, now_ns());
 }
 
 void pht_begin(const char* name) {
@@ -102,6 +107,7 @@ void pht_begin(const char* name) {
 void pht_end() {
   if (!g_enabled.load(std::memory_order_relaxed)) return;
   auto* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);
   if (b->stack.empty()) return;
   auto open = b->stack.back();
   b->stack.pop_back();
@@ -111,7 +117,10 @@ void pht_end() {
 // One-shot complete span (begin+end supplied by caller, ns).
 void pht_span(const char* name, int64_t t0_ns, int64_t t1_ns) {
   if (!g_enabled.load(std::memory_order_relaxed)) return;
-  tls()->spans.push_back(Span{intern(name), t0_ns, t1_ns});
+  uint32_t id = intern(name);
+  auto* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);
+  b->spans.push_back(Span{id, t0_ns, t1_ns});
 }
 
 int64_t pht_now_ns() { return now_ns(); }
@@ -121,9 +130,13 @@ int64_t pht_now_ns() { return now_ns(); }
 char* pht_dump_json(int pid) {
   std::lock_guard<std::mutex> g(g_mu);
   std::ostringstream os;
+  // default 6-sig-digit doubles collapse ~1e12-ns timestamps; chrome trace
+  // wants microseconds — emit with fixed sub-us precision
+  os << std::fixed << std::setprecision(3);
   os << "[";
   bool first = true;
   for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> gb(b->mu);
     for (auto& s : b->spans) {
       if (!first) os << ",";
       first = false;
@@ -150,22 +163,23 @@ char* pht_dump_json(int pid) {
 // count, fills *out (caller frees). Names via pht_get_name.
 int64_t pht_dump_raw(char** out) {
   std::lock_guard<std::mutex> g(g_mu);
-  int64_t n = 0;
-  for (auto* b : g_bufs) n += static_cast<int64_t>(b->spans.size());
-  const size_t rec = 8 + 4 + 8 + 8;
-  char* p = static_cast<char*>(malloc(static_cast<size_t>(n) * rec));
-  char* q = p;
+  std::vector<std::pair<uint64_t, Span>> all;
   for (auto* b : g_bufs) {
-    for (auto& s : b->spans) {
-      memcpy(q, &b->tid, 8);
-      memcpy(q + 8, &s.name_id, 4);
-      memcpy(q + 12, &s.t0_ns, 8);
-      memcpy(q + 20, &s.t1_ns, 8);
-      q += rec;
-    }
+    std::lock_guard<std::mutex> gb(b->mu);
+    for (auto& s : b->spans) all.emplace_back(b->tid, s);
+  }
+  const size_t rec = 8 + 4 + 8 + 8;
+  char* p = static_cast<char*>(malloc(all.size() * rec + 1));
+  char* q = p;
+  for (auto& ts : all) {
+    memcpy(q, &ts.first, 8);
+    memcpy(q + 8, &ts.second.name_id, 4);
+    memcpy(q + 12, &ts.second.t0_ns, 8);
+    memcpy(q + 20, &ts.second.t1_ns, 8);
+    q += rec;
   }
   *out = p;
-  return n;
+  return static_cast<int64_t>(all.size());
 }
 
 // malloc'd copy (free with pht_free): interior string pointers are not

@@ -18,11 +18,21 @@ from ..core import dtype as dtypes
 
 
 class _GlobalGenerator(threading.local):
+    """Per-thread root key, created LAZILY: minting a PRNGKey initializes
+    the XLA backend, which must not happen at import time (it would break
+    jax.distributed.initialize in launched multi-process jobs)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self.key = None
 
 
 _gen = _GlobalGenerator()
+
+
+def _root_key():
+    if _gen.key is None:
+        _gen.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    return _gen.key
 
 
 def seed(s: int):
@@ -32,7 +42,7 @@ def seed(s: int):
 
 
 def get_rng_state():
-    return _gen.key
+    return _root_key()
 
 
 def set_rng_state(state):
@@ -63,7 +73,7 @@ def next_key():
         k, sub = jax.random.split(k)
         _trace_keys.stack[-1] = k
         return sub
-    _gen.key, sub = jax.random.split(_gen.key)
+    _gen.key, sub = jax.random.split(_root_key())
     return sub
 
 

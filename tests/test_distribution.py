@@ -1,0 +1,210 @@
+"""Distribution package tests (reference: test/distribution/ —
+per-distribution numeric checks vs scipy; here vs closed forms and
+moment/Monte-Carlo estimates)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Normal, LogNormal, Uniform, Bernoulli, Geometric, Categorical,
+    Multinomial, Gamma, Beta, Dirichlet, Exponential, Chi2, Laplace, Gumbel,
+    Cauchy, StudentT, TransformedDistribution, AffineTransform, ExpTransform,
+    TanhTransform, SigmoidTransform, StickBreakingTransform, Independent,
+    kl_divergence, register_kl,
+)
+
+
+def setup_function(_):
+    paddle.seed(0)
+
+
+# sampling moments: tolerant MC checks
+N = 20000
+
+
+def _moments(dist, n=N):
+    s = np.asarray(dist.sample((n,)).numpy())
+    return s.mean(0), s.var(0)
+
+
+@pytest.mark.parametrize("dist,atol", [
+    (Normal(1.5, 2.0), 0.1),
+    (Uniform(-1.0, 3.0), 0.1),
+    (Laplace(0.5, 1.5), 0.15),
+    (Gumbel(1.0, 0.5), 0.05),
+    (Gamma(3.0, 2.0), 0.1),
+    (Beta(2.0, 5.0), 0.02),
+    (Exponential(2.0), 0.05),
+    (Bernoulli(probs=0.3), 0.02),
+    (Geometric(0.4), 0.1),
+    (LogNormal(0.0, 0.5), 0.05),
+])
+def test_sample_moments_match(dist, atol):
+    m, v = _moments(dist)
+    np.testing.assert_allclose(m, float(dist.mean), atol=atol * 3)
+    np.testing.assert_allclose(v, float(dist.variance), atol=atol * 6)
+
+
+def test_normal_log_prob_entropy_cdf():
+    d = Normal(0.0, 2.0)
+    x = np.array([-1.0, 0.0, 2.5])
+    want = -0.5 * (x / 2) ** 2 - np.log(2) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(d.log_prob(x).numpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()),
+                               0.5 * np.log(2 * np.pi * np.e * 4), rtol=1e-6)
+    np.testing.assert_allclose(float(d.cdf(0.0)), 0.5, atol=1e-6)
+    np.testing.assert_allclose(float(d.icdf(0.5)), 0.0, atol=1e-6)
+
+
+def test_entropy_matches_mc():
+    for d in [Gamma(2.0, 1.5), Beta(2.0, 3.0), Laplace(0.0, 2.0),
+              Gumbel(0.0, 1.0), StudentT(5.0, 0.0, 1.0), Cauchy(0.0, 1.0)]:
+        s = d.sample((N,))
+        mc = -np.mean(d.log_prob(s).numpy())
+        np.testing.assert_allclose(float(d.entropy()), mc, rtol=0.05,
+                                   atol=0.02)
+
+
+def test_categorical_and_multinomial():
+    probs = np.array([0.2, 0.5, 0.3])
+    c = Categorical(probs=probs)
+    s = np.asarray(c.sample((N,)).numpy())
+    freq = np.bincount(s, minlength=3) / N
+    np.testing.assert_allclose(freq, probs, atol=0.02)
+    np.testing.assert_allclose(
+        c.log_prob(np.array([0, 1, 2])).numpy(), np.log(probs), rtol=1e-5)
+    np.testing.assert_allclose(float(c.entropy()),
+                               -(probs * np.log(probs)).sum(), rtol=1e-5)
+
+    m = Multinomial(10, probs)
+    sm = np.asarray(m.sample((500,)).numpy())
+    assert sm.shape == (500, 3)
+    np.testing.assert_array_equal(sm.sum(-1), np.full(500, 10.0))
+    np.testing.assert_allclose(sm.mean(0), 10 * probs, atol=0.3)
+    # log_prob normalizes over a small support slice
+    from math import factorial
+    np.testing.assert_allclose(
+        float(m.log_prob(np.array([2.0, 5.0, 3.0]))),
+        np.log(factorial(10) / (factorial(2) * factorial(5) * factorial(3))
+               * 0.2 ** 2 * 0.5 ** 5 * 0.3 ** 3), rtol=1e-5)
+
+
+def test_dirichlet():
+    a = np.array([2.0, 3.0, 5.0])
+    d = Dirichlet(a)
+    s = np.asarray(d.sample((N,)).numpy())
+    np.testing.assert_allclose(s.sum(-1), np.ones(N), rtol=1e-5)
+    np.testing.assert_allclose(s.mean(0), a / a.sum(), atol=0.01)
+    x = np.array([0.2, 0.3, 0.5])
+    from math import lgamma
+    want = (sum((ai - 1) * np.log(xi) for ai, xi in zip(a, x))
+            + lgamma(a.sum()) - sum(lgamma(ai) for ai in a))
+    np.testing.assert_allclose(float(d.log_prob(x)), want, rtol=1e-5)
+
+
+def test_chi2_is_gamma():
+    d = Chi2(4.0)
+    g = Gamma(2.0, 0.5)
+    x = np.array([0.5, 2.0, 7.0])
+    np.testing.assert_allclose(d.log_prob(x).numpy(), g.log_prob(x).numpy(),
+                               rtol=1e-6)
+
+
+def test_transformed_lognormal_equals_exp_of_normal():
+    base = Normal(0.3, 0.7)
+    td = TransformedDistribution(base, [ExpTransform()])
+    ln = LogNormal(0.3, 0.7)
+    x = np.array([0.5, 1.0, 2.5])
+    np.testing.assert_allclose(td.log_prob(x).numpy(), ln.log_prob(x).numpy(),
+                               rtol=1e-5)
+    s = np.asarray(td.sample((N,)).numpy())
+    np.testing.assert_allclose(s.mean(), float(ln.mean), rtol=0.1)
+
+
+def test_transformed_affine_and_tanh():
+    base = Normal(0.0, 1.0)
+    td = TransformedDistribution(base, [AffineTransform(1.0, 2.0)])
+    ref = Normal(1.0, 2.0)
+    x = np.array([-2.0, 0.5, 3.0])
+    np.testing.assert_allclose(td.log_prob(x).numpy(), ref.log_prob(x).numpy(),
+                               rtol=1e-5)
+    # tanh-squashed: density integrates to 1 on (-1, 1)
+    tt = TransformedDistribution(base, [TanhTransform()])
+    xs = np.linspace(-0.999, 0.999, 4001)
+    dens = np.exp(tt.log_prob(xs).numpy())
+    integral = np.trapezoid(dens, xs)
+    np.testing.assert_allclose(integral, 1.0, atol=5e-3)
+
+
+def test_stick_breaking_roundtrip_and_density():
+    t = StickBreakingTransform()
+    x = np.array([0.3, -0.2, 0.5])
+    y = t.forward(x).numpy()
+    assert y.shape == (4,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-6)
+    back = t.inverse(y).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_independent_reinterprets_batch():
+    base = Normal(np.zeros((3, 4)), np.ones((3, 4)))
+    ind = Independent(base, 1)
+    assert ind.batch_shape == (3,)
+    assert ind.event_shape == (4,)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(ind.log_prob(x).numpy(),
+                               base.log_prob(x).numpy().sum(-1), rtol=1e-6)
+
+
+def test_kl_closed_forms_match_mc():
+    pairs = [
+        (Normal(0.0, 1.0), Normal(1.0, 2.0)),
+        (Bernoulli(probs=0.3), Bernoulli(probs=0.6)),
+        (Categorical(probs=np.array([0.2, 0.8])),
+         Categorical(probs=np.array([0.5, 0.5]))),
+        (Gamma(2.0, 1.0), Gamma(3.0, 2.0)),
+        (Beta(2.0, 2.0), Beta(4.0, 1.0)),
+        (Dirichlet(np.array([1.0, 2.0, 3.0])),
+         Dirichlet(np.array([2.0, 2.0, 2.0]))),
+        (Laplace(0.0, 1.0), Laplace(0.5, 2.0)),
+        (Uniform(0.0, 1.0), Uniform(-1.0, 2.0)),
+        (Geometric(0.5), Geometric(0.3)),
+    ]
+    for p, q in pairs:
+        kl = float(kl_divergence(p, q))
+        s = p.sample((N,))
+        mc = float(np.mean(p.log_prob(s).numpy() - q.log_prob(s).numpy()))
+        np.testing.assert_allclose(kl, mc, rtol=0.1, atol=0.02), (p, q)
+
+
+def test_kl_independent_and_registry():
+    p = Independent(Normal(np.zeros(4), np.ones(4)), 1)
+    q = Independent(Normal(np.ones(4), np.ones(4)), 1)
+    np.testing.assert_allclose(float(kl_divergence(p, q)), 4 * 0.5, rtol=1e-5)
+
+    class MyDist(Normal):
+        pass
+
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Uniform(0.0, 1.0), Bernoulli(probs=0.5))
+
+    @register_kl(MyDist, MyDist)
+    def _kl_my(p, q):  # noqa
+        return p.loc * 0 + 42.0
+
+    assert float(kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0))) == 42.0
+
+
+def test_rsample_differentiable():
+    """Pathwise gradient: d/dscale E[x^2] for N(0, s) is 2s."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(s):
+        d = Normal(0.0, 1.0)
+        key = jax.random.PRNGKey(0)
+        eps = jax.random.normal(key, (50000,))
+        return jnp.mean((eps * s) ** 2)
+
+    g = jax.grad(f)(1.5)
+    np.testing.assert_allclose(float(g), 3.0, rtol=0.05)

@@ -1,0 +1,114 @@
+"""Launcher + elastic tests (reference: launch tests and
+fleet/elastic tests; single-host multi-process per SURVEY §4)."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(script, tmp_path, *extra, procs=4, env=None, timeout=120):
+    sp = tmp_path / "worker.py"
+    sp.write_text(textwrap.dedent(script))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(procs), *extra, str(sp)]
+    e = dict(os.environ, PYTHONPATH=REPO)
+    e.update(env or {})
+    return subprocess.run(cmd, cwd=REPO, env=e, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_launch_spawns_ranked_workers(tmp_path):
+    """Workers see rank env + the shared store, and rendezvous through it."""
+    out = tmp_path / "out"
+    out.mkdir()
+    r = _run_launch(f"""
+        import os
+        from paddle_tpu.distributed.store import TCPStore
+        rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+        world = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+        assert os.environ["PADDLE_TRAINER_ID"] == str(rank)
+        host, _, port = os.environ["PADDLE_TPU_MASTER"].rpartition(":")
+        s = TCPStore(host, int(port), world_size=world, timeout=20)
+        s.set(f"/r/{{rank}}", str(rank))
+        s.barrier("test")
+        peers = sorted(int(s.get(f"/r/{{i}}")) for i in range(world))
+        assert peers == list(range(world)), peers
+        open(r"{out}" + f"/rank{{rank}}", "w").write("ok")
+        s.close()
+    """, tmp_path, procs=4)
+    assert r.returncode == 0, r.stderr
+    assert sorted(os.listdir(out)) == [f"rank{i}" for i in range(4)]
+
+
+def test_launch_fail_fast_propagates_exit_code(tmp_path):
+    r = _run_launch("""
+        import os, sys, time
+        if os.environ["PADDLE_TPU_PROCESS_ID"] == "1":
+            sys.exit(7)
+        time.sleep(30)  # must be torn down by the controller
+    """, tmp_path, procs=3, timeout=60)
+    assert r.returncode == 7
+    assert "rank" in r.stderr and "failed" in r.stderr
+
+
+def test_launch_elastic_relaunches(tmp_path):
+    """First attempt fails; elastic relaunch (restart epoch 1) succeeds."""
+    r = _run_launch(f"""
+        import os, sys
+        epoch = int(os.environ["PADDLE_RESTART_EPOCH"])
+        rank = os.environ["PADDLE_TPU_PROCESS_ID"]
+        if epoch == 0 and rank == "0":
+            sys.exit(1)  # simulated failure on the first attempt
+        if epoch >= 1:
+            open(r"{tmp_path}" + f"/ok{{rank}}", "w").write(str(epoch))
+    """, tmp_path, "--elastic", "--max_restarts", "2", procs=2, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "relaunching" in r.stderr
+    assert sorted(f for f in os.listdir(tmp_path) if f.startswith("ok")) \
+        == ["ok0", "ok1"]
+
+
+def test_launch_log_dir(tmp_path):
+    logs = tmp_path / "logs"
+    r = _run_launch("""
+        import os
+        print("hello from", os.environ["PADDLE_TPU_PROCESS_ID"])
+    """, tmp_path, "--log_dir", str(logs), procs=2)
+    assert r.returncode == 0, r.stderr
+    files = sorted(os.listdir(logs))
+    assert files == ["worker.0.log", "worker.1.log"]
+    assert "hello from 0" in (logs / "worker.0.log").read_text()
+
+
+def test_elastic_manager_membership():
+    from paddle_tpu.distributed.store import create_master_store, TCPStore
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+
+    master = create_master_store()
+    nodes = [TCPStore(port=master.port) for _ in range(2)]
+    mgrs = [ElasticManager(nodes[i], job_id="j", rank=i, np_target=2,
+                           ttl=0.6, interval=0.1) for i in range(2)]
+    for m in mgrs:
+        m.register()
+    assert mgrs[0].wait_for_world(timeout=10)
+    assert mgrs[0].check() == ElasticStatus.HOLD
+
+    events = []
+    mgrs[0].watch(on_change=lambda st, alive: events.append((st, alive)))
+    # node 1 dies (stops heartbeating)
+    mgrs[1].deregister()
+    deadline = time.time() + 10
+    while not events and time.time() < deadline:
+        time.sleep(0.05)
+    mgrs[0].exit()
+    assert events and events[0][0] == ElasticStatus.RESTART
+    assert events[0][1] == ["j/node0"]
+    for s in nodes:
+        s.close()
+    master.close()
