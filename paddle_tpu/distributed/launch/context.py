@@ -33,8 +33,11 @@ def parse_args(argv=None):
                    help="elastic: maximum relaunch attempts")
     p.add_argument("--devices", default=os.environ.get("PADDLE_DEVICES"),
                    help="visible device ids for this node (comma-separated)")
+    p.add_argument("-m", "--module", action="store_true",
+                   help="treat training_script as a module name "
+                        "(python -m semantics)")
     p.add_argument("training_script",
-                   help="the script (or module with -m inside) to run")
+                   help="the script (or, with -m, module name) to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 

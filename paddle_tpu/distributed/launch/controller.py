@@ -33,12 +33,32 @@ class Controller:
         self._shutdown = threading.Event()
 
     # -- rendezvous --------------------------------------------------------
+    @staticmethod
+    def _is_local_host(host):
+        import socket
+
+        if host in ("", "localhost", "127.0.0.1", "0.0.0.0"):
+            return True
+        try:
+            addrs = {ai[4][0] for ai in socket.getaddrinfo(host, None)}
+        except socket.gaierror:
+            return False
+        local = {"127.0.0.1", "::1"}
+        try:
+            local |= {ai[4][0] for ai in socket.getaddrinfo(
+                socket.gethostname(), None)}
+        except socket.gaierror:
+            pass
+        return bool(addrs & local)
+
     def rendezvous(self):
-        """Determine (node_rank, master addr), hosting the store on node 0.
+        """Determine (node_rank, master addr); the controller on the master
+        host also hosts the store daemon.
 
         Single-node default: host a store on a free port locally.
-        Multi-node: --master required; node ranks from an atomic counter
-        (reference: master.py sync_peers)."""
+        Multi-node: --master required; explicitly ranked nodes claim their
+        rank, auto-rank (-1) nodes draw from an atomic counter skipping
+        claimed ranks (reference: master.py sync_peers)."""
         from ..store import TCPStore
 
         args = self.args
@@ -53,18 +73,31 @@ class Controller:
             self.node_rank = 0
             return
         host, _, port = args.master.rpartition(":")
-        is_host = args.rank in (0, -1) and args.nnodes == 1
-        if args.rank == 0 or is_host:
-            self._store = TCPStore(host, int(port), is_master=True,
-                                   world_size=args.nnodes)
-        else:
-            self._store = TCPStore(host, int(port), world_size=args.nnodes)
+        port = int(port)
         self.master = args.master
+        # the node running on the master address hosts the daemon (works
+        # with auto-rank too); everyone else is a client
+        if args.rank == 0 or (args.rank == -1 and self._is_local_host(host)):
+            try:
+                self._store = TCPStore(host, port, is_master=True,
+                                       world_size=args.nnodes)
+            except RuntimeError:
+                # lost the local bind race to a peer controller
+                self._store = TCPStore(host, port, world_size=args.nnodes)
+        else:
+            self._store = TCPStore(host, port, world_size=args.nnodes)
+        job = args.job_id
         if args.rank >= 0:
+            self._store.set(f"/rdzv/{job}/taken/{args.rank}", b"1")
             self.node_rank = args.rank
         else:
-            self.node_rank = self._store.add(
-                f"/rdzv/{args.job_id}/nodes", 1) - 1
+            # counter assignment that skips explicitly claimed ranks
+            while True:
+                n = self._store.add(f"/rdzv/{job}/next", 1) - 1
+                if self._store.get_nowait(f"/rdzv/{job}/taken/{n}") is None:
+                    self._store.set(f"/rdzv/{job}/taken/{n}", b"1")
+                    self.node_rank = n
+                    break
 
     # -- spawn -------------------------------------------------------------
     def _env_for(self, local_rank, restart_epoch=0):
@@ -101,12 +134,15 @@ class Controller:
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)
         for lr in range(args.nproc_per_node):
-            cmd = [sys.executable, args.training_script,
-                   *args.training_script_args]
-            if args.training_script == "-m":
-                cmd = [sys.executable, "-m", *args.training_script_args]
+            if getattr(args, "module", False):
+                cmd = [sys.executable, "-m", args.training_script,
+                       *args.training_script_args]
+            else:
+                cmd = [sys.executable, args.training_script,
+                       *args.training_script_args]
             log_path = None
             stdout = stderr = None
+            f = None
             if args.log_dir:
                 rank = self.node_rank * args.nproc_per_node + lr
                 log_path = os.path.join(args.log_dir,
@@ -115,6 +151,8 @@ class Controller:
                 stdout, stderr = f, subprocess.STDOUT
             p = subprocess.Popen(cmd, env=self._env_for(lr, restart_epoch),
                                  stdout=stdout, stderr=stderr)
+            if f is not None:
+                f.close()  # Popen dup'd the fd; don't leak per relaunch
             self.procs.append(Proc(lr, p, log_path))
 
     def terminate(self, sig=signal.SIGTERM, grace=10.0):

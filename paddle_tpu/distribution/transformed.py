@@ -210,11 +210,22 @@ class TransformedDistribution(Distribution):
         return self.transform._forward(self.base._sample(key, shape))
 
     def _log_prob(self, value):
-        x = self.transform._inverse(value)
-        # scalar transforms (event_dims=0) return per-element jacobians,
-        # matching per-element base log-probs; event transforms (e.g.
-        # stick-breaking) return jacobians already reduced over the event
-        # dim, matching event-reduced base log-probs — shapes line up in
-        # both cases
-        ldj = self.transform._forward_log_det_jacobian(x)
-        return self.base._log_prob(x) - ldj
+        # walk transforms last-to-first, reducing each jacobian over the
+        # base's event dims it does NOT already cover: scalar transforms
+        # (event_dims=0) over an event-shaped base must sum their
+        # per-element ldj; event transforms (e.g. stick-breaking) return
+        # event-reduced ldj already
+        transforms = self.transform.transforms \
+            if isinstance(self.transform, ChainTransform) \
+            else [self.transform]
+        event_ndim = len(self.base.event_shape)
+        x = value
+        total_ldj = 0.0
+        for t in reversed(transforms):
+            x = t._inverse(x)
+            ldj = t._forward_log_det_jacobian(x)
+            reduce_d = event_ndim - t.event_dims
+            if reduce_d > 0 and getattr(ldj, "ndim", 0) >= reduce_d:
+                ldj = ldj.sum(tuple(range(-reduce_d, 0)))
+            total_ldj = total_ldj + ldj
+        return self.base._log_prob(x) - total_ldj

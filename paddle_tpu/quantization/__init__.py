@@ -1,0 +1,217 @@
+"""paddle_tpu.quantization — QAT (fake-quant) and PTQ.
+
+Reference analog: python/paddle/quantization/ (QuantConfig config.py, QAT
+qat.py wrapping layers with quanted counterparts, PTQ ptq.py with
+observers) and the imperative qat pass. TPU-native: fake-quant is pure
+jnp math with a straight-through estimator, so QAT graphs stay fully
+jittable; "convert" bakes int8 weights + scales for a simulated-int8
+deploy path (XLA int8 matmul).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from .. import nn
+
+__all__ = [
+    "fake_quant", "quant_linear", "dequant_linear",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
+    "QuantConfig", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
+]
+
+
+# ---- functional -----------------------------------------------------------
+
+def _fake_quant_impl(x, scale, *, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    # straight-through estimator: identity gradient
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quant(x, scale, bits=8):
+    """Simulated quantization with STE gradients (reference:
+    fake_quantize_abs_max op)."""
+    return apply("fake_quant", _fake_quant_impl, [x, scale], {"bits": bits})
+
+
+def quant_linear(x, scale, bits=8):
+    """float -> int8 values (deploy path)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    s = jnp.maximum(jnp.asarray(scale), 1e-9)
+    return Tensor(jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+                  .astype(jnp.int8))
+
+
+def dequant_linear(q, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    v = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return Tensor(v.astype(jnp.float32) * jnp.asarray(scale) / qmax)
+
+
+# ---- observers ------------------------------------------------------------
+
+class AbsmaxObserver:
+    """Running max(|x|) (reference: AbsmaxObserver observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        self._max = max(self._max, float(np.abs(v).max(initial=0.0)))
+
+    def scale(self):
+        return self._max if self._max > 0 else 1e-9
+
+
+class MovingAverageAbsmaxObserver(AbsmaxObserver):
+    """EMA of abs-max (reference: moving_average_abs_max)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.rate = moving_rate
+        self._ema = None
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        cur = float(np.abs(v).max(initial=0.0))
+        self._ema = cur if self._ema is None else \
+            self.rate * self._ema + (1 - self.rate) * cur
+
+    def scale(self):
+        return self._ema if self._ema else 1e-9
+
+
+class PerChannelAbsmaxObserver:
+    """Per-output-channel abs-max for weights (reference:
+    channel_wise_abs_max)."""
+
+    def __init__(self, quant_bits=8, axis=0):
+        self.quant_bits = quant_bits
+        self.axis = axis
+        self._max = None
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        red = tuple(i for i in range(v.ndim) if i != self.axis)
+        cur = np.abs(v).max(axis=red)
+        self._max = cur if self._max is None else np.maximum(self._max, cur)
+
+    def scale(self):
+        if self._max is None:
+            return np.asarray(1e-9)
+        return np.maximum(self._max, 1e-9)
+
+
+# ---- config + quanted layers ---------------------------------------------
+
+class QuantConfig:
+    """Reference: quantization/config.py — which layers get quantized and
+    with what observers."""
+
+    def __init__(self, activation=None, weight=None, weight_bits=8,
+                 activation_bits=8):
+        self.activation_factory = activation or MovingAverageAbsmaxObserver
+        self.weight_factory = weight or AbsmaxObserver
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = (nn.Linear, nn.Conv2D)
+
+
+class _QuantedBase(nn.Layer):
+    def __init__(self, layer, cfg: QuantConfig):
+        super().__init__()
+        self.inner = layer
+        self.cfg = cfg
+        self.w_observer = cfg.weight_factory(cfg.weight_bits)
+        self.a_observer = cfg.activation_factory(cfg.activation_bits)
+
+    def forward(self, x):
+        self.a_observer.observe(x)
+        a_scale = Tensor(np.float32(self.a_observer.scale()))
+        xq = fake_quant(x, a_scale, self.cfg.activation_bits)
+        w = self.inner.weight
+        self.w_observer.observe(w)
+        w_scale = Tensor(np.float32(self.w_observer.scale()))
+        wq = fake_quant(w, w_scale, self.cfg.weight_bits)
+        return self._call_inner(xq, wq)
+
+
+class QuantedLinear(_QuantedBase):
+    def _call_inner(self, x, w):
+        from ..nn import functional as F
+
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def _call_inner(self, x, w):
+        from ..nn import functional as F
+
+        inner = self.inner
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups)
+
+
+# ---- QAT / PTQ drivers ----------------------------------------------------
+
+def _swap_layers(model, cfg, wrap):
+    for name, sub in list(model.named_sublayers()):
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        if isinstance(sub, cfg.types) and not isinstance(sub, _QuantedBase):
+            wrapped = wrap(sub)
+            setattr(parent, parts[-1], wrapped)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (reference: qat.py QAT.quantize)."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.cfg = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        def wrap(layer):
+            if isinstance(layer, nn.Conv2D):
+                return QuantedConv2D(layer, self.cfg)
+            return QuantedLinear(layer, self.cfg)
+
+        return _swap_layers(model, self.cfg, wrap)
+
+    def convert(self, model, inplace=True):
+        """Bake int8 weights + scales (simulated-int8 deploy)."""
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, _QuantedBase):
+                w_scale = sub.w_observer.scale()
+                sub.inner.weight_int8 = quant_linear(
+                    sub.inner.weight, w_scale, self.cfg.weight_bits)
+                sub.inner.weight_scale = w_scale
+        return model
+
+
+class PTQ:
+    """Post-training quantization (reference: ptq.py): wrap, run calib
+    batches, convert."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.cfg = config or QuantConfig(
+            activation=MovingAverageAbsmaxObserver)
+        self._qat = QAT(self.cfg)
+
+    def quantize(self, model, inplace=True):
+        return self._qat.quantize(model, inplace)
+
+    def convert(self, model, inplace=True):
+        return self._qat.convert(model, inplace)

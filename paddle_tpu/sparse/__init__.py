@@ -1,0 +1,293 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors and ops.
+
+Reference analog: python/paddle/sparse/ (creation.py sparse_coo_tensor/
+sparse_csr_tensor, unary/binary ops, matmul, nn layers) over phi's
+SparseCooTensor/SparseCsrTensor (phi/core/sparse_coo_tensor.h).
+
+TPU-native: backed by jax.experimental.sparse.BCOO — XLA lowers its
+dot_general to gather/scatter+MXU ops, which is the only sparse story the
+TPU has; CSR is kept as a view-level format that converts through COO.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "add", "subtract", "multiply",
+    "matmul", "masked_matmul", "relu", "tanh", "sqrt", "sin", "abs",
+    "neg", "pow", "cast", "transpose", "sum",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x))
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: phi SparseCooTensor + python surface).
+    Wraps a BCOO; autograd flows through .values() into dense ops."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- construction ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # [ndim, nnz] reference layout
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor.from_coo(self)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def astype(self, dtype):
+        from ..core.dtype import convert_dtype
+
+        return SparseCooTensor(self._bcoo.astype(convert_dtype(dtype)))
+
+    def transpose(self, perm):
+        return SparseCooTensor(self._bcoo.transpose(tuple(perm)))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR view (reference: phi SparseCsrTensor). Stored as
+    (crows, cols, values) on host-conversion from COO; compute converts
+    through COO/BCOO."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _v(crows).astype(jnp.int32)
+        self._cols = _v(cols).astype(jnp.int32)
+        self._values = _v(values)
+        self._shape = list(int(s) for s in shape)
+
+    @classmethod
+    def from_coo(cls, coo: SparseCooTensor):
+        if len(coo.shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        b = coo._bcoo.sum_duplicates()
+        idx = np.asarray(b.indices)
+        order = np.lexsort((idx[:, 1], idx[:, 0]))
+        rows, cols = idx[order, 0], idx[order, 1]
+        vals = jnp.asarray(np.asarray(b.data)[order])
+        crows = np.zeros(coo.shape[0] + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return cls(crows, cols, vals, coo.shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = np.repeat(np.arange(self._shape[0]),
+                         np.diff(np.asarray(self._crows)))
+        idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                         self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO(
+            (self._values, idx), shape=tuple(self._shape)))
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Reference: paddle.sparse.sparse_coo_tensor(creation.py)."""
+    idx = _v(indices).astype(jnp.int32)  # [ndim, nnz]
+    vals = _v(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) for i in np.asarray(idx).max(1) + 1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx.T), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    vals = _v(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def _binary(x, y, op):
+    x, y = _coo(x), _coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = op(x._bcoo.todense(), y._bcoo.todense())
+        return _dense_to_coo(out)
+    raise TypeError("sparse binary ops need two sparse operands")
+
+
+def _dense_to_coo(dense):
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+
+
+def add(x, y):
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y):
+    return _binary(x, y, jnp.subtract)
+
+
+def multiply(x, y):
+    return _binary(x, y, jnp.multiply)
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference sparse/matmul.py)."""
+    x = _coo(x)
+    yv = _v(y)
+    out = x._bcoo @ yv
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at mask's sparsity (reference SDDMM)."""
+    xv, yv = _v(x), _v(y)
+    m = _coo(mask)
+    idx = m._bcoo.indices  # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = (xv[rows] * yv[:, cols].T).sum(-1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=m._bcoo.shape))
+
+
+def _unary(x, fn):
+    x = _coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (fn(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
+
+
+def relu(x):
+    return _unary(x, jax.nn.relu)
+
+
+def tanh(x):
+    return _unary(x, jnp.tanh)
+
+
+def sqrt(x):
+    return _unary(x, jnp.sqrt)
+
+
+def sin(x):
+    return _unary(x, jnp.sin)
+
+
+def abs(x):  # noqa: A001 — reference name
+    return _unary(x, jnp.abs)
+
+
+def neg(x):
+    return _unary(x, jnp.negative)
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    x = _coo(x)
+    idx = x._bcoo.indices
+    vals = x._bcoo.data
+    from ..core.dtype import convert_dtype
+
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    if value_dtype is not None:
+        vals = vals.astype(convert_dtype(value_dtype))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=x._bcoo.shape))
+
+
+def transpose(x, perm):
+    return _coo(x).transpose(perm)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    x = _coo(x)
+    out = x._bcoo.todense().sum(
+        axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+        keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)

@@ -1,0 +1,12 @@
+"""paddle_tpu.vision (reference: python/paddle/vision/ — transforms,
+datasets, models, ops)."""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, AlexNet, VGG, ResNet, MobileNetV1, MobileNetV2, SqueezeNet,
+    resnet18, resnet34, resnet50, resnet101, resnet152, alexnet,
+    vgg11, vgg13, vgg16, vgg19, mobilenet_v1, mobilenet_v2,
+    squeezenet1_0, squeezenet1_1,
+)

@@ -1,0 +1,155 @@
+"""paddle_tpu.vision.datasets (reference: python/paddle/vision/datasets/ —
+MNIST mnist.py, Cifar10/100 cifar.py, FashionMNIST, DatasetFolder
+folder.py). No download in this environment (zero egress): file-backed
+datasets load from a user-supplied local path; FakeData provides the
+synthetic stand-in the benchmarks use."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder"]
+
+
+class FakeData(Dataset):
+    """Synthetic classification images (reference: the ImageNet-synthetic
+    benchmark input; torchvision FakeData analog)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = int(size)
+        self.image_shape = tuple(image_shape)
+        self.num_classes = int(num_classes)
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._labels = self._rng.randint(0, num_classes, size)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self._labels[idx])
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST from local files (reference mnist.py parses the
+    same ubyte files)."""
+
+    _files = {
+        "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }
+
+    def __init__(self, root, mode="train", transform=None,
+                 backend="numpy"):
+        img_f, lbl_f = self._files["train" if mode == "train" else "test"]
+        self.images = self._read_idx(os.path.join(root, img_f), 16)
+        self.labels = self._read_idx(os.path.join(root, lbl_f), 8)
+        n = len(self.labels)
+        self.images = self.images.reshape(n, 28, 28)
+        self.transform = transform
+
+    @staticmethod
+    def _read_idx(path, header):
+        op = gzip.open if path.endswith(".gz") else open
+        if not os.path.exists(path) and path.endswith(".gz"):
+            path = path[:-3]
+            op = open
+        with op(path, "rb") as f:
+            data = f.read()
+        return np.frombuffer(data, np.uint8, offset=header)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the local python-pickle tarball (reference
+    cifar.py)."""
+
+    def __init__(self, data_file, mode="train", transform=None):
+        self.transform = transform
+        imgs, labels = [], []
+        with tarfile.open(data_file) as tf:
+            names = [m for m in tf.getmembers()
+                     if ("data_batch" in m.name if mode == "train"
+                         else "test_batch" in m.name)]
+            for m in sorted(names, key=lambda m: m.name):
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                imgs.append(np.asarray(d[b"data"]))
+                labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file, mode="train", transform=None):
+        self.transform = transform
+        imgs, labels = [], []
+        with tarfile.open(data_file) as tf:
+            want = "train" if mode == "train" else "test"
+            for m in tf.getmembers():
+                if os.path.basename(m.name) == want:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"]))
+                    labels.extend(d[b"fine_labels"])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdir layout of .npy files (reference folder.py; image
+    decoding is out of scope without PIL — store arrays)."""
+
+    def __init__(self, root, transform=None, extensions=(".npy",)):
+        self.transform = transform
+        self.samples = []
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.load(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)

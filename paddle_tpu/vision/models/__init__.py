@@ -1,0 +1,13 @@
+"""paddle_tpu.vision.models (reference: python/paddle/vision/models/ —
+lenet.py, alexnet.py, vgg.py, mobilenetv1.py, mobilenetv2.py,
+squeezenet.py, plus resnet re-exported from the core model zoo)."""
+from ...models.resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+)
+from .lenet import LeNet  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401

@@ -1,0 +1,113 @@
+"""MobileNetV1/V2 (reference: python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py). Depthwise convs map to XLA's feature_group_count — the
+grouped-conv path the TPU compiler tiles natively."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1, act="relu6"):
+    layers = [nn.Conv2D(c_in, c_out, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(c_out)]
+    if act == "relu6":
+        layers.append(nn.ReLU6())
+    elif act == "relu":
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [  # (out, stride) of each depthwise-separable block
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1),
+        ]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1, act="relu")]
+        c_in = c(32)
+        for out, s in cfg:
+            layers.append(_conv_bn(c_in, c_in, 3, stride=s, padding=1,
+                                   groups=c_in, act="relu"))  # depthwise
+            layers.append(_conv_bn(c_in, c(out), 1, act="relu"))  # pointwise
+            c_in = c(out)
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_in, num_classes)
+        self._out_ch = c_in
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        if self.num_classes > 0:
+            x = self.fc(x)
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, expand):
+        super().__init__()
+        hidden = int(round(c_in * expand))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand != 1:
+            layers.append(_conv_bn(c_in, hidden, 1))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, padding=1,
+                     groups=hidden),
+            nn.Conv2D(hidden, c_out, 1, bias_attr=False),
+            nn.BatchNorm2D(c_out),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, dropout=0.2):
+        super().__init__()
+        self.num_classes = num_classes
+
+        def c(ch):
+            return max(8, int(ch * scale + 4) // 8 * 8)
+
+        cfg = [  # t (expand), c (out), n (repeat), s (stride)
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        c_in = c(32)
+        layers = [_conv_bn(3, c_in, 3, stride=2, padding=1)]
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    c_in, c(ch), s if i == 0 else 1, t))
+                c_in = c(ch)
+        last = max(1280, int(1280 * scale))
+        layers.append(_conv_bn(c_in, last, 1))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(dropout), nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x)).flatten(1)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(scale=1.0, num_classes=1000, **kw):
+    return MobileNetV1(scale=scale, num_classes=num_classes, **kw)
+
+
+def mobilenet_v2(scale=1.0, num_classes=1000, **kw):
+    return MobileNetV2(scale=scale, num_classes=num_classes, **kw)

@@ -1,0 +1,70 @@
+"""VGG family (reference: python/paddle/vision/models/vgg.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_layers(cfg, batch_norm=False):
+    layers = []
+    c_in = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+            continue
+        layers.append(nn.Conv2D(c_in, v, 3, padding=1))
+        if batch_norm:
+            layers.append(nn.BatchNorm2D(v))
+        layers.append(nn.ReLU())
+        c_in = v
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    def __init__(self, features, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 49, 4096), nn.ReLU(), nn.Dropout(dropout),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
+                nn.Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _vgg(cfg, batch_norm, num_classes, **kw):
+    return VGG(_make_layers(_CFGS[cfg], batch_norm),
+               num_classes=num_classes, **kw)
+
+
+def vgg11(batch_norm=False, num_classes=1000, **kw):
+    return _vgg("A", batch_norm, num_classes, **kw)
+
+
+def vgg13(batch_norm=False, num_classes=1000, **kw):
+    return _vgg("B", batch_norm, num_classes, **kw)
+
+
+def vgg16(batch_norm=False, num_classes=1000, **kw):
+    return _vgg("D", batch_norm, num_classes, **kw)
+
+
+def vgg19(batch_norm=False, num_classes=1000, **kw):
+    return _vgg("E", batch_norm, num_classes, **kw)

@@ -1,0 +1,168 @@
+"""paddle_tpu.vision.ops (reference: python/paddle/vision/ops.py — nms,
+roi_align, box coders, deform_conv). TPU-native: everything is jnp math
+dispatched through the eager tape; nms uses the O(n^2) mask formulation
+(static shapes — no data-dependent loops for XLA)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["box_iou", "nms", "roi_align", "box_coder"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _box_area(b):
+    return (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU of [N,4] x [M,4] xyxy boxes."""
+    return apply("box_iou", _iou_matrix, [boxes1, boxes2])
+
+
+def _nms_impl(boxes, scores, *, iou_threshold):
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_matrix(b, b)
+    # keep[i] iff no higher-scoring kept box overlaps it; resolved by a
+    # scan over the score order (sequential dependency, static length)
+    n = b.shape[0]
+
+    def body(keep, i):
+        sup = (iou[i] > iou_threshold) & keep & \
+            (jnp.arange(n) < i)  # higher-scored kept boxes only
+        k = ~jnp.any(sup)
+        keep = keep.at[i].set(k)
+        return keep, None
+
+    keep0 = jnp.ones((n,), bool)
+    keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+    idx = keep.nonzero(size=n, fill_value=-1)[0]
+    # -1 padding must stay -1, not wrap around into order[-1]
+    return jnp.where(idx >= 0, order[idx], -1)
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, top_k=None):
+    """Indices of kept boxes, score-descending; -1-padded to N (static
+    shape for XLA). Slice with top_k or filter >= 0 on host."""
+    if scores is None:
+        scores = _v(boxes)[:, 3] * 0 + jnp.arange(
+            _v(boxes).shape[0], 0, -1)  # keep input order
+    idx = apply("nms", _nms_impl, [boxes, scores],
+                {"iou_threshold": float(iou_threshold)})
+    if top_k is not None:
+        idx = idx[:top_k]
+    return idx
+
+
+def _roi_align_impl(feat, rois, roi_batch_idx, *, output_size,
+                    spatial_scale, sampling_ratio):
+    """feat [N,C,H,W], rois [R,4] xyxy in input coords -> [R,C,oh,ow]."""
+    oh, ow = output_size
+    sr = max(1, int(sampling_ratio))
+
+    def one(roi, bi):
+        f = feat[bi]  # [C,H,W]
+        x0, y0, x1, y1 = roi * spatial_scale
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bh, bw = rh / oh, rw / ow
+        # sr x sr sample grid per bin, bilinear, averaged
+        iy = (jnp.arange(oh)[:, None] * bh + y0 +
+              (jnp.arange(sr)[None, :] + 0.5) * bh / sr)  # [oh, sr]
+        ix = (jnp.arange(ow)[:, None] * bw + x0 +
+              (jnp.arange(sr)[None, :] + 0.5) * bw / sr)  # [ow, sr]
+
+        def bilinear(y, x):
+            h, w = f.shape[1:]
+            y = jnp.clip(y, 0, h - 1.0)
+            x = jnp.clip(x, 0, w - 1.0)
+            y0i = jnp.floor(y).astype(jnp.int32)
+            x0i = jnp.floor(x).astype(jnp.int32)
+            y1i = jnp.minimum(y0i + 1, h - 1)
+            x1i = jnp.minimum(x0i + 1, w - 1)
+            wy = y - y0i
+            wx = x - x0i
+            v00 = f[:, y0i, x0i]
+            v01 = f[:, y0i, x1i]
+            v10 = f[:, y1i, x0i]
+            v11 = f[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        yy = iy.reshape(-1)  # [oh*sr]
+        xx = ix.reshape(-1)  # [ow*sr]
+        vals = jax.vmap(lambda y: jax.vmap(lambda x: bilinear(y, x))(xx))(yy)
+        # vals [oh*sr, ow*sr, C] -> [C, oh, sr, ow, sr] mean over samples
+        vals = vals.reshape(oh, sr, ow, sr, -1).mean((1, 3))
+        return vals.transpose(2, 0, 1)
+
+    return jax.vmap(one)(rois, roi_batch_idx)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=2, aligned=False):
+    """RoIAlign (reference vision/ops.py roi_align). boxes [R,4];
+    boxes_num [N] rois per image (defaults to all on image 0)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    import numpy as np
+
+    r = _v(boxes).shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        bn = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                        else boxes_num)
+        batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    return apply("roi_align", _roi_align_impl, [x, boxes, batch_idx],
+                 {"output_size": tuple(output_size),
+                  "spatial_scale": float(spatial_scale),
+                  "sampling_ratio": int(sampling_ratio)})
+
+
+def _box_coder_impl(prior, prior_var, target, *, code_type, box_normalized):
+    pw = prior[:, 2] - prior[:, 0] + (0 if box_normalized else 1)
+    ph = prior[:, 3] - prior[:, 1] + (0 if box_normalized else 1)
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + (0 if box_normalized else 1)
+        th = target[:, 3] - target[:, 1] + (0 if box_normalized else 1)
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], -1)
+        return out / prior_var
+    # decode
+    t = target * prior_var
+    cx = t[:, 0] * pw + pcx
+    cy = t[:, 1] * ph + pcy
+    w = jnp.exp(t[:, 2]) * pw
+    h = jnp.exp(t[:, 3]) * ph
+    off = 0 if box_normalized else 1
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - off, cy + h / 2 - off], -1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    return apply("box_coder", _box_coder_impl,
+                 [prior_box, prior_box_var, target_box],
+                 {"code_type": code_type,
+                  "box_normalized": bool(box_normalized)})

@@ -1,0 +1,416 @@
+"""paddle_tpu.vision.transforms (reference: python/paddle/vision/
+transforms/transforms.py + functional.py).
+
+Numpy-native: transforms operate on HWC uint8/float arrays (or CHW when
+data_format='CHW'), since the input pipeline assembles numpy host batches
+and the device only sees the final tensor. PIL is not required.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+    "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "RandomResizedCrop", "Pad", "Grayscale", "Transpose",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "RandomRotation", "to_tensor",
+    "normalize", "resize", "center_crop", "crop", "hflip", "vflip", "pad",
+    "to_grayscale", "adjust_brightness", "adjust_contrast", "adjust_hue",
+    "rotate",
+]
+
+
+def _as_float(img):
+    if img.dtype == np.uint8:
+        return img.astype(np.float32) / 255.0
+    return img.astype(np.float32)
+
+
+def _size2(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# ---- functional -----------------------------------------------------------
+
+def to_tensor(img, data_format="CHW"):
+    """HWC [0,255] uint8 (or float) -> CHW float32 in [0,1]."""
+    arr = _as_float(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Nearest/bilinear resize of an HWC (or HW) numpy image."""
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        # shorter edge -> size, keep aspect (reference semantics)
+        if h < w:
+            nh, nw = int(size), int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), int(size)
+    else:
+        nh, nw = _size2(size)
+    if (nh, nw) == (h, w):
+        return img
+    if interpolation == "nearest":
+        ys = (np.arange(nh) * h / nh).astype(np.int64).clip(0, h - 1)
+        xs = (np.arange(nw) * w / nw).astype(np.int64).clip(0, w - 1)
+        return img[ys][:, xs]
+    # bilinear (align_corners=False convention)
+    ys = (np.arange(nh) + 0.5) * h / nh - 0.5
+    xs = (np.arange(nw) + 0.5) * w / nw - 0.5
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    y0c = y0.clip(0, h - 1)
+    y1c = (y0 + 1).clip(0, h - 1)
+    x0c = x0.clip(0, w - 1)
+    x1c = (x0 + 1).clip(0, w - 1)
+    f = _as_float(img)
+    if f.ndim == 2:
+        f = f[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    wy = wy[..., None]
+    wx = wx[..., None]
+    out = (f[y0c][:, x0c] * (1 - wy) * (1 - wx)
+           + f[y0c][:, x1c] * (1 - wy) * wx
+           + f[y1c][:, x0c] * wy * (1 - wx)
+           + f[y1c][:, x1c] * wy * wx)
+    if squeeze:
+        out = out[..., 0]
+    if img.dtype == np.uint8:  # _as_float scaled to [0,1]; undo
+        out = np.clip(out * 255.0, 0, 255).astype(np.uint8)
+    return out
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    th, tw = _size2(output_size)
+    h, w = img.shape[:2]
+    return crop(img, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def hflip(img):
+    return img[:, ::-1]
+
+
+def vflip(img):
+    return img[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, pads, mode="constant", constant_values=fill)
+    return np.pad(img, pads, mode=padding_mode)
+
+
+def to_grayscale(img, num_output_channels=1):
+    f = _as_float(img)
+    g = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+    g = np.repeat(g[..., None], num_output_channels, -1)
+    if img.dtype == np.uint8:
+        return np.clip(g * 255 if g.max() <= 1 + 1e-6 else g,
+                       0, 255).astype(np.uint8)
+    return g
+
+
+def adjust_brightness(img, factor):
+    f = _as_float(img) * factor
+    if img.dtype == np.uint8:
+        return np.clip(f * 255, 0, 255).astype(np.uint8)
+    return f
+
+
+def adjust_contrast(img, factor):
+    f = _as_float(img)
+    mean = to_grayscale(np.asarray(f))[..., 0].mean()
+    out = mean + factor * (f - mean)
+    if img.dtype == np.uint8:
+        return np.clip(out * 255, 0, 255).astype(np.uint8)
+    return out
+
+
+def adjust_saturation(img, factor):
+    f = _as_float(img)
+    g = to_grayscale(np.asarray(f)).astype(np.float32)
+    out = g + factor * (f - g)
+    if img.dtype == np.uint8:
+        return np.clip(out * 255, 0, 255).astype(np.uint8)
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]: shift hue channel in HSV space."""
+    f = _as_float(img)
+    mx = f.max(-1)
+    mn = f.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6).astype(np.int64) % 6
+    fr = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - fr * s)
+    t = v * (1 - (1 - fr) * s)
+    rr = np.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    gg = np.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    bb = np.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    out = np.stack([rr, gg, bb], -1)
+    if img.dtype == np.uint8:
+        return np.clip(out * 255, 0, 255).astype(np.uint8)
+    return out
+
+
+def rotate(img, angle, fill=0):
+    """Rotate by angle degrees (nearest sampling, same output size)."""
+    h, w = img.shape[:2]
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    rad = -np.deg2rad(angle)
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = cy + (yy - cy) * np.cos(rad) - (xx - cx) * np.sin(rad)
+    xs = cx + (yy - cy) * np.sin(rad) + (xx - cx) * np.cos(rad)
+    yi = np.rint(ys).astype(np.int64)
+    xi = np.rint(xs).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+# ---- class transforms -----------------------------------------------------
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0):
+        self.size = _size2(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        th, tw = self.size
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill)
+        h, w = img.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, 0, max(0, tw - w), max(0, th - h)), self.fill)
+            h, w = img.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = _size2(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, (min(h, w), min(h, w))), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(img, self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(self.ts)
+        random.shuffle(order)
+        for t in order:
+            img = t(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return rotate(img, random.uniform(*self.degrees), self.fill)

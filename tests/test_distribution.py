@@ -208,3 +208,17 @@ def test_rsample_differentiable():
 
     g = jax.grad(f)(1.5)
     np.testing.assert_allclose(float(g), 3.0, rtol=0.05)
+
+
+def test_transformed_scalar_transform_over_event_base():
+    """Scalar transform over an event-shaped base must event-reduce its
+    jacobian (regression: shape-(K,) broadcast instead of scalar)."""
+    from paddle_tpu.distribution import Dirichlet
+
+    base = Dirichlet(np.array([2.0, 3.0, 4.0]))
+    td = TransformedDistribution(base, [AffineTransform(0.0, 2.0)])
+    y = np.array([0.4, 0.6, 1.0], np.float32)  # 2 * simplex point
+    lp = td.log_prob(y).numpy()
+    assert lp.shape == ()  # scalar, not (3,)
+    want = base.log_prob(y / 2).numpy() - 3 * np.log(2.0)
+    np.testing.assert_allclose(lp, want, rtol=1e-5)

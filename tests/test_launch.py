@@ -112,3 +112,47 @@ def test_elastic_manager_membership():
     for s in nodes:
         s.close()
     master.close()
+
+
+def test_launch_module_mode(tmp_path):
+    """-m module launch (regression: argparse rejected -m entirely)."""
+    pkg = tmp_path / "mymod.py"
+    pkg.write_text("import os; print('mod rank', "
+                   "os.environ['PADDLE_TPU_PROCESS_ID'])")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+           "-m", "mymod"]
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:{tmp_path}")
+    r = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    logs = (tmp_path / "logs")
+    assert "mod rank 0" in (logs / "worker.0.log").read_text()
+
+
+def test_rendezvous_mixed_explicit_and_auto_ranks():
+    """Auto-assigned node ranks must skip explicitly claimed ones, and the
+    node on the master address self-elects as store host under --rank -1."""
+    from paddle_tpu.distributed.launch.context import (Context, parse_args,
+                                                       free_port)
+    from paddle_tpu.distributed.launch.controller import Controller
+
+    port = free_port()
+    master = f"127.0.0.1:{port}"
+
+    def ctl(*extra):
+        args = parse_args(["--nnodes", "3", "--master", master, *extra,
+                           "x.py"])
+        c = Controller(Context(args))
+        c.rendezvous()
+        return c
+
+    c_host = ctl()              # auto rank; local master address -> hosts
+    assert c_host._store._server is not None
+    assert c_host.node_rank == 0
+    c_explicit = ctl("--rank", "1")
+    assert c_explicit.node_rank == 1
+    c_auto = ctl()              # must skip claimed ranks 0 and 1
+    assert c_auto.node_rank == 2
+    for c in (c_auto, c_explicit, c_host):
+        c.close()

@@ -1,0 +1,155 @@
+"""Sparse + quantization tests (reference: test/legacy_test sparse_* and
+quantization tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.sparse as sparse
+import paddle_tpu.quantization as Q
+
+
+# ---- sparse ---------------------------------------------------------------
+
+def _coo_example():
+    dense = np.zeros((3, 4), np.float32)
+    dense[0, 1] = 1.0
+    dense[1, 2] = -2.0
+    dense[2, 0] = 3.0
+    idx = np.array([[0, 1, 2], [1, 2, 0]])
+    vals = np.array([1.0, -2.0, 3.0], np.float32)
+    return dense, idx, vals
+
+
+def test_coo_create_and_to_dense():
+    dense, idx, vals = _coo_example()
+    s = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    assert s.shape == [3, 4]
+    assert s.nnz() == 3
+    np.testing.assert_array_equal(s.to_dense().numpy(), dense)
+    np.testing.assert_array_equal(s.indices().numpy(), idx)
+    np.testing.assert_array_equal(s.values().numpy(), vals)
+
+
+def test_csr_roundtrip():
+    dense, idx, vals = _coo_example()
+    coo = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    csr = coo.to_sparse_csr()
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(csr.cols().numpy(), [1, 2, 0])
+    np.testing.assert_array_equal(csr.to_dense().numpy(), dense)
+    csr2 = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], vals, [3, 4])
+    np.testing.assert_array_equal(csr2.to_dense().numpy(), dense)
+
+
+def test_sparse_elementwise_and_unary():
+    dense, idx, vals = _coo_example()
+    a = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    b = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    np.testing.assert_array_equal(sparse.add(a, b).to_dense().numpy(),
+                                  dense * 2)
+    np.testing.assert_array_equal(sparse.multiply(a, b).to_dense().numpy(),
+                                  dense * dense)
+    np.testing.assert_array_equal(sparse.relu(a).to_dense().numpy(),
+                                  np.maximum(dense, 0))
+    np.testing.assert_allclose(sparse.neg(a).to_dense().numpy(), -dense)
+    assert float(sparse.sum(a).numpy()) == dense.sum()
+
+
+def test_sparse_matmul():
+    dense, idx, vals = _coo_example()
+    s = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    y = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    out = sparse.matmul(s, y).numpy()
+    np.testing.assert_allclose(out, dense @ y, rtol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.RandomState(1)
+    x = rng.rand(3, 6).astype(np.float32)
+    y = rng.rand(6, 4).astype(np.float32)
+    dense, idx, vals = _coo_example()
+    mask = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    out = sparse.masked_matmul(x, y, mask)
+    full = x @ y
+    got = out.to_dense().numpy()
+    for r, c in zip(*np.nonzero(dense)):
+        np.testing.assert_allclose(got[r, c], full[r, c], rtol=1e-5)
+    assert got[dense == 0].max() == 0.0
+
+
+def test_sparse_transpose_cast():
+    dense, idx, vals = _coo_example()
+    s = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    t = sparse.transpose(s, [1, 0])
+    np.testing.assert_array_equal(t.to_dense().numpy(), dense.T)
+    c = sparse.cast(s, value_dtype="float64")
+    assert "float" in str(c.dtype)
+
+
+# ---- quantization ---------------------------------------------------------
+
+def test_fake_quant_ste_gradient():
+    import jax
+
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    x.stop_gradient = False
+    scale = paddle.to_tensor(np.float32(1.0))
+    q = Q.fake_quant(x, scale, bits=8)
+    err = np.abs(q.numpy() - x.numpy()).max()
+    assert err <= 1.0 / 127 + 1e-6  # quantization step bound
+    # STE: gradient of sum(fq(x)) wrt x is 1
+    y = Q.fake_quant(x, scale).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(11), rtol=1e-6)
+
+
+def test_quant_dequant_roundtrip():
+    x = np.array([-2.0, -1.0, 0.0, 0.5, 2.0], np.float32)
+    q = Q.quant_linear(x, scale=2.0)
+    assert q.numpy().dtype == np.int8
+    back = Q.dequant_linear(q, scale=2.0).numpy()
+    np.testing.assert_allclose(back, x, atol=2.0 / 127)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    qat = Q.QAT()
+    model = qat.quantize(model)
+    assert isinstance(model[0], Q.QuantedLinear)
+    assert isinstance(model[2], Q.QuantedLinear)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(32, 8).astype(np.float32))
+    t = paddle.to_tensor(rng.randint(0, 2, 32).astype(np.int64))
+    lf = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(15):
+        loss = lf(model(x), t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    qat.convert(model)
+    assert model[0].inner.weight_int8.numpy().dtype == np.int8
+
+
+def test_ptq_observes_and_bounds_error():
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 4))
+    rng = np.random.RandomState(2)
+    x = rng.rand(64, 8).astype(np.float32)
+    ref = model(paddle.to_tensor(x)).numpy()
+    ptq = Q.PTQ()
+    qmodel = ptq.quantize(model)
+    for i in range(4):  # calibration passes
+        qmodel(paddle.to_tensor(x[i * 16:(i + 1) * 16]))
+    out = qmodel(paddle.to_tensor(x)).numpy()
+    # int8 sim must stay close to the float model
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.1, rel
+    ptq.convert(qmodel)
+    assert qmodel[0].inner.weight_int8.numpy().dtype == np.int8

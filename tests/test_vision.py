@@ -1,0 +1,168 @@
+"""Vision package tests (reference: test/legacy_test/test_transforms*,
+test_vision_models*, test_ops_nms/roi_align)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData, DatasetFolder
+from paddle_tpu.vision import ops as vops
+
+
+def setup_function(_):
+    paddle.seed(0)
+
+
+# ---- transforms -----------------------------------------------------------
+
+def test_to_tensor_normalize_roundtrip():
+    img = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(3, 3, 2)
+    t = T.to_tensor(img)  # CHW, [0,1]
+    assert t.shape == (2, 3, 3) and t.dtype == np.float32
+    assert t.max() <= 1.0
+    n = T.normalize(t, mean=[0.5, 0.5, 0.5][:2], std=[0.5, 0.5, 0.5][:2])
+    np.testing.assert_allclose(n, (t - 0.5) / 0.5, rtol=1e-6)
+
+
+def test_resize_shapes_and_shorter_edge():
+    img = np.random.RandomState(0).randint(0, 255, (40, 60, 3), np.uint8)
+    assert T.resize(img, (20, 30)).shape == (20, 30, 3)
+    assert T.resize(img, 20).shape == (20, 30, 3)  # shorter edge
+    tall = T.resize(np.transpose(img, (1, 0, 2)), 20)
+    assert tall.shape == (30, 20, 3)
+    # bilinear downsample of a constant image stays constant
+    const = np.full((16, 16), 7.0, np.float32)
+    np.testing.assert_allclose(T.resize(const, (8, 8)), 7.0, rtol=1e-6)
+
+
+def test_crops_flips_pad():
+    img = np.arange(36, dtype=np.float32).reshape(6, 6)
+    assert T.center_crop(img, 4).shape == (4, 4)
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    p = T.pad(img, 2)
+    assert p.shape == (10, 10) and p[0, 0] == 0
+    rc = T.RandomCrop(4)(img)
+    assert rc.shape == (4, 4)
+    rrc = T.RandomResizedCrop(8)(np.zeros((32, 32, 3), np.float32))
+    assert rrc.shape == (8, 8, 3)
+
+
+def test_color_ops():
+    img = np.random.RandomState(1).rand(8, 8, 3).astype(np.float32)
+    b = T.adjust_brightness(img, 2.0)
+    np.testing.assert_allclose(b, img * 2, rtol=1e-6)
+    g = T.to_grayscale(img, 3)
+    assert g.shape == (8, 8, 3)
+    np.testing.assert_allclose(g[..., 0], g[..., 1])
+    # hue shift by 0 is identity
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-5)
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+    assert out.shape == img.shape
+    rot = T.rotate(np.eye(5, dtype=np.float32), 90)
+    np.testing.assert_allclose(rot, np.eye(5)[::-1].T, atol=1e-6)
+
+
+def test_compose_pipeline_on_dataset():
+    tf = T.Compose([T.Resize((16, 16)), T.RandomHorizontalFlip(1.0),
+                    T.Normalize(0.5, 0.5, data_format="HWC"),
+                    T.Transpose()])
+    ds = FakeData(size=4, image_shape=(24, 24, 3), transform=tf)
+    img, lbl = ds[0]
+    assert img.shape == (3, 16, 16)
+    assert 0 <= int(lbl) < 10
+
+
+def test_dataset_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy", np.zeros((4, 4), np.float32))
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    img, lbl = ds[5]
+    assert img.shape == (4, 4) and int(lbl) == 1
+
+
+# ---- models ---------------------------------------------------------------
+
+@pytest.mark.parametrize("name,ctor_kw,in_shape", [
+    ("LeNet", dict(num_classes=10), (2, 1, 28, 28)),
+    ("alexnet", dict(num_classes=7), (2, 3, 224, 224)),
+    ("vgg11", dict(num_classes=5), (1, 3, 64, 64)),
+    ("mobilenet_v1", dict(num_classes=6, scale=0.25), (2, 3, 64, 64)),
+    ("mobilenet_v2", dict(num_classes=6, scale=0.25), (2, 3, 64, 64)),
+    ("squeezenet1_1", dict(num_classes=4), (2, 3, 64, 64)),
+])
+def test_model_forward_shapes(name, ctor_kw, in_shape):
+    import paddle_tpu.vision as vision
+
+    model = getattr(vision, name)(**ctor_kw)
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(*in_shape).astype(np.float32))
+    out = model(x)
+    ncls = ctor_kw["num_classes"]
+    assert tuple(out.shape) == (in_shape[0], ncls)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_vision_model_trains():
+    from paddle_tpu.vision import LeNet
+
+    model = LeNet(num_classes=4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(16, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, 16).astype(np.int64))
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(10):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+# ---- ops ------------------------------------------------------------------
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    iou = vops.box_iou(a, a).numpy()
+    np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 1 / 7, rtol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([
+        [0, 0, 10, 10],      # best
+        [1, 1, 11, 11],      # big overlap with 0 -> suppressed
+        [20, 20, 30, 30],    # separate -> kept
+        [21, 21, 29, 29],    # overlaps 2 -> suppressed
+    ], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    keep = vops.nms(boxes, scores, iou_threshold=0.5).numpy()
+    kept = [i for i in keep if i >= 0]
+    assert kept == [0, 2]
+
+
+def test_roi_align_constant_feature():
+    feat = np.full((1, 2, 8, 8), 5.0, np.float32)
+    rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+    out = vops.roi_align(feat, rois, output_size=2).numpy()
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out, 5.0, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+    var = np.ones((2, 4), np.float32)
+    target = np.array([[1, 1, 9, 9], [6, 7, 18, 22]], np.float32)
+    enc = vops.box_coder(prior, var, target, "encode_center_size").numpy()
+    dec = vops.box_coder(prior, var, enc, "decode_center_size").numpy()
+    np.testing.assert_allclose(dec, target, rtol=1e-4, atol=1e-4)
