@@ -71,7 +71,7 @@ def synchronize():
 
 _LAZY_SUBMODULES = ("profiler", "metric", "vision", "hapi", "distribution",
                     "sparse", "quantization", "fft", "signal", "linalg",
-                    "text", "audio", "onnx", "static")
+                    "inference", "text", "audio", "onnx", "static")
 
 
 def __getattr__(name):

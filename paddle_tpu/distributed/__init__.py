@@ -49,3 +49,4 @@ from . import fleet  # noqa: F401
 from .fleet.recompute import (  # noqa: F401
     recompute, recompute_sequential, GradientMergeOptimizer,
 )
+from .ps import ShardedEmbedding, DistributedLookupTable  # noqa: F401

@@ -87,15 +87,17 @@ class Controller:
         else:
             self._store = TCPStore(host, port, world_size=args.nnodes)
         job = args.job_id
+        # claims are atomic: the first add() on a rank's claim key wins,
+        # so explicit and auto assignment cannot race into the same rank
         if args.rank >= 0:
-            self._store.set(f"/rdzv/{job}/taken/{args.rank}", b"1")
+            if self._store.add(f"/rdzv/{job}/claim/{args.rank}", 1) != 1:
+                raise SystemExit(
+                    f"node rank {args.rank} already claimed by another node")
             self.node_rank = args.rank
         else:
-            # counter assignment that skips explicitly claimed ranks
             while True:
                 n = self._store.add(f"/rdzv/{job}/next", 1) - 1
-                if self._store.get_nowait(f"/rdzv/{job}/taken/{n}") is None:
-                    self._store.set(f"/rdzv/{job}/taken/{n}", b"1")
+                if self._store.add(f"/rdzv/{job}/claim/{n}", 1) == 1:
                     self.node_rank = n
                     break
 

@@ -1,0 +1,103 @@
+"""paddle_tpu.fft (reference: python/paddle/fft.py — fft/ifft/rfft/
+irfft/hfft/ihfft + 2d/nd variants, fftfreq, fftshift). Dispatched through
+the eager tape so gradients flow (jnp.fft is differentiable)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    # reference accepts backward/ortho/forward; jnp uses the same names
+    if norm is None:
+        return "backward"
+    return norm
+
+
+def _mk1d(name, fn):
+    def impl(x, *, n, axis, norm):
+        return fn(x, n=n, axis=axis, norm=norm)
+
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(name, impl, [x],
+                     {"n": n, "axis": int(axis), "norm": _norm(norm)})
+
+    op.__name__ = name
+    return op
+
+
+fft = _mk1d("fft", jnp.fft.fft)
+ifft = _mk1d("ifft", jnp.fft.ifft)
+rfft = _mk1d("rfft", jnp.fft.rfft)
+irfft = _mk1d("irfft", jnp.fft.irfft)
+hfft = _mk1d("hfft", jnp.fft.hfft)
+ihfft = _mk1d("ihfft", jnp.fft.ihfft)
+
+
+def _mknd(name, fn, default_axes):
+    def impl(x, *, s, axes, norm):
+        return fn(x, s=s, axes=axes, norm=norm)
+
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
+        return apply(name, impl, [x],
+                     {"s": tuple(s) if s is not None else None,
+                      "axes": tuple(axes) if axes is not None else None,
+                      "norm": _norm(norm)})
+
+    op.__name__ = name
+    return op
+
+
+fft2 = _mknd("fft2", jnp.fft.fft2, (-2, -1))
+ifft2 = _mknd("ifft2", jnp.fft.ifft2, (-2, -1))
+rfft2 = _mknd("rfft2", jnp.fft.rfft2, (-2, -1))
+irfft2 = _mknd("irfft2", jnp.fft.irfft2, (-2, -1))
+fftn = _mknd("fftn", jnp.fft.fftn, None)
+ifftn = _mknd("ifftn", jnp.fft.ifftn, None)
+rfftn = _mknd("rfftn", jnp.fft.rfftn, None)
+irfftn = _mknd("irfftn", jnp.fft.irfftn, None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def _shift_impl(x, *, axes, inverse):
+    f = jnp.fft.ifftshift if inverse else jnp.fft.fftshift
+    return f(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", _shift_impl, [x],
+                 {"axes": tuple(axes) if axes is not None else None,
+                  "inverse": False})
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", _shift_impl, [x],
+                 {"axes": tuple(axes) if axes is not None else None,
+                  "inverse": True})

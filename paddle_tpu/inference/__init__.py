@@ -1,0 +1,107 @@
+"""paddle_tpu.inference — deployment predictor.
+
+Reference analog: paddle_inference_api (`AnalysisPredictor`
+fluid/inference/api/analysis_predictor.h:100 — Config + create_predictor +
+named input/output handles). TPU-native: the artifact is a serialized
+jax.export StableHLO module (written by paddle_tpu.jit.save); "analysis
+passes" are XLA's job at AOT-compile time, so the predictor is a thin
+executable wrapper with the reference's handle-style API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """Reference: paddle.inference.Config(prog_file, params_file) — here a
+    single artifact prefix (as written by paddle_tpu.jit.save)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # accept either the artifact prefix or the .pdmodel path
+        path = prog_file or ""
+        for suffix in (".pdmodel.json", ".pdmodel", ".stablehlo.mlir",
+                       ".pdiparams"):
+            if path.endswith(suffix):
+                path = path[: -len(suffix)]
+                break
+        self.model_prefix = path
+        self._device = "auto"
+        self.memory_pool_init_size_mb = 0
+
+    # device selection parity (XLA owns placement; kept as hints)
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._device = "device"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "device"
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self, flag=True):
+        pass
+
+
+class _Handle:
+    """Input/output tensor handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self):
+        self._arr = None
+
+    def copy_from_cpu(self, arr):
+        self._arr = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return self._arr
+
+    def reshape(self, shape):
+        pass  # shapes are fixed by the exported program
+
+    @property
+    def shape(self):
+        return list(self._arr.shape) if self._arr is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.save_load import load
+
+        self._layer = load(config.model_prefix)
+        n_in = len(self._layer.input_spec)
+        self._inputs = {f"input_{i}": _Handle() for i in range(n_in)}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Either handle-style (copy_from_cpu then run()) or direct
+        run([arrays]) -> list of numpy outputs."""
+        if inputs is None:
+            inputs = [self._inputs[n].copy_to_cpu()
+                      for n in self.get_input_names()]
+        outs = self._layer(*inputs)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        res = [np.asarray(o.numpy()) for o in outs]
+        self._outputs = {f"output_{i}": h for i, h in enumerate(res)}
+        return res
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        h = _Handle()
+        h.copy_from_cpu(self._outputs[name])
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -63,12 +63,19 @@ def save(layer, path, input_spec=None, **configs):
             for h, v in zip(holders, saved):
                 h._value = v
 
-    lowered = jax.jit(pure).lower([h._value for h in holders], *examples)
-    stablehlo = lowered.as_text(dialect="stablehlo")
+    # one trace: the jax.export module is both the runnable .pdmodel blob
+    # and the source of the inspectable StableHLO text
+    exported = jax.export.export(jax.jit(pure))(
+        [jax.ShapeDtypeStruct(h.shape, h._value.dtype) for h in holders],
+        *[jax.ShapeDtypeStruct(e.shape, e.dtype) for e in examples])
+    blob = exported.serialize()
+    stablehlo = exported.mlir_module()
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".stablehlo.mlir", "w") as f:
         f.write(stablehlo)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({n: np.asarray(h._value) for n, h in zip(names, holders)},
                     f, protocol=4)
@@ -84,18 +91,44 @@ def save(layer, path, input_spec=None, **configs):
 
 
 class TranslatedLayer:
-    """Loaded inference program (reference: TranslatedLayer). Runs the saved
-    computation by re-tracing is impossible (no Python body), so we hold the
-    params and expose __call__ over a jit-compiled StableHLO round-trip when
-    available; currently params-only load + user re-binding."""
+    """Loaded inference program (reference: TranslatedLayer, jit/
+    translated_layer.py). Executes the deserialized jax.export module —
+    no Python body needed; the program IS the artifact."""
 
-    def __init__(self, params, meta, stablehlo_text):
+    def __init__(self, params, meta, stablehlo_text, exported=None):
+        self._param_names = list(params)
         self._params = {k: Tensor(jnp.asarray(v)) for k, v in params.items()}
         self._meta = meta
         self._stablehlo = stablehlo_text
+        self._exported = exported
+        self._call = jax.jit(exported.call) if exported is not None else None
+
+    def __call__(self, *inputs):
+        if self._call is None:
+            raise RuntimeError("artifact has no executable module "
+                               "(.pdmodel missing)")
+        vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        holder_vals = [self._params[n]._value for n in self._param_names]
+        out = self._call(holder_vals, *vals)
+        if isinstance(out, (list, tuple)):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    forward = __call__
 
     def state_dict(self):
         return dict(self._params)
+
+    def set_state_dict(self, state):
+        for k, v in state.items():
+            if k in self._params:
+                self._params[k] = v if isinstance(v, Tensor) else \
+                    Tensor(jnp.asarray(np.asarray(v)))
+
+    @property
+    def input_spec(self):
+        return self._meta["inputs"]
 
     @property
     def program_text(self):
@@ -109,4 +142,9 @@ def load(path, **configs):
         meta = json.load(f)
     with open(path + ".stablehlo.mlir") as f:
         text = f.read()
-    return TranslatedLayer(params, meta, text)
+    exported = None
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jax.export.deserialize(bytearray(f.read()))
+    ordered = {n: params[n] for n in meta.get("param_names", params)}
+    return TranslatedLayer(ordered, meta, text, exported)

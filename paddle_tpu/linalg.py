@@ -1,0 +1,10 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports
+the tensor.linalg surface)."""
+from .ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, dot, mv, dist, norm, cross, cholesky, cholesky_solve,
+    inverse, pinv, solve, triangular_solve, lu, qr, svd, eig, eigh,
+    eigvalsh, eigvals, matrix_power, matrix_rank, det, slogdet, lstsq,
+    multi_dot, corrcoef, cov, householder_product, matrix_exp,
+)
+
+inv = inverse  # reference alias

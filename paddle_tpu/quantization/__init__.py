@@ -134,12 +134,23 @@ class _QuantedBase(nn.Layer):
         self.w_observer = cfg.weight_factory(cfg.weight_bits)
         self.a_observer = cfg.activation_factory(cfg.activation_bits)
 
+    @staticmethod
+    def _concrete(t):
+        import jax
+
+        v = t._value if isinstance(t, Tensor) else t
+        return not isinstance(v, jax.core.Tracer)
+
     def forward(self, x):
-        self.a_observer.observe(x)
+        # observers pull values to host — skip under tracing (jit.save /
+        # user-jitted steps run with the last calibrated scales frozen)
+        if self._concrete(x):
+            self.a_observer.observe(x)
         a_scale = Tensor(np.float32(self.a_observer.scale()))
         xq = fake_quant(x, a_scale, self.cfg.activation_bits)
         w = self.inner.weight
-        self.w_observer.observe(w)
+        if self._concrete(w):
+            self.w_observer.observe(w)
         w_scale = Tensor(np.float32(self.w_observer.scale()))
         wq = fake_quant(w, w_scale, self.cfg.weight_bits)
         return self._call_inner(xq, wq)

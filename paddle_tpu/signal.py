@@ -1,0 +1,99 @@
+"""paddle_tpu.signal (reference: python/paddle/signal.py — stft/istft
+built on frame + fft)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply
+
+__all__ = ["stft", "istft"]
+
+
+def _frames(x, frame_length, hop_length):
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num)[:, None] * hop_length +
+           jnp.arange(frame_length)[None, :])
+    return x[..., idx]  # [..., num_frames, frame_length]
+
+
+def _stft_impl(x, window, *, n_fft, hop_length, center, pad_mode, onesided,
+               norm):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    fr = _frames(x, n_fft, hop_length) * window
+    f = jnp.fft.rfft(fr, axis=-1, norm=norm) if onesided else \
+        jnp.fft.fft(fr, axis=-1, norm=norm)
+    # reference layout: [..., n_fft//2+1, num_frames]
+    return jnp.swapaxes(f, -1, -2)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Reference: paddle.signal.stft (signal.py). x: [..., T] real (or
+    complex with onesided=False)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._value if hasattr(window, "_value") else \
+            jnp.asarray(np.asarray(window))
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    return apply("stft", _stft_impl, [x, win],
+                 {"n_fft": int(n_fft), "hop_length": int(hop_length),
+                  "center": bool(center), "pad_mode": pad_mode,
+                  "onesided": bool(onesided),
+                  "norm": "ortho" if normalized else "backward"})
+
+
+def _istft_impl(spec, window, *, n_fft, hop_length, center, length,
+                onesided, norm):
+    f = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+    if onesided:
+        fr = jnp.fft.irfft(f, n=n_fft, axis=-1, norm=norm)
+    else:
+        fr = jnp.fft.ifft(f, axis=-1, norm=norm).real
+    fr = fr * window
+    num = fr.shape[-2]
+    out_len = n_fft + hop_length * (num - 1)
+    batch = fr.shape[:-2]
+    out = jnp.zeros(batch + (out_len,), fr.dtype)
+    wsum = jnp.zeros((out_len,), fr.dtype)
+    for i in range(num):  # static frame count: unrolled overlap-add
+        sl = (Ellipsis, slice(i * hop_length, i * hop_length + n_fft))
+        out = out.at[sl].add(fr[..., i, :])
+        wsum = wsum.at[i * hop_length:i * hop_length + n_fft].add(
+            window ** 2)
+    out = out / jnp.maximum(wsum, 1e-10)
+    if center:
+        out = out[..., n_fft // 2:out_len - n_fft // 2]
+    if length is not None:
+        out = out[..., :length]
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._value if hasattr(window, "_value") else \
+            jnp.asarray(np.asarray(window))
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    return apply("istft", _istft_impl, [x, win],
+                 {"n_fft": int(n_fft), "hop_length": int(hop_length),
+                  "center": bool(center),
+                  "length": int(length) if length is not None else None,
+                  "onesided": bool(onesided),
+                  "norm": "ortho" if normalized else "backward"})

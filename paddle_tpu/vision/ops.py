@@ -71,16 +71,23 @@ def nms(boxes, scores=None, iou_threshold=0.3, top_k=None):
 
 
 def _roi_align_impl(feat, rois, roi_batch_idx, *, output_size,
-                    spatial_scale, sampling_ratio):
+                    spatial_scale, sampling_ratio, aligned):
     """feat [N,C,H,W], rois [R,4] xyxy in input coords -> [R,C,oh,ow]."""
     oh, ow = output_size
-    sr = max(1, int(sampling_ratio))
+    # adaptive sampling (reference sampling_ratio=-1) is data-dependent —
+    # impossible under static XLA shapes; use a fixed 2x2 grid instead
+    sr = int(sampling_ratio) if sampling_ratio > 0 else 2
 
     def one(roi, bi):
         f = feat[bi]  # [C,H,W]
-        x0, y0, x1, y1 = roi * spatial_scale
-        rw = jnp.maximum(x1 - x0, 1.0)
-        rh = jnp.maximum(y1 - y0, 1.0)
+        offset = 0.5 if aligned else 0.0
+        x0, y0, x1, y1 = roi * spatial_scale - offset
+        if aligned:
+            rw = x1 - x0
+            rh = y1 - y0
+        else:
+            rw = jnp.maximum(x1 - x0, 1.0)
+            rh = jnp.maximum(y1 - y0, 1.0)
         bh, bw = rh / oh, rw / ow
         # sr x sr sample grid per bin, bilinear, averaged
         iy = (jnp.arange(oh)[:, None] * bh + y0 +
@@ -116,7 +123,7 @@ def _roi_align_impl(feat, rois, roi_batch_idx, *, output_size,
 
 
 def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
-              sampling_ratio=2, aligned=False):
+              sampling_ratio=-1, aligned=True):
     """RoIAlign (reference vision/ops.py roi_align). boxes [R,4];
     boxes_num [N] rois per image (defaults to all on image 0)."""
     if isinstance(output_size, int):
@@ -133,7 +140,8 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
     return apply("roi_align", _roi_align_impl, [x, boxes, batch_idx],
                  {"output_size": tuple(output_size),
                   "spatial_scale": float(spatial_scale),
-                  "sampling_ratio": int(sampling_ratio)})
+                  "sampling_ratio": int(sampling_ratio),
+                  "aligned": bool(aligned)})
 
 
 def _box_coder_impl(prior, prior_var, target, *, code_type, box_normalized):

@@ -19,8 +19,8 @@ __all__ = [
     "BrightnessTransform", "ContrastTransform", "SaturationTransform",
     "HueTransform", "ColorJitter", "RandomRotation", "to_tensor",
     "normalize", "resize", "center_crop", "crop", "hflip", "vflip", "pad",
-    "to_grayscale", "adjust_brightness", "adjust_contrast", "adjust_hue",
-    "rotate",
+    "to_grayscale", "adjust_brightness", "adjust_contrast",
+    "adjust_saturation", "adjust_hue", "rotate",
 ]
 
 

@@ -1,0 +1,96 @@
+"""fft/signal/linalg-namespace tests (reference: test/legacy_test
+test_fft.py, test_stft_op.py, test_signal.py) vs numpy references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+def test_fft_roundtrip_and_numpy_parity():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32)
+    got = fft.fft(x).numpy()
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    back = fft.ifft(got).numpy()
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_irfft_and_freqs():
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 32).astype(np.float32)
+    R = fft.rfft(x).numpy()
+    np.testing.assert_allclose(R, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    back = fft.irfft(paddle.to_tensor(R), n=32).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(fft.rfftfreq(8).numpy(), np.fft.rfftfreq(8),
+                               rtol=1e-6)
+
+
+def test_fft2_fftn_shift():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(fft.fft2(x).numpy(), np.fft.fft2(x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.fftn(x).numpy(), np.fft.fftn(x),
+                               rtol=1e-3, atol=1e-3)
+    s = fft.fftshift(x).numpy()
+    np.testing.assert_allclose(s, np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(fft.ifftshift(paddle.to_tensor(s)).numpy(),
+                               x, rtol=1e-6)
+
+
+def test_fft_norm_modes():
+    x = np.ones((8,), np.float32)
+    o = fft.fft(x, norm="ortho").numpy()
+    np.testing.assert_allclose(o, np.fft.fft(x, norm="ortho"), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fft_gradient_flows():
+    x = paddle.to_tensor(np.random.RandomState(3).randn(16).astype(np.float32))
+    x.stop_gradient = False
+    # Parseval: d/dx sum|fft(x)|^2 = 2*N*x
+    y = fft.fft(x).abs().square().sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * 16 * x.numpy(), rtol=1e-3)
+
+
+def _hann(n):
+    return 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / n)
+
+
+def test_stft_matches_manual():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 256).astype(np.float32)
+    win = _hann(64).astype(np.float32)
+    S = signal.stft(x, n_fft=64, hop_length=16,
+                    window=paddle.to_tensor(win), center=False).numpy()
+    assert S.shape == (2, 33, 13)  # freq bins, frames
+    # manual frame 0
+    want0 = np.fft.rfft(x[0, :64] * win)
+    np.testing.assert_allclose(S[0, :, 0], want0, rtol=1e-3, atol=1e-3)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(5)
+    x = rng.randn(512).astype(np.float32)
+    win = paddle.to_tensor(_hann(128).astype(np.float32))
+    S = signal.stft(x, n_fft=128, hop_length=32, window=win)
+    back = signal.istft(S, n_fft=128, hop_length=32, window=win,
+                        length=512).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_namespace():
+    import paddle_tpu.linalg as L
+
+    a = np.random.RandomState(6).rand(4, 4).astype(np.float32) + np.eye(
+        4, dtype=np.float32) * 4
+    inv = L.inv(a).numpy()
+    np.testing.assert_allclose(inv @ a, np.eye(4), atol=1e-4)
+    sign, logdet = L.slogdet(a)
+    np.testing.assert_allclose(float(sign) * np.exp(float(logdet)),
+                               np.linalg.det(a), rtol=1e-4)

@@ -153,3 +153,19 @@ def test_ptq_observes_and_bounds_error():
     assert rel < 0.1, rel
     ptq.convert(qmodel)
     assert qmodel[0].inner.weight_int8.numpy().dtype == np.int8
+
+
+def test_qat_model_is_jit_exportable(tmp_path):
+    """QAT models must trace (regression: observer numpy() on tracers)."""
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    qat = Q.QAT()
+    model = qat.quantize(model)
+    x = np.ones((2, 4), np.float32)
+    model(paddle.to_tensor(x))  # calibrate once eagerly
+    model.eval()
+    path = str(tmp_path / "qat_infer")
+    paddle.jit.save(model, path, input_spec=[paddle.to_tensor(x)])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                               model(paddle.to_tensor(x)).numpy(), rtol=1e-5)
