@@ -97,3 +97,6 @@ def __getattr__(name):
         globals()["summary"] = summary
         return summary
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+from .flags import set_flags, get_flags  # noqa: E402,F401

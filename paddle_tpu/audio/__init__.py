@@ -1,0 +1,4 @@
+"""paddle_tpu.audio (reference: python/paddle/audio/ — functional mel/
+spectrogram features + feature layers)."""
+from . import functional  # noqa: F401
+from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC  # noqa: F401

@@ -1,0 +1,69 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py
+— Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+from .. import signal as _signal
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        return spec.abs() ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                             htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [..., freq, frames]
+        return self.fbank @ spec
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_mels=64, **mel_kwargs):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **mel_kwargs)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        m = self.logmel(x)  # [..., n_mels, frames]
+        return self.dct.t() @ m

@@ -251,6 +251,25 @@ def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
     out_is_seq = isinstance(out, (tuple, list))
     outs = list(out) if out_is_seq else [out]
 
+    # numerical sanitizer (reference: FLAGS_check_nan_inf ->
+    # eager/nan_inf_utils.cc per-op scan); debugging mode — forces a sync
+    from .. import flags as _flags
+
+    if _flags.flag("check_nan_inf"):
+        for i, o in enumerate(outs):
+            if isinstance(o, jax.core.Tracer):
+                continue  # traced value: nothing concrete to scan
+            if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact) \
+                    and not bool(jnp.isfinite(o).all()):
+                msg = (f"NaN/Inf detected in output {i} of op '{name}' "
+                       f"(shape {getattr(o, 'shape', ())})")
+                if _flags.flag("check_nan_inf_level") >= 1:
+                    import warnings
+
+                    warnings.warn(msg)
+                else:
+                    raise RuntimeError(msg)
+
     node = None
     if any_grad:
         node = GradNode(name, impl, statics, statics_key, arrays, metas, len(outs), out_is_seq)

@@ -88,11 +88,16 @@ class Controller:
             self._store = TCPStore(host, port, world_size=args.nnodes)
         job = args.job_id
         # claims are atomic: the first add() on a rank's claim key wins,
-        # so explicit and auto assignment cannot race into the same rank
+        # so explicit and auto assignment cannot race into the same rank.
+        # A restarted node may RE-claim its explicit rank when the previous
+        # holder's controller heartbeat has gone stale (elastic rejoin).
         if args.rank >= 0:
             if self._store.add(f"/rdzv/{job}/claim/{args.rank}", 1) != 1:
-                raise SystemExit(
-                    f"node rank {args.rank} already claimed by another node")
+                age = self._store.heartbeat_age(f"ctl/{job}/{args.rank}")
+                if age is not None and age < 10.0:
+                    raise SystemExit(
+                        f"node rank {args.rank} already claimed by a live "
+                        "node")
             self.node_rank = args.rank
         else:
             while True:
@@ -100,6 +105,9 @@ class Controller:
                 if self._store.add(f"/rdzv/{job}/claim/{n}", 1) == 1:
                     self.node_rank = n
                     break
+        # liveness lease backing the re-claim rule above
+        self._store.start_heartbeat(f"ctl/{job}/{self.node_rank}",
+                                    interval=1.0)
 
     # -- spawn -------------------------------------------------------------
     def _env_for(self, local_rank, restart_epoch=0):

@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate/ — experimental
+APIs; autograd functional here, MoE lives in distributed.moe)."""
+from . import autograd  # noqa: F401

@@ -1,0 +1,93 @@
+"""Functional higher-order autograd (reference:
+python/paddle/incubate/autograd/functional.py — jacobian, hessian, jvp,
+vjp; the primapi higher-order path). TPU-native: these are direct jax
+transforms over functionalized Tensor computations, so nested/forward-mode
+AD comes from the compiler rather than double-grad graph surgery."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "forward_grad"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def _wrap_fn(func):
+    """Tensor-level callable -> array-level pure callable."""
+
+    def pure(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return pure
+
+
+def _wrap_out(v):
+    return jax.tree.map(Tensor, v)
+
+
+def jacobian(func, xs, create_graph=False):
+    """d func / d xs (reference functional.py jacobian). xs: Tensor or
+    list of Tensors; returns Tensor or (nested) tuple."""
+    single = not isinstance(xs, (list, tuple))
+    arrays = [_unwrap(x) for x in (xs if not single else [xs])]
+    jac = jax.jacobian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if single:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+    return _wrap_out(jac)
+
+
+def hessian(func, xs, create_graph=False):
+    """d^2 func / d xs^2 for scalar-output func."""
+    single = not isinstance(xs, (list, tuple))
+    arrays = [_unwrap(x) for x in (xs if not single else [xs])]
+    hes = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if single:
+        hes = hes[0][0] if isinstance(hes, tuple) else hes
+    return _wrap_out(hes)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: (outputs, J @ v) (reference functional.py jvp)."""
+    single = not isinstance(xs, (list, tuple))
+    arrays = tuple(_unwrap(x) for x in (xs if not single else [xs]))
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(_unwrap(t) for t in vs)
+    out, tan = jax.jvp(_wrap_fn(func), arrays, tangents)
+    return _wrap_out(out), _wrap_out(tan)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (outputs, v @ J) (reference functional.py vjp)."""
+    single = not isinstance(xs, (list, tuple))
+    arrays = tuple(_unwrap(x) for x in (xs if not single else [xs]))
+    out, pull = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        ct = jax.tree.map(jnp.ones_like, out)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        ct = tuple(_unwrap(t) for t in vs)
+        if not isinstance(out, tuple):
+            ct = ct[0]
+    grads = pull(ct)
+    if single:
+        grads = grads[0]
+    return _wrap_out(out), _wrap_out(grads)
+
+
+forward_grad = jvp  # reference incubate name

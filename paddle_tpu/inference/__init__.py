@@ -74,7 +74,9 @@ class Predictor:
         self._layer = load(config.model_prefix)
         n_in = len(self._layer.input_spec)
         self._inputs = {f"input_{i}": _Handle() for i in range(n_in)}
-        self._outputs = {}
+        # output arity is known from the exported module before any run
+        n_out = self._layer.num_outputs or 1
+        self._outputs = {f"output_{i}": None for i in range(n_out)}
 
     def get_input_names(self):
         return list(self._inputs)
@@ -91,7 +93,8 @@ class Predictor:
         outs = self._layer(*inputs)
         outs = outs if isinstance(outs, tuple) else (outs,)
         res = [np.asarray(o.numpy()) for o in outs]
-        self._outputs = {f"output_{i}": h for i, h in enumerate(res)}
+        for i, h in enumerate(res):
+            self._outputs[f"output_{i}"] = h
         return res
 
     def get_output_names(self):

@@ -131,6 +131,12 @@ class TranslatedLayer:
         return self._meta["inputs"]
 
     @property
+    def num_outputs(self):
+        if self._exported is None:
+            return None
+        return len(self._exported.out_avals)
+
+    @property
     def program_text(self):
         return self._stablehlo
 

@@ -19,20 +19,27 @@ _lock = threading.Lock()
 _libs = {}
 
 
-def build_and_load(name: str, extra_flags=()) -> ctypes.CDLL:
-    """Compile native/<name>.cc to a cached .so and dlopen it."""
+def build_sources(name: str, sources, extra_flags=(),
+                  build_dir=None) -> ctypes.CDLL:
+    """Compile arbitrary C++ sources to a cached .so and dlopen it
+    (shared by the built-in components and user cpp_extension ops)."""
     with _lock:
-        if name in _libs:
-            return _libs[name]
-        src = os.path.join(_DIR, name + ".cc")
-        with open(src, "rb") as f:
-            tag = hashlib.sha256(f.read()).hexdigest()[:16]
-        os.makedirs(_BUILD, exist_ok=True)
-        so = os.path.join(_BUILD, f"lib{name}-{tag}.so")
+        h = hashlib.sha256()
+        for src in sources:
+            with open(src, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(extra_flags).encode())
+        tag = h.hexdigest()[:16]
+        key = (name, tag, build_dir)
+        if key in _libs:
+            return _libs[key]
+        out_dir = build_dir or _BUILD
+        os.makedirs(out_dir, exist_ok=True)
+        so = os.path.join(out_dir, f"lib{name}-{tag}.so")
         if not os.path.exists(so):
             tmp = so + f".tmp{os.getpid()}"
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", "-o", tmp, src, *extra_flags]
+                   "-pthread", "-o", tmp, *sources, *extra_flags]
             try:
                 subprocess.run(cmd, check=True, capture_output=True,
                                text=True)
@@ -41,5 +48,11 @@ def build_and_load(name: str, extra_flags=()) -> ctypes.CDLL:
                     f"native build of {name} failed:\n{e.stderr}") from e
             os.replace(tmp, so)  # atomic vs concurrent builders
         lib = ctypes.CDLL(so)
-        _libs[name] = lib
+        _libs[key] = lib
         return lib
+
+
+def build_and_load(name: str, extra_flags=()) -> ctypes.CDLL:
+    """Compile native/<name>.cc to a cached .so and dlopen it."""
+    return build_sources(name, [os.path.join(_DIR, name + ".cc")],
+                         extra_flags)

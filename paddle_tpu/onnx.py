@@ -1,0 +1,29 @@
+"""paddle.onnx surface (reference: python/paddle/onnx/export.py wraps the
+external paddle2onnx converter).
+
+Zero-egress TPU build: paddle2onnx/onnx are not vendored, and the
+XLA-native deployment format is the jax.export StableHLO artifact
+(paddle_tpu.jit.save -> paddle_tpu.inference.Predictor). `export` writes
+that artifact; requesting a real .onnx protobuf raises with guidance.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """Export for deployment. Writes the StableHLO inference artifact at
+    `path` (reference semantics: paddle.onnx.export writes path.onnx)."""
+    if str(path).endswith(".onnx"):
+        raise NotImplementedError(
+            "ONNX protobuf emission requires the external paddle2onnx "
+            "toolchain, which is not available in this environment. Use "
+            "paddle_tpu.jit.save / paddle_tpu.onnx.export without the "
+            ".onnx suffix to produce the StableHLO deployment artifact "
+            "(loadable via paddle_tpu.inference.create_predictor).")
+    from .jit.save_load import save
+
+    save(layer, os.fspath(path), input_spec=input_spec)
+    return path

@@ -1,6 +1,6 @@
 """paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
 from .optimizer import (
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta,
-    Adamax, Lamb,
+    Adamax, Lamb, Rprop, ASGD, LBFGS,
 )
 from . import lr

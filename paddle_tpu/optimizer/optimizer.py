@@ -13,6 +13,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..core.dispatch import no_grad
@@ -350,3 +351,169 @@ class Lamb(Optimizer):
         return new_p.astype(param.dtype), {
             "moment1": m.astype(state["moment1"].dtype),
             "moment2": v.astype(state["moment2"].dtype)}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: optimizer/rprop.py) — per-element
+    step sizes grown/shrunk by gradient sign agreement."""
+
+    _state_names = ("prev_grad", "step_size")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_minus, self.eta_plus = etas
+
+    def _ensure_state(self, params):
+        for p in params:
+            if id(p) not in self._accumulators:
+                self._accumulators[id(p)] = {
+                    "prev_grad": jnp.zeros_like(p._value),
+                    "step_size": jnp.full_like(p._value, self.get_lr()),
+                }
+
+    def _update_one(self, p, g, s, lr, step):
+        sign = jnp.sign(g * s["prev_grad"])
+        size = jnp.clip(
+            jnp.where(sign > 0, s["step_size"] * self.eta_plus,
+                      jnp.where(sign < 0, s["step_size"] * self.eta_minus,
+                                s["step_size"])),
+            self.lr_min, self.lr_max)
+        g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+        new_p = p - jnp.sign(g_eff) * size
+        return new_p, {"prev_grad": g_eff, "step_size": size}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: optimizer/asgd.py simplified — SGD step +
+    running average of iterates available as the 'averaged' slot)."""
+
+    _state_names = ("avg",)
+
+    def _update_one(self, p, g, s, lr, step):
+        wd = self._weight_decay
+        if wd:
+            g = g + wd * p
+        new_p = p - lr * g
+        t = jnp.maximum(step.astype(new_p.dtype), 1.0)
+        avg = s["avg"] + (new_p - s["avg"]) / t
+        return new_p, {"avg": avg}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure (reference: optimizer/lbfgs.py —
+    step(closure) re-evaluates the loss; two-loop recursion over a
+    history of (s, y) pairs; optional backtracking line search)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.max_iter = int(max_iter)
+        self.tolerance_grad = float(tolerance_grad)
+        self.tolerance_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        self.line_search_fn = line_search_fn
+        self._s_hist = []
+        self._y_hist = []
+        self._prev_flat_grad = None
+        self._prev_loss = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def _unflat(self, flat):
+        out, off = [], 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            out.append(flat[off:off + n].reshape(p._value.shape))
+            off += n
+        return out
+
+    def _gather_grad(self):
+        return self._flat([
+            (p.grad._value if p.grad is not None
+             else jnp.zeros_like(p._value)).astype(jnp.float32)
+            for p in self._parameter_list])
+
+    def _direction(self, flat_grad):
+        # two-loop recursion
+        q = -flat_grad
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y_hist:
+            y, s = self._y_hist[-1], self._s_hist[-1]
+            q = q * (jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return q
+
+    def step(self, closure):
+        """closure(): zero grads, compute loss, backward, return loss."""
+        loss = closure()
+        cur = float(loss)
+        flat_grad = self._gather_grad()
+        for _ in range(self.max_iter):
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            d = self._direction(flat_grad)
+            lr = self.get_lr()
+            x0 = self._flat([p._value.astype(jnp.float32)
+                             for p in self._parameter_list])
+            if self.line_search_fn in ("strong_wolfe", "backtracking"):
+                lr = self._backtrack(
+                    closure, x0, d, cur, flat_grad, lr,
+                    curvature=self.line_search_fn == "strong_wolfe")
+            self._assign(x0 + lr * d)
+            new_loss = closure()
+            new_flat = self._gather_grad()
+            s = lr * d
+            y = new_flat - flat_grad
+            if float(jnp.vdot(y, s)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if abs(float(new_loss) - cur) < self.tolerance_change:
+                cur = float(new_loss)
+                flat_grad = new_flat
+                break
+            cur = float(new_loss)
+            flat_grad = new_flat
+        self._step_count += 1
+        return cur
+
+    def _backtrack(self, closure, x0, d, f0, g0, lr, c1=1e-4, c2=0.9,
+                   shrink=0.5, max_ls=10, curvature=False):
+        """Armijo backtracking; with curvature=True also enforces the
+        (strong) Wolfe curvature condition |g1.d| <= c2 |g0.d| so accepted
+        steps give y.s > 0 and the history stays well-conditioned."""
+        gd = float(jnp.vdot(g0, d))
+        for _ in range(max_ls):
+            self._assign(x0 + lr * d)
+            f = float(closure())
+            if f <= f0 + c1 * lr * gd:
+                if not curvature:
+                    return lr
+                g1d = float(jnp.vdot(self._gather_grad(), d))
+                if abs(g1d) <= c2 * abs(gd):
+                    return lr
+                if g1d < 0:  # still descending: step further
+                    lr /= shrink
+                    continue
+            lr *= shrink
+        return lr
+
+    def _assign(self, flat):
+        for p, v in zip(self._parameter_list, self._unflat(flat)):
+            p._value = v.astype(p._value.dtype)

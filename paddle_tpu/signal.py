@@ -53,12 +53,14 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
 
 
 def _istft_impl(spec, window, *, n_fft, hop_length, center, length,
-                onesided, norm):
+                onesided, norm, return_complex):
     f = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
     if onesided:
         fr = jnp.fft.irfft(f, n=n_fft, axis=-1, norm=norm)
     else:
-        fr = jnp.fft.ifft(f, axis=-1, norm=norm).real
+        fr = jnp.fft.ifft(f, axis=-1, norm=norm)
+        if not return_complex:
+            fr = fr.real
     fr = fr * window
     num = fr.shape[-2]
     out_len = n_fft + hop_length * (num - 1)
@@ -81,6 +83,9 @@ def _istft_impl(spec, window, *, n_fft, hop_length, center, length,
 def istft(x, n_fft, hop_length=None, win_length=None, window=None,
           center=True, normalized=False, onesided=True, length=None,
           return_complex=False, name=None):
+    if return_complex and onesided:
+        raise ValueError("return_complex requires onesided=False (a "
+                         "onesided spectrum implies a real signal)")
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
     if window is None:
@@ -96,4 +101,5 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
                   "center": bool(center),
                   "length": int(length) if length is not None else None,
                   "onesided": bool(onesided),
-                  "norm": "ortho" if normalized else "backward"})
+                  "norm": "ortho" if normalized else "backward",
+                  "return_complex": bool(return_complex)})

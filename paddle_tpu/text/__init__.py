@@ -1,0 +1,3 @@
+"""paddle_tpu.text (reference: python/paddle/text/ — viterbi_decode +
+dataset loaders; datasets need local files in this zero-egress build)."""
+from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401

@@ -1,0 +1,81 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py —
+ViterbiDecoder over CRF emission/transition potentials). TPU-native: the
+DP recursion is a lax.scan (static length), argmax backtrace a reverse
+scan — one compiled program, no host loop."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_impl(potentials, trans, lengths, *, include_bos_eos_tag):
+    """potentials [B, T, N], trans [N, N] (+2 rows/cols when tags), lengths
+    [B] -> (scores [B], paths [B, T])."""
+    b, t, n = potentials.shape
+    if include_bos_eos_tag:
+        bos, eos = n, n + 1
+        start = trans[bos, :n]
+        stop = trans[:n, eos]
+        tr = trans[:n, :n]
+    else:
+        start = jnp.zeros((n,), potentials.dtype)
+        stop = jnp.zeros((n,), potentials.dtype)
+        tr = trans
+
+    alpha0 = potentials[:, 0] + start  # [B, N]
+
+    def step(carry, xs):
+        alpha, i = carry
+        emit = xs  # [B, N]
+        # scores[b, prev, cur] = alpha[b, prev] + tr[prev, cur]
+        scores = alpha[:, :, None] + tr[None]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new_alpha = jnp.max(scores, axis=1) + emit
+        # sequences shorter than i keep their old alpha (masked update)
+        live = (i < lengths)[:, None]
+        alpha = jnp.where(live, new_alpha, alpha)
+        return (alpha, i + 1), best_prev
+
+    (alpha, _), back = jax.lax.scan(
+        step, (alpha0, jnp.ones((), jnp.int32)),
+        jnp.swapaxes(potentials[:, 1:], 0, 1))
+    final = alpha + stop
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)  # [B]
+
+    # backtrace from each sequence's last step down to 0
+    def bt(carry, xs):
+        tag, i = carry
+        bp = xs  # [B, N] backpointers of step i+1
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only follow pointers while inside the sequence
+        inside = (i + 1) < lengths
+        tag_out = jnp.where(inside, prev, tag)
+        return (tag_out, i - 1), tag_out
+
+    # back[i] holds pointers for transition i->i+1, i in [0, T-2]
+    (first_tag, _), rev = jax.lax.scan(
+        bt, (last_tag, jnp.asarray(t - 2, jnp.int32)), back[::-1])
+    paths = jnp.concatenate([rev[::-1], last_tag[None]], 0)  # [T, B]
+    return scores, jnp.swapaxes(paths, 0, 1).astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    return apply("viterbi_decode", _viterbi_impl,
+                 [potentials, transition_params, lengths],
+                 {"include_bos_eos_tag": bool(include_bos_eos_tag)})
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,12 @@
+"""paddle_tpu.utils (reference: python/paddle/utils/)."""
+from . import cpp_extension  # noqa: F401
+from .custom_op import register_op  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

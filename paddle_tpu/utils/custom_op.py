@@ -1,0 +1,56 @@
+"""Python-level custom op registration.
+
+Reference analog: the python custom-op surface over PD_BUILD_OP
+(fluid/framework/custom_operator.cc) — user ops with optional custom
+gradients that behave like built-ins. TPU-native: the impl is any pure
+jnp/pallas function; a custom VJP makes it differentiate on the eager
+tape and under jit exactly like generated ops.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import apply
+
+__all__ = ["register_op"]
+
+_REGISTRY = {}
+
+
+def register_op(name, forward, backward=None, namespace=None):
+    """Register `forward(*arrays, **statics)` as op `name`; returns the
+    python wrapper (also attached to `namespace` if given).
+
+    backward, if given: (saved_inputs_tuple, cotangent) -> tuple of input
+    cotangents. Without it, jax AD differentiates the forward directly.
+    """
+    if backward is not None:
+        from functools import partial
+
+        # custom_vjp can't bind kwargs: statics travel as a hashable
+        # nondiff positional tuple
+        @partial(jax.custom_vjp, nondiff_argnums=(0,))
+        def cv(static_items, *args):
+            return forward(*args, **dict(static_items))
+
+        def fwd(static_items, *args):
+            return cv(static_items, *args), args
+
+        def bwd(static_items, saved, ct):
+            return tuple(backward(saved, ct))
+
+        cv.defvjp(fwd, bwd)
+
+        def impl(*args, **statics):
+            return cv(tuple(sorted(statics.items())), *args)
+    else:
+        impl = forward
+
+    def op(*tensors, **statics):
+        return apply(name, impl, tensors, statics or None)
+
+    op.__name__ = name
+    _REGISTRY[name] = op
+    if namespace is not None:
+        setattr(namespace, name, op)
+    return op

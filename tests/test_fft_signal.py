@@ -94,3 +94,17 @@ def test_linalg_namespace():
     sign, logdet = L.slogdet(a)
     np.testing.assert_allclose(float(sign) * np.exp(float(logdet)),
                                np.linalg.det(a), rtol=1e-4)
+
+
+def test_istft_return_complex_roundtrip():
+    rng = np.random.RandomState(7)
+    x = (rng.randn(256) + 1j * rng.randn(256)).astype(np.complex64)
+    win = paddle.to_tensor(_hann(64).astype(np.float32))
+    S = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                    window=win, onesided=False)
+    back = signal.istft(S, n_fft=64, hop_length=16, window=win,
+                        onesided=False, return_complex=True,
+                        length=256).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+    with pytest.raises(ValueError):
+        signal.istft(S, n_fft=64, onesided=True, return_complex=True)

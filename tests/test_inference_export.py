@@ -64,3 +64,16 @@ def test_predictor_api(tmp_path):
                                rtol=1e-5)
     oh = pred.get_output_handle("output_0")
     assert oh.copy_to_cpu().shape == (4, 4)
+
+
+def test_predictor_output_names_before_run(tmp_path):
+    """Reference idiom: get_output_names before the first run."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "infer2")
+    x = np.ones((2, 8), np.float32)
+    paddle.jit.save(model, path, input_spec=[paddle.to_tensor(x)])
+    pred = create_predictor(Config(path))
+    assert pred.get_output_names() == ["output_0"]

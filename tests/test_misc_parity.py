@@ -1,0 +1,147 @@
+"""Tests for flags, nan-checker, incubate.autograd, audio, text viterbi,
+onnx gate, and the new optimizers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_flags_set_get_and_env(monkeypatch):
+    paddle.set_flags({"FLAGS_benchmark": True})
+    assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    paddle.set_flags({"benchmark": False})
+    assert paddle.get_flags(["FLAGS_benchmark"])["FLAGS_benchmark"] is False
+    with pytest.raises(KeyError):
+        paddle.set_flags({"FLAGS_does_not_exist": 1})
+
+
+def test_check_nan_inf_flag():
+    x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="NaN/Inf.*divide"):
+            _ = x / paddle.to_tensor(np.zeros(2, np.float32))
+        _ = x + x  # finite ops pass
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_incubate_jacobian_hessian_jvp_vjp():
+    from paddle_tpu.incubate.autograd import jacobian, hessian, jvp, vjp
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def f(v):
+        return (v ** 2).sum()
+
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), 2 * np.eye(3), rtol=1e-6)
+    j = jacobian(lambda v: v ** 3, x)
+    np.testing.assert_allclose(j.numpy(), np.diag(3 * x.numpy() ** 2),
+                               rtol=1e-5)
+    out, tan = jvp(f, x, paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(float(tan), 2 * (1 + 2 + 3), rtol=1e-6)
+    out, g = vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+
+def test_audio_features():
+    from paddle_tpu.audio import MelSpectrogram, LogMelSpectrogram, MFCC
+    from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+
+    np.testing.assert_allclose(mel_to_hz(hz_to_mel(440.0)), 440.0, rtol=1e-6)
+    sig = paddle.to_tensor(
+        np.sin(2 * np.pi * 440 * np.arange(4096) / 16000).astype(np.float32))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(sig)
+    assert mel.shape[0] == 40 and np.isfinite(mel.numpy()).all()
+    # energy concentrates at the 440 Hz mel bin
+    peak_bin = int(np.argmax(mel.numpy().sum(-1)))
+    from paddle_tpu.audio.functional import compute_fbank_matrix
+    fb = compute_fbank_matrix(16000, 512, 40).numpy()
+    freqs = np.linspace(0, 8000, 257)
+    centers = (fb * freqs).sum(1) / np.maximum(fb.sum(1), 1e-9)
+    assert abs(centers[peak_bin] - 440) < 150
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(sig)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_mels=40, n_fft=512)(sig)
+    assert mfcc.shape[0] == 13
+
+
+def test_viterbi_decode_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+    import itertools
+
+    rng = np.random.RandomState(0)
+    b, t, n = 2, 5, 3
+    pots = rng.rand(b, t, n).astype(np.float32)
+    trans = rng.rand(n, n).astype(np.float32)
+    lengths = np.array([5, 5], np.int64)
+    scores, paths = viterbi_decode(pots, trans, lengths,
+                                   include_bos_eos_tag=False)
+    for bi in range(b):
+        best, best_path = -1e9, None
+        for path in itertools.product(range(n), repeat=t):
+            s = pots[bi, 0, path[0]]
+            for i in range(1, t):
+                s += trans[path[i - 1], path[i]] + pots[bi, i, path[i]]
+            if s > best:
+                best, best_path = s, path
+        np.testing.assert_allclose(float(scores.numpy()[bi]), best, rtol=1e-5)
+        assert tuple(paths.numpy()[bi]) == best_path
+
+
+def test_onnx_gate_and_artifact(tmp_path):
+    net = nn.Linear(4, 2)
+    net.eval()
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    with pytest.raises(NotImplementedError, match="paddle2onnx"):
+        paddle.onnx.export(net, str(tmp_path / "m.onnx"), input_spec=[x])
+    paddle.onnx.export(net, str(tmp_path / "m"), input_spec=[x])
+    loaded = paddle.jit.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-6)
+
+
+def _quadratic_problem():
+    paddle.seed(0)
+    A = np.array([[3.0, 0.5], [0.5, 1.0]], np.float32)
+    b = np.array([1.0, -2.0], np.float32)
+    w = paddle.to_tensor(np.zeros(2, np.float32))
+    w.stop_gradient = False
+
+    def loss_fn():
+        Aw = paddle.to_tensor(A) @ w
+        return 0.5 * (w * Aw).sum() - (paddle.to_tensor(b) * w).sum()
+
+    return w, loss_fn, np.linalg.solve(A, b)
+
+
+def test_lbfgs_solves_quadratic():
+    w, loss_fn, w_star = _quadratic_problem()
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=10,
+                                 parameters=[w],
+                                 line_search_fn="backtracking")
+
+    def closure():
+        opt.clear_grad()
+        loss = loss_fn()
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        opt.step(closure)
+    np.testing.assert_allclose(w.numpy(), w_star, atol=1e-3)
+
+
+def test_rprop_and_asgd_reduce_loss():
+    for cls, kw in [(paddle.optimizer.Rprop, dict(learning_rate=0.01)),
+                    (paddle.optimizer.ASGD, dict(learning_rate=0.05))]:
+        w, loss_fn, w_star = _quadratic_problem()
+        opt = cls(parameters=[w], **kw)
+        first = float(loss_fn())
+        for _ in range(30):
+            opt.clear_grad()
+            loss = loss_fn()
+            loss.backward()
+            opt.step()
+        assert float(loss_fn()) < first
