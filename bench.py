@@ -32,7 +32,7 @@ def main():
 
     name = os.environ.get("BENCH_MODEL", "gpt_base")
     seq_len = int(os.environ.get("BENCH_SEQLEN", "1024"))
-    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "16" if on_tpu else "2"))
     steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
     if not on_tpu:  # CPU smoke: shrink
         name = os.environ.get("BENCH_MODEL", "gpt_tiny")

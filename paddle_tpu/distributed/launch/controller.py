@@ -92,12 +92,26 @@ class Controller:
         # A restarted node may RE-claim its explicit rank when the previous
         # holder's controller heartbeat has gone stale (elastic rejoin).
         if args.rank >= 0:
-            if self._store.add(f"/rdzv/{job}/claim/{args.rank}", 1) != 1:
-                age = self._store.heartbeat_age(f"ctl/{job}/{args.rank}")
-                if age is not None and age < 10.0:
+            gen = self._store.add(f"/rdzv/{job}/claim/{args.rank}", 1)
+            if gen != 1:
+                # conflict. Give the current holder a grace window to prove
+                # liveness (its heartbeat starts right after its claim);
+                # then only the LATEST claimant (per the atomic counter)
+                # may take over — so concurrent rejoiners can't both win.
+                ttl = float(os.environ.get("PADDLE_RDZV_TTL", "5"))
+                deadline = time.time() + ttl
+                while time.time() < deadline:
+                    age = self._store.heartbeat_age(f"ctl/{job}/{args.rank}")
+                    if age is not None and age < ttl:
+                        raise SystemExit(
+                            f"node rank {args.rank} already claimed by a "
+                            "live node")
+                    time.sleep(min(0.25, ttl / 4))
+                cur = self._store.get_nowait(f"/rdzv/{job}/claim/{args.rank}")
+                if cur is not None and int(cur) != gen:
                     raise SystemExit(
-                        f"node rank {args.rank} already claimed by a live "
-                        "node")
+                        f"node rank {args.rank} superseded by a newer "
+                        "claimant")
             self.node_rank = args.rank
         else:
             while True:

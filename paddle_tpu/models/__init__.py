@@ -8,3 +8,9 @@ from .resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock,
     resnet18, resnet34, resnet50, resnet101, resnet152,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForMaskedLM,
+    ErnieModel, ErnieForSequenceClassification, ErnieForMaskedLM,
+    bert, bert_for_sequence_classification, bert_for_masked_lm,
+)
+from .generation import generate, GenerationConfig  # noqa: F401

@@ -1,0 +1,220 @@
+"""BERT/ERNIE-class encoder models (reference: the PaddleNLP BERT/ERNIE
+families exercised by BASELINE config 2 — bidirectional transformer
+encoder with token/position/segment embeddings, pooler, MLM and
+sequence-classification heads).
+
+TPU-native: the whole forward is jnp math over [B, S, H] activations
+through the fused attention path (nn.functional.scaled_dot_product_
+attention -> Pallas/XLA fused kernels); padding enters as an additive
+mask so shapes stay static.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..core.tensor import Tensor
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    num_labels: int = 2
+
+
+CONFIGS = {
+    "bert_tiny": dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, intermediate_size=128,
+                      max_position_embeddings=128),
+    "bert_base": dict(),
+    "bert_large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+    # ERNIE-3.0-base shares the BERT-base geometry (vocab differs)
+    "ernie_base": dict(vocab_size=40000),
+}
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None])
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros(tuple(input_ids.shape), jnp.int32))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout_p = cfg.attention_probs_dropout_prob
+
+    def forward(self, x, attn_bias=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_bias, dropout_p=self.dropout_p,
+            is_causal=False, training=self.training)
+        return self.out(out.reshape([b, s, h]))
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.act = F.gelu
+
+    def forward(self, x, attn_bias=None):
+        x = self.ln1(x + self.dropout(self.attention(x, attn_bias)))
+        y = self.fc2(self.act(self.fc1(x)))
+        return self.ln2(x + self.dropout(y))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        return self.dense(hidden[:, 0]).tanh()
+
+
+class BertModel(nn.Layer):
+    """Reference: BertModel — returns (sequence_output, pooled_output)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = nn.LayerList(
+            [BertLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        bias = None
+        if attention_mask is not None:
+            m = attention_mask
+            mv = m._value if isinstance(m, Tensor) else jnp.asarray(m)
+            # [B, S] 1/0 keep-mask -> additive [B, 1, 1, S] bias
+            bias = Tensor(
+                jnp.where(mv[:, None, None, :].astype(bool), 0.0,
+                          jnp.asarray(-1e9, jnp.float32)))
+        for layer in self.layers:
+            x = layer(x, bias)
+        return x, self.pooler(x)
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, cfg.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             attention_mask=None):
+        logits = self.forward(input_ids, token_type_ids, attention_mask)
+        return F.cross_entropy(logits, labels).mean()
+
+
+class BertForMaskedLM(nn.Layer):
+    """MLM head tied to the word embedding (reference
+    BertForMaskedLM/ErnieForPretraining)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight
+        return h @ w.t() + self.bias
+
+    def loss(self, input_ids, labels, ignore_index=-100, **kw):
+        """labels: masked positions carry target ids, others ignore_index."""
+        logits = self.forward(input_ids, **kw)
+        v = self.cfg.vocab_size
+        lbl = labels if isinstance(labels, Tensor) else Tensor(labels)
+        return F.cross_entropy(logits.reshape([-1, v]), lbl.reshape([-1]),
+                               ignore_index=ignore_index).mean()
+
+
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification
+ErnieForMaskedLM = BertForMaskedLM
+
+
+def bert(name="bert_base", **overrides):
+    d = dict(CONFIGS[name])
+    d.update(overrides)
+    return BertModel(BertConfig(**d))
+
+
+def bert_for_sequence_classification(name="bert_base", **overrides):
+    d = dict(CONFIGS[name])
+    d.update(overrides)
+    return BertForSequenceClassification(BertConfig(**d))
+
+
+def bert_for_masked_lm(name="bert_base", **overrides):
+    d = dict(CONFIGS[name])
+    d.update(overrides)
+    return BertForMaskedLM(BertConfig(**d))

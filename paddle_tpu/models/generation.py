@@ -1,0 +1,110 @@
+"""Autoregressive generation (reference: the PaddleNLP generate() surface
+backing BASELINE config 5's LLaMA inference).
+
+TPU-native: decode runs as ONE jitted lax.while-free scan over a fixed
+max_new_tokens window with a padded token buffer — static shapes, no
+per-token retraces. The model is re-run on the full (padded) prefix each
+step; a KV-cached decode path is the planned optimization, the API is the
+stable surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..distributed.functional import functionalize
+
+__all__ = ["generate", "GenerationConfig"]
+
+
+class GenerationConfig:
+    def __init__(self, max_new_tokens=32, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0,
+                 seed=0):
+        self.max_new_tokens = int(max_new_tokens)
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = int(pad_token_id)
+        self.seed = int(seed)
+
+
+def _sample_logits(logits, key, cfg: GenerationConfig):
+    if not cfg.do_sample:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = logits / jnp.maximum(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        kth = jnp.sort(logits, -1)[..., -cfg.top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if cfg.top_p < 1.0:
+        sorted_l = jnp.sort(logits, -1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, -1)
+        cum = jnp.cumsum(probs, -1)
+        cutoff_idx = jnp.sum(cum < cfg.top_p, -1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, -1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def generate(model, input_ids, generation_config=None, **kwargs):
+    """Greedy / top-k / top-p decoding. input_ids: [B, S] Tensor/ndarray.
+    Returns [B, S + max_new_tokens] int32 (padded with pad_token_id after
+    eos)."""
+    cfg = generation_config or GenerationConfig(**kwargs)
+    ids = input_ids._value if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids))
+    ids = ids.astype(jnp.int32)
+    b, s = ids.shape
+    total = s + cfg.max_new_tokens
+
+    # inference mode: dropout inside a traced scan would bake ONE concrete
+    # RNG key into the program (same mask every step) — decode in eval
+    was_training = getattr(model, "training", False)
+    model.eval()
+
+    apply_fn, params, buffers = functionalize(
+        model, method=lambda t: model.forward(t))
+    param_vals = {n: p._value for n, p in params.items()}
+    buffer_vals = {n: v._value for n, v in buffers.items()}
+
+    def logits_fn(pv, tokens):
+        out, _ = apply_fn(pv, buffer_vals, Tensor(tokens))
+        return out._value if isinstance(out, Tensor) else out
+
+    eos = -1 if cfg.eos_token_id is None else int(cfg.eos_token_id)
+
+    def decode(pv, ids0, key):
+        buf = jnp.full((b, total), cfg.pad_token_id, jnp.int32)
+        buf = buf.at[:, :s].set(ids0)
+        done0 = jnp.zeros((b,), bool)
+
+        def step(carry, i):
+            buf, done, key = carry
+            logits = logits_fn(pv, buf)
+            # next-token logits live at position i-1 (the last real token)
+            last = jax.lax.dynamic_index_in_dim(
+                logits, i - 1, axis=1, keepdims=False)
+            key, sub = jax.random.split(key)
+            nxt = _sample_logits(last.astype(jnp.float32), sub, cfg)
+            nxt = jnp.where(done, cfg.pad_token_id, nxt)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, nxt, i, axis=1)
+            done = done | (nxt == eos)
+            return (buf, done, key), None
+
+        (buf, _, _), _ = jax.lax.scan(
+            step, (buf, done0, key), jnp.arange(s, total))
+        return buf
+
+    key = jax.random.PRNGKey(cfg.seed)
+    try:
+        out = jax.jit(decode)(param_vals, ids, key)
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out)

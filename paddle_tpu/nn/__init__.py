@@ -46,3 +46,4 @@ from .clip import (
 )
 from . import functional
 from . import initializer
+from . import lora  # noqa: F401

@@ -1,0 +1,122 @@
+"""LoRA — low-rank adaptation of Linear layers.
+
+Reference analog: the PaddleNLP PEFT/LoRA stack exercised by BASELINE
+config 5 (LLaMA-2-7B LoRA fine-tune): wrap target Linears with frozen base
+weights + trainable low-rank A/B adapters, train only the adapters, merge
+for inference.
+
+TPU-native: the adapter matmul fuses into the surrounding XLA program; the
+base weight stays donated/sharded exactly as before (A/B carry no
+dist_spec -> replicated, the standard LoRA sharding)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .layer.layers import Layer
+from .layer.common import Linear
+from . import initializer as I
+from . import functional as F
+
+__all__ = ["LoRAConfig", "LoRALinear", "apply_lora", "merge_lora",
+           "lora_parameters", "mark_only_lora_as_trainable"]
+
+
+class LoRAConfig:
+    def __init__(self, r=8, lora_alpha=16, lora_dropout=0.0,
+                 target_modules=("qkv", "q_proj", "k_proj", "v_proj",
+                                 "out", "o_proj", "up", "down", "gate")):
+        self.r = int(r)
+        self.lora_alpha = float(lora_alpha)
+        self.lora_dropout = float(lora_dropout)
+        self.target_modules = tuple(target_modules)
+
+
+class LoRALinear(Layer):
+    """y = x @ W (frozen) + scale * (x @ A) @ B, A: [in, r], B: [r, out]."""
+
+    def __init__(self, base: Linear, r=8, lora_alpha=16, lora_dropout=0.0):
+        super().__init__()
+        self.base = base
+        base.weight.stop_gradient = True
+        if base.bias is not None:
+            base.bias.stop_gradient = True
+        in_f, out_f = base.weight.shape
+        self.r = int(r)
+        self.scaling = float(lora_alpha) / self.r
+        self.lora_A = self.create_parameter(
+            [in_f, self.r],
+            default_initializer=I.KaimingUniform(
+                fan_in=in_f, nonlinearity="leaky_relu",
+                negative_slope=math.sqrt(5.0)))
+        self.lora_B = self.create_parameter(
+            [self.r, out_f], default_initializer=I.Constant(0.0))
+        self._dropout_p = float(lora_dropout)
+        self.merged = False
+
+    def forward(self, x):
+        y = self.base(x)
+        if self.merged:
+            return y
+        h = x
+        if self._dropout_p > 0.0 and self.training:
+            h = F.dropout(h, p=self._dropout_p, training=True)
+        return y + (h @ self.lora_A) @ self.lora_B * self.scaling
+
+    def merge(self):
+        """Fold the adapter into the base weight (inference deploy)."""
+        if self.merged:
+            return
+        delta = (self.lora_A._value @ self.lora_B._value) * self.scaling
+        self.base.weight._value = (
+            self.base.weight._value + delta.astype(
+                self.base.weight._value.dtype))
+        self.merged = True
+
+    def unmerge(self):
+        if not self.merged:
+            return
+        delta = (self.lora_A._value @ self.lora_B._value) * self.scaling
+        self.base.weight._value = (
+            self.base.weight._value - delta.astype(
+                self.base.weight._value.dtype))
+        self.merged = False
+
+
+def apply_lora(model: Layer, config: LoRAConfig | None = None, **kwargs):
+    """Swap matching Linear sublayers for LoRALinear wrappers (in place)
+    and freeze everything but the adapters."""
+    cfg = config or LoRAConfig(**kwargs)
+    for name, sub in list(model.named_sublayers()):
+        if not isinstance(sub, Linear) or isinstance(sub, LoRALinear):
+            continue
+        leaf = name.split(".")[-1]
+        if not any(t in leaf for t in cfg.target_modules):
+            continue
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        setattr(parent, parts[-1],
+                LoRALinear(sub, cfg.r, cfg.lora_alpha, cfg.lora_dropout))
+    mark_only_lora_as_trainable(model)
+    return model
+
+
+def mark_only_lora_as_trainable(model: Layer):
+    for name, p in model.named_parameters():
+        p.stop_gradient = "lora_A" not in name and "lora_B" not in name
+    return model
+
+
+def lora_parameters(model: Layer):
+    return [p for n, p in model.named_parameters()
+            if "lora_A" in n or "lora_B" in n]
+
+
+def merge_lora(model: Layer):
+    for sub in model.sublayers():
+        if isinstance(sub, LoRALinear):
+            sub.merge()
+    return model

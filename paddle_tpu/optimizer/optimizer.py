@@ -361,7 +361,7 @@ class Rprop(Optimizer):
 
     def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
                  parameters=None, etas=(0.5, 1.2), grad_clip=None,
-                 name=None, **kw):
+                 name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
         self.lr_min, self.lr_max = learning_rate_range
         self.eta_minus, self.eta_plus = etas
@@ -418,10 +418,10 @@ class LBFGS(Optimizer):
         self.tolerance_change = float(tolerance_change)
         self.history_size = int(history_size)
         self.line_search_fn = line_search_fn
+        self.max_eval = int(max_eval) if max_eval is not None else \
+            self.max_iter * 5 // 4
         self._s_hist = []
         self._y_hist = []
-        self._prev_flat_grad = None
-        self._prev_loss = None
 
     def _flat(self, vals):
         return jnp.concatenate([v.reshape(-1) for v in vals])
@@ -458,11 +458,21 @@ class LBFGS(Optimizer):
         return q
 
     def step(self, closure):
-        """closure(): zero grads, compute loss, backward, return loss."""
+        """closure(): zero grads, compute loss, backward, return loss.
+        Closure evaluations are capped at max_eval (reference parity)."""
+        evals = [0]
+        user_closure = closure
+
+        def closure():
+            evals[0] += 1
+            return user_closure()
+
         loss = closure()
         cur = float(loss)
         flat_grad = self._gather_grad()
         for _ in range(self.max_iter):
+            if evals[0] >= self.max_eval:
+                break
             if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
                 break
             d = self._direction(flat_grad)

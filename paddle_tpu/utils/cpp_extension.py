@@ -75,25 +75,9 @@ class CppExtensionModule:
             return jax.ffi.ffi_call(target, out)(*arrays, **attrs)
 
         if vjp is not None:
-            inner = impl
-            # custom_vjp can't bind kwargs: attrs travel as a hashable
-            # nondiff positional tuple
-            from functools import partial as _partial
+            from .custom_op import wrap_custom_vjp
 
-            @_partial(jax.custom_vjp, nondiff_argnums=(0,))
-            def cv(attr_items, *arrays):
-                return inner(*arrays, **dict(attr_items))
-
-            def fwd(attr_items, *arrays):
-                return cv(attr_items, *arrays), arrays
-
-            def bwd(attr_items, saved, ct):
-                return tuple(vjp(saved, ct))
-
-            cv.defvjp(fwd, bwd)
-
-            def impl(*arrays, **attrs):  # noqa: F811
-                return cv(tuple(sorted(attrs.items())), *arrays)
+            impl = wrap_custom_vjp(impl, vjp)
 
         def op(*tensors, **attrs):
             return apply(f"{self.name}.{symbol}", impl, tensors,

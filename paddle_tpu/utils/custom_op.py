@@ -12,9 +12,34 @@ import jax
 
 from ..core.dispatch import apply
 
-__all__ = ["register_op"]
+__all__ = ["register_op", "wrap_custom_vjp"]
 
 _REGISTRY = {}
+
+
+def wrap_custom_vjp(forward, backward):
+    """Wrap forward(*arrays, **statics) with a user backward
+    ((saved_inputs, cotangent) -> input cotangents). custom_vjp can't bind
+    kwargs, so statics travel as a hashable nondiff positional tuple.
+    Shared by register_op and cpp_extension.get_op."""
+    from functools import partial
+
+    @partial(jax.custom_vjp, nondiff_argnums=(0,))
+    def cv(static_items, *args):
+        return forward(*args, **dict(static_items))
+
+    def fwd(static_items, *args):
+        return cv(static_items, *args), args
+
+    def bwd(static_items, saved, ct):
+        return tuple(backward(saved, ct))
+
+    cv.defvjp(fwd, bwd)
+
+    def impl(*args, **statics):
+        return cv(tuple(sorted(statics.items())), *args)
+
+    return impl
 
 
 def register_op(name, forward, backward=None, namespace=None):
@@ -24,27 +49,8 @@ def register_op(name, forward, backward=None, namespace=None):
     backward, if given: (saved_inputs_tuple, cotangent) -> tuple of input
     cotangents. Without it, jax AD differentiates the forward directly.
     """
-    if backward is not None:
-        from functools import partial
-
-        # custom_vjp can't bind kwargs: statics travel as a hashable
-        # nondiff positional tuple
-        @partial(jax.custom_vjp, nondiff_argnums=(0,))
-        def cv(static_items, *args):
-            return forward(*args, **dict(static_items))
-
-        def fwd(static_items, *args):
-            return cv(static_items, *args), args
-
-        def bwd(static_items, saved, ct):
-            return tuple(backward(saved, ct))
-
-        cv.defvjp(fwd, bwd)
-
-        def impl(*args, **statics):
-            return cv(tuple(sorted(statics.items())), *args)
-    else:
-        impl = forward
+    impl = wrap_custom_vjp(forward, backward) if backward is not None \
+        else forward
 
     def op(*tensors, **statics):
         return apply(name, impl, tensors, statics or None)

@@ -156,3 +156,38 @@ def test_rendezvous_mixed_explicit_and_auto_ranks():
     assert c_auto.node_rank == 2
     for c in (c_auto, c_explicit, c_host):
         c.close()
+
+
+def test_explicit_rank_reclaim_after_crash():
+    """A relaunched node with the same rank may re-claim once the previous
+    holder's heartbeat is stale; a LIVE holder blocks the claim."""
+    from paddle_tpu.distributed.launch.context import (Context, parse_args,
+                                                       free_port)
+    from paddle_tpu.distributed.launch.controller import Controller
+
+    port = free_port()
+    master = f"127.0.0.1:{port}"
+
+    def ctl(*extra):
+        args = parse_args(["--nnodes", "2", "--master", master, *extra,
+                           "x.py"])
+        c = Controller(Context(args))
+        c.rendezvous()
+        return c
+
+    os.environ["PADDLE_RDZV_TTL"] = "1"
+    try:
+        host = ctl()                 # hosts the store, rank 0
+        worker = ctl("--rank", "1")  # live holder of rank 1
+        with pytest.raises(SystemExit, match="live node"):
+            ctl("--rank", "1")       # duplicate while holder is alive
+        # holder dies (heartbeat stops)
+        worker._store.stop_heartbeat()
+        worker._store.close()
+        time.sleep(1.5)              # let the heartbeat go stale (> ttl)
+        rejoin = ctl("--rank", "1")  # stale heartbeat -> re-claim succeeds
+        assert rejoin.node_rank == 1
+        rejoin.close()
+        host.close()
+    finally:
+        del os.environ["PADDLE_RDZV_TTL"]

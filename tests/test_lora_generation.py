@@ -1,0 +1,95 @@
+"""LoRA + generation tests (reference: BASELINE config 5 — LLaMA LoRA
+fine-tune + inference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import gpt, generate, GenerationConfig
+from paddle_tpu.nn.lora import (LoRAConfig, LoRALinear, apply_lora,
+                                merge_lora, lora_parameters)
+
+
+def _tiny_llama():
+    paddle.seed(0)
+    return gpt("gpt_tiny", num_layers=2, rope=True, swiglu=True,
+               vocab_size=128, max_position_embeddings=64)
+
+
+def test_apply_lora_freezes_base_and_trains_adapters():
+    m = _tiny_llama()
+    n_before = sum(1 for _ in m.parameters())
+    apply_lora(m, LoRAConfig(r=4, target_modules=("qkv", "out")))
+    loras = lora_parameters(m)
+    assert loras and all(not p.stop_gradient for p in loras)
+    frozen = [p for n, p in m.named_parameters()
+              if "lora" not in n]
+    assert len(frozen) == n_before
+    assert all(p.stop_gradient for p in frozen)
+
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (4, 16)).astype("int32"))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=loras)
+    losses = []
+    for _ in range(8):
+        loss = m.loss(ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_lora_zero_init_is_identity_and_merge_matches():
+    m = _tiny_llama()
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 128, (2, 8)).astype("int32"))
+    m.eval()
+    ref = m(ids).numpy()
+    apply_lora(m, LoRAConfig(r=4))
+    np.testing.assert_allclose(m(ids).numpy(), ref, rtol=1e-5)  # B=0 init
+    # perturb adapters, then merging must preserve outputs
+    for p in lora_parameters(m):
+        p.set_value(np.random.RandomState(2).randn(*p.shape)
+                    .astype(np.float32) * 0.01)
+    unmerged = m(ids).numpy()
+    merge_lora(m)
+    np.testing.assert_allclose(m(ids).numpy(), unmerged, rtol=1e-4,
+                               atol=1e-5)
+    assert not np.allclose(unmerged, ref)
+
+
+def test_generate_greedy_matches_stepwise():
+    m = _tiny_llama()
+    m.eval()
+    ids = np.random.RandomState(3).randint(0, 128, (2, 5)).astype(np.int32)
+    out = generate(m, paddle.to_tensor(ids), max_new_tokens=4).numpy()
+    assert out.shape == (2, 9)
+    np.testing.assert_array_equal(out[:, :5], ids)
+    # stepwise greedy reference
+    cur = ids
+    for _ in range(4):
+        logits = m(paddle.to_tensor(cur)).numpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], 1)
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_generate_sampling_and_eos():
+    m = _tiny_llama()
+    m.eval()
+    ids = np.zeros((1, 3), np.int32)
+    out = generate(m, paddle.to_tensor(ids),
+                   GenerationConfig(max_new_tokens=6, do_sample=True,
+                                    top_k=10, top_p=0.9, temperature=0.8,
+                                    seed=5)).numpy()
+    assert out.shape == (1, 9)
+    assert (out < 128).all() and (out >= 0).all()
+    # eos stopping: force eos as the only likely token? just smoke the path
+    out2 = generate(m, paddle.to_tensor(ids), max_new_tokens=3,
+                    eos_token_id=7).numpy()
+    after_eos = False
+    for tok in out2[0, 3:]:
+        if after_eos:
+            assert tok == 0  # pad after eos
+        if tok == 7:
+            after_eos = True
