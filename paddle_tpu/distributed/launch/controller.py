@@ -119,9 +119,12 @@ class Controller:
                 if self._store.add(f"/rdzv/{job}/claim/{n}", 1) == 1:
                     self.node_rank = n
                     break
-        # liveness lease backing the re-claim rule above
+        # liveness lease backing the re-claim rule above; beat well inside
+        # the TTL so a live holder is never mistaken for stale by a
+        # rejoiner sampling with the same TTL
+        ttl = float(os.environ.get("PADDLE_RDZV_TTL", "5"))
         self._store.start_heartbeat(f"ctl/{job}/{self.node_rank}",
-                                    interval=1.0)
+                                    interval=min(1.0, ttl / 4))
 
     # -- spawn -------------------------------------------------------------
     def _env_for(self, local_rank, restart_epoch=0):

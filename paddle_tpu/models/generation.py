@@ -23,7 +23,7 @@ __all__ = ["generate", "GenerationConfig"]
 class GenerationConfig:
     def __init__(self, max_new_tokens=32, do_sample=False, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0,
-                 seed=0):
+                 seed=0, use_cache=True):
         self.max_new_tokens = int(max_new_tokens)
         self.do_sample = bool(do_sample)
         self.temperature = float(temperature)
@@ -32,6 +32,11 @@ class GenerationConfig:
         self.eos_token_id = eos_token_id
         self.pad_token_id = int(pad_token_id)
         self.seed = int(seed)
+        # KV-cached decode: O(context) work per new token instead of a full
+        # prefix re-run — the only viable mode at LLM scale. (At toy sizes
+        # per-op dispatch latency can dominate; use_cache=False re-runs the
+        # prefix, which XLA executes as fewer, larger ops.)
+        self.use_cache = bool(use_cache)
 
 
 def _sample_logits(logits, key, cfg: GenerationConfig):
@@ -66,6 +71,13 @@ def generate(model, input_ids, generation_config=None, **kwargs):
     # RNG key into the program (same mask every step) — decode in eval
     was_training = getattr(model, "training", False)
     model.eval()
+
+    if cfg.use_cache and hasattr(model, "decode_step"):
+        try:
+            return _generate_cached(model, ids, cfg, b, s, total)
+        finally:
+            if was_training:
+                model.train()
 
     apply_fn, params, buffers = functionalize(
         model, method=lambda t: model.forward(t))
@@ -107,4 +119,60 @@ def generate(model, input_ids, generation_config=None, **kwargs):
     finally:
         if was_training:
             model.train()
+    return Tensor(out)
+
+
+def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
+    """KV-cached decode: one prefill pass over the prompt, then a jitted
+    scan of single-token steps against per-layer caches — O(total) attention
+    reads per new token instead of a full-prefix re-run."""
+    caches = model.init_cache(b, total)
+    cache_vals = [(kc._value, vc._value) for kc, vc in caches]
+
+    def wrapped(tokens, cache_vals, pos):
+        cts = [(Tensor(k), Tensor(v)) for k, v in cache_vals]
+        logits, new_caches = model.decode_step(
+            Tensor(tokens), cts, Tensor(pos))
+        return (logits._value,
+                [(nk._value, nv._value) for nk, nv in new_caches])
+
+    apply_fn, params, buffers = functionalize(model, method=wrapped)
+    param_vals = {n: p._value for n, p in params.items()}
+    buffer_vals = {n: v._value for n, v in buffers.items()}
+
+    eos = -1 if cfg.eos_token_id is None else int(cfg.eos_token_id)
+
+    def decode(pv, ids0, cache_vals, key):
+        # prefill the whole prompt in one chunk
+        (logits, cache_vals), _ = apply_fn(
+            pv, buffer_vals, ids0, cache_vals, jnp.asarray(0, jnp.int32))
+        key, sub = jax.random.split(key)
+        nxt = _sample_logits(logits[:, -1].astype(jnp.float32), sub, cfg)
+        buf = jnp.full((b, total), cfg.pad_token_id, jnp.int32)
+        buf = buf.at[:, :s].set(ids0)
+        buf = buf.at[:, s].set(nxt)
+        done0 = nxt == eos
+
+        def step(carry, i):
+            buf, cache_vals, done, key = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, i - 1, 1, axis=1)
+            (logits, cache_vals), _ = apply_fn(
+                pv, buffer_vals, tok, cache_vals,
+                (i - 1).astype(jnp.int32))
+            key, sub = jax.random.split(key)
+            nxt = _sample_logits(logits[:, -1].astype(jnp.float32), sub,
+                                 cfg)
+            nxt = jnp.where(done, cfg.pad_token_id, nxt)
+            buf = jax.lax.dynamic_update_index_in_dim(buf, nxt, i, axis=1)
+            done = done | (nxt == eos)
+            return (buf, cache_vals, done, key), None
+
+        if total > s + 1:
+            (buf, _, _, _), _ = jax.lax.scan(
+                step, (buf, cache_vals, done0, key),
+                jnp.arange(s + 1, total))
+        return buf
+
+    key = jax.random.PRNGKey(cfg.seed)
+    out = jax.jit(decode)(param_vals, ids, cache_vals, key)
     return Tensor(out)

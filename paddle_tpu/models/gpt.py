@@ -22,9 +22,11 @@ import math
 from dataclasses import dataclass, field
 
 import numpy as np
+import jax.numpy as jnp
 
 from .. import nn
 from .. import ops
+from ..core.dispatch import apply
 from ..nn import functional as F
 
 
@@ -112,7 +114,7 @@ class GPTAttention(nn.Layer):
                                   bias_attr=None if bias else False)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None):
         cfg = self.cfg
         b = x.shape[0]
         s = x.shape[1]
@@ -127,6 +129,17 @@ class GPTAttention(nn.Layer):
         if cfg.rope:
             q, k = F.apply_rotary_pos_emb(q, k, position_ids,
                                           theta=cfg.rope_theta)
+        if cache is not None:
+            # KV-cached decode (reference: the cached inference path of the
+            # LLM families): write this chunk's K/V at `pos`, attend over
+            # the whole static-length cache with a position mask
+            k_cache, v_cache, pos = cache
+            out, new_k, new_v = apply(
+                "cached_attn", _cached_attn_impl,
+                [q, k, v, k_cache, v_cache, pos],
+                {"num_heads": cfg.num_heads})
+            out = ops.reshape(out, [b, s, q_sz])
+            return self.out_proj(out), (new_k, new_v)
         if cfg.num_kv_heads != cfg.num_heads:
             rep = cfg.num_heads // cfg.num_kv_heads
             k = ops.repeat_interleave(k, rep, axis=2)
@@ -135,6 +148,33 @@ class GPTAttention(nn.Layer):
                                    training=self.training)
         out = ops.reshape(out, [b, s, q_sz])
         return self.dropout(self.out_proj(out))
+
+
+def _cached_attn_impl(q, k_new, v_new, k_cache, v_cache, pos, *, num_heads):
+    """q [B,s,H,D]; k/v_new [B,s,Hkv,D]; caches [B,T,Hkv,D]; pos scalar
+    global offset of this chunk. Returns (out, new_k_cache, new_v_cache)."""
+    import jax
+
+    k_cache = jax.lax.dynamic_update_slice_in_dim(
+        k_cache, k_new.astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(
+        v_cache, v_new.astype(v_cache.dtype), pos, axis=1)
+    hkv = k_cache.shape[2]
+    kk, vv = k_cache, v_cache
+    if hkv != num_heads:
+        rep = num_heads // hkv
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    s, t = q.shape[1], kk.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+    q_idx = pos + jnp.arange(s)[:, None]
+    mask = jnp.arange(t)[None, :] <= q_idx  # causal over global positions
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32),
+                       -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    return out, k_cache, v_cache
 
 
 class GPTMLP(nn.Layer):
@@ -176,7 +216,12 @@ class GPTBlock(nn.Layer):
         self.ln_2 = _make_norm(cfg)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None):
+        if cache is not None:
+            att, new_cache = self.attn(self.ln_1(x), position_ids, cache)
+            x = x + att
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
         x = x + self.attn(self.ln_1(x), position_ids)
         x = x + self.mlp(self.ln_2(x))
         return x
@@ -214,6 +259,23 @@ class GPTModel(nn.Layer):
             x = blk(x, position_ids)
         return self.ln_f(x)
 
+    def forward_step(self, input_ids, caches, pos):
+        """Cached decode: input_ids [B, s] at global positions
+        [pos, pos+s); caches = [(k, v)] per layer, [B, T, Hkv, D].
+        Returns (hidden, new_caches)."""
+        b, s = input_ids.shape
+        position_ids = ops.unsqueeze(
+            ops.arange(s, dtype="int32"), 0) + pos
+        position_ids = ops.expand(position_ids, [b, s])
+        x = self.wte(input_ids)
+        if not self.cfg.rope:
+            x = x + self.wpe(position_ids)
+        new_caches = []
+        for blk, (kc, vc) in zip(self.layers, caches):
+            x, nc = blk(x, position_ids, cache=(kc, vc, pos))
+            new_caches.append(nc)
+        return self.ln_f(x), new_caches
+
 
 class GPTForCausalLM(nn.Layer):
     """LM head on the trunk; `forward` returns logits, `loss` the next-token
@@ -237,6 +299,28 @@ class GPTForCausalLM(nn.Layer):
             return ops.matmul(hidden, self.transformer.wte.weight,
                               transpose_y=True)
         return self.lm_head(hidden)
+
+    def init_cache(self, batch_size, max_length, dtype="float32"):
+        """Zeroed per-layer KV caches [B, T, Hkv, D] for cached decode."""
+        cfg = self.cfg
+        shape = (batch_size, int(max_length), cfg.num_kv_heads, cfg.head_dim)
+        from ..core.tensor import Tensor
+
+        return [(Tensor(jnp.zeros(shape, dtype)),
+                 Tensor(jnp.zeros(shape, dtype)))
+                for _ in range(cfg.num_layers)]
+
+    def decode_step(self, input_ids, caches, pos):
+        """Cached decode step: logits for input_ids at global offset pos
+        plus updated caches (the generation fast path)."""
+        hidden, new_caches = self.transformer.forward_step(
+            input_ids, caches, pos)
+        if self.lm_head is None:
+            logits = ops.matmul(hidden, self.transformer.wte.weight,
+                                transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        return logits, new_caches
 
     def loss(self, input_ids, labels=None, position_ids=None):
         """Causal LM loss. labels defaults to input_ids (shift happens here)."""

@@ -480,8 +480,12 @@ class LBFGS(Optimizer):
             x0 = self._flat([p._value.astype(jnp.float32)
                              for p in self._parameter_list])
             if self.line_search_fn in ("strong_wolfe", "backtracking"):
+                # the line search shares the eval budget (reserve one for
+                # the post-step gradient evaluation below)
+                budget = max(0, self.max_eval - evals[0] - 1)
                 lr = self._backtrack(
                     closure, x0, d, cur, flat_grad, lr,
+                    max_ls=min(10, budget),
                     curvature=self.line_search_fn == "strong_wolfe")
             self._assign(x0 + lr * d)
             new_loss = closure()

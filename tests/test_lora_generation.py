@@ -93,3 +93,16 @@ def test_generate_sampling_and_eos():
             assert tok == 0  # pad after eos
         if tok == 7:
             after_eos = True
+
+
+def test_cached_and_uncached_decode_agree():
+    """KV-cached decode must produce exactly the uncached tokens."""
+    m = _tiny_llama()
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(9).randint(0, 128, (2, 12)).astype(np.int32))
+    a = generate(m, ids, GenerationConfig(max_new_tokens=6,
+                                          use_cache=True)).numpy()
+    b = generate(m, ids, GenerationConfig(max_new_tokens=6,
+                                          use_cache=False)).numpy()
+    np.testing.assert_array_equal(a, b)
