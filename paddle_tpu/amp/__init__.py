@@ -196,3 +196,6 @@ def is_float16_supported(device=None):
 
 def is_bfloat16_supported(device=None):
     return True
+
+
+from . import debugging  # noqa: E402,F401

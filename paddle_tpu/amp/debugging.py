@@ -1,0 +1,96 @@
+"""AMP debugging tools (reference: python/paddle/amp/debugging.py —
+enable_operator_stats_collection / collect_operator_stats printing per-op
+call counts, check_numerics, compare_accuracy).
+
+TPU-native: the dispatch profile hook already sees every eager op; the
+collector counts op invocations through it (chained with any active
+profiler hook), and numeric checking rides the FLAGS_check_nan_inf
+sanitizer."""
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+
+from ..core import dispatch
+from .. import flags as _flags
+
+__all__ = ["collect_operator_stats", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "check_numerics",
+           "operator_stats"]
+
+_counts: Counter = Counter()
+_prev_hook = None
+
+
+class _CountingSpan:
+    __slots__ = ("inner",)
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def begin(self):
+        if self.inner is not None:
+            self.inner.begin()
+
+    def end(self):
+        if self.inner is not None:
+            self.inner.end()
+
+
+def _hook(name):
+    _counts[name] += 1
+    inner = _prev_hook(name) if _prev_hook is not None else None
+    return _CountingSpan(inner)
+
+
+def enable_operator_stats_collection():
+    global _prev_hook
+    _counts.clear()
+    # chain rather than clobber: an active Profiler keeps its op spans
+    _prev_hook = dispatch._profile_hook
+    dispatch.set_profile_hook(_hook)
+
+
+def disable_operator_stats_collection():
+    global _prev_hook
+    dispatch.set_profile_hook(_prev_hook)
+    _prev_hook = None
+    _print_stats()
+
+
+def operator_stats():
+    return dict(_counts)
+
+
+def _print_stats():
+    if not _counts:
+        print("<no operators collected>")
+        return
+    width = max(len(k) for k in _counts) + 2
+    print(f"{'op':<{width}} {'calls':>8}")
+    for name, n in _counts.most_common():
+        print(f"{name:<{width}} {n:>8}")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Reference: paddle.amp.debugging.collect_operator_stats."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+@contextlib.contextmanager
+def check_numerics(level=0):
+    """Per-op NaN/Inf scan inside the context (reference: check_numerics /
+    enable_tensor_checker). level 0 raises, 1 warns."""
+    prev = _flags.get_flags(["FLAGS_check_nan_inf",
+                             "FLAGS_check_nan_inf_level"])
+    _flags.set_flags({"FLAGS_check_nan_inf": True,
+                      "FLAGS_check_nan_inf_level": int(level)})
+    try:
+        yield
+    finally:
+        _flags.set_flags(prev)

@@ -1,11 +1,12 @@
 """Autoregressive generation (reference: the PaddleNLP generate() surface
 backing BASELINE config 5's LLaMA inference).
 
-TPU-native: decode runs as ONE jitted lax.while-free scan over a fixed
-max_new_tokens window with a padded token buffer — static shapes, no
-per-token retraces. The model is re-run on the full (padded) prefix each
-step; a KV-cached decode path is the planned optimization, the API is the
-stable surface.
+TPU-native: decode runs as ONE jitted scan over a fixed max_new_tokens
+window with a padded token buffer — static shapes, no per-token retraces.
+Models exposing decode_step/init_cache (the GPT/LLaMA family) use the
+KV-cached path by default: one prefill chunk, then O(context) attention
+reads per new token; use_cache=False falls back to full-prefix re-runs
+(fewer, larger ops — can win at toy sizes).
 """
 from __future__ import annotations
 
@@ -66,6 +67,8 @@ def generate(model, input_ids, generation_config=None, **kwargs):
     ids = ids.astype(jnp.int32)
     b, s = ids.shape
     total = s + cfg.max_new_tokens
+    if cfg.max_new_tokens <= 0:
+        return Tensor(ids)
 
     # inference mode: dropout inside a traced scan would bake ONE concrete
     # RNG key into the program (same mask every step) — decode in eval

@@ -11,3 +11,4 @@ from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .yolo import PPYOLOE, ppyoloe_s  # noqa: F401

@@ -145,3 +145,23 @@ def test_rprop_and_asgd_reduce_loss():
             loss.backward()
             opt.step()
         assert float(loss_fn()) < first
+
+
+def test_amp_debugging_operator_stats(capsys):
+    from paddle_tpu.amp.debugging import (collect_operator_stats,
+                                          operator_stats, check_numerics)
+
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with collect_operator_stats():
+        _ = x @ x
+        _ = x + x
+        _ = x + x
+    stats = operator_stats()
+    assert stats.get("add", 0) >= 2 and stats.get("matmul", 0) >= 1
+    out = capsys.readouterr().out
+    assert "add" in out and "calls" in out
+
+    with check_numerics():
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            _ = x / paddle.to_tensor(np.zeros((4, 4), np.float32))
+    _ = x / x  # flag restored after the context
