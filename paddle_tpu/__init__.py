@@ -100,3 +100,9 @@ def __getattr__(name):
 
 
 from .flags import set_flags, get_flags  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
+from . import strings  # noqa: E402,F401
+
+# complete the op schema registry with the non-tensor namespaces
+# (nn.functional / linalg / fft / signal / sparse / geometric / strings)
+ops.register_namespaces()

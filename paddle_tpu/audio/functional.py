@@ -101,3 +101,27 @@ def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
     else:
         dct *= 2.0
     return Tensor(jnp.asarray(dct.astype(dtype)))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """Mel-spaced frequency bin centers (reference:
+    python/paddle/audio/functional/functional.py mel_frequencies)."""
+    mmin = hz_to_mel(f_min, htk=htk)
+    mmax = hz_to_mel(f_max, htk=htk)
+    mels = jnp.linspace(float(mmin), float(mmax), n_mels)
+    return Tensor(jnp.asarray(
+        [float(mel_to_hz(float(m), htk=htk)) for m in mels],
+        _np_dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """FFT bin center frequencies (reference: audio/functional/functional.py
+    fft_frequencies)."""
+    return Tensor(jnp.linspace(0.0, float(sr) / 2, 1 + n_fft // 2).astype(
+        _np_dtype(dtype)))
+
+
+def _np_dtype(dtype):
+    from ..core.dtype import convert_dtype
+    return convert_dtype(dtype)

@@ -101,3 +101,40 @@ def ifftshift(x, axes=None, name=None):
     return apply("ifftshift", _shift_impl, [x],
                  {"axes": tuple(axes) if axes is not None else None,
                   "inverse": True})
+
+
+# Hermitian n-d transforms (reference: python/paddle/fft.py hfft2/hfftn/
+# ihfft2/ihfftn). Identity: hfft(a, n, norm) == irfft(conj(a), n, norm')
+# with backward<->forward swapped (ortho unchanged); likewise
+# ihfft(a, n, norm) == conj(rfft(a, n, norm')).
+_NORM_SWAP = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def _mkherm(name, inverse, default_axes):
+    def impl(x, *, s, axes, norm):
+        if inverse:
+            return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes,
+                                          norm=_NORM_SWAP[norm]))
+        return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes,
+                              norm=_NORM_SWAP[norm])
+
+    impl.__name__ = f"_{name}_impl"
+
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
+        return apply(_n, impl, [x],
+                     {"s": tuple(s) if s is not None else None,
+                      "axes": tuple(axes) if axes is not None else None,
+                      "norm": _norm(norm)})
+
+    _n = name
+    op.__name__ = name
+    op.__doc__ = (f"{'Inverse ' if inverse else ''}FFT of a signal with "
+                  f"Hermitian symmetry over the given axes (reference: "
+                  f"python/paddle/fft.py {name}).")
+    return op
+
+
+hfft2 = _mkherm("hfft2", False, (-2, -1))
+ihfft2 = _mkherm("ihfft2", True, (-2, -1))
+hfftn = _mkherm("hfftn", False, None)
+ihfftn = _mkherm("ihfftn", True, None)

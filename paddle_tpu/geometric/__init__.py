@@ -1,0 +1,271 @@
+"""paddle.geometric parity — graph segment ops, message passing, reindex,
+sampling.
+
+Reference: python/paddle/geometric/ (segment kernels
+phi/kernels/gpu/segment_pool_kernel.cu, graph_send_recv kernels
+phi/kernels/gpu/graph_send_recv_kernel.cu). TPU design: everything is a
+`jax.ops.segment_*` reduction — one XLA scatter per op, which Mosaic lowers
+to an efficient sorted-segment loop; no custom kernel needed. Neighbor
+sampling is host-side (data-dependent shapes don't jit) like the
+reference's CPU sampling kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import apply, wrap, Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+# ---------------------------------------------------------------------------
+# segment reductions (geometric/math.py)
+# ---------------------------------------------------------------------------
+
+def _seg_n(ids):
+    return int(np.asarray(ids if not isinstance(ids, Tensor)
+                          else ids._value).max()) + 1 if (
+        np.asarray(ids if not isinstance(ids, Tensor)
+                   else ids._value).size) else 0
+
+
+def _segment_factory(name, jfn, empty_fill):
+    def impl(data, ids, *, n):
+        out = jfn(data, ids, num_segments=n)
+        if empty_fill is not None:
+            # segments with no members: reference fills 0
+            counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32), ids,
+                                         num_segments=n)
+            shape = (n,) + (1,) * (data.ndim - 1)
+            out = jnp.where(counts.reshape(shape) > 0, out, empty_fill)
+        return out
+
+    impl.__name__ = f"_{name}_impl"
+
+    def op(data, segment_ids, name=None):
+        data, segment_ids = wrap(data), wrap(segment_ids)
+        return apply(_n, impl, (data, segment_ids),
+                     {"n": _seg_n(segment_ids)})
+
+    _n = name
+    op.__name__ = name
+    op.__doc__ = (f"Segment {name.split('_')[1]} over the leading dim "
+                  f"(reference: python/paddle/geometric/math.py {name}).")
+    return op
+
+
+segment_sum = _segment_factory("segment_sum", jax.ops.segment_sum, None)
+segment_mean = _segment_factory(
+    "segment_mean",
+    lambda d, i, num_segments: jax.ops.segment_sum(d, i, num_segments)
+    / jnp.maximum(jax.ops.segment_sum(
+        jnp.ones(d.shape[:1] + (1,) * (d.ndim - 1), d.dtype), i,
+        num_segments), 1.0),
+    0.0)
+segment_min = _segment_factory("segment_min", jax.ops.segment_min, 0.0)
+segment_max = _segment_factory("segment_max", jax.ops.segment_max, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# message passing (geometric/message_passing/send_recv.py)
+# ---------------------------------------------------------------------------
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled via sum/count
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _finalize(msg, dst, n, reduce_op, dtype):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],) + (1,) *
+                                         (msg.ndim - 1), msg.dtype),
+                                dst, num_segments=n)
+        return s / jnp.maximum(c, 1.0)
+    out = _REDUCERS[reduce_op](msg, dst, num_segments=n)
+    if reduce_op in ("min", "max"):
+        c = jax.ops.segment_sum(jnp.ones_like(dst, jnp.int32), dst,
+                                num_segments=n)
+        out = jnp.where(c.reshape((n,) + (1,) * (out.ndim - 1)) > 0, out,
+                        jnp.zeros((), dtype))
+    return out
+
+
+def _send_u_recv_impl(x, src, dst, *, reduce_op, n):
+    return _finalize(x[src], dst, n, reduce_op, x.dtype)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x rows at src_index, segment-reduce them at dst_index.
+
+    Reference: geometric/message_passing/send_recv.py send_u_recv."""
+    x, src_index, dst_index = wrap(x), wrap(src_index), wrap(dst_index)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    return apply("send_u_recv", _send_u_recv_impl,
+                 (x, src_index, dst_index),
+                 {"reduce_op": reduce_op, "n": n})
+
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _send_ue_recv_impl(x, y, src, dst, *, message_op, reduce_op, n):
+    msg = _MSG_OPS[message_op](x[src], y)
+    return _finalize(msg, dst, n, reduce_op, x.dtype)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine source-node features with edge features, reduce at dst.
+
+    Reference: geometric/message_passing/send_recv.py send_ue_recv."""
+    x, y = wrap(x), wrap(y)
+    src_index, dst_index = wrap(src_index), wrap(dst_index)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    return apply("send_ue_recv", _send_ue_recv_impl,
+                 (x, y, src_index, dst_index),
+                 {"message_op": message_op, "reduce_op": reduce_op, "n": n})
+
+
+def _send_uv_impl(x, y, src, dst, *, message_op):
+    return _MSG_OPS[message_op](x[src], y[dst])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge combination of source and destination node features.
+
+    Reference: geometric/message_passing/send_recv.py send_uv."""
+    return apply("send_uv", _send_uv_impl,
+                 (wrap(x), wrap(y), wrap(src_index), wrap(dst_index)),
+                 {"message_op": message_op})
+
+
+# ---------------------------------------------------------------------------
+# reindex / sampling (host-side: output shapes are data-dependent)
+# ---------------------------------------------------------------------------
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference:
+    geometric/reindex.py reindex_graph)."""
+    xs = np.asarray(wrap(x)._value)
+    nbr = np.asarray(wrap(neighbors)._value)
+    cnt = np.asarray(wrap(count)._value)
+    # reference keeps x's ids first, in order
+    order = {v: i for i, v in enumerate(xs.tolist())}
+    nxt = len(order)
+    for v in nbr.tolist():
+        if v not in order:
+            order[v] = nxt
+            nxt += 1
+    remap = np.vectorize(order.get)
+    reindex_src = remap(nbr).astype(np.int64)
+    dst = np.repeat(np.arange(len(xs)), cnt).astype(np.int64)
+    out_nodes = np.array(sorted(order, key=order.get), dtype=xs.dtype)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are lists per edge type.
+
+    Reference: geometric/reindex.py reindex_heter_graph."""
+    xs = np.asarray(wrap(x)._value)
+    order = {v: i for i, v in enumerate(xs.tolist())}
+    nxt = len(order)
+    srcs, dsts = [], []
+    for nb, ct in zip(neighbors, count):
+        nb = np.asarray(wrap(nb)._value)
+        ct = np.asarray(wrap(ct)._value)
+        for v in nb.tolist():
+            if v not in order:
+                order[v] = nxt
+                nxt += 1
+        remap = np.vectorize(order.get)
+        srcs.append(remap(nb).astype(np.int64))
+        dsts.append(np.repeat(np.arange(len(xs)), ct).astype(np.int64))
+    out_nodes = np.array(sorted(order, key=order.get), dtype=xs.dtype)
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to sample_size neighbors per input node from a
+    CSC graph (reference: geometric/sampling/neighbors.py sample_neighbors;
+    CPU kernel phi/kernels/cpu/graph_sample_neighbors_kernel.cc)."""
+    r = np.asarray(wrap(row)._value)
+    cp = np.asarray(wrap(colptr)._value)
+    nodes = np.asarray(wrap(input_nodes)._value)
+    rng = np.random.RandomState(np.uint32(len(nodes) * 2654435761 % 2**31))
+    out, cnt, out_eids = [], [], []
+    e = np.asarray(wrap(eids)._value) if eids is not None else None
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        nbrs = r[beg:end]
+        idx = np.arange(beg, end)
+        if 0 <= sample_size < len(nbrs):
+            pick = rng.choice(len(nbrs), sample_size, replace=False)
+            nbrs = nbrs[pick]
+            idx = idx[pick]
+        out.append(nbrs)
+        cnt.append(len(nbrs))
+        if return_eids and e is not None:
+            out_eids.append(e[idx])
+    res = (Tensor(jnp.asarray(np.concatenate(out) if out else
+                              np.empty(0, r.dtype))),
+           Tensor(jnp.asarray(np.array(cnt, np.int32))))
+    if return_eids and e is not None:
+        res = res + (Tensor(jnp.asarray(np.concatenate(out_eids))),)
+    return res
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted (without-replacement) neighbor sampling.
+
+    Reference: geometric/sampling/neighbors.py weighted_sample_neighbors."""
+    r = np.asarray(wrap(row)._value)
+    cp = np.asarray(wrap(colptr)._value)
+    w = np.asarray(wrap(edge_weight)._value).astype(np.float64)
+    nodes = np.asarray(wrap(input_nodes)._value)
+    rng = np.random.RandomState(np.uint32(len(nodes) * 40503 % 2**31))
+    out, cnt, out_eids = [], [], []
+    e = np.asarray(wrap(eids)._value) if eids is not None else None
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        nbrs = r[beg:end]
+        idx = np.arange(beg, end)
+        if 0 <= sample_size < len(nbrs):
+            pw = w[beg:end]
+            pw = pw / pw.sum() if pw.sum() > 0 else None
+            pick = rng.choice(len(nbrs), sample_size, replace=False, p=pw)
+            nbrs = nbrs[pick]
+            idx = idx[pick]
+        out.append(nbrs)
+        cnt.append(len(nbrs))
+        if return_eids and e is not None:
+            out_eids.append(e[idx])
+    res = (Tensor(jnp.asarray(np.concatenate(out) if out else
+                              np.empty(0, r.dtype))),
+           Tensor(jnp.asarray(np.array(cnt, np.int32))))
+    if return_eids and e is not None:
+        res = res + (Tensor(jnp.asarray(np.concatenate(out_eids))),)
+    return res

@@ -1,3 +1,50 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/ — experimental
 APIs; autograd functional here, MoE lives in distributed.moe)."""
 from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+
+# graph / segment op aliases (reference: python/paddle/incubate/operators —
+# the incubate spellings of the geometric surface)
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_min, segment_max,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: E402,F401
+from ..geometric import reindex_graph as graph_reindex  # noqa: E402,F401
+from ..geometric import (  # noqa: E402,F401
+    sample_neighbors as graph_sample_neighbors,
+)
+
+
+def identity_loss(x, reduction="none"):
+    """Returns the input as a loss (IPU pattern); reduction none/mean/sum
+    (reference: python/paddle/incubate/operators/identity_loss.py)."""
+    from ..ops._helpers import wrap
+    x = wrap(x)
+    if reduction in (1, "sum"):
+        return x.sum()
+    if reduction in (0, "mean"):
+        return x.mean()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference:
+    incubate/operators/softmax_mask_fuse.py; XLA fuses the add)."""
+    from ..nn.functional import softmax
+    return softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference:
+    incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    from ..ops._helpers import apply, wrap
+    return apply("softmax_mask_fuse_upper_triangle",
+                 _softmax_upper_tri_impl, [wrap(x)])
+
+
+def _softmax_upper_tri_impl(x):
+    import jax
+    import jax.numpy as jnp
+    s = x.shape[-1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    return jax.nn.softmax(jnp.where(mask, x, -1e9), axis=-1)

@@ -1,0 +1,2 @@
+"""incubate.nn (reference: python/paddle/incubate/nn/)."""
+from . import functional  # noqa: F401

@@ -1,0 +1,4 @@
+"""incubate.nn.functional — re-export of the fused-op surface
+(implementations in paddle_tpu/incubate/nn_functional.py)."""
+from ..nn_functional import *  # noqa: F401,F403
+from ..nn_functional import __all__  # noqa: F401

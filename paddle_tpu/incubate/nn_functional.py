@@ -1,0 +1,261 @@
+"""incubate.nn.functional — fused-op surface (reference:
+python/paddle/incubate/nn/functional/: fused_dropout_add, fused_rms_norm,
+fused_layer_norm, fused_rotary_position_embedding, fused_matmul_bias,
+swiglu, fused_linear...).
+
+TPU design: these exist in the reference because CUDA needs hand-fused
+kernels; XLA fuses elementwise chains into the surrounding matmuls
+automatically, so each "fused_*" op here is the plain composition — the
+fusion is real, it just happens in the compiler. Keeping the API names
+gives drop-in parity for models written against incubate."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import apply, wrap, Tensor
+
+__all__ = [
+    "fused_dropout_add", "fused_rms_norm", "fused_layer_norm",
+    "fused_rotary_position_embedding", "fused_matmul_bias", "fused_linear",
+    "fused_linear_activation", "swiglu", "fused_bias_act",
+    "fused_bias_dropout_residual_layer_norm", "masked_multihead_attention",
+]
+
+
+def _dropout_add_impl(x, y, key, *, p, training):
+    if not training or p == 0.0:
+        return x + y
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0) + y
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one fused region
+    (reference: incubate/nn/functional/fused_dropout_add.py)."""
+    from ..ops import random as _rnd
+    return apply("fused_dropout_add", _dropout_add_impl,
+                 (wrap(x), wrap(y), Tensor(_rnd.next_key())),
+                 {"p": float(p), "training": bool(training)})
+
+
+def _rms_norm_impl(x, w, b, *, eps, begin_axis):
+    red = tuple(range(begin_axis, x.ndim))
+    ms = jnp.mean(jax.lax.square(x.astype(jnp.float32)), red, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps)).astype(x.dtype)
+    out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, name=None):
+    """RMSNorm with optional residual-add pre-norm
+    (reference: incubate/nn/functional/fused_rms_norm.py)."""
+    x = wrap(x)
+    if bias is not None:
+        x = x + wrap(bias)
+    if residual is not None:
+        x = x + wrap(residual)
+    axis = begin_norm_axis % x.ndim
+    return apply("fused_rms_norm", _rms_norm_impl,
+                 (x, wrap(norm_weight),
+                  wrap(norm_bias) if norm_bias is not None else None),
+                 {"eps": float(epsilon), "begin_axis": axis})
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     name=None):
+    """LayerNorm with optional fused residual/bias add
+    (reference: incubate/nn/functional/fused_layer_norm.py)."""
+    from ..nn.functional import layer_norm
+    x = wrap(x)
+    if bias is not None:
+        x = x + wrap(bias)
+    if residual is not None:
+        x = x + wrap(residual)
+    shape = x.shape[begin_norm_axis % x.ndim:]
+    return layer_norm(x, shape, weight=norm_weight, bias=norm_bias,
+                      epsilon=epsilon)
+
+
+def _rope_one_impl(t, sin, cos, pos, *, neox, theta):
+    # t: [B,S,H,D]; sin/cos optional [B,S,1,D/2] (or broadcastable); pos
+    # optional [B,S]. Trig in fp32, cast back (matches nn.functional rope).
+    d = t.shape[-1]
+    half = d // 2
+    if sin is None:
+        if pos is None:
+            pos = jnp.broadcast_to(jnp.arange(t.shape[1]),
+                                   t.shape[:2]).astype(jnp.float32)
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, half,
+                                               dtype=jnp.float32) / half))
+        ang = pos.astype(jnp.float32)[..., None] * inv_freq
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
+    else:
+        sin = sin.astype(jnp.float32)
+        cos = cos.astype(jnp.float32)
+        if sin.shape[-1] == d:  # interleaved tables: keep one half
+            sin = sin[..., :half]
+            cos = cos[..., :half]
+        while sin.ndim < 4:
+            sin = sin[None]
+            cos = cos[None]
+        if pos is not None:
+            sin = jnp.take_along_axis(
+                jnp.broadcast_to(sin, (pos.shape[0],) + sin.shape[1:]),
+                pos[:, :, None, None], axis=1)
+            cos = jnp.take_along_axis(
+                jnp.broadcast_to(cos, (pos.shape[0],) + cos.shape[1:]),
+                pos[:, :, None, None], axis=1)
+    x1f = t[..., :half].astype(jnp.float32)
+    x2f = t[..., half:].astype(jnp.float32)
+    if neox:
+        r1 = x1f * cos - x2f * sin
+        r2 = x2f * cos + x1f * sin
+        return jnp.concatenate([r1, r2], -1).astype(t.dtype)
+    ev = t[..., 0::2].astype(jnp.float32)
+    od = t[..., 1::2].astype(jnp.float32)
+    r_ev = ev * cos - od * sin
+    r_od = od * cos + ev * sin
+    return jnp.stack([r_ev, r_od], -1).reshape(t.shape).astype(t.dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True, name=None):
+    """Apply RoPE to q/k(/v) in one pass (reference:
+    incubate/nn/functional/fused_rotary_position_embedding.py; CUDA kernel
+    phi/kernels/fusion/gpu/fused_rope_kernel.cu — on TPU the trig+mul chain
+    fuses into the adjacent matmuls)."""
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(apply(
+            "fused_rope", _rope_one_impl,
+            (wrap(t), wrap(sin) if sin is not None else None,
+             wrap(cos) if cos is not None else None,
+             wrap(position_ids) if position_ids is not None else None),
+            {"neox": bool(use_neox_rotary_style), "theta": 10000.0}))
+    return tuple(outs)
+
+
+def _matmul_bias_impl(x, y, b, *, tx, ty):
+    out = jnp.matmul(jnp.swapaxes(x, -2, -1) if tx else x,
+                     jnp.swapaxes(y, -2, -1) if ty else y)
+    return out if b is None else out + b
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (reference:
+    incubate/nn/functional/fused_matmul_bias.py — cublasLt epilogue; on TPU
+    XLA fuses the add into the MXU epilogue natively)."""
+    return apply("fused_matmul_bias", _matmul_bias_impl,
+                 (wrap(x), wrap(y), wrap(bias) if bias is not None else None),
+                 {"tx": bool(transpose_x), "ty": bool(transpose_y)})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Reference: incubate/nn/functional/fused_transformer.py fused_linear."""
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+_ACTS = {"relu": jax.nn.relu, "gelu": jax.nn.gelu, "silu": jax.nn.silu,
+         "swish": jax.nn.silu, "none": lambda x: x, "": lambda x: x}
+
+
+def _linear_act_impl(x, w, b, *, act, tw):
+    out = jnp.matmul(x, jnp.swapaxes(w, -2, -1) if tw else w)
+    if b is not None:
+        out = out + b
+    return _ACTS[act](out)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """matmul + bias + activation epilogue (reference:
+    incubate/nn/functional/fused_transformer.py fused_linear_activation)."""
+    if trans_x:
+        x = wrap(x).transpose([*range(wrap(x).ndim - 2), -1, -2])
+    return apply("fused_linear_activation", _linear_act_impl,
+                 (wrap(x), wrap(y), wrap(bias) if bias is not None else None),
+                 {"act": activation or "none", "tw": bool(trans_y)})
+
+
+def _swiglu_impl(x, y):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y, splitting x in half when y is None
+    (reference: incubate/nn/functional/swiglu.py)."""
+    return apply("swiglu", _swiglu_impl,
+                 (wrap(x), wrap(y) if y is not None else None))
+
+
+def _bias_act_impl(x, b, *, act):
+    if b is not None:
+        x = x + b
+    return _ACTS[act](x)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """bias + activation (reference:
+    incubate/nn/functional/fused_bias_act.py; quant paths gated off)."""
+    if dequant_scales is not None or quant_scale != -1:
+        raise NotImplementedError(
+            "fused_bias_act quantization paths are not supported on the "
+            "TPU build; use paddle_tpu.quantization instead")
+    return apply("fused_bias_act", _bias_act_impl,
+                 (wrap(x), wrap(bias) if bias is not None else None),
+                 {"act": act_method})
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode=
+                                           "upscale_in_train", name=None):
+    """(x+bias) -> dropout -> +residual -> LayerNorm (reference:
+    incubate/nn/functional/fused_transformer.py
+    fused_bias_dropout_residual_layer_norm)."""
+    from ..nn.functional import dropout, layer_norm
+    x = wrap(x)
+    if bias is not None:
+        x = x + wrap(bias)
+    x = dropout(x, p=dropout_rate, training=training)
+    x = x + wrap(residual)
+    return layer_norm(x, x.shape[-1:], weight=ln_scale, bias=ln_bias,
+                      epsilon=ln_epsilon)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decode attention over a running KV cache (reference:
+    incubate/nn/functional/masked_multihead_attention.py). The TPU decode
+    path lives in models/generation (KV-cached jit decode); this shim keeps
+    API parity for incubate callers."""
+    raise NotImplementedError(
+        "masked_multihead_attention: use paddle_tpu.models generation "
+        "(KV-cached decode) — the incubate fused-kernel signature has no "
+        "TPU equivalent")

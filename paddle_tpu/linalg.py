@@ -8,3 +8,20 @@ from .ops.linalg import (  # noqa: F401
 )
 
 inv = inverse  # reference alias
+
+from .ops.extra import lu_unpack, pca_lowrank  # noqa: E402,F401
+from .ops.extra import cdist  # noqa: E402,F401
+from .ops.reduction import histogram  # noqa: E402,F401
+from .ops.extra import histogramdd  # noqa: E402,F401
+
+
+def _cond_impl(a, *, p):
+    import jax.numpy as _jnp
+    return _jnp.linalg.cond(a, p=p)
+
+
+def cond(x, p=None, name=None):
+    """Condition number of a matrix (reference:
+    python/paddle/tensor/linalg.py cond)."""
+    from .ops._helpers import apply as _apply, wrap as _wrap
+    return _apply("cond", _cond_impl, [_wrap(x)], {"p": p})

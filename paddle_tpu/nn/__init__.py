@@ -47,3 +47,4 @@ from .clip import (
 from . import functional
 from . import initializer
 from . import lora  # noqa: F401
+from . import utils  # noqa: F401

@@ -30,3 +30,22 @@ from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
     sparse_attention, apply_rotary_pos_emb,
 )
+from .extra import (  # noqa: F401
+    affine_grid, grid_sample, channel_shuffle, temporal_shift, zeropad2d,
+    diag_embed, sequence_mask, gather_tree, max_unpool1d, max_unpool2d,
+    max_unpool3d, pairwise_distance, pdist, dice_loss, gaussian_nll_loss,
+    sigmoid_focal_loss, multi_margin_loss, npair_loss,
+    triplet_margin_with_distance_loss, hsigmoid_loss, margin_cross_entropy,
+    rnnt_loss, edit_distance, class_center_sample,
+)
+
+# in-place activation variants (reference: generate_inplace_fn in
+# python/paddle/tensor/layer_function_generator.py)
+from ...ops.schema import make_inplace as _mk_inplace  # noqa: E402
+from . import activation as _act  # noqa: E402
+
+elu_ = _mk_inplace(_act.elu, "elu")
+leaky_relu_ = _mk_inplace(_act.leaky_relu, "leaky_relu")
+hardtanh_ = _mk_inplace(_act.hardtanh, "hardtanh")
+thresholded_relu_ = _mk_inplace(_act.thresholded_relu, "thresholded_relu")
+softmax_ = _mk_inplace(_act.softmax, "softmax")

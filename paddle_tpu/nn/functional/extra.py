@@ -1,0 +1,674 @@
+"""Long-tail functionals (reference: python/paddle/nn/functional/ — vision
+warps, specialty losses, unpooling, sequence utilities). Pure jnp/lax;
+grid_sample and max_unpool lower to XLA gathers/scatters which tile fine on
+TPU; the DP losses (rnnt) use lax.scan so they compile as single fused
+loops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import apply, wrap, Tensor
+from .loss import _reduce
+
+
+# ---------------------------------------------------------------------------
+# vision warps / layout ops
+# ---------------------------------------------------------------------------
+
+def _affine_grid_impl(theta, *, out_shape, align_corners):
+    def lin(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        half = (n - 1) / n
+        return jnp.linspace(-half, half, n)
+
+    if len(out_shape) == 4:
+        _, _, H, W = out_shape
+        ys, xs = jnp.meshgrid(lin(H), lin(W), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # H W 3
+        grid = jnp.einsum("hwk,nck->nhwc", base, theta)    # N H W 2
+        return grid
+    _, _, D, H, W = out_shape
+    zs, ys, xs = jnp.meshgrid(lin(D), lin(H), lin(W), indexing="ij")
+    base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)
+    return jnp.einsum("dhwk,nck->ndhwc", base, theta)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Affine sampling grid from batched 2x3 (or 3x4) matrices.
+
+    Reference: python/paddle/nn/functional/vision.py affine_grid."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    return apply("affine_grid", _affine_grid_impl, (wrap(theta),),
+                 {"out_shape": tuple(int(s) for s in out_shape),
+                  "align_corners": bool(align_corners)})
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _grid_sample_impl(x, grid, *, mode, padding_mode, align_corners):
+    # x: N C H W; grid: N Ho Wo 2 (xy in [-1, 1])
+    N, C, H, W = x.shape
+    gx = _unnormalize(grid[..., 0], W, align_corners)
+    gy = _unnormalize(grid[..., 1], H, align_corners)
+
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, n):
+            if align_corners:
+                span = 2 * (n - 1) if n > 1 else 1
+                v = jnp.abs(v) % span
+                return jnp.where(v > n - 1, span - v, v)
+            span = 2 * n
+            v = (v + 0.5) % span
+            v = jnp.where(v > n, span - v, v) - 0.5
+            return jnp.clip(v, 0, n - 1)
+        gx = reflect(gx, W)
+        gy = reflect(gy, H)
+
+    def sample(ix, iy):
+        inb = ((ix >= 0) & (ix < W) & (iy >= 0) & (iy < H))
+        ixc = jnp.clip(ix, 0, W - 1)
+        iyc = jnp.clip(iy, 0, H - 1)
+        # gather per batch: out[n, c, ho, wo] = x[n, c, iy[n,ho,wo], ix[..]]
+        flat = x.reshape(N, C, H * W)
+        lin = (iyc * W + ixc).reshape(N, 1, -1)
+        g = jnp.take_along_axis(flat, jnp.broadcast_to(
+            lin, (N, C, lin.shape[-1])), axis=2)
+        g = g.reshape(N, C, *ix.shape[1:])
+        if padding_mode == "zeros":
+            g = g * inb[:, None].astype(g.dtype)
+        return g
+
+    if mode == "nearest":
+        return sample(jnp.round(gx).astype(jnp.int32),
+                      jnp.round(gy).astype(jnp.int32))
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+    v00 = sample(x0, y0)
+    v01 = sample(x1, y0)
+    v10 = sample(x0, y1)
+    v11 = sample(x1, y1)
+    wx = wx[:, None].astype(x.dtype)
+    wy = wy[:, None].astype(x.dtype)
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear/nearest sampling of x at normalized grid locations.
+
+    Reference: python/paddle/nn/functional/vision.py grid_sample (kernel
+    phi/kernels/gpu/grid_sample_kernel.cu). XLA lowering: one gather per
+    corner + fused lerp — bandwidth-bound, fine on TPU."""
+    return apply("grid_sample", _grid_sample_impl, (wrap(x), wrap(grid)),
+                 {"mode": mode, "padding_mode": padding_mode,
+                  "align_corners": bool(align_corners)})
+
+
+def _channel_shuffle_impl(x, *, groups, data_format):
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        x = x.reshape(N, groups, C // groups, H, W)
+        x = jnp.swapaxes(x, 1, 2)
+        return x.reshape(N, C, H, W)
+    N, H, W, C = x.shape
+    x = x.reshape(N, H, W, groups, C // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(N, H, W, C)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Reference: nn/functional/vision.py channel_shuffle."""
+    return apply("channel_shuffle", _channel_shuffle_impl, (wrap(x),),
+                 {"groups": int(groups), "data_format": data_format})
+
+
+def _temporal_shift_impl(x, *, seg_num, shift_ratio, data_format):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    x = x.reshape(N, seg_num, C, H, W)
+    fold = int(C * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold], jnp.zeros_like(x[:, :1, :fold])],
+                           axis=1)
+    mid = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]),
+                           x[:, :-1, fold:2 * fold]], axis=1)
+    out = jnp.concatenate([left, mid, x[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(NT, C, H, W)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Shift channels across the time dimension (TSM).
+
+    Reference: nn/functional/extension.py temporal_shift."""
+    return apply("temporal_shift", _temporal_shift_impl, (wrap(x),),
+                 {"seg_num": int(seg_num), "shift_ratio": float(shift_ratio),
+                  "data_format": data_format})
+
+
+def _zeropad2d_impl(x, *, padding, data_format):
+    l, r, t, b = padding
+    if data_format == "NCHW":
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+    return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Reference: nn/functional/common.py zeropad2d."""
+    if isinstance(padding, Tensor):
+        padding = [int(v) for v in padding.numpy()]
+    return apply("zeropad2d", _zeropad2d_impl, (wrap(x),),
+                 {"padding": tuple(int(p) for p in padding),
+                  "data_format": data_format})
+
+
+def _diag_embed_impl(x, *, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    out_ndim = x.ndim + 1
+    d1 = dim1 % out_ndim
+    d2 = dim2 % out_ndim
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    base = base.at[..., i - min(offset, 0), i + max(offset, 0)].set(x)
+    # base currently has the two matrix dims last; move them to (d1, d2)
+    return jnp.moveaxis(base, (-2, -1), (d1, d2))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal matrix construction.
+
+    Reference: nn/functional/extension.py diag_embed."""
+    return apply("diag_embed", _diag_embed_impl, (wrap(input),),
+                 {"offset": int(offset), "dim1": int(dim1),
+                  "dim2": int(dim2)})
+
+
+def _sequence_mask_impl(x, *, maxlen, dtype):
+    ar = jnp.arange(maxlen)
+    return (ar < x[..., None]).astype(dtype)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[..., j] = j < x[...]. Reference: nn/functional/extension.py."""
+    x = wrap(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._value).max())
+    from ...core.dtype import convert_dtype
+    return apply("sequence_mask", _sequence_mask_impl, (x,),
+                 {"maxlen": int(maxlen), "dtype": str(convert_dtype(dtype))})
+
+
+def _gather_tree_impl(ids, parents):
+    # ids/parents: [T, batch, beam]
+    T = ids.shape[0]
+
+    def step(nxt_parent, t):
+        idx = T - 1 - t
+        cur = jnp.take_along_axis(ids[idx], nxt_parent, axis=-1)
+        par = jnp.take_along_axis(parents[idx], nxt_parent, axis=-1)
+        return par, cur
+
+    beam = ids.shape[-1]
+    init = jnp.broadcast_to(jnp.arange(beam), ids.shape[1:]).astype(
+        ids.dtype)
+    _, rev = jax.lax.scan(step, init, jnp.arange(T))
+    return rev[::-1]
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: nn/functional/extension.py
+    gather_tree; kernel phi/kernels/cpu/gather_tree_kernel.cc)."""
+    return apply("gather_tree", _gather_tree_impl,
+                 (wrap(ids), wrap(parents)))
+
+
+# ---------------------------------------------------------------------------
+# unpooling
+# ---------------------------------------------------------------------------
+
+def _max_unpool_impl(x, indices, *, out_elems, out_shape):
+    # x/indices: [N, C, *spatial]; indices index the flattened output window
+    N, C = x.shape[0], x.shape[1]
+    xf = x.reshape(N, C, -1)
+    idxf = indices.reshape(N, C, -1)
+    out = jnp.zeros((N, C, out_elems), x.dtype)
+    ni = jnp.arange(N)[:, None, None]
+    ci = jnp.arange(C)[None, :, None]
+    out = out.at[ni, ci, idxf].set(xf)
+    return out.reshape((N, C) + out_shape)
+
+
+def _max_unpool(ndim, x, indices, kernel_size, stride=None, padding=0,
+                data_format=None, output_size=None, name=None):
+    x, indices = wrap(x), wrap(indices)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * ndim
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * ndim
+    if isinstance(padding, int):
+        padding = (padding,) * ndim
+    if output_size is None:
+        spatial = x.shape[2:]
+        output_size = tuple(
+            (s - 1) * st - 2 * p + k
+            for s, st, p, k in zip(spatial, stride, padding, kernel_size))
+    else:
+        output_size = tuple(int(v) for v in output_size[-ndim:])
+    out_elems = int(np.prod(output_size))
+    return apply(f"max_unpool{ndim}d", _max_unpool_impl, (x, indices),
+                 {"out_elems": out_elems, "out_shape": tuple(output_size)})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d given pooled indices.
+
+    Reference: nn/functional/pooling.py max_unpool1d."""
+    return _max_unpool(1, x, indices, kernel_size, stride, padding,
+                       data_format, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Reference: nn/functional/pooling.py max_unpool2d."""
+    return _max_unpool(2, x, indices, kernel_size, stride, padding,
+                       data_format, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Reference: nn/functional/pooling.py max_unpool3d."""
+    return _max_unpool(3, x, indices, kernel_size, stride, padding,
+                       data_format, output_size)
+
+
+# ---------------------------------------------------------------------------
+# distances
+# ---------------------------------------------------------------------------
+
+def _pairwise_distance_impl(x, y, *, p, epsilon, keepdim):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Reference: nn/functional/distance.py pairwise_distance."""
+    return apply("pairwise_distance", _pairwise_distance_impl,
+                 (wrap(x), wrap(y)),
+                 {"p": float(p), "epsilon": float(epsilon),
+                  "keepdim": bool(keepdim)})
+
+
+def _pdist_impl(x, *, p):
+    n = x.shape[0]
+    iu, ju = np.triu_indices(n, k=1)
+    d = x[jnp.asarray(iu)] - x[jnp.asarray(ju)]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, -1))
+    return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of row vectors (upper triangle).
+
+    Reference: nn/functional/distance.py pdist."""
+    return apply("pdist", _pdist_impl, (wrap(x),), {"p": float(p)})
+
+
+# ---------------------------------------------------------------------------
+# specialty losses
+# ---------------------------------------------------------------------------
+
+def _dice_loss_impl(x, label, *, epsilon):
+    label_oh = jax.nn.one_hot(label.squeeze(-1), x.shape[-1], dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label_oh, reduce_dims)
+    union = jnp.sum(x, reduce_dims) + jnp.sum(label_oh, reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference: nn/functional/loss.py dice_loss."""
+    return apply("dice_loss", _dice_loss_impl, (wrap(input), wrap(label)),
+                 {"epsilon": float(epsilon)})
+
+
+def _gaussian_nll_impl(input, label, variance, *, full, epsilon, reduction):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * np.log(2 * np.pi)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Reference: nn/functional/loss.py gaussian_nll_loss."""
+    return apply("gaussian_nll_loss", _gaussian_nll_impl,
+                 (wrap(input), wrap(label), wrap(variance)),
+                 {"full": bool(full), "epsilon": float(epsilon),
+                  "reduction": reduction})
+
+
+def _sigmoid_focal_impl(logit, label, normalizer, *, alpha, gamma,
+                        reduction):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def _sigmoid_focal_nonorm_impl(lg, lb, *, alpha, gamma, reduction):
+    return _sigmoid_focal_impl(lg, lb, None, alpha=alpha, gamma=gamma,
+                               reduction=reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    """Reference: nn/functional/loss.py sigmoid_focal_loss."""
+    statics = {"alpha": float(alpha), "gamma": float(gamma),
+               "reduction": reduction}
+    if normalizer is not None:
+        return apply("sigmoid_focal_loss", _sigmoid_focal_impl,
+                     (wrap(logit), wrap(label), wrap(normalizer)), statics)
+    return apply("sigmoid_focal_loss", _sigmoid_focal_nonorm_impl,
+                 (wrap(logit), wrap(label)), statics)
+
+
+def _multi_margin_impl(input, label, *, p, margin, reduction):
+    n, c = input.shape
+    correct = jnp.take_along_axis(input, label[:, None], 1)
+    loss = jnp.maximum(0.0, margin - correct + input) ** p
+    loss = (jnp.sum(loss, 1) - margin ** p) / c  # subtract the y==label term
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Reference: nn/functional/loss.py multi_margin_loss."""
+    return apply("multi_margin_loss", _multi_margin_impl,
+                 (wrap(input), wrap(label)),
+                 {"p": int(p), "margin": float(margin),
+                  "reduction": reduction})
+
+
+def _npair_impl(anchor, positive, labels, *, l2_reg):
+    logits = anchor @ positive.T
+    labels = labels.reshape(-1)
+    eq = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    targets = eq / jnp.sum(eq, -1, keepdims=True)
+    logp = jax.nn.log_softmax(logits, -1)
+    xent = -jnp.mean(jnp.sum(targets * logp, -1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, -1))
+                    + jnp.mean(jnp.sum(positive * positive, -1))) * 0.25
+    return xent + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference: nn/functional/loss.py npair_loss."""
+    return apply("npair_loss", _npair_impl,
+                 (wrap(anchor), wrap(positive), wrap(labels)),
+                 {"l2_reg": float(l2_reg)})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Reference: nn/functional/loss.py triplet_margin_with_distance_loss."""
+    dist = distance_function or pairwise_distance
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_sw = dist(positive, negative)
+        d_neg = d_neg.minimum(d_sw)
+    from ...ops.math import maximum
+    loss = maximum(d_pos - d_neg + margin, wrap(0.0))
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def _hsigmoid_impl(x, lbl, w, tb, cd, bvec):
+    lbl = lbl.reshape(-1)
+    nodes = tb[lbl]                      # [N, D]
+    bits = cd[lbl]                       # [N, D]
+    valid = (nodes >= 0).astype(x.dtype)
+    nodes = jnp.maximum(nodes, 0)
+    wn = w[nodes]                        # [N, D, F]
+    logits = jnp.einsum("nf,ndf->nd", x, wn)
+    if bvec is not None:
+        logits = logits + bvec.reshape(-1)[nodes]
+    # bit==1 → sigmoid(logit), bit==0 → 1-sigmoid(logit)
+    lp = -jax.nn.log_sigmoid(jnp.where(bits > 0.5, logits, -logits))
+    return jnp.sum(lp * valid, -1, keepdims=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (or custom
+    paths). Reference: nn/functional/loss.py hsigmoid_loss.
+
+    Default tree: Huffman-free complete binary tree over num_classes leaves,
+    matching the reference's default coding (bit i of (label + num_classes)
+    walking up)."""
+    input, label = wrap(input), wrap(label)
+    weight = wrap(weight)
+    C = int(num_classes)
+    depth = max(1, int(np.ceil(np.log2(max(C, 2)))))
+    if path_table is None:
+        # complete-binary-tree paths: internal node ids 0..C-2
+        tbl = np.full((C, depth), -1, np.int32)
+        code = np.zeros((C, depth), np.float32)
+        for c in range(C):
+            node = c + C  # leaf position in implicit heap
+            d = 0
+            path, bits = [], []
+            while node > 1 and d < depth:
+                bits.append(node & 1)
+                node >>= 1
+                path.append(node - 1)  # internal node id
+                d += 1
+            for i, (pnode, bit) in enumerate(zip(reversed(path),
+                                                 reversed(bits))):
+                tbl[c, i] = pnode
+                code[c, i] = float(bit)
+        path_table = tbl
+        path_code = code
+    tbl = wrap(np.asarray(path_table, np.int32) if not isinstance(
+        path_table, Tensor) else path_table)
+    code = wrap(np.asarray(path_code, np.float32) if not isinstance(
+        path_code, Tensor) else path_code)
+    args = [input, label, weight, tbl, code,
+            wrap(bias) if bias is not None else None]
+    return apply("hsigmoid_loss", _hsigmoid_impl, args)
+
+
+def _margin_ce_impl(logits, label, *, m1, m2, m3, scale, return_softmax):
+    # ArcFace-family margin: cos(m1*theta + m2) - m3 on the target logit
+    theta = jnp.arccos(jnp.clip(logits, -1.0, 1.0))
+    target = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    marg = jnp.cos(m1 * theta + m2) - m3
+    out = jnp.where(target > 0, marg, logits) * scale
+    logp = jax.nn.log_softmax(out, -1)
+    loss = -jnp.sum(target * logp, -1, keepdims=True)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace margin softmax cross-entropy (single-group form;
+    the model-parallel path shards the class dim via mp_layers).
+
+    Reference: nn/functional/loss.py margin_cross_entropy."""
+    out = apply("margin_cross_entropy", _margin_ce_impl,
+                (wrap(logits), wrap(label)),
+                {"m1": float(margin1), "m2": float(margin2),
+                 "m3": float(margin3), "scale": float(scale),
+                 "return_softmax": bool(return_softmax)})
+    loss = out[0] if return_softmax else out
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, out[1]) if return_softmax else loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T (transducer) loss via the standard log-alpha DP, compiled as a
+    lax.scan over time with an in-row scan over labels.
+
+    Reference: nn/functional/loss.py rnnt_loss (warprnnt kernel
+    phi/kernels/cpu/rnnt_loss_kernel.cc)."""
+    acts = wrap(input)       # [B, T, U+1, V] logits
+    labels = wrap(label)     # [B, U] int
+    tlen = wrap(input_lengths)
+    ulen = wrap(label_lengths)
+
+    def impl(a, lb, tl, ul, *, blank, reduction):
+        logp = jax.nn.log_softmax(a, -1)
+        B, T, U1, V = logp.shape
+        neg_inf = jnp.array(-1e30, logp.dtype)
+        u_ar = jnp.arange(U1)
+
+        lb_pad = jnp.concatenate(
+            [lb.astype(jnp.int32),
+             jnp.zeros((B, 1), jnp.int32)], axis=1)[:, :U1]
+
+        # per-sample label emission logp: [B, T, U+1]
+        emit = jnp.take_along_axis(
+            logp, lb_pad[:, None, :, None], axis=3)[..., 0]
+        blk = logp[..., blank]                       # [B, T, U+1]
+
+        def step(alpha, t):
+            # alpha: [B, U+1] log-prob at time t-1
+            # move right in t: blank from alpha[t-1, u]
+            from_blank = alpha + blk[:, t - 1, :]
+            # then fold in label moves within the row sequentially.
+            def u_step(carry, u):
+                prev = carry  # alpha_t at u-1, [B]
+                cur = jnp.where(
+                    u == 0, from_blank[:, 0],
+                    jnp.logaddexp(from_blank[:, u],
+                                  prev + emit[:, t, u - 1]))
+                return cur, cur
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), neg_inf), u_ar)
+            new_alpha = jnp.swapaxes(cols, 0, 1)  # [B, U+1]
+            return new_alpha, None
+
+        # t = 0 row: only label moves from alpha[0,0]=0
+        def u0_step(carry, u):
+            prev = carry
+            cur = jnp.where(u == 0, 0.0, prev + emit[:, 0, u - 1])
+            return cur, cur
+        _, cols0 = jax.lax.scan(u0_step, jnp.zeros((B,), logp.dtype), u_ar)
+        alpha0 = jnp.swapaxes(cols0, 0, 1)
+
+        # collect every time row so per-utterance lengths can gather theirs
+        def step_collect(alpha, t):
+            new_alpha, _ = step(alpha, t)
+            return new_alpha, new_alpha
+        _, alphas = jax.lax.scan(step_collect, alpha0, jnp.arange(1, T))
+        alphas = jnp.concatenate([alpha0[None], alphas], 0)  # [T, B, U+1]
+        bi = jnp.arange(B)
+        a_end = alphas[tl - 1, bi, ul]                       # [B]
+        ll = a_end + blk[bi, tl - 1, ul]
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("rnnt_loss", impl, (acts, labels, tlen, ulen),
+                 {"blank": int(blank), "reduction": reduction})
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance between int sequences (host-side DP — a metric,
+    not a differentiable op; the reference CPU kernel is host-side too).
+
+    Reference: nn/functional/loss.py edit_distance
+    (phi/kernels/cpu/edit_distance_kernel.cc)."""
+    a = np.asarray(wrap(input)._value)
+    b = np.asarray(wrap(label)._value)
+    alen = (np.asarray(wrap(input_length)._value) if input_length is not None
+            else np.full(a.shape[0], a.shape[1]))
+    blen = (np.asarray(wrap(label_length)._value) if label_length is not None
+            else np.full(b.shape[0], b.shape[1]))
+    dists = np.zeros((a.shape[0], 1), np.float32)
+    for i in range(a.shape[0]):
+        s = [int(v) for v in a[i, :int(alen[i])]]
+        t = [int(v) for v in b[i, :int(blen[i])]]
+        if ignored_tokens:
+            s = [v for v in s if v not in ignored_tokens]
+            t = [v for v in t if v not in ignored_tokens]
+        m, n = len(s), len(t)
+        dp = list(range(n + 1))
+        for r in range(1, m + 1):
+            prev = dp[0]
+            dp[0] = r
+            for c in range(1, n + 1):
+                cur = dp[c]
+                dp[c] = min(dp[c] + 1, dp[c - 1] + 1,
+                            prev + (s[r - 1] != t[c - 1]))
+                prev = cur
+        d = float(dp[n])
+        if normalized and n > 0:
+            d /= n
+        dists[i, 0] = d
+    seq_num = Tensor(jnp.asarray([a.shape[0]], jnp.int64))
+    return Tensor(jnp.asarray(dists)), seq_num
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers plus all positive classes; remap labels.
+
+    Reference: nn/functional/common.py class_center_sample. Host-side
+    sampling (label-dependent set ops don't jit); returns (remapped_label,
+    sampled_class_index)."""
+    lbl = np.asarray(wrap(label)._value).astype(np.int64)
+    pos = np.unique(lbl)
+    n_extra = max(0, int(num_samples) - pos.size)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.RandomState(len(pos) + int(lbl.sum()) % 9973)
+    neg = rng.choice(rest, size=min(n_extra, rest.size), replace=False) \
+        if n_extra > 0 and rest.size else np.empty(0, np.int64)
+    sampled = np.concatenate([pos, np.sort(neg)]).astype(np.int64)
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.vectorize(lambda c: remap[c])(lbl).astype(np.int64)
+    return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled)))

@@ -1,0 +1,166 @@
+"""nn.utils — gradient clipping helpers, parameter vector transforms,
+weight/spectral norm reparameterizations.
+
+Reference: python/paddle/nn/utils/ (clip_grad_norm_.py, clip_grad_value_.py,
+transform_parameters.py, weight_norm_hook.py, spectral_norm_hook.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Clip gradients in place by global norm; returns the total norm
+    (reference: nn/utils/clip_grad_norm_.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.abs(g._value).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value) ** norm_type) for g in grads])
+        ) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"The total norm of {norm_type} order of the gradients is "
+            "non-finite, so it cannot be clipped")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._value = g._value * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clip gradient values in place to [-clip_value, clip_value]
+    (reference: nn/utils/clip_grad_value_.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -cv, cv)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one vector
+    (reference: nn/utils/transform_parameters.py)."""
+    return Tensor(jnp.concatenate(
+        [p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write a flat vector back into parameters (in place)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape or (1,)))
+        p._value = v[off:off + n].reshape(tuple(p.shape)).astype(
+            p._value.dtype)
+        off += n
+    return parameters
+
+
+def _norm_except_dim(w, dim):
+    if dim == -1:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.<name>` as g * v/||v|| (reference:
+    nn/utils/weight_norm_hook.py). The recompute runs in a pre-forward
+    hook so the jitted step sees the composed weight."""
+    from .layer.layers import Parameter
+
+    w = getattr(layer, name)
+    dim = dim if dim is not None else -1
+    g = Parameter(_norm_except_dim(w._value, dim))
+    v = Parameter(w._value)
+    layer._parameters.pop(name, None)
+    layer._parameters[name + "_g"] = g
+    layer._parameters[name + "_v"] = v
+
+    def _recompute(lyr, inputs):
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        composed = vv * (gg / Tensor(_norm_except_dim(vv._value, dim)))
+        object.__setattr__(lyr, name, composed)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_handle = (handle, name, dim)
+    _recompute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Undo weight_norm, folding g*v/||v|| back into a single parameter."""
+    handle, nm, dim = layer._weight_norm_handle
+    handle.remove()
+    from .layer.layers import Parameter
+
+    v = getattr(layer, nm + "_v")
+    g = getattr(layer, nm + "_g")
+    composed = v * (g / Tensor(_norm_except_dim(v._value, dim)))
+    layer._parameters.pop(nm + "_g", None)
+    layer._parameters.pop(nm + "_v", None)
+    layer.__dict__.pop(nm, None)  # drop the composed plain-tensor attr
+    layer._parameters[nm] = Parameter(composed._value)
+    del layer._weight_norm_handle
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, dim=0,
+                  eps=1e-12):
+    """Spectral normalization W / sigma_max(W) via power iteration
+    (reference: nn/utils/spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    wm = w._value
+    if dim != 0:
+        perm = [dim] + [d for d in range(wm.ndim) if d != dim]
+        wm = jnp.transpose(wm, perm)
+    h = wm.shape[0]
+    wmat = wm.reshape(h, -1)
+    rng = np.random.RandomState(0)
+    u = jnp.asarray(rng.randn(h).astype(np.float32))
+    v = jnp.asarray(rng.randn(wmat.shape[1]).astype(np.float32))
+    from .layer.layers import Parameter
+
+    layer._parameters.pop(name, None)
+    orig = Parameter(w._value)
+    layer._parameters[name + "_orig"] = orig
+    state = {"u": u / jnp.linalg.norm(u), "v": v / jnp.linalg.norm(v)}
+
+    def _recompute(lyr, inputs):
+        wt = getattr(lyr, name + "_orig")._value
+        wmt = wt
+        if dim != 0:
+            wmt = jnp.transpose(wt, perm)
+        mat = wmt.reshape(h, -1)
+        uu, vv = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            vv = mat.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = mat @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        state["u"], state["v"] = uu, vv
+        sigma = uu @ mat @ vv
+        object.__setattr__(lyr, name,
+                           getattr(lyr, name + "_orig") / Tensor(sigma))
+        return inputs
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, ())
+    return layer

@@ -115,3 +115,160 @@ def _bind_tensor_methods():
 
 _install_operators()
 _bind_tensor_methods()
+
+
+# ---------------------------------------------------------------------------
+# Schema registry: migrate the hand-written surface, generate the long tail
+# (reference: ops.yaml + api_gen.py; see schema.py)
+# ---------------------------------------------------------------------------
+
+from . import schema as _schema  # noqa: E402
+from . import extra as _extra  # noqa: E402  (defop rows self-register)
+
+_AUTOREG_SKIP = {"apply", "wrap", "unary_op", "binary_op", "norm_axis",
+                 "static_dtype", "Tensor", "to_tensor", "seed",
+                 "get_rng_state", "set_rng_state"}
+for _mod, _cat in ((_math, "math"), (_creation, "creation"),
+                   (_manip, "manipulation"), (_reduction, "reduction"),
+                   (_linalg, "linalg"), (_logic, "logic"),
+                   (random, "random")):
+    _schema.autoregister_module(_mod, _cat, skip=_AUTOREG_SKIP)
+_schema.register_op("to_tensor", _creation.to_tensor, category="creation",
+                    tensor_method=False)
+
+# In-place variants owed by the reference surface (ops.yaml `inplace:` rows /
+# python/paddle/tensor generate_inplace_fn) whose base op exists but whose
+# in-place spelling was never generated.
+_REF_INPLACE = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
+    "remainder", "cast", "scale", "clip", "tril", "triu", "t", "squeeze",
+    "unsqueeze", "flatten", "reshape", "masked_fill", "lerp",
+    "gcd", "lcm", "hypot", "logit", "cumsum", "cumprod", "nan_to_num",
+    "put_along_axis", "scatter", "index_add", "addmm", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "equal", "not_equal", "greater_than",
+    "greater_equal", "less_than", "less_equal",
+]
+def _find_spec(name):
+    spec = _schema.OPS.get(name)
+    if spec is not None:
+        return spec
+    for s in _schema.OPS.values():
+        if name in s.aliases:
+            return s
+    return None
+
+
+for _n in _REF_INPLACE:
+    _spec = _find_spec(_n)
+    if _spec is not None and _spec.inplace_fn is None:
+        _spec.inplace_fn = _schema.make_inplace(_spec.fn, _spec.name)
+
+# where_ mutates x (the second arg), not the condition — make_inplace's
+# first-arg convention doesn't apply (reference: paddle.where_)
+def _where_(condition, x, y, name=None):
+    _spec = _find_spec("where")
+    out = _spec.fn(condition, x, y)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+_where_.__name__ = "where_"
+_wspec = _find_spec("where")
+if _wspec is not None and _wspec.inplace_fn is None:
+    _wspec.inplace_fn = _where_
+
+# alias in-place spellings (reference exposes both, e.g. remainder_ == mod_)
+_INPLACE_ALIASES = {"remainder_": "mod", "floor_mod_": "mod", "mod_": "mod"}
+
+
+def _zero_(x):
+    """Zero the tensor in place (reference: paddle.Tensor.zero_)."""
+    x._value = jnp.zeros_like(x._value)
+    x._grad_node = None
+    x._out_idx = 0
+    return x
+
+
+def _fill_(x, value):
+    """Fill the tensor with a scalar in place (reference: paddle.fill_)."""
+    x._value = jnp.full_like(x._value, value)
+    x._grad_node = None
+    x._out_idx = 0
+    return x
+
+
+_schema.register_op("zero", _zero_, category="creation",
+                    tensor_method=False).inplace_fn = _zero_
+_schema.register_op("fill", _fill_, category="creation",
+                    tensor_method=False).inplace_fn = _fill_
+
+
+def _export_registry():
+    """Generate the public surface from the registry: module globals (star-
+    imported into `paddle_tpu`) + Tensor methods."""
+    g = globals()
+    for spec in _schema.OPS.values():
+        names = [(spec.name, spec.fn)]
+        names += [(a, spec.fn) for a in spec.aliases]
+        if spec.inplace_fn is not None:
+            names.append((spec.name + "_", spec.inplace_fn))
+        for nm, fn in names:
+            g.setdefault(nm, fn)
+            if spec.tensor_method and getattr(Tensor, nm, None) is None:
+                setattr(Tensor, nm, fn)
+    for alias, base in _INPLACE_ALIASES.items():
+        spec = _find_spec(base)
+        if spec is not None and spec.inplace_fn is not None:
+            g.setdefault(alias, spec.inplace_fn)
+            if getattr(Tensor, alias, None) is None:
+                setattr(Tensor, alias, spec.inplace_fn)
+    # Tensor in-place methods are bound even for non-method base ops where
+    # the reference patches them (e.g. Tensor.zero_()).
+    for nm in ("zero_", "fill_"):
+        if getattr(Tensor, nm, None) is None:
+            setattr(Tensor, nm, g[nm])
+
+
+_export_registry()
+
+
+def register_namespaces():
+    """Pull the non-tensor namespaces (nn.functional, linalg, fft, signal,
+    sparse) into the registry so the whole public op surface is schema-
+    tracked (≈ ops.yaml's fused/sparse/strings sections). Deferred: nn
+    imports ops, so this runs after the package finishes importing
+    (called at the end of paddle_tpu/__init__)."""
+    import importlib
+
+    for modname, cat in (("..nn.functional", "nn.functional"),
+                         ("..linalg", "linalg"), ("..fft", "fft"),
+                         ("..signal", "signal"), ("..sparse", "sparse"),
+                         ("..sparse.nn", "sparse.nn"),
+                         ("..vision.ops", "vision.ops"),
+                         ("..audio.functional", "audio.functional"),
+                         ("..nn.utils", "nn.utils"),
+                         ("..incubate", "incubate"),
+                         ("..geometric", "geometric"),
+                         ("..strings", "strings"),
+                         ("..incubate.nn_functional",
+                          "incubate.nn.functional")):
+        try:
+            mod = importlib.import_module(modname, __name__)
+        except ImportError:
+            continue
+        for n in dir(mod):
+            if n.startswith("_") or n in _AUTOREG_SKIP:
+                continue
+            fn = getattr(mod, n)
+            if not callable(fn) or isinstance(fn, type) \
+                    or getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            qual = f"{cat}.{n}"
+            if qual not in _schema.OPS and n not in _schema.OPS:
+                _schema.register_op(qual, fn, category=cat,
+                                    module=f"paddle.{cat}",
+                                    tensor_method=False)

@@ -1,0 +1,919 @@
+"""Long-tail ops, declared as schema rows (one `defop`/`register_op` call
+per op).
+
+Reference surface: python/paddle/tensor/manipulation.py (stack/split/scatter
+family), math.py (special-function tail), linalg.py, einsum helpers and
+search ops. Implementations are pure jnp/lax — each lowers to a handful of
+XLA HLO ops and fuses; nothing here needs a custom kernel.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._helpers import apply, wrap, Tensor, norm_axis
+from .schema import defop, register_op, make_inplace, OPS
+
+
+def _s(shape, seed=0, dtype="float32"):
+    rng = np.random.RandomState(seed)
+    if dtype.startswith("int"):
+        return rng.randint(0, 8, shape).astype(dtype)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# stack / split family (reference: python/paddle/tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+
+def _multi_in(name, jfn, doc, sample=None, np_ref=None):
+    """Ops taking a list of tensors (hstack family)."""
+    def impl(*arrs):
+        return jfn(list(arrs))
+
+    impl.__name__ = f"_{name}_impl"
+    impl.__qualname__ = impl.__name__
+
+    def op(x, name=None):
+        return apply(_n, impl, [wrap(t) for t in x])
+
+    _n = name
+    op.__name__ = name
+    op.__doc__ = doc
+    register_op(name, op, category="manipulation", generated=True,
+                sample=sample, np_ref=np_ref, tensor_method=False)
+    return op
+
+hstack = _multi_in("hstack", jnp.hstack,
+                   "Stack tensors horizontally (column-wise).",
+                   sample=lambda: (([_s((3, 2)), _s((3, 4), 1)],), {}),
+                   np_ref=lambda xs: np.hstack(xs))
+vstack = _multi_in("vstack", jnp.vstack,
+                   "Stack tensors vertically (row-wise).",
+                   sample=lambda: (([_s((2, 3)), _s((4, 3), 1)],), {}),
+                   np_ref=lambda xs: np.vstack(xs))
+dstack = _multi_in("dstack", jnp.dstack,
+                   "Stack tensors along the third axis.",
+                   sample=lambda: (([_s((2, 3)), _s((2, 3), 1)],), {}),
+                   np_ref=lambda xs: np.dstack(xs))
+column_stack = _multi_in("column_stack", jnp.column_stack,
+                         "Stack 1-D tensors as columns of a 2-D tensor.",
+                         sample=lambda: (([_s((4,)), _s((4,), 1)],), {}),
+                         np_ref=lambda xs: np.column_stack(xs))
+OPS["vstack"].aliases = ("row_stack",)
+row_stack = vstack
+
+add_n = _multi_in("add_n", lambda xs: sum(xs[1:], xs[0]),
+                  "Elementwise sum of a list of tensors "
+                  "(reference: python/paddle/tensor/math.py add_n).",
+                  sample=lambda: (([_s((3, 4)), _s((3, 4), 1)],), {}),
+                  np_ref=lambda xs: np.add.reduce(xs))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Split into sub-tensors along `axis` (uneven allowed, numpy
+    array_split semantics). Reference: tensor/manipulation.py tensor_split."""
+    x = wrap(x)
+    axis = int(axis)
+    n = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, rem = divmod(n, k)
+        sizes = [base + 1] * rem + [base] * (k - rem)
+    else:
+        idx = [0] + [int(i) for i in num_or_indices] + [n]
+        sizes = [b - a for a, b in zip(idx[:-1], idx[1:])]
+    from .manipulation import split
+    return split(x, sizes, axis=axis)
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = wrap(x)
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+for _nm, _f in (("tensor_split", tensor_split), ("hsplit", hsplit),
+                ("vsplit", vsplit), ("dsplit", dsplit)):
+    register_op(_nm, _f, category="manipulation", generated=True,
+                tensor_method=(_nm == "tensor_split"))
+
+
+def _atleast(nd):
+    jfn = {1: jnp.atleast_1d, 2: jnp.atleast_2d, 3: jnp.atleast_3d}[nd]
+
+    def op(*inputs, name=None):
+        outs = [Tensor(jfn(wrap(t)._value)) for t in inputs]
+        return outs if len(outs) > 1 else outs[0]
+
+    op.__name__ = f"atleast_{nd}d"
+    op.__doc__ = f"View each input with at least {nd} dimensions."
+    register_op(op.__name__, op, category="manipulation", generated=True,
+                tensor_method=False)
+    return op
+
+
+atleast_1d = _atleast(1)
+atleast_2d = _atleast(2)
+atleast_3d = _atleast(3)
+
+
+# ---------------------------------------------------------------------------
+# indexing / scatter family
+# ---------------------------------------------------------------------------
+
+take = defop(
+    "take", "x, index, mode='raise'",
+    lambda x, index, *, mode: jnp.take(
+        x.ravel(), index, mode={"raise": "clip", "wrap": "wrap",
+                                "clip": "clip"}[mode]),
+    statics=("mode",), category="indexing",
+    ref="python/paddle/tensor/math.py take",
+    doc="Gather from the flattened tensor by integer index "
+        "(mode raise/wrap/clip; 'raise' clamps under jit).",
+    sample=lambda: ((_s((3, 4)), _s((5,), 1, "int32")), {}),
+    np_ref=lambda x, i: np.take(x.ravel(), np.clip(i, -x.size, x.size - 1)))
+
+index_sample = defop(
+    "index_sample", "x, index",
+    lambda x, index: jnp.take_along_axis(x, index, axis=1),
+    category="indexing", ref="python/paddle/tensor/search.py index_sample",
+    doc="Per-row gather: out[i, j] = x[i, index[i, j]].",
+    sample=lambda: ((_s((3, 8)), _s((3, 4), 1, "int32")), {}),
+    np_ref=lambda x, i: np.take_along_axis(x, i, axis=1))
+
+index_fill = defop(
+    "index_fill", "x, index, axis, value",
+    lambda x, index, value, *, axis: x.at[
+        tuple([slice(None)] * axis + [index])].set(value),
+    statics=("axis",), inplace=True, category="indexing",
+    ref="python/paddle/tensor/manipulation.py index_fill",
+    doc="Fill slices selected by `index` along `axis` with a scalar.",
+    sample=lambda: ((_s((4, 5)), np.array([0, 2]), 0, 1.5), {}),
+    np_ref=lambda x, i, axis, v: _np_index_fill(x, i, axis, v))
+
+
+def _np_index_fill(x, index, axis, value):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    out[tuple(sl)] = value
+    return out
+
+
+def _index_put_impl(x, value, *indices, accumulate):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """Scatter `value` at positions given by the tuple of index tensors
+    (reference: tensor/manipulation.py index_put)."""
+    x, value = wrap(x), wrap(value)
+    return apply("index_put", _index_put_impl,
+                 [x, value] + [wrap(i) for i in indices],
+                 statics={"accumulate": bool(accumulate)})
+
+
+register_op("index_put", index_put, category="indexing", generated=True,
+            sample=lambda: ((_s((4, 5)), (np.array([0, 2]), np.array([1, 3])),
+                             np.array([9.0, 7.0], "float32")), {}),
+            np_ref=lambda x, idx, v: _np_index_put(x, idx, v))
+OPS["index_put"].inplace_fn = make_inplace(index_put, "index_put")
+
+
+def _np_index_put(x, idx, v):
+    out = x.copy()
+    out[tuple(idx)] = v
+    return out
+
+
+select_scatter = defop(
+    "select_scatter", "x, values, axis, index",
+    lambda x, values, *, axis, index: x.at[
+        tuple([slice(None)] * axis + [index])].set(values),
+    statics=("axis", "index"), category="indexing",
+    ref="python/paddle/tensor/manipulation.py select_scatter",
+    doc="Embed `values` into x at position `index` of dimension `axis`.",
+    sample=lambda: ((_s((3, 4)), _s((4,), 1)), {"axis": 0, "index": 1}),
+    np_ref=lambda x, v, axis, index: _np_select_scatter(x, v, axis, index))
+
+
+def _np_select_scatter(x, v, axis, index):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    out[tuple(sl)] = v
+    return out
+
+
+def _slice_scatter_impl(x, value, *, axes, starts, ends, strides):
+    sl = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax] = slice(st, en, sr)
+    return x.at[tuple(sl)].set(value)
+
+
+slice_scatter = defop(
+    "slice_scatter", "x, value, axes=(), starts=(), ends=(), strides=()",
+    _slice_scatter_impl, statics=("axes", "starts", "ends", "strides"),
+    category="indexing",
+    ref="python/paddle/tensor/manipulation.py slice_scatter",
+    doc="Embed `value` into the strided slice of x.",
+    sample=lambda: ((_s((6, 4)), _s((2, 4), 1)),
+                    {"axes": [0], "starts": [1], "ends": [5],
+                     "strides": [2]}),
+    np_ref=lambda x, v, axes, starts, ends, strides: _np_slice_scatter(
+        x, v, axes, starts, ends, strides))
+
+
+def _np_slice_scatter(x, v, axes, starts, ends, strides):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax] = slice(st, en, sr)
+    out[tuple(sl)] = v
+    return out
+
+
+def _diagonal_scatter_impl(x, y, *, offset, axis1, axis2):
+    x2 = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    m, n = x2.shape[-2], x2.shape[-1]
+    L = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+    i = jnp.arange(L)
+    rows = i - min(offset, 0)
+    cols = i + max(offset, 0)
+    x2 = x2.at[..., rows, cols].set(y)
+    return jnp.moveaxis(x2, (-2, -1), (axis1, axis2))
+
+
+diagonal_scatter = defop(
+    "diagonal_scatter", "x, y, offset=0, axis1=0, axis2=1",
+    _diagonal_scatter_impl, statics=("offset", "axis1", "axis2"),
+    category="indexing",
+    ref="python/paddle/tensor/manipulation.py diagonal_scatter",
+    doc="Embed `y` along the (offset) diagonal of x over (axis1, axis2).",
+    sample=lambda: ((_s((4, 5)), _s((4,), 1)),
+                    {"offset": 0, "axis1": 0, "axis2": 1}),
+    np_ref=lambda x, y, offset, axis1, axis2: _np_diag_scatter(
+        x, y, offset, axis1, axis2))
+
+
+def _np_diag_scatter(x, y, offset, axis1, axis2):
+    out = np.moveaxis(x.copy(), (axis1, axis2), (-2, -1))
+    m, n = out.shape[-2:]
+    L = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+    i = np.arange(L)
+    out[..., i - min(offset, 0), i + max(offset, 0)] = y
+    return np.moveaxis(out, (-2, -1), (axis1, axis2))
+
+
+fill_diagonal_tensor = defop(
+    "fill_diagonal_tensor", "x, y, offset=0, dim1=0, dim2=1",
+    lambda x, y, *, offset, dim1, dim2: _diagonal_scatter_impl(
+        x, y, offset=offset, axis1=dim1, axis2=dim2),
+    statics=("offset", "dim1", "dim2"), inplace=True, category="indexing",
+    ref="python/paddle/tensor/manipulation.py fill_diagonal_tensor",
+    doc="Fill the (offset) diagonal of x over (dim1, dim2) with tensor y.",
+    sample=lambda: ((_s((4, 5)), _s((4,), 1)),
+                    {"offset": 0, "dim1": 0, "dim2": 1}),
+    np_ref=lambda x, y, offset, dim1, dim2: _np_diag_scatter(
+        x, y, offset, dim1, dim2))
+
+fill_diagonal = defop(
+    "fill_diagonal", "x, value, offset=0, wrap=False",
+    lambda x, *, value, offset, wrap: _fill_diag_impl(x, value, offset, wrap),
+    statics=("value", "offset", "wrap"), inplace=True, category="indexing",
+    ref="python/paddle/tensor/manipulation.py fill_diagonal_",
+    doc="Fill the main diagonal with a scalar "
+        "(`wrap` re-wraps on tall matrices).",
+    sample=lambda: ((_s((4, 4)),), {"value": 7.0}),
+    np_ref=lambda x, value, offset=0, wrap=False: _np_fill_diag(
+        x, value, offset, wrap))
+
+
+def _fill_diag_impl(x, value, offset, wrap):
+    m, n = x.shape[-2], x.shape[-1]
+    if wrap and x.ndim == 2 and m > n:
+        # wrap semantics: the diagonal restarts every n+1 rows
+        rows = np.arange(m)
+        rows = rows[(rows % (n + 1)) != n]
+        return x.at[rows, rows % (n + 1)].set(value)
+    L = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+    i = jnp.arange(L)
+    return x.at[..., i - min(offset, 0), i + max(offset, 0)].set(value)
+
+
+def _np_fill_diag(x, value, offset, wrap):
+    out = x.copy()
+    np.fill_diagonal(out, value, wrap=wrap)
+    return out
+
+
+def _masked_scatter_impl(x, mask, value):
+    mask = jnp.broadcast_to(mask, x.shape)
+    flat_mask = mask.ravel()
+    pos = jnp.cumsum(flat_mask) - 1
+    src = value.ravel()
+    gathered = src[jnp.clip(pos, 0, src.shape[0] - 1)]
+    return jnp.where(flat_mask, gathered, x.ravel()).reshape(x.shape)
+
+
+masked_scatter = defop(
+    "masked_scatter", "x, mask, value", _masked_scatter_impl,
+    inplace=True, category="indexing",
+    ref="python/paddle/tensor/manipulation.py masked_scatter",
+    doc="Copy elements of `value` (in order) into x where mask is True.",
+    sample=lambda: ((_s((3, 4)), _s((3, 4), 1) > 0, _s((12,), 2)), {}),
+    np_ref=lambda x, m, v: _np_masked_scatter(x, m, v))
+
+
+def _np_masked_scatter(x, mask, value):
+    out = x.copy()
+    out[mask] = value.ravel()[: int(mask.sum())]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shape / window / layout
+# ---------------------------------------------------------------------------
+
+def _unflatten_impl(x, *, axis, sizes):
+    shape = x.shape[:axis] + tuple(sizes) + x.shape[axis + 1:]
+    return x.reshape(shape)
+
+
+unflatten = defop(
+    "unflatten", "x, axis, shape", lambda x, *, axis, shape: _unflatten_impl(
+        x, axis=axis, sizes=shape),
+    statics=("axis", "shape"), category="manipulation",
+    ref="python/paddle/tensor/manipulation.py unflatten",
+    doc="Expand one dimension into the given shape (may contain one -1).",
+    sample=lambda: ((_s((2, 12)),), {"axis": 1, "shape": (3, 4)}),
+    np_ref=lambda x, axis, shape: x.reshape(
+        x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]))
+
+
+def _unfold_impl(x, *, axis, size, step):
+    n = x.shape[axis]
+    starts = np.arange(0, n - size + 1, step)
+    idx = starts[:, None] + np.arange(size)[None, :]
+    out = jnp.take(x, jnp.asarray(idx), axis=axis)
+    # take inserts (W, size) at `axis`; reference puts the window last
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+unfold = defop(
+    "unfold", "x, axis, size, step", _unfold_impl,
+    statics=("axis", "size", "step"), category="manipulation",
+    ref="python/paddle/tensor/manipulation.py unfold",
+    doc="Sliding windows of `size` every `step` along `axis` "
+        "(window dim appended last).",
+    sample=lambda: ((_s((8,)),), {"axis": 0, "size": 3, "step": 2}),
+    np_ref=lambda x, axis, size, step: np.moveaxis(
+        np.take(x, np.arange(0, x.shape[axis] - size + 1, step)[:, None]
+                + np.arange(size)[None, :], axis=axis), axis + 1, -1))
+
+
+def _as_strided_impl(x, *, shape, stride, offset):
+    flat = x.ravel()
+    idx = np.full(tuple(shape), offset, dtype=np.int64)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        ix = np.arange(s) * st
+        idx = idx + ix.reshape((-1,) + (1,) * (len(shape) - d - 1))
+    return flat[jnp.asarray(idx)]
+
+
+as_strided = defop(
+    "as_strided", "x, shape, stride, offset=0", _as_strided_impl,
+    statics=("shape", "stride", "offset"), category="manipulation",
+    ref="python/paddle/tensor/manipulation.py as_strided",
+    doc="Strided view (materialized gather on TPU — XLA has no aliased "
+        "strides; the gather fuses and costs one pass of HBM reads).",
+    sample=lambda: ((_s((12,)),), {"shape": (3, 4), "stride": (4, 1)}),
+    np_ref=lambda x, shape, stride, offset=0: np.lib.stride_tricks.as_strided(
+        x.ravel()[offset:], shape, [s * x.itemsize for s in stride]).copy())
+
+
+def view(x, shape_or_dtype, name=None):
+    """Zero-copy reshape/dtype-bitcast view (XLA reshapes are free).
+    Reference: tensor/manipulation.py view."""
+    x = wrap(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        from .manipulation import reshape
+        return reshape(x, shape_or_dtype)
+    from .creation import cast
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    from .manipulation import reshape
+    return reshape(wrap(x), wrap(other).shape)
+
+
+register_op("view", view, category="manipulation", generated=True)
+register_op("view_as", view_as, category="manipulation", generated=True)
+
+
+def _combinations_impl(x, *, r, with_replacement):
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.array(list(gen(range(n), r)), dtype=np.int64)
+    if idx.size == 0:
+        idx = idx.reshape(0, r)
+    return x[jnp.asarray(idx)]
+
+
+combinations = defop(
+    "combinations", "x, r=2, with_replacement=False", _combinations_impl,
+    statics=("r", "with_replacement"), category="manipulation",
+    ref="python/paddle/tensor/math.py combinations",
+    doc="All length-r combinations of a 1-D tensor's elements.",
+    sample=lambda: ((_s((5,)),), {"r": 2}),
+    np_ref=lambda x, r=2, with_replacement=False: x[
+        np.array(list((itertools.combinations_with_replacement
+                       if with_replacement else itertools.combinations)(
+                           range(x.shape[0]), r)), dtype=np.int64)])
+
+vander = defop(
+    "vander", "x, n=None, increasing=False",
+    lambda x, *, n, increasing: jnp.vander(x, n, increasing=increasing),
+    statics=("n", "increasing"), category="linalg",
+    ref="python/paddle/tensor/creation.py vander",
+    doc="Vandermonde matrix.",
+    sample=lambda: ((_s((4,)),), {"n": 3}),
+    np_ref=lambda x, n=None, increasing=False: np.vander(x, n, increasing),
+    tol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# math long tail
+# ---------------------------------------------------------------------------
+
+sgn = defop(
+    "sgn", "x",
+    lambda x: (jnp.where(x == 0, 0, x / jnp.abs(x))
+               if jnp.issubdtype(x.dtype, jnp.complexfloating)
+               else jnp.sign(x)),
+    category="unary", ref="python/paddle/tensor/math.py sgn",
+    doc="Sign for real; x/|x| for complex.",
+    sample=lambda: ((_s((3, 4)),), {}), np_ref=np.sign)
+
+signbit = defop(
+    "signbit", "x", lambda x: jnp.signbit(x), category="unary",
+    ref="python/paddle/tensor/math.py signbit",
+    doc="True where the sign bit is set.",
+    sample=lambda: ((_s((3, 4)),), {}), np_ref=np.signbit)
+
+frexp = defop(
+    "frexp", "x", lambda x: jnp.frexp(x), category="unary",
+    ref="python/paddle/tensor/math.py frexp",
+    doc="Decompose into mantissa and exponent (two outputs).")
+
+ldexp = defop(
+    "ldexp", "x, y", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)),
+    inplace=True, category="binary",
+    ref="python/paddle/tensor/math.py ldexp",
+    doc="x * 2**y.",
+    sample=lambda: ((_s((3,)), _s((3,), 1, "int32")), {}),
+    np_ref=lambda x, y: np.ldexp(x, y))
+
+polygamma = defop(
+    "polygamma", "x, n",
+    lambda x, *, n: jax.scipy.special.polygamma(n, x), statics=("n",),
+    inplace=True, category="unary",
+    ref="python/paddle/tensor/math.py polygamma",
+    doc="n-th derivative of digamma.",
+    sample=lambda: ((np.abs(_s((3, 4))) + 0.5,), {"n": 1}),
+    np_ref=None, tol=1e-3)
+
+multigammaln = defop(
+    "multigammaln", "x, p",
+    lambda x, *, p: jax.scipy.special.multigammaln(x, p), statics=("p",),
+    inplace=True, category="unary",
+    ref="python/paddle/tensor/math.py multigammaln",
+    doc="Log of the multivariate gamma function.",
+    sample=lambda: ((np.abs(_s((3,))) + 3.0,), {"p": 2}),
+    np_ref=None, tol=1e-3)
+
+
+def _trapezoid_impl(y, x, *, dx, axis):
+    if x is not None:
+        return jnp.trapezoid(y, x, axis=axis)
+    return jnp.trapezoid(y, dx=dx, axis=axis)
+
+
+trapezoid = defop(
+    "trapezoid", "y, x=None, dx=None, axis=-1",
+    lambda y, x, *, dx, axis: _trapezoid_impl(
+        y, x, dx=1.0 if dx is None else dx, axis=axis),
+    statics=("dx", "axis"), category="reduction",
+    ref="python/paddle/tensor/math.py trapezoid",
+    doc="Trapezoidal-rule integral along an axis.",
+    sample=lambda: ((_s((3, 8)), None), {"dx": 0.5}),
+    np_ref=lambda y, x=None, dx=0.5, axis=-1: np.trapz(
+        y, x, dx=dx, axis=axis))
+
+
+def _cumulative_trapezoid_impl(y, x, *, dx, axis):
+    y1 = jax.lax.slice_in_dim(y, 1, None, axis=axis)
+    y0 = jax.lax.slice_in_dim(y, 0, -1, axis=axis)
+    if x is not None:
+        if x.ndim == 1:
+            d = jnp.diff(x)
+            shape = [1] * y.ndim
+            shape[axis] = d.shape[0]
+            d = d.reshape(shape)
+        else:
+            d = (jax.lax.slice_in_dim(x, 1, None, axis=axis)
+                 - jax.lax.slice_in_dim(x, 0, -1, axis=axis))
+    else:
+        d = dx
+    return jnp.cumsum((y0 + y1) * d / 2.0, axis=axis)
+
+
+cumulative_trapezoid = defop(
+    "cumulative_trapezoid", "y, x=None, dx=None, axis=-1",
+    lambda y, x, *, dx, axis: _cumulative_trapezoid_impl(
+        y, x, dx=1.0 if dx is None else dx, axis=axis),
+    statics=("dx", "axis"), category="reduction",
+    ref="python/paddle/tensor/math.py cumulative_trapezoid",
+    doc="Cumulative trapezoidal-rule integral along an axis.",
+    sample=lambda: ((_s((3, 8)), None), {"dx": 0.5}))
+
+nanquantile = defop(
+    "nanquantile", "x, q, axis=None, keepdim=False",
+    lambda x, *, q, axis, keepdim: jnp.nanquantile(
+        x, jnp.asarray(q), axis=axis, keepdims=keepdim),
+    statics=("q", "axis", "keepdim"), category="reduction",
+    ref="python/paddle/tensor/stat.py nanquantile",
+    doc="Quantile ignoring NaNs.",
+    sample=lambda: ((_s((4, 6)),), {"q": 0.5, "axis": 1}),
+    np_ref=lambda x, q, axis=None, keepdim=False: np.nanquantile(
+        x, q, axis=axis, keepdims=keepdim), tol=1e-4)
+
+cdist = defop(
+    "cdist", "x, y, p=2.0",
+    lambda x, y, *, p: _cdist_impl(x, y, p),
+    statics=("p",), category="linalg",
+    ref="python/paddle/tensor/linalg.py cdist",
+    doc="Pairwise p-norm distances between row vectors of two batches.",
+    sample=lambda: ((_s((5, 3)), _s((4, 3), 1)), {"p": 2.0}),
+    np_ref=lambda x, y, p=2.0: np.linalg.norm(
+        x[..., :, None, :] - y[..., None, :, :], ord=None, axis=-1)
+    if p == 2.0 else None, tol=1e-4)
+
+
+def _cdist_impl(x, y, p):
+    d = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, axis=-1) + 0.0)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d), axis=-1)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+
+def _histogramdd_impl(x, weights, *, bins, ranges, density):
+    kw = {}
+    if ranges is not None:
+        lo = np.asarray(ranges, np.float64).reshape(-1, 2)
+        kw["range"] = [tuple(r) for r in lo]
+    h, edges = jnp.histogramdd(x, bins=bins, weights=weights,
+                               density=density, **kw)
+    return (h,) + tuple(edges)
+
+
+histogramdd = defop(
+    "histogramdd", "x, bins=10, ranges=None, density=False, weights=None",
+    lambda x, weights, *, bins, ranges, density: _histogramdd_impl(
+        x, weights, bins=bins, ranges=ranges, density=density),
+    statics=("bins", "ranges", "density"), category="reduction",
+    ref="python/paddle/tensor/linalg.py histogramdd",
+    doc="N-dimensional histogram; returns (hist, edges...).",
+    tensor_method=False)
+
+renorm = defop(
+    "renorm", "x, p, axis, max_norm",
+    lambda x, *, p, axis, max_norm: _renorm_impl(x, p, axis, max_norm),
+    statics=("p", "axis", "max_norm"), inplace=True, category="linalg",
+    ref="python/paddle/tensor/math.py renorm",
+    doc="Renormalize slices along `axis` whose p-norm exceeds max_norm.",
+    sample=lambda: ((_s((4, 5)),), {"p": 2.0, "axis": 0, "max_norm": 1.0}))
+
+
+def _renorm_impl(x, p, axis, max_norm):
+    dims = tuple(d for d in range(x.ndim) if d != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+rollaxis = defop(
+    "rollaxis", "x, axis, start=0",
+    lambda x, *, axis, start: jnp.rollaxis(x, axis, start),
+    statics=("axis", "start"), category="manipulation",
+    doc="numpy-style rollaxis (moveaxis is the preferred spelling).",
+    sample=lambda: ((_s((2, 3, 4)),), {"axis": 2}),
+    np_ref=lambda x, axis, start=0: np.rollaxis(x, axis, start))
+
+baddbmm = defop(
+    "baddbmm", "input, x, y, beta=1.0, alpha=1.0",
+    lambda input, x, y, *, beta, alpha: beta * input + alpha * jnp.matmul(
+        x, y),
+    statics=("beta", "alpha"), category="linalg",
+    ref="python/paddle/tensor/math.py addmm (batched variant)",
+    doc="beta*input + alpha*(x @ y) over batched matrices.",
+    sample=lambda: ((_s((2, 3, 5)), _s((2, 3, 4), 1), _s((2, 4, 5), 2)), {}),
+    np_ref=lambda inp, x, y, beta=1.0, alpha=1.0: beta * inp
+    + alpha * np.matmul(x, y), tol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# complex / dtype predicates / misc
+# ---------------------------------------------------------------------------
+
+as_complex = defop(
+    "as_complex", "x", lambda x: jax.lax.complex(x[..., 0], x[..., 1]),
+    category="unary", ref="python/paddle/tensor/manipulation.py as_complex",
+    doc="View a trailing-2 float tensor as complex.",
+    sample=lambda: ((_s((3, 4, 2)),), {}),
+    np_ref=lambda x: x[..., 0] + 1j * x[..., 1])
+
+as_real = defop(
+    "as_real", "x", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], -1),
+    category="unary", ref="python/paddle/tensor/manipulation.py as_real",
+    doc="View a complex tensor as float with trailing dim 2.")
+
+
+def is_complex(x):
+    return jnp.issubdtype(wrap(x)._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(wrap(x)._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(wrap(x)._value.dtype, jnp.integer)
+
+
+for _nm, _f in (("is_complex", is_complex),
+                ("is_floating_point", is_floating_point),
+                ("is_integer", is_integer)):
+    register_op(_nm, _f, category="logic", generated=True)
+
+
+def numel(x, name=None):
+    """Element count, as a 0-D int64 Tensor (reference: tensor/stat.py)."""
+    return Tensor(jnp.asarray(int(np.prod(wrap(x).shape or (1,))),
+                              jnp.int64 if jax.config.jax_enable_x64
+                              else jnp.int32))
+
+
+def rank(x, name=None):
+    """Tensor of the input's ndim (reference: tensor/attribute.py rank)."""
+    return Tensor(jnp.asarray(wrap(x).ndim, jnp.int32))
+
+
+def shape(x, name=None):
+    """Runtime shape as a 1-D int32 Tensor (reference: paddle.shape)."""
+    return Tensor(jnp.asarray(wrap(x).shape, jnp.int32))
+
+
+def tolist(x):
+    """Nested python list of the tensor's values."""
+    return np.asarray(wrap(x)._value).tolist()
+
+
+for _nm, _f in (("numel", numel), ("rank", rank), ("shape", shape),
+                ("tolist", tolist)):
+    register_op(_nm, _f, category="attribute", generated=True,
+                tensor_method=(_nm in ("tolist", "numel")))
+
+
+# ---------------------------------------------------------------------------
+# linalg tail
+# ---------------------------------------------------------------------------
+
+def _lu_unpack_impl(lu_data, lu_pivots, *, unpack_ludata, unpack_pivots):
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    outs = []
+    if unpack_pivots:
+        nb = lu_pivots.shape[:-1]
+        npiv = lu_pivots.shape[-1]
+        perm = jnp.broadcast_to(jnp.arange(m), nb + (m,)).astype(jnp.int32)
+        ar = jnp.arange(m)
+        for i in range(npiv):
+            j = lu_pivots[..., i].astype(jnp.int32) - 1  # LAPACK: 1-indexed
+            pi = perm[..., i]
+            pj = jnp.take_along_axis(perm, j[..., None], -1)[..., 0]
+            perm = jnp.where(ar == j[..., None], pi[..., None], perm)
+            perm = perm.at[..., i].set(pj)
+        # P[perm[i], i] = 1  (row-permutation matrix: P @ L @ U = A)
+        P = jnp.swapaxes(jax.nn.one_hot(perm, m, dtype=lu_data.dtype),
+                         -2, -1)
+        outs.append(P)
+    else:
+        outs.append(jnp.zeros(()))
+    if unpack_ludata:
+        L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(
+            m, k, dtype=lu_data.dtype)
+        U = jnp.triu(lu_data[..., :k, :])
+        outs.extend([L, U])
+    return tuple(outs)
+
+
+lu_unpack = defop(
+    "lu_unpack", "x, y, unpack_ludata=True, unpack_pivots=True",
+    lambda x, y, *, unpack_ludata, unpack_pivots: _lu_unpack_impl(
+        x, y, unpack_ludata=unpack_ludata, unpack_pivots=unpack_pivots),
+    statics=("unpack_ludata", "unpack_pivots"), category="linalg",
+    ref="python/paddle/tensor/linalg.py lu_unpack",
+    doc="Unpack paddle.linalg.lu output into (P, L, U).",
+    tensor_method=False)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Principal components via (truncated) SVD.
+
+    Reference: python/paddle/tensor/linalg.py pca_lowrank. Computes the
+    exact SVD and truncates to q components — on TPU the full SVD of the
+    covariance factor is cheap relative to a randomized sketch for the
+    matrix sizes this API sees.
+    """
+    x = wrap(x)
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+
+    def impl(a, *, q, center):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -2, -1)[..., :q]
+
+    return apply("pca_lowrank", impl, [x],
+                 statics={"q": int(q), "center": bool(center)})
+
+
+register_op("pca_lowrank", pca_lowrank, category="linalg", generated=True,
+            tensor_method=False)
+
+
+# ---------------------------------------------------------------------------
+# TensorArray + static-graph creation helpers
+# (reference: paddle/phi/core/tensor_array.h, python/paddle/tensor/array.py,
+#  tensor/creation.py create_*)
+# ---------------------------------------------------------------------------
+
+class TensorArray(list):
+    """Dynamic tensor list (reference: phi TensorArray — in the TPU build a
+    host-side list; inside jit, use lax.scan-carried stacks instead)."""
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Reference: python/paddle/tensor/array.py create_array."""
+    arr = TensorArray()
+    if initialized_list:
+        arr.extend(wrap(t) for t in initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """Reference: tensor/array.py array_write."""
+    if array is None:
+        array = TensorArray()
+    i = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    while len(array) <= i:
+        array.append(None)
+    array[i] = wrap(x)
+    return array
+
+
+def array_read(array, i):
+    """Reference: tensor/array.py array_read."""
+    i = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    return array[i]
+
+
+def array_length(array):
+    """Reference: tensor/array.py array_length."""
+    return Tensor(jnp.asarray(len(array), jnp.int32))
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
+    """Reference: tensor/manipulation.py tensor_array_to_tensor."""
+    ts = [wrap(t) for t in input if t is not None]
+    from .manipulation import stack as _stack, concat as _concat
+    out = _stack(ts, axis=axis) if use_stack else _concat(ts, axis=axis)
+    sizes = Tensor(jnp.asarray(
+        [1 if use_stack else t.shape[axis] for t in ts], jnp.int32))
+    return out, sizes
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Reference: tensor/creation.py create_tensor — an empty typed slot."""
+    from ..core import dtype as dtypes
+    return Tensor(jnp.zeros((0,), dtypes.convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Reference: tensor/creation.py create_parameter."""
+    from ..nn.layer.layers import Layer
+    holder = Layer()
+    p = holder.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Reference: tensor/creation.py create_global_var."""
+    from ..core import dtype as dtypes
+    return Tensor(jnp.full(tuple(shape), value,
+                           dtypes.convert_dtype(dtype)))
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Reference: tensor/creation.py fill_constant (alias of full)."""
+    from .creation import full
+    return full(shape, value, dtype=dtype)
+
+
+for _nm, _f in (("create_array", create_array),
+                ("array_write", array_write), ("array_read", array_read),
+                ("array_length", array_length),
+                ("tensor_array_to_tensor", tensor_array_to_tensor),
+                ("create_tensor", create_tensor),
+                ("create_parameter", create_parameter),
+                ("create_global_var", create_global_var),
+                ("fill_constant", fill_constant)):
+    register_op(_nm, _f, category="creation", generated=True,
+                tensor_method=False)
+
+
+# ---------------------------------------------------------------------------
+# einops-style rearrange + print options
+# ---------------------------------------------------------------------------
+
+def rearrange(tensor, pattern, **axes_lengths):
+    """einops rearrange over Tensors (reference:
+    python/paddle/tensor/einsum.py rearrange, itself einops-backed)."""
+    import einops
+    if isinstance(tensor, (list, tuple)):
+        arrs = [wrap(t)._value for t in tensor]
+        return Tensor(einops.rearrange(arrs, pattern, **axes_lengths))
+    return Tensor(einops.rearrange(wrap(tensor)._value, pattern,
+                                   **axes_lengths))
+
+
+register_op("rearrange", rearrange, category="manipulation", generated=True,
+            tensor_method=False,
+            sample=lambda: ((_s((2, 3, 4)), "b c d -> b (c d)"), {}),
+            np_ref=lambda x, p: x.reshape(2, 12))
+
+
+_PRINTOPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+              "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: python/paddle/tensor/to_string.py set_printoptions."""
+    kw = {}
+    if precision is not None:
+        _PRINTOPTS["precision"] = precision
+        kw["precision"] = precision
+    if threshold is not None:
+        _PRINTOPTS["threshold"] = threshold
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        _PRINTOPTS["edgeitems"] = edgeitems
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        _PRINTOPTS["linewidth"] = linewidth
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        _PRINTOPTS["sci_mode"] = sci_mode
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+register_op("set_printoptions", set_printoptions, category="attribute",
+            generated=True, tensor_method=False)

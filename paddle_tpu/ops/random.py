@@ -77,6 +77,16 @@ def next_key():
     return sub
 
 
+def _fill_value(x, value):
+    """In-place random fill: replace storage AND detach from any stale
+    producer node (the tape would otherwise backprop through a producer
+    whose output no longer matches x)."""
+    x._value = value
+    x._grad_node = None
+    x._out_idx = 0
+    return x
+
+
 def _resolve(dtype):
     d = dtypes.convert_dtype(dtype)
     return d if d is not None else dtypes.get_default_dtype()
@@ -100,9 +110,9 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    x._value = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
-                                  minval=float(min), maxval=float(max))
-    return x
+    return _fill_value(x, jax.random.uniform(
+        next_key(), tuple(x.shape), x.dtype,
+        minval=float(min), maxval=float(max)))
 
 
 def randn(shape, dtype=None, name=None):
@@ -122,8 +132,8 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 
 def normal_(x, mean=0.0, std=1.0, name=None):
-    x._value = jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean
-    return x
+    return _fill_value(x, jax.random.normal(
+        next_key(), tuple(x.shape), x.dtype) * std + mean)
 
 
 def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
@@ -176,8 +186,8 @@ def bernoulli(x, name=None):
 
 
 def bernoulli_(x, p=0.5, name=None):
-    x._value = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x.dtype)
-    return x
+    return _fill_value(x, jax.random.bernoulli(
+        next_key(), p, tuple(x.shape)).astype(x.dtype))
 
 
 def poisson(x, name=None):
@@ -192,8 +202,8 @@ def binomial(count, prob, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
-    x._value = jax.random.exponential(next_key(), tuple(x.shape), x.dtype) / lam
-    return x
+    return _fill_value(x, jax.random.exponential(
+        next_key(), tuple(x.shape), x.dtype) / lam)
 
 
 def rand_like(x, dtype=None, name=None):
@@ -204,3 +214,63 @@ def rand_like(x, dtype=None, name=None):
 def randn_like(x, dtype=None, name=None):
     xx = wrap(x)
     return randn(tuple(xx.shape), dtype or xx.dtype)
+
+
+def gaussian_(x, mean=0.0, std=1.0, seed=0, name=None):
+    """In-place gaussian fill (reference: tensor/random.py gaussian_)."""
+    return normal_(x, mean=mean, std=std)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place Cauchy fill: loc + scale*tan(pi*(U-1/2))
+    (reference: tensor/random.py cauchy_)."""
+    u = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    return _fill_value(x, loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+
+
+def geometric_(x, probs, name=None):
+    """In-place geometric fill (number of Bernoulli(p) trials to first
+    success; reference: tensor/random.py geometric_)."""
+    p = wrap(probs)._value if isinstance(probs, Tensor) else float(probs)
+    u = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    return _fill_value(x, jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(x.dtype))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place log-normal fill (reference: tensor/random.py log_normal_)."""
+    return _fill_value(x, jnp.exp(
+        jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Log-normal samples (reference: tensor/random.py log_normal)."""
+    out = gaussian(shape if shape is not None else [1], mean=0.0, std=1.0)
+    return Tensor(jnp.exp(out._value * std + mean))
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over the last axis of logits/probs.
+
+    Reference: python/paddle/tensor/random.py top_p_sampling (CUDA kernel
+    phi/kernels/gpu/top_p_sampling_kernel.cu). Returns (scores, ids)."""
+    xx = wrap(x)
+    probs = xx._value
+    ps_v = wrap(ps)._value if isinstance(ps, Tensor) else jnp.full(
+        (probs.shape[0],), float(ps))
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, sort_idx, -1)
+    cum = jnp.cumsum(sorted_p, -1)
+    keep = cum - sorted_p <= ps_v[:, None]   # always keep the top token
+    masked = jnp.where(keep, sorted_p, 0.0)
+    masked = masked / jnp.maximum(masked.sum(-1, keepdims=True), 1e-9)
+    choice = jax.random.categorical(next_key(),
+                                    jnp.log(jnp.maximum(masked, 1e-30)),
+                                    axis=-1)
+    ids = jnp.take_along_axis(sort_idx, choice[:, None], -1)
+    scores = jnp.take_along_axis(probs, ids, -1)
+    return Tensor(scores), Tensor(ids.astype(jnp.int64 if
+                                             jax.config.jax_enable_x64
+                                             else jnp.int32))

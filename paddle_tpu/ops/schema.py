@@ -1,0 +1,234 @@
+"""Declarative op schema registry — the single source of truth for the
+public op surface.
+
+Reference analog: the YAML op schema + generators
+(/root/reference/paddle/phi/api/yaml/ops.yaml:8-17,
+ /root/reference/paddle/phi/api/yaml/generator/api_gen.py): one declarative
+row per op drives the generated API, autograd glue, docs, and tests. Here a
+row is an `OpSpec`; the "codegen" target is Python itself:
+
+  * `defop(...)` stamps a public eager wrapper from a signature string +
+    a pure-JAX impl (dispatched through `core.dispatch.apply`, so it gets
+    the per-op jit cache, AMP hooks, the autograd tape, and profiling for
+    free — `jax.grad` supplies the VJP, no backward yaml needed);
+  * in-place `name_` variants are generated from the same row
+    (≈ ops.yaml `inplace:` entries);
+  * Tensor-method binding and namespace export are driven by the row;
+  * `tests/test_op_schema.py` walks the registry and checks every row with
+    a `sample`/`np_ref` against numpy — the OpTest analog
+    (/root/reference/test/legacy_test/op_test.py:420).
+
+Existing hand-written ops are migrated by `autoregister_module`, which
+captures them as rows (so the registry covers the whole surface), while new
+long-tail ops are added as fully declarative rows (ops/extra.py) — the
+marginal cost of a new op is one `defop` call.
+"""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+__all__ = ["OpSpec", "OPS", "register_op", "defop", "make_inplace",
+           "autoregister_module", "public_op_count", "attach_sample"]
+
+
+@dataclass
+class OpSpec:
+    """One schema row (≈ one ops.yaml entry)."""
+    name: str
+    fn: Callable
+    category: str = "misc"         # unary/binary/reduction/manipulation/...
+    module: str = "paddle"         # export namespace (dotted)
+    aliases: tuple = ()            # extra public names for fn
+    inplace_fn: Optional[Callable] = None   # the generated `name_` variant
+    tensor_method: bool = True     # bind as Tensor.<name>
+    ref: str = ""                  # reference file:line parity citation
+    sample: Optional[Callable] = None       # () -> (args, kwargs)
+    np_ref: Optional[Callable] = None       # numpy reference implementation
+    tol: float = 1e-5
+    generated: bool = False        # True if stamped by defop (vs migrated)
+
+    @property
+    def public_names(self):
+        n = [self.name] + list(self.aliases)
+        if self.inplace_fn is not None:
+            n.append(self.name + "_")
+        return n
+
+
+# name -> OpSpec. Insertion-ordered; name collisions keep the first
+# registration (explicit rows are registered before module auto-scan).
+OPS: dict = {}
+
+
+def register_op(name, fn, **kw) -> OpSpec:
+    if name in OPS:
+        return OPS[name]
+    spec = OpSpec(name=name, fn=fn, **kw)
+    OPS[name] = spec
+    return spec
+
+
+def attach_sample(name, sample, np_ref=None, tol=None):
+    """Attach a parity-test sample to an already-registered (migrated) op."""
+    spec = OPS.get(name)
+    if spec is None:
+        raise KeyError(f"op '{name}' is not registered")
+    spec.sample = sample
+    if np_ref is not None:
+        spec.np_ref = np_ref
+    if tol is not None:
+        spec.tol = tol
+    return spec
+
+
+def make_inplace(op, name=None):
+    """Generate the `op_` in-place variant (≈ ops.yaml `inplace:` rows).
+
+    Functional world: compute out-of-place, then redirect the input
+    Tensor's storage/tape pointers at the result — observationally
+    in-place, still autograd-correct (the tape node holds the original
+    input arrays).
+    """
+    def op_(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        x._value = out._value
+        x._grad_node = out._grad_node
+        x._out_idx = out._out_idx
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    op_.__name__ = (name or op.__name__) + "_"
+    op_.__qualname__ = op_.__name__
+    op_.__doc__ = f"In-place variant of `{op.__name__}` (writes back into x)."
+    return op_
+
+
+def _parse_sig(sig: str):
+    """Parse a mini signature string: "x, index, axis=None, mode='raise'".
+
+    Returns list of (name, default) where default is `inspect._empty` for
+    required params.
+    """
+    params = []
+    if not sig.strip():
+        return params
+    for part in sig.split(","):
+        part = part.strip()
+        if "=" in part:
+            pname, default = part.split("=", 1)
+            params.append((pname.strip(), eval(default.strip(), {}, {})))  # noqa: S307 — literals only, authored in-tree
+        else:
+            params.append((part, inspect.Parameter.empty))
+    return params
+
+
+def _hashable_static(v):
+    if isinstance(v, list):
+        return tuple(_hashable_static(x) for x in v)
+    if isinstance(v, tuple):
+        return tuple(_hashable_static(x) for x in v)
+    return v
+
+
+def defop(name, sig, impl, *, statics=(), module="paddle", aliases=(),
+          inplace=False, tensor_method=True, category="misc", ref="",
+          doc="", sample=None, np_ref=None, tol=1e-5, n_outs=1):
+    """Declarative op row: stamp the public wrapper from a schema entry.
+
+    Args:
+      name: public op name.
+      sig: signature string, e.g. "x, index, axis=0, mode='raise'".
+      impl: pure-JAX function taking the tensor params positionally (as
+        arrays) followed by the static params as keywords.
+      statics: names of params passed as non-traced statics (hashable).
+      inplace: also generate + register the `name_` variant.
+      sample/np_ref/tol: parity-test row (see tests/test_op_schema.py).
+
+    Returns the public wrapper (and registers everything).
+    """
+    from ._helpers import apply, wrap
+
+    params = _parse_sig(sig)
+    static_set = set(statics)
+    tensor_params = [p for p, _ in params if p not in static_set]
+    pnames = [p for p, _ in params]
+    defaults = {p: d for p, d in params if d is not inspect.Parameter.empty}
+
+    def op(*args, **kwargs):
+        bound = dict(defaults)
+        for pname, val in zip(pnames, args):
+            bound[pname] = val
+        for k, v in kwargs.items():
+            if k == "name":      # reference APIs accept a cosmetic name=
+                continue
+            if k not in pnames:
+                raise TypeError(f"{name}() got unexpected kwarg '{k}'")
+            bound[k] = v
+        missing = [p for p in pnames if p not in bound]
+        if missing:
+            raise TypeError(f"{name}() missing required args: {missing}")
+        tensors = []
+        for p in tensor_params:
+            v = bound[p]
+            tensors.append(wrap(v) if v is not None else None)
+        st = {p: _hashable_static(bound[p]) for p in static_set if p in bound}
+        return apply(name, impl, tensors, statics=st)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    cite = f"\n\nReference: {ref}" if ref else ""
+    op.__doc__ = (doc or f"`{name}` — schema-generated op.") + cite
+
+    spec = register_op(
+        name, op, category=category, module=module, aliases=tuple(aliases),
+        tensor_method=tensor_method, ref=ref, sample=sample, np_ref=np_ref,
+        tol=tol, generated=True)
+    if inplace:
+        spec.inplace_fn = make_inplace(op, name)
+    return op
+
+
+def autoregister_module(mod, category, module="paddle", skip=()):
+    """Migrate a hand-written op module into the registry.
+
+    Scans public callables; a trailing-underscore name whose base exists in
+    the same module is recorded as that base op's in-place variant rather
+    than its own row.
+    """
+    names = [n for n in dir(mod) if not n.startswith("_") and n not in skip]
+    callables = {}
+    for n in names:
+        fn = getattr(mod, n)
+        if callable(fn) and not isinstance(fn, type) \
+                and not inspect.ismodule(fn):
+            callables[n] = fn
+
+    # pass 1: base ops (alias detection: same function object, later name)
+    seen_fn = {}
+    for n, fn in callables.items():
+        if n.endswith("_") and n[:-1] in callables:
+            continue
+        key = id(fn)
+        if key in seen_fn:
+            base = OPS.get(seen_fn[key])
+            if base is not None and n not in base.public_names \
+                    and n not in OPS:
+                base.aliases = base.aliases + (n,)
+            continue
+        seen_fn[key] = n
+        register_op(n, fn, category=category, module=module)
+
+    # pass 2: in-place variants
+    for n, fn in callables.items():
+        if n.endswith("_") and n[:-1] in OPS:
+            spec = OPS[n[:-1]]
+            if spec.inplace_fn is None:
+                spec.inplace_fn = fn
+
+
+def public_op_count() -> int:
+    """Total public callables managed by the registry (base + aliases +
+    in-place variants)."""
+    return sum(len(s.public_names) for s in OPS.values())

@@ -2,6 +2,7 @@
 built on frame + fft)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -103,3 +104,69 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
                   "onesided": bool(onesided),
                   "norm": "ortho" if normalized else "backward",
                   "return_complex": bool(return_complex)})
+
+
+def _frame_impl(x, *, frame_length, hop_length, axis, trailing):
+    n = x.shape[axis]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    out = jnp.take(x, idx, axis=axis)
+    # take inserts (num, frame_length) at `axis`; the reference layout is
+    # [..., frame_length, num_frames] when the user said axis=-1 but
+    # [num_frames, frame_length, ...] when they said axis=0 — the literal
+    # axis value picks the layout (they coincide for 1-D inputs)
+    if trailing:
+        return jnp.swapaxes(out, axis, axis + 1)
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (reference: python/paddle/signal.py
+    frame — output [..., frame_length, num_frames] for axis=-1,
+    [num_frames, frame_length, ...] for axis=0)."""
+    from .ops._helpers import apply as _apply, wrap as _wrap
+    x = _wrap(x)
+    if int(axis) not in (0, -1, x.ndim - 1):
+        raise ValueError("frame supports axis 0 or -1")
+    return _apply("frame", _frame_impl, [x],
+                  {"frame_length": int(frame_length),
+                   "hop_length": int(hop_length),
+                   "axis": int(axis) % x.ndim,
+                   "trailing": int(axis) != 0})
+
+
+def _overlap_add_impl(x, *, hop_length, front):
+    # normalized input: [..., frame_length, num_frames]; `front` means the
+    # reconstructed axis goes to position 0 (reference axis=0 layout)
+    if front:
+        # [num_frames, frame_length, ...] -> [..., frame_length, num_frames]
+        x = jnp.moveaxis(x, (0, 1), (-1, -2))
+    xx = jnp.swapaxes(x, -1, -2)          # [..., num_frames, frame_length]
+    *batch, num, flen = xx.shape
+    n = (num - 1) * hop_length + flen
+    out = jnp.zeros(tuple(batch) + (n,), x.dtype)
+    for i in range(num):  # static frame count — unrolled, XLA fuses
+        seg = jax.lax.dynamic_slice_in_dim(out, i * hop_length, flen, -1)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, seg + xx[..., i, :], i * hop_length, -1)
+    if front:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from overlapping frames (reference:
+    python/paddle/signal.py overlap_add; axis=-1 input
+    [..., frame_length, num_frames], axis=0 input
+    [num_frames, frame_length, ...])."""
+    from .ops._helpers import apply as _apply, wrap as _wrap
+    x = _wrap(x)
+    ax = int(axis) % x.ndim
+    if ax not in (0, x.ndim - 1):
+        raise ValueError("overlap_add supports axis 0 or -1")
+    return _apply("overlap_add", _overlap_add_impl, [x],
+                  {"hop_length": int(hop_length), "front": ax == 0})
+
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]

@@ -291,3 +291,174 @@ def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
 
         out = out.astype(convert_dtype(dtype))
     return Tensor(out)
+
+
+# ---------------------------------------------------------------------------
+# unary tail (reference: python/paddle/sparse/unary.py — ops act on values,
+# indices unchanged; XLA fuses the value transform into one pass)
+# ---------------------------------------------------------------------------
+
+def asin(x):
+    return _unary(x, jnp.arcsin)
+
+
+def asinh(x):
+    return _unary(x, jnp.arcsinh)
+
+
+def atan(x):
+    return _unary(x, jnp.arctan)
+
+
+def atanh(x):
+    return _unary(x, jnp.arctanh)
+
+
+def sinh(x):
+    return _unary(x, jnp.sinh)
+
+
+def tan(x):
+    return _unary(x, jnp.tan)
+
+
+def square(x):
+    return _unary(x, jnp.square)
+
+
+def log1p(x):
+    return _unary(x, jnp.log1p)
+
+
+def expm1(x):
+    return _unary(x, jnp.expm1)
+
+
+def deg2rad(x):
+    return _unary(x, jnp.deg2rad)
+
+
+def rad2deg(x):
+    return _unary(x, jnp.rad2deg)
+
+
+def isnan(x):
+    return _unary(x, jnp.isnan)
+
+
+def relu6(x):
+    return _unary(x, jax.nn.relu6)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(x, lambda v: jax.nn.leaky_relu(v, negative_slope))
+
+
+def divide(x, y):
+    return _binary(x, y, jnp.divide)
+
+
+def coalesce(x):
+    """Merge duplicate indices (reference: sparse/unary.py coalesce)."""
+    x = _coo(x)
+    return SparseCooTensor(x._bcoo.sum_duplicates())
+
+
+def reshape(x, shape):
+    """Reference: sparse/unary.py reshape (COO index arithmetic)."""
+    x = _coo(x)
+    return _dense_to_coo(x._bcoo.todense().reshape(tuple(shape)))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001 — reference name
+    """Reference: sparse/unary.py slice."""
+    import builtins
+
+    x = _coo(x)
+    d = x._bcoo.todense()
+    sl = [builtins.slice(None)] * d.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(st, en)
+    return _dense_to_coo(d[tuple(sl)])
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector (reference: sparse/binary.py mv)."""
+    x = _coo(x)
+    return Tensor(x._bcoo @ _v(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) where x may be sparse
+    (reference: sparse/binary.py addmm)."""
+    xv = _coo(x)._bcoo if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else _v(x)
+    out = alpha * (xv @ _v(y)) + beta * _v(input)
+    return Tensor(out)
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over stored values only (reference:
+    sparse/nn/functional/activation.py softmax — empty slots are -inf).
+
+    TPU design: segment-softmax over the nnz row ids — three segment
+    reductions, no densification."""
+    x = _coo(x)
+    if axis not in (-1, x._bcoo.ndim - 1):
+        raise NotImplementedError("sparse softmax supports the last axis")
+    bc = x._bcoo.sum_duplicates()
+    idx = bc.indices          # [nnz, ndim]
+    vals = bc.data
+    nrows = int(np.prod(bc.shape[:-1]))
+    row_mult = np.cumprod((bc.shape[1:-1] + (1,))[::-1])[::-1]
+    rows = (idx[:, :-1] * jnp.asarray(row_mult.copy())).sum(-1)
+    mx = jax.ops.segment_max(vals, rows, num_segments=nrows)
+    ex = jnp.exp(vals - mx[rows])
+    den = jax.ops.segment_sum(ex, rows, num_segments=nrows)
+    return SparseCooTensor(jsparse.BCOO((ex / den[rows], idx),
+                                        shape=bc.shape))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None):
+    """Sparse-pattern attention: scores computed ONLY at sparse_mask's nnz
+    (SDDMM), segment-softmax per row, then SpMM with V.
+
+    Reference: python/paddle/sparse/nn/functional/transformer.py attention
+    (CUDA kernel phi/kernels/sparse/gpu/fused_attention_kernel.cu).
+    q/k/v: [B, H, S, D]; sparse_mask: SparseCsrTensor [B*H, S, S]."""
+    qv, kv, vv = _v(query), _v(key), _v(value)
+    B, H, S, D = qv.shape
+    m = _coo(sparse_mask)
+    idx = m._bcoo.indices            # [nnz, 3] (bh, row, col)
+    bh, r, c = idx[:, 0], idx[:, 1], idx[:, 2]
+    qf = qv.reshape(B * H, S, D)
+    kf = kv.reshape(B * H, S, D)
+    vf = vv.reshape(B * H, S, D)
+    scores = (qf[bh, r] * kf[bh, c]).sum(-1) / np.sqrt(D)
+    if key_padding_mask is not None:
+        scores = scores + _v(key_padding_mask).reshape(B, S)[bh // H, c]
+    if attn_mask is not None:
+        scores = scores + _v(attn_mask)[r, c]
+    rows = bh * S + r
+    nrows = B * H * S
+    mx = jax.ops.segment_max(scores, rows, num_segments=nrows)
+    ex = jnp.exp(scores - mx[rows])
+    den = jax.ops.segment_sum(ex, rows, num_segments=nrows)
+    p = ex / jnp.maximum(den[rows], 1e-9)
+    out = jax.ops.segment_sum(p[:, None] * vf[bh, c], rows,
+                              num_segments=nrows)
+    return Tensor(out.reshape(B, H, S, D))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: sparse pca_lowrank — densify then call the dense path."""
+    from ..ops.extra import pca_lowrank as _dense_pca
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else x
+    return _dense_pca(xd, q=q, center=center, niter=niter)
+
+from . import nn  # noqa: E402,F401  (sparse conv/pool layers + functional)
+from .nn import (  # noqa: E402,F401
+    conv2d, conv3d, subm_conv2d, subm_conv3d, max_pool3d,
+)

@@ -1,0 +1,359 @@
+"""paddle.sparse.nn — sparse conv/pool layers + functional.
+
+Reference: python/paddle/sparse/nn/ (Conv3D/SubmConv3D layer.py,
+functional/conv.py) over phi's sparse conv kernels
+(phi/kernels/sparse/gpu/conv_kernel.cu — gather-GEMM-scatter with a
+"rulebook" of (kernel-offset, in-site, out-site) triples).
+
+TPU design: the rulebook is built host-side with numpy (active-site sets
+are data-dependent — no static shapes to jit), then the compute is pure
+XLA: one gather + per-offset MXU matmul + segment-sum scatter. That is the
+same gather-GEMM-scatter scheme the CUDA kernel uses, with XLA fusing the
+scatter chain.  Layout NDHWC (reference sparse conv convention).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import SparseCooTensor, _coo, _unary, _v
+from jax.experimental import sparse as jsparse
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+    "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D",
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+]
+
+
+def _tupled(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _rulebook(coords, spatial, kernel, stride, padding, dilation, subm):
+    """Host-side rulebook: for each kernel offset, pairs of
+    (input-site row, output-site row). Returns (out_coords [M, ndim+1],
+    per-offset (in_rows, out_rows))."""
+    nd = len(kernel)
+    coords = np.asarray(coords)  # [nnz, 1+nd] (batch, spatial...)
+    in_map = {tuple(c): i for i, c in enumerate(coords.tolist())}
+
+    if subm:
+        out_coords = coords
+        out_map = in_map
+    else:
+        out_sites = {}
+        for c in coords.tolist():
+            b, sp = c[0], c[1:]
+            for off in np.ndindex(*kernel):
+                o = []
+                ok = True
+                for d in range(nd):
+                    v = sp[d] + padding[d] - off[d] * dilation[d]
+                    if v % stride[d] != 0:
+                        ok = False
+                        break
+                    v //= stride[d]
+                    if v < 0 or v >= (spatial[d] + 2 * padding[d]
+                                      - dilation[d] * (kernel[d] - 1)
+                                      - 1) // stride[d] + 1:
+                        ok = False
+                        break
+                    o.append(v)
+                if ok:
+                    out_sites.setdefault((b, *o), None)
+        out_coords = np.array(sorted(out_sites), np.int32).reshape(
+            -1, nd + 1)
+        out_map = {tuple(c): i for i, c in enumerate(out_coords.tolist())}
+
+    pairs = []
+    for off in np.ndindex(*kernel):
+        ins, outs = [], []
+        for i, c in enumerate(coords.tolist()):
+            b, sp = c[0], c[1:]
+            o = []
+            ok = True
+            for d in range(nd):
+                v = sp[d] + padding[d] - off[d] * dilation[d]
+                if v % stride[d] != 0:
+                    ok = False
+                    break
+                o.append(v // stride[d])
+            if not ok:
+                continue
+            key = (b, *o)
+            j = out_map.get(key)
+            if j is not None:
+                ins.append(i)
+                outs.append(j)
+        pairs.append((np.array(ins, np.int32), np.array(outs, np.int32)))
+    return out_coords, pairs
+
+
+def _sparse_conv(x, weight, bias, stride, padding, dilation, subm, nd):
+    """x: SparseCooTensor [N, *spatial, C_in]; weight [*kernel, C_in, C_out]
+    (reference layout)."""
+    x = _coo(x)
+    bc = x._bcoo.sum_duplicates()
+    coords = np.asarray(bc.indices)      # [nnz, 1+nd] — channel dim is dense
+    vals = bc.data                        # [nnz, C_in] (dense trailing dim)
+    if vals.ndim == 1:
+        raise ValueError(
+            "sparse conv expects a COO tensor with a dense channel dim "
+            "(shape [N, *spatial, C], n_sparse_dims = 1+spatial)")
+    w = _v(weight)
+    kernel = w.shape[:nd]
+    cin, cout = w.shape[nd], w.shape[nd + 1]
+    spatial = x.shape[1:1 + nd]
+    stride = _tupled(stride, nd)
+    padding = _tupled(padding, nd)
+    dilation = _tupled(dilation, nd)
+
+    out_coords, pairs = _rulebook(coords, spatial, kernel, stride, padding,
+                                  dilation, subm)
+    m = len(out_coords)
+    wk = w.reshape((-1, cin, cout))
+    out_vals = jnp.zeros((m, cout), vals.dtype)
+    for k, (ins, outs) in enumerate(pairs):
+        if len(ins) == 0:
+            continue
+        contrib = vals[jnp.asarray(ins)] @ wk[k]
+        out_vals = out_vals.at[jnp.asarray(outs)].add(contrib)
+    if bias is not None:
+        out_vals = out_vals + _v(bias)
+    out_spatial = tuple(
+        (spatial[d] + 2 * padding[d] - dilation[d] * (kernel[d] - 1) - 1)
+        // stride[d] + 1 for d in range(nd)) if not subm else tuple(spatial)
+    shape = (x.shape[0],) + out_spatial + (cout,)
+    return SparseCooTensor(jsparse.BCOO(
+        (out_vals, jnp.asarray(out_coords)), shape=shape))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D convolution (reference: sparse/nn/functional/conv.py
+    conv3d)."""
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        subm=False, nd=3)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: output sites == input sites
+    (reference: sparse/nn/functional/conv.py subm_conv3d)."""
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        subm=True, nd=3)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Sparse 2-D convolution (reference: sparse/nn/functional/conv.py)."""
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        subm=False, nd=2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        subm=True, nd=2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over active sites
+    (reference: sparse/nn/functional/pooling.py max_pool3d)."""
+    x = _coo(x)
+    bc = x._bcoo.sum_duplicates()
+    coords = np.asarray(bc.indices)
+    vals = bc.data
+    nd = 3
+    kernel = _tupled(kernel_size, nd)
+    stride = _tupled(stride if stride is not None else kernel_size, nd)
+    padding = _tupled(padding, nd)
+    out_coords, pairs = _rulebook(coords, x.shape[1:1 + nd], kernel, stride,
+                                  padding, (1, 1, 1), subm=False)
+    m = len(out_coords)
+    neg = jnp.full((m, vals.shape[-1]), -jnp.inf, vals.dtype)
+    out_vals = neg
+    for ins, outs in pairs:
+        if len(ins) == 0:
+            continue
+        seg = jax.ops.segment_max(vals[jnp.asarray(ins)],
+                                  jnp.asarray(outs), num_segments=m)
+        # segment_max fills empty segments with -inf for floats
+        out_vals = jnp.maximum(out_vals, seg)
+    out_spatial = tuple(
+        (x.shape[1 + d] + 2 * padding[d] - kernel[d]) // stride[d] + 1
+        for d in range(nd))
+    shape = (x.shape[0],) + out_spatial + (vals.shape[-1],)
+    return SparseCooTensor(jsparse.BCOO(
+        (out_vals, jnp.asarray(out_coords)), shape=shape))
+
+
+# ---------------------------------------------------------------------------
+# layers (reference: python/paddle/sparse/nn/layer/)
+# ---------------------------------------------------------------------------
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd, subm,
+                 stride=1, padding=0, dilation=1, groups=1, padding_mode=
+                 "zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        self._nd = nd
+        self._subm = subm
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        kernel = _tupled(kernel_size, nd)
+        self.weight = self.create_parameter(
+            list(kernel) + [in_channels, out_channels], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return _sparse_conv(x, self.weight, self.bias, self._stride,
+                            self._padding, self._dilation, self._subm,
+                            self._nd)
+
+
+class Conv2D(_ConvNd):
+    """Reference: sparse/nn/layer/conv.py Conv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, False,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, True,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class Conv3D(_ConvNd):
+    """Reference: sparse/nn/layer/conv.py Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, False,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, True,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class MaxPool3D(Layer):
+    """Reference: sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._k = kernel_size
+        self._s = stride
+        self._p = padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._k, self._s, self._p)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _unary(_coo(x), jax.nn.relu)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _unary(_coo(x), jax.nn.relu6)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _unary(_coo(x),
+                      lambda v: jax.nn.leaky_relu(v, self._slope))
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from . import softmax as _sp_softmax
+        return _sp_softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over stored values (reference: sparse/nn/layer/norm.py
+    BatchNorm — normalizes the dense channel dim of active sites only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn.initializer import Constant
+        self._eps = epsilon
+        self._momentum = momentum
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        x = _coo(x)
+        bc = x._bcoo
+        vals = bc.data
+        if self.training:
+            mean = vals.mean(0)
+            var = vals.var(0)
+            m = self._momentum
+            self._mean._value = m * self._mean._value + (1 - m) * mean
+            self._variance._value = (m * self._variance._value
+                                     + (1 - m) * var)
+        else:
+            mean = self._mean._value
+            var = self._variance._value
+        out = ((vals - mean) * jax.lax.rsqrt(var + self._eps)
+               * self.weight._value + self.bias._value)
+        return SparseCooTensor(jsparse.BCOO((out, bc.indices),
+                                            shape=bc.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm: under pjit/GSPMD batch stats are already
+    global (the mean/var lower to psums over the data axis), so the single-
+    program implementation IS the sync variant (reference:
+    sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer

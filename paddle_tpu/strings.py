@@ -1,0 +1,90 @@
+"""String-tensor ops (reference: paddle/phi/kernels/strings/ —
+strings_empty, strings_lower_upper over StringTensor,
+paddle/phi/core/string_tensor.h).
+
+TPU design note: strings never touch the device — the reference keeps
+StringTensor on host for CPU kernels too. Here a StringTensor is a thin
+wrapper over a numpy object array; ops are vectorized host transforms used
+by data pipelines (tokenizers feed int ids to the device)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "lower", "upper", "copy"]
+
+
+class StringTensor:
+    """Host-side tensor of variable-length UTF-8 strings
+    (reference: paddle/phi/core/string_tensor.h:31)."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        self._data = np.asarray(data, dtype=object)
+        self.name = name or "string_tensor"
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return bool(np.all(self._data == other))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def to_string_tensor(data, name=None):
+    return StringTensor(data, name)
+
+
+def empty(shape, name=None):
+    """Uninitialized (empty-string) StringTensor of the given shape
+    (reference: strings_empty_kernel.cc)."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x, name=None):
+    return empty(StringTensor(x).shape)
+
+
+def _map(x, fn):
+    x = StringTensor(x)
+    return StringTensor(np.vectorize(fn, otypes=[object])(x._data)
+                        if x._data.size else x._data.copy())
+
+
+def lower(x, use_utf8_encoding=False, name=None):
+    """Elementwise lowercase (reference: strings_lower_upper_kernel.h;
+    use_utf8_encoding selects full unicode folding — python str.lower is
+    always unicode-aware, which is a superset)."""
+    return _map(x, str.lower)
+
+
+def upper(x, use_utf8_encoding=False, name=None):
+    """Elementwise uppercase (reference: strings_lower_upper_kernel.h)."""
+    return _map(x, str.upper)
+
+
+def copy(x, name=None):
+    """Deep copy (reference: strings_copy_kernel.h)."""
+    return StringTensor(np.array(StringTensor(x)._data, dtype=object))

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
@@ -174,3 +175,598 @@ def box_coder(prior_box, prior_box_var, target_box,
                  [prior_box, prior_box_var, target_box],
                  {"code_type": code_type,
                   "box_normalized": bool(box_normalized)})
+
+
+# ---------------------------------------------------------------------------
+# detection long tail (reference: python/paddle/vision/ops.py)
+# ---------------------------------------------------------------------------
+
+def _wrapv(x):
+    from ..ops._helpers import wrap
+    return wrap(x)
+
+
+def _deform_conv2d_impl(x, offset, weight, mask, bias, *, stride, padding,
+                        dilation, groups, deform_groups):
+    # x [N,C,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo]; weight [Co, C/g, kh, kw]
+    N, C, H, W = x.shape
+    Co, Cg, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = deform_groups
+    off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
+
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None]
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :]
+
+    cols = []
+    for k in range(kh * kw):
+        ky, kx = divmod(k, kw)
+        # sample position per output pixel: [N, dg, Ho, Wo]; phi layout
+        # stores (delta-y, delta-x) pairs: channel 2k is y, 2k+1 is x
+        py = base_y[None, None] + ky * dh + off[:, :, k, 0]
+        px = base_x[None, None] + kx * dw + off[:, :, k, 1]
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        def gather(yy, xx):
+            inb = ((yy >= 0) & (yy < H) & (xx >= 0) & (xx < W))
+            yc = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xc = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            lin = yc * W + xc                       # [N, dg, Ho, Wo]
+            xf = x.reshape(N, dg, C // dg, H * W)
+            g = jnp.take_along_axis(
+                xf, lin[:, :, None].reshape(N, dg, 1, -1), axis=3)
+            g = g.reshape(N, dg, C // dg, Ho, Wo)
+            return g * inb[:, :, None].astype(x.dtype)
+
+        v = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+             + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+             + gather(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+             + gather(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        if mask is not None:
+            mk = mask.reshape(N, dg, kh * kw, Ho, Wo)[:, :, k]
+            v = v * mk[:, :, None]
+        cols.append(v.reshape(N, C, Ho, Wo))
+    col = jnp.stack(cols, 2)  # [N, C, kh*kw, Ho, Wo]
+    col = col.reshape(N, groups, C // groups, kh * kw, Ho * Wo)
+    wg = weight.reshape(groups, Co // groups, Cg * kh * kw)
+    col2 = col.reshape(N, groups, (C // groups) * kh * kw, Ho * Wo)
+    out = jnp.einsum("ngkp,gok->ngop", col2, wg)
+    out = out.reshape(N, Co, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (DCN). mask=None → v1.
+
+    Reference: python/paddle/vision/ops.py deform_conv2d (CUDA kernel
+    phi/kernels/gpu/deformable_conv_kernel.cu). TPU lowering: bilinear
+    gathers (4 per tap) + one grouped MXU matmul over the im2col buffer."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    args = (_wrapv(x), _wrapv(offset), _wrapv(weight),
+            _wrapv(mask) if mask is not None else None,
+            _wrapv(bias) if bias is not None else None)
+    return apply("deform_conv2d", _deform_conv2d_impl, args,
+                 {"stride": pair(stride), "padding": pair(padding),
+                  "dilation": pair(dilation), "groups": int(groups),
+                  "deform_groups": int(deformable_groups)})
+
+
+def _yolo_box_impl(x, img_size, *, anchors, class_num, conf_thresh,
+                   downsample_ratio, clip_bbox, scale_x_y, iou_aware,
+                   iou_aware_factor):
+    # x: [N, an*(5+C), H, W]
+    N, _, H, W = x.shape
+    an = len(anchors) // 2
+    anc = jnp.asarray(np.array(anchors, np.float32).reshape(an, 2))
+    if iou_aware:
+        ious = x[:, :an].reshape(N, an, 1, H, W)
+        x = x[:, an:]
+    feats = x.reshape(N, an, 5 + class_num, H, W)
+    cx = jnp.arange(W)[None, None, None, :]
+    cy = jnp.arange(H)[None, None, :, None]
+    bx = (jax.nn.sigmoid(feats[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1) + cx) / W
+    by = (jax.nn.sigmoid(feats[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1) + cy) / H
+    bw = jnp.exp(feats[:, :, 2]) * anc[None, :, 0:1, None] / (
+        W * downsample_ratio)
+    bh = jnp.exp(feats[:, :, 3]) * anc[None, :, 1:2, None] / (
+        H * downsample_ratio)
+    conf = jax.nn.sigmoid(feats[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * jax.nn.sigmoid(
+            ious[:, :, 0]) ** iou_aware_factor
+    probs = jax.nn.sigmoid(feats[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x0 = (bx - bw / 2) * img_w
+    y0 = (by - bh / 2) * img_h
+    x1 = (bx + bw / 2) * img_w
+    y1 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, img_w - 1)
+        y0 = jnp.clip(y0, 0, img_h - 1)
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], -1).reshape(N, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(N, -1, class_num)
+    keep = conf.reshape(N, -1, 1) > conf_thresh
+    boxes = boxes * keep.astype(boxes.dtype)
+    scores = scores * keep.astype(scores.dtype)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLO head features into boxes+scores (reference:
+    python/paddle/vision/ops.py yolo_box)."""
+    return apply("yolo_box", _yolo_box_impl, (_wrapv(x), _wrapv(img_size)),
+                 {"anchors": tuple(anchors), "class_num": int(class_num),
+                  "conf_thresh": float(conf_thresh),
+                  "downsample_ratio": int(downsample_ratio),
+                  "clip_bbox": bool(clip_bbox),
+                  "scale_x_y": float(scale_x_y),
+                  "iou_aware": bool(iou_aware),
+                  "iou_aware_factor": float(iou_aware_factor)})
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: python/paddle/vision/ops.py
+    yolo_loss; kernel phi/kernels/cpu/yolov3_loss_kernel.cc).
+
+    Target assignment (best-anchor matching per gt) is host-side numpy —
+    it is data-dependent and non-differentiable; the loss itself is jnp so
+    gradients flow to x. The PP-YOLOE detector in vision/models uses its
+    own TPU-friendly loss; this op serves YOLOv3-style parity."""
+    xv = _v(x)
+    N, _, H, W = xv.shape
+    an_mask = list(anchor_mask)
+    n_mask = len(an_mask)
+    gt = np.asarray(_v(gt_box), np.float32)      # [N, B, 4] cx,cy,w,h (0-1)
+    gl = np.asarray(_v(gt_label))                # [N, B]
+    gs = (np.asarray(_v(gt_score), np.float32) if gt_score is not None
+          else np.ones(gl.shape, np.float32))
+    all_anchors = np.array(anchors, np.float32).reshape(-1, 2)
+    input_size = downsample_ratio * H
+
+    # ---- host-side target build ------------------------------------------
+    tobj = np.zeros((N, n_mask, H, W), np.float32)
+    tscale = np.zeros((N, n_mask, H, W), np.float32)
+    txy = np.zeros((N, n_mask, 2, H, W), np.float32)
+    twh = np.zeros((N, n_mask, 2, H, W), np.float32)
+    tcls = np.zeros((N, n_mask, class_num, H, W), np.float32)
+    gt_list = [[] for _ in range(N)]
+    for n in range(N):
+        for b in range(gt.shape[1]):
+            gw, gh = gt[n, b, 2], gt[n, b, 3]
+            if gw <= 0 or gh <= 0:
+                continue
+            gt_list[n].append(gt[n, b])
+            # best anchor by IoU of (w, h) at origin
+            aw = all_anchors[:, 0] / input_size
+            ah = all_anchors[:, 1] / input_size
+            inter = np.minimum(gw, aw) * np.minimum(gh, ah)
+            iou = inter / (gw * gh + aw * ah - inter)
+            best = int(np.argmax(iou))
+            if best not in an_mask:
+                continue
+            k = an_mask.index(best)
+            gi = min(int(gt[n, b, 0] * W), W - 1)
+            gj = min(int(gt[n, b, 1] * H), H - 1)
+            tobj[n, k, gj, gi] = gs[n, b]
+            tscale[n, k, gj, gi] = 2.0 - gw * gh
+            txy[n, k, 0, gj, gi] = gt[n, b, 0] * W - gi
+            txy[n, k, 1, gj, gi] = gt[n, b, 1] * H - gj
+            twh[n, k, 0, gj, gi] = np.log(max(
+                gw * input_size / all_anchors[best, 0], 1e-9))
+            twh[n, k, 1, gj, gi] = np.log(max(
+                gh * input_size / all_anchors[best, 1], 1e-9))
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            tcls[n, k, :, gj, gi] = smooth
+            tcls[n, k, int(gl[n, b]), gj, gi] = 1.0 - smooth \
+                if use_label_smooth else 1.0
+
+    # ignore mask: predicted boxes with IoU > thresh vs any gt
+    feats = np.asarray(xv).reshape(N, n_mask, 5 + class_num, H, W)
+    ign = np.ones((N, n_mask, H, W), np.float32)
+    cx = np.arange(W)[None, :]
+    cy = np.arange(H)[:, None]
+    for n in range(N):
+        if not gt_list[n]:
+            continue
+        g = np.stack(gt_list[n])  # [G, 4]
+        for k in range(n_mask):
+            aw, ah = all_anchors[an_mask[k]]
+            px = (1 / (1 + np.exp(-feats[n, k, 0])) + cx) / W
+            py = (1 / (1 + np.exp(-feats[n, k, 1])) + cy) / H
+            pw = np.exp(np.clip(feats[n, k, 2], -10, 10)) * aw / input_size
+            ph = np.exp(np.clip(feats[n, k, 3], -10, 10)) * ah / input_size
+            x0, x1 = px - pw / 2, px + pw / 2
+            y0, y1 = py - ph / 2, py + ph / 2
+            best_iou = np.zeros((H, W), np.float32)
+            for gb in g:
+                gx0, gx1 = gb[0] - gb[2] / 2, gb[0] + gb[2] / 2
+                gy0, gy1 = gb[1] - gb[3] / 2, gb[1] + gb[3] / 2
+                iw = np.clip(np.minimum(x1, gx1) - np.maximum(x0, gx0),
+                             0, None)
+                ih = np.clip(np.minimum(y1, gy1) - np.maximum(y0, gy0),
+                             0, None)
+                inter = iw * ih
+                u = pw * ph + gb[2] * gb[3] - inter
+                best_iou = np.maximum(best_iou, inter / np.maximum(u, 1e-10))
+            ign[n, k][best_iou > ignore_thresh] = 0.0
+
+    return apply("yolo_loss", _yolo_loss_impl,
+                 (_wrapv(x), Tensor(jnp.asarray(tobj)),
+                  Tensor(jnp.asarray(tscale)), Tensor(jnp.asarray(txy)),
+                  Tensor(jnp.asarray(twh)), Tensor(jnp.asarray(tcls)),
+                  Tensor(jnp.asarray(ign))),
+                 {"n_mask": n_mask, "class_num": int(class_num)})
+
+
+def _yolo_loss_impl(xx, tobj, tscale, txy, twh, tcls, ign, *, n_mask,
+                    class_num):
+    N, _, H, W = xx.shape
+    f = xx.reshape(N, n_mask, 5 + class_num, H, W)
+
+    def bce(logit, target):
+        return (jnp.maximum(logit, 0) - logit * target
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    lxy = (bce(f[:, :, 0:2], txy) * tscale[:, :, None]
+           * tobj[:, :, None]).sum((1, 2, 3, 4))
+    lwh = (jnp.abs(f[:, :, 2:4] - twh) * tscale[:, :, None]
+           * tobj[:, :, None]).sum((1, 2, 3, 4))
+    lobj = (bce(f[:, :, 4], tobj)
+            * jnp.where(tobj > 0, 1.0, ign)).sum((1, 2, 3))
+    lcls = (bce(f[:, :, 5:], tcls) * tobj[:, :, None]).sum((1, 2, 3, 4))
+    return lxy + lwh + lobj + lcls
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=
+              False, name=None):
+    """SSD prior (anchor) boxes for one feature map (reference:
+    python/paddle/vision/ops.py prior_box). Host-side box generation — the
+    boxes depend only on static shapes."""
+    feat_h, feat_w = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / feat_w
+    step_h = steps[1] or img_h / feat_h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    vars_ = []
+    for h in range(feat_h):
+        for w in range(feat_w):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((ms, ms))
+                    if max_sizes:
+                        big = np.sqrt(ms * max_sizes[k])
+                        cell.append((big, big))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                    if max_sizes:
+                        big = np.sqrt(ms * max_sizes[k])
+                        cell.append((big, big))
+            for bw_, bh_ in cell:
+                box = [(cx - bw_ / 2) / img_w, (cy - bh_ / 2) / img_h,
+                       (cx + bw_ / 2) / img_w, (cy + bh_ / 2) / img_h]
+                if clip:
+                    box = [min(max(v, 0.0), 1.0) for v in box]
+                boxes.append(box)
+                vars_.append(list(variance))
+    nprior = len(boxes) // (feat_h * feat_w)
+    b = np.array(boxes, np.float32).reshape(feat_h, feat_w, nprior, 4)
+    v = np.array(vars_, np.float32).reshape(feat_h, feat_w, nprior, 4)
+    return Tensor(jnp.asarray(b)), Tensor(jnp.asarray(v))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max-pool RoI pooling (reference: python/paddle/vision/ops.py
+    roi_pool). Uses the roi_align machinery with max reduction."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    xv = _v(x)
+    bx = _v(boxes)
+    bn = np.asarray(_v(boxes_num)) if boxes_num is not None else np.array(
+        [bx.shape[0]])
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    oh, ow = output_size
+    outs = []
+    H, W = xv.shape[2], xv.shape[3]
+    bx_np = np.asarray(bx)
+    for r in range(bx_np.shape[0]):
+        bi = int(batch_idx[r])
+        x0, y0, x1, y1 = bx_np[r] * spatial_scale
+        x0, y0 = int(np.floor(x0)), int(np.floor(y0))
+        x1, y1 = int(np.ceil(x1)), int(np.ceil(y1))
+        x1 = max(x1, x0 + 1)
+        y1 = max(y1, y0 + 1)
+        ys = np.linspace(y0, y1, oh + 1)
+        xs = np.linspace(x0, x1, ow + 1)
+        cells = []
+        for i in range(oh):
+            row = []
+            for j in range(ow):
+                ya, yb = int(np.floor(ys[i])), int(np.ceil(ys[i + 1]))
+                xa, xb = int(np.floor(xs[j])), int(np.ceil(xs[j + 1]))
+                ya, yb = np.clip([ya, yb], 0, H)
+                xa, xb = np.clip([xa, xb], 0, W)
+                if yb <= ya or xb <= xa:
+                    row.append(jnp.zeros(xv.shape[1], xv.dtype))
+                else:
+                    row.append(xv[bi, :, ya:yb, xa:xb].max((-2, -1)))
+            cells.append(jnp.stack(row, -1))
+        outs.append(jnp.stack(cells, -2))
+    return Tensor(jnp.stack(outs) if outs else
+                  jnp.zeros((0, xv.shape[1], oh, ow), xv.dtype))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py psroi_pool:
+    channel dim is split into output_size^2 position groups)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    xv = _v(x)
+    C = xv.shape[1]
+    co = C // (oh * ow)
+    bx = np.asarray(_v(boxes))
+    bn = np.asarray(_v(boxes_num)) if boxes_num is not None else np.array(
+        [bx.shape[0]])
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    H, W = xv.shape[2], xv.shape[3]
+    outs = []
+    for r in range(bx.shape[0]):
+        bi = int(batch_idx[r])
+        x0, y0, x1, y1 = bx[r] * spatial_scale
+        rh = max(y1 - y0, 0.1) / oh
+        rw = max(x1 - x0, 0.1) / ow
+        grid = []
+        for i in range(oh):
+            row = []
+            for j in range(ow):
+                ya = int(np.floor(y0 + i * rh))
+                yb = int(np.ceil(y0 + (i + 1) * rh))
+                xa = int(np.floor(x0 + j * rw))
+                xb = int(np.ceil(x0 + (j + 1) * rw))
+                ya, yb = np.clip([ya, yb], 0, H)
+                xa, xb = np.clip([xa, xb], 0, W)
+                c0 = (i * ow + j) * co
+                if yb <= ya or xb <= xa:
+                    row.append(jnp.zeros(co, xv.dtype))
+                else:
+                    row.append(xv[bi, c0:c0 + co, ya:yb, xa:xb].mean(
+                        (-2, -1)))
+            grid.append(jnp.stack(row, -1))
+        outs.append(jnp.stack(grid, -2))
+    return Tensor(jnp.stack(outs) if outs else
+                  jnp.zeros((0, co, oh, ow), xv.dtype))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2) — soft suppression via pairwise IoU matrix,
+    no sequential loop (reference: python/paddle/vision/ops.py matrix_nms).
+    Naturally TPU-friendly: one IoU matrix + rowwise max."""
+    bv = _v(bboxes)      # [N, M, 4]
+    sv = _v(scores)      # [N, C, M]
+    N, C, M = sv.shape
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(N):
+        per_img = []
+        per_idx = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = sv[n, c]
+            keep = np.asarray(sc > score_threshold).nonzero()[0]
+            if keep.size == 0:
+                continue
+            sc_k = np.asarray(sc)[keep]
+            order = np.argsort(-sc_k)[:nms_top_k]
+            keep = keep[order]
+            sc_k = sc_k[order]
+            bx = np.asarray(bv[n])[keep]
+            # pairwise IoU (upper triangle: each box vs higher-scored)
+            x0 = np.maximum(bx[:, None, 0], bx[None, :, 0])
+            y0 = np.maximum(bx[:, None, 1], bx[None, :, 1])
+            x1 = np.minimum(bx[:, None, 2], bx[None, :, 2])
+            y1 = np.minimum(bx[:, None, 3], bx[None, :, 3])
+            inter = np.clip(x1 - x0, 0, None) * np.clip(y1 - y0, 0, None)
+            area = ((bx[:, 2] - bx[:, 0]) * (bx[:, 3] - bx[:, 1]))
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, 1)
+            iou_max = iou.max(0)  # max IoU with any higher-scored box
+            comp = iou.max(1)
+            if use_gaussian:
+                decay = np.exp(-(iou_max ** 2 - comp ** 2) / gaussian_sigma)
+            else:
+                decay = (1 - iou_max) / np.maximum(1 - comp, 1e-10)
+            dec_sc = sc_k * np.minimum(decay, 1.0)
+            sel = dec_sc >= post_threshold
+            for i in np.nonzero(sel)[0]:
+                per_img.append([c, dec_sc[i], *bx[i]])
+                per_idx.append(n * M + keep[i])
+        if per_img:
+            arr = np.array(per_img, np.float32)
+            order = np.argsort(-arr[:, 1])[:keep_top_k]
+            arr = arr[order]
+            idxs = np.array(per_idx)[order]
+        else:
+            arr = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        all_out.append(arr)
+        all_idx.append(idxs)
+        rois_num.append(len(arr))
+    out = Tensor(jnp.asarray(np.concatenate(all_out)
+                             if all_out else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.concatenate(all_idx).astype(np.int64).reshape(-1, 1))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.array(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference:
+    python/paddle/vision/ops.py distribute_fpn_proposals)."""
+    rois = np.asarray(_v(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs, nums = [], [], []
+    order_all = np.arange(rois.shape[0])
+    for L in range(min_level, max_level + 1):
+        sel = order_all[lvl == L]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+        nums.append(Tensor(jnp.asarray(np.array([len(sel)], np.int32))))
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.zeros(0)
+    restore_t = Tensor(jnp.asarray(restore.astype(np.int32).reshape(-1, 1)))
+    if rois_num is not None:
+        return outs, restore_t, nums
+    return outs, restore_t, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation: decode anchors + deltas, clip, filter,
+    NMS (reference: python/paddle/vision/ops.py generate_proposals)."""
+    sc = np.asarray(_v(scores))        # [N, A, H, W]
+    bd = np.asarray(_v(bbox_deltas))   # [N, 4A, H, W]
+    im = np.asarray(_v(img_size))      # [N, 2] (h, w)
+    an = np.asarray(_v(anchors)).reshape(-1, 4)    # [A*H*W, 4]
+    var = np.asarray(_v(variances)).reshape(-1, 4)
+    N = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    rois_all, num_all, scores_all = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).ravel()
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        a = an[order]
+        v = var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000. / 16.))) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000. / 16.))) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], -1)
+        H_img, W_img = im[n]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W_img - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H_img - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, s = boxes[keep], s[keep]
+        # greedy NMS
+        order = np.argsort(-s)
+        sel = []
+        areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        while order.size > 0 and len(sel) < post_nms_top_n:
+            i = order[0]
+            sel.append(i)
+            xx0 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+            yy0 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+            xx1 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+            yy1 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+            inter = np.clip(xx1 - xx0, 0, None) * np.clip(yy1 - yy0, 0,
+                                                          None)
+            iou = inter / np.maximum(areas[i] + areas[order[1:]] - inter,
+                                     1e-10)
+            order = order[1:][iou <= nms_thresh]
+        rois_all.append(boxes[sel])
+        scores_all.append(s[sel].reshape(-1, 1))
+        num_all.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_all).astype(np.float32)))
+    rscores = Tensor(jnp.asarray(
+        np.concatenate(scores_all).astype(np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(
+            np.array(num_all, np.int32)))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (reference: vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference: vision/ops.py
+    decode_jpeg; GPU uses nvjpeg — here PIL does the host-side decode, the
+    same role nvjpeg plays off the accelerator)."""
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+    raw = bytes(np.asarray(_v(x)).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -1,0 +1,127 @@
+"""Schema-registry parity tests (OpTest analog: reference
+test/legacy_test/op_test.py:420 drives every op from its schema row; here
+every OpSpec with a sample runs against its numpy reference).
+
+Also locks in the registry's coverage guarantees:
+  * the registry is the single source of truth for the public surface;
+  * in-place variants mutate their input observably;
+  * coverage counters stay above the round-2 floor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import schema
+
+
+def _to_tensors(v):
+    if isinstance(v, np.ndarray):
+        return paddle.to_tensor(v)
+    if isinstance(v, (list, tuple)) and v and isinstance(v[0], np.ndarray):
+        return type(v)(paddle.to_tensor(a) for a in v)
+    return v
+
+
+SAMPLED = [s for s in schema.OPS.values() if s.sample is not None]
+
+
+@pytest.mark.parametrize("spec", SAMPLED, ids=[s.name for s in SAMPLED])
+def test_op_parity(spec):
+    args, kwargs = spec.sample()
+    t_args = [_to_tensors(a) for a in args]
+    out = spec.fn(*t_args, **kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    got = np.asarray(out._value if isinstance(out, Tensor) else out)
+    if spec.np_ref is None:
+        assert np.all(np.isfinite(got) | ~np.isfinite(got))  # ran at all
+        return
+    want = spec.np_ref(*args, **kwargs)
+    if want is None:
+        return
+    np.testing.assert_allclose(got, np.asarray(want), rtol=spec.tol,
+                               atol=spec.tol,
+                               err_msg=f"op {spec.name} parity failed")
+
+
+def test_registry_is_source_of_truth():
+    # every registered base name resolves to a public callable
+    import paddle_tpu.ops as ops
+    for spec in schema.OPS.values():
+        if "." in spec.name:      # namespaced (linalg.x etc.)
+            continue
+        assert callable(getattr(ops, spec.name, None)), spec.name
+
+
+def test_inplace_variants_mutate():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    y = x.add_(paddle.to_tensor(np.array([1.0, 1.0], "float32")))
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    z = paddle.to_tensor(np.array([-1.0, 4.0], "float32"))
+    z.clip_(0.0, 2.0)
+    np.testing.assert_allclose(z.numpy(), [0.0, 2.0])
+    w = paddle.to_tensor(np.zeros((2, 2), "float32"))
+    w.fill_(3.0)
+    np.testing.assert_allclose(w.numpy(), 3.0)
+    w.zero_()
+    np.testing.assert_allclose(w.numpy(), 0.0)
+
+
+def test_inplace_autograd_flows():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                         stop_gradient=False)
+    y = (x * 2.0)
+    y.exp_()            # in-place on an autograd intermediate
+    loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), 2.0 * np.exp(2.0 * np.array([1.0, 2.0])), rtol=1e-5)
+
+
+def test_coverage_floor():
+    # round-2 floor: the registry manages the full public op surface
+    fn_count = schema.public_op_count()
+    assert fn_count >= 650, fn_count
+    # tensor-method artifacts generated from the same rows
+    method_count = sum(
+        1 for s in schema.OPS.values() if s.tensor_method
+        for nm in s.public_names if getattr(Tensor, nm, None) is not None)
+    assert fn_count + method_count >= 900, (fn_count, method_count)
+
+
+def test_reference_tensor_surface_complete():
+    """Every public def in the reference's python/paddle/tensor modules has
+    a counterpart (modulo einsum-planner internals)."""
+    import os
+    import re
+
+    root = "/root/reference/python/paddle/tensor"
+    if not os.path.isdir(root):
+        pytest.skip("reference tree not present")
+    internal = {
+        "add_sample_code", "escape_math", "templatedoc", "preprocess",
+        "rhs_inference", "validate_rhs", "parse_op_labels", "parse_labels",
+        "parse_fake_shape", "plan_broadcast", "plan_einsum", "plan_matmul",
+        "plan_reduce", "plan_scalar_prod", "plan_summation",
+        "gen_einsum_op", "gen_equation_for_opteinsum",
+        "has_duplicated_labels", "infer_broadcast_shape",
+        "non_negative_axis", "build_view", "build_global_view",
+        "build_global_shape", "generate_activation_fn",
+        "generate_inplace_fn", "generate_layer_fn",
+        "dist_tensor_to_string", "sparse_tensor_to_string",
+        "tensor_to_string", "to_string", "einsum_v2", "diagonalize",
+        "uniform_random_batch_size_like",
+    }
+    ref = set()
+    for f in os.listdir(root):
+        if not f.endswith(".py"):
+            continue
+        src = open(os.path.join(root, f), encoding="utf-8",
+                   errors="replace").read()
+        ref |= set(re.findall(r"^def ([a-z][a-zA-Z0-9_]*)\(", src, re.M))
+    missing = sorted(n for n in ref - internal
+                     if not hasattr(paddle, n)
+                     and not hasattr(paddle.linalg, n))
+    assert not missing, f"reference tensor fns missing: {missing}"
