@@ -28,6 +28,12 @@ from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
 )
+from . import sequence_parallel  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    SegmentParallel, mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
 from .random import (  # noqa: F401
     RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
 )

@@ -99,6 +99,68 @@ _ACTS = {
 }
 
 
+def _gate_dispatch(xl, gw, top_k, capacity):
+    """Shared gating front-end for the dense and all-to-all paths: softmax
+    gate -> capacity-bounded top-k -> one-hot dispatch buffers."""
+    logits = jnp.einsum("sm,me->se", xl, gw).astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    combine, dispatch, aux = _topk_gating(gates, top_k, capacity)
+    return combine.astype(xl.dtype), dispatch.astype(xl.dtype), aux
+
+
+def _moe_ffn_alltoall_impl(x, gate_w, w1, b1, w2, b2, *, top_k, capacity,
+                           act, mesh, axis, data_axes=()):
+    """Explicit expert-parallel dispatch (reference: moe_layer.py:263 →
+    global_scatter / expert FFN / global_gather,
+    fluid/operators/collective/global_scatter_op.cc).
+
+    shard_map over the expert axis (and any data axes): tokens are sharded
+    over data_axes x expert axis, expert weights [E/n, ...] per expert
+    shard. Each device gates its own tokens, packs per-(expert,
+    source-device) capacity buffers, and ONE tiled lax.all_to_all over the
+    expert axis exchanges them so each device receives every source's
+    buffer for its local experts — the exact global_scatter exchange, as an
+    XLA ICI collective. Expert FFN then runs on [E/n, n*C, M]: per-device
+    FLOPs scale as E/n (real MoE scaling, not dense). The reverse
+    all_to_all is global_gather; combine happens back on the source device.
+    Tokens stay local to their data-parallel shard throughout.
+
+    Drop/padding semantics match the reference: capacity is enforced
+    per (source rank, expert) buffer, exactly like the reference's
+    per-rank local_count buffers."""
+    act_fn = _ACTS[act]
+    all_axes = tuple(data_axes) + (axis,)
+
+    def body(xl, gw, w1l, b1l, w2l, b2l):
+        # xl [S_loc, M]; w1l [E/n, M, H]
+        combine, dispatch, aux = _gate_dispatch(xl, gw, top_k, capacity)
+        xd = jnp.einsum("sec,sm->ecm", dispatch, xl)     # [E, C, M]
+        # global_scatter: split the expert dim, concat the capacity dim —
+        # device d receives [E/n, n*C, M] holding every source's buffer
+        # for its local experts
+        xg = jax.lax.all_to_all(xd, axis, split_axis=0, concat_axis=1,
+                                tiled=True)
+        h = act_fn(jnp.einsum("ecm,emh->ech", xg, w1l) + b1l[:, None, :])
+        ye = jnp.einsum("ech,ehm->ecm", h, w2l) + b2l[:, None, :]
+        # global_gather: the inverse exchange
+        yl = jax.lax.all_to_all(ye, axis, split_axis=1, concat_axis=0,
+                                tiled=True)                # [E, C, M]
+        y = jnp.einsum("sec,ecm->sm", combine, yl)
+        # out_specs replicate aux across every mapped axis, so reduce over
+        # all of them (expert + data), not just the expert axis
+        return y, jax.lax.pmean(aux, all_axes)
+
+    tok = P(all_axes, None)
+    ew = P(axis, *([None] * (w1.ndim - 1)))
+    eb = P(axis, None)
+    from jax import shard_map
+    y, aux = shard_map(
+        body, mesh=mesh,
+        in_specs=(tok, P(None, None), ew, eb, P(axis, None, None), eb),
+        out_specs=(tok, P()))(x, gate_w, w1, b1, w2, b2)
+    return y, aux.astype(jnp.float32)
+
+
 def _moe_ffn_impl(x, gate_w, w1, b1, w2, b2, *, top_k, capacity, act,
                   disp_sharding):
     """One fused MoE-FFN: gate → dispatch einsum → stacked expert FFN →
@@ -108,11 +170,7 @@ def _moe_ffn_impl(x, gate_w, w1, b1, w2, b2, *, top_k, capacity, act,
     E = gate_w.shape[1]
     act_fn = _ACTS[act]
 
-    logits = jnp.einsum("sm,me->se", x, gate_w).astype(jnp.float32)
-    gates = jax.nn.softmax(logits, axis=-1)
-    combine, dispatch, aux_loss = _topk_gating(gates, top_k, capacity)
-    combine = combine.astype(x.dtype)
-    dispatch = dispatch.astype(x.dtype)
+    combine, dispatch, aux_loss = _gate_dispatch(x, gate_w, top_k, capacity)
 
     xd = jnp.einsum("sec,sm->ecm", dispatch, x)          # [E, C, M]
     if disp_sharding is not None:
@@ -172,11 +230,14 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
                  capacity_factor=1.25, act="gelu", expert_axis="mp",
-                 weight_attr=None, name=None):
+                 dispatch_mode="auto", weight_attr=None, name=None):
         super().__init__()
         if isinstance(gate, str):
             gate = _GATES[gate]()
         self.gate = gate
+        if dispatch_mode not in ("auto", "alltoall", "dense"):
+            raise ValueError("dispatch_mode must be auto|alltoall|dense")
+        self.dispatch_mode = dispatch_mode
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
@@ -210,18 +271,60 @@ class MoELayer(Layer):
             return None
         return NamedSharding(mesh, P(self.expert_axis, None, None))
 
+    def _ep_mesh(self):
+        """(mesh, data_axes, total_split) when the expert axis is usable
+        for all-to-all dispatch: axis size >1 and experts divisible.
+        data_axes are the other token-carrying mesh axes (dp/sharding/sep)
+        so tokens stay sharded on them inside the shard_map instead of
+        being gathered/replicated."""
+        mesh = topo_mod.get_mesh()
+        if mesh is None:
+            return None, (), 1
+        n = mesh.shape.get(self.expert_axis, 1)
+        if n <= 1 or self.num_experts % n != 0:
+            return None, (), 1
+        data_axes = tuple(
+            a for a in ("dp", "sharding", "sep")
+            if a != self.expert_axis and mesh.shape.get(a, 1) > 1)
+        total = n
+        for a in data_axes:
+            total *= mesh.shape[a]
+        return mesh, data_axes, total
+
     def forward(self, x):
         orig_shape = x.shape
         if x.ndim > 2:
             from ..ops.manipulation import reshape
             x = reshape(x, [-1, orig_shape[-1]])
         n_tokens = x.shape[0]
-        capacity = self._capacity(n_tokens)
-        y, aux = apply(
-            "moe_ffn", _moe_ffn_impl,
-            (x, self.gate_weight, self.w1, self.b1, self.w2, self.b2),
-            {"top_k": self.gate.top_k, "capacity": capacity,
-             "act": self.act, "disp_sharding": self._disp_sharding()})
+        mesh, data_axes, total = self._ep_mesh()
+        use_a2a = (self.dispatch_mode == "alltoall"
+                   or (self.dispatch_mode == "auto" and mesh is not None))
+        if use_a2a and (mesh is None or n_tokens % total != 0):
+            if self.dispatch_mode == "alltoall":
+                raise ValueError(
+                    f"alltoall dispatch needs an expert mesh axis "
+                    f"{self.expert_axis!r} with tokens ({n_tokens}) "
+                    f"divisible by the token split ({total}) and experts "
+                    f"({self.num_experts}) divisible by its size")
+            use_a2a = False
+        if use_a2a:
+            # per-(source-rank, expert) capacity, like the reference's
+            # per-rank local_count buffers
+            capacity = self._capacity(n_tokens // total)
+            y, aux = apply(
+                "moe_ffn_alltoall", _moe_ffn_alltoall_impl,
+                (x, self.gate_weight, self.w1, self.b1, self.w2, self.b2),
+                {"top_k": self.gate.top_k, "capacity": capacity,
+                 "act": self.act, "mesh": mesh, "axis": self.expert_axis,
+                 "data_axes": data_axes})
+        else:
+            capacity = self._capacity(n_tokens)
+            y, aux = apply(
+                "moe_ffn", _moe_ffn_impl,
+                (x, self.gate_weight, self.w1, self.b1, self.w2, self.b2),
+                {"top_k": self.gate.top_k, "capacity": capacity,
+                 "act": self.act, "disp_sharding": self._disp_sharding()})
         from ..ops.math import scale
         self.aux_loss = scale(aux, self.gate.loss_weight)
         if len(orig_shape) > 2:

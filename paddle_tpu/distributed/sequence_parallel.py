@@ -1,0 +1,251 @@
+"""Megatron-style sequence parallelism (SP) + SegmentParallel wrapper.
+
+Reference analog:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+`ScatterOp`/`GatherOp`/`AllGatherOp`/`ReduceScatterOp` PyLayers (:85-147),
+`ColumnSequenceParallelLinear` (:230), `RowSequenceParallelLinear` (:340),
+`register_sequence_parallel_allreduce_hooks` (:192) — and
+fleet/meta_parallel/segment_parallel.py `SegmentParallel`.
+
+SP is distinct from ring/Ulysses context parallelism
+(context_parallel.py): CP shards the *attention computation* over `sep`;
+SP shards the *activations around TP blocks* over the **mp** axis, the
+memory win being that LayerNorm/dropout/residual activations hold only
+seq/mp per chip.
+
+TPU-native redesign: the reference hand-codes the collectives as PyLayers
+(all-gather before the column matmul, reduce-scatter after the row
+matmul). Here each comm op is a GSPMD sharding constraint on the sequence
+dim; differentiating a constraint yields the dual collective
+(all-gather ↔ reduce-scatter), which is exactly the pairing the
+reference's ScatterOp/GatherOp backward methods implement by hand. XLA
+then fuses/overlaps the collectives with the adjacent MXU matmuls —
+including the all-gather-matmul overlap the reference gets from its fused
+comm kernels.
+
+Layout convention (matches Megatron/reference): activations between TP
+blocks are [b, s/mp, h]; inside a TP block they are [b, s, h/mp].
+Sequence dim index is 1 ([batch, seq, hidden]) as in the reference.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = [
+    "scatter", "all_gather", "gather", "reduce_scatter",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "create_fused_allreduce_gradient_hooks", "SegmentParallel",
+]
+
+_SEQ_DIM = 1  # [batch, seq, hidden] — reference sequence_parallel_utils.py
+
+
+def _constrain_impl(v, *, sharding):
+    import jax
+    return jax.lax.with_sharding_constraint(v, sharding)
+
+
+def _constrain_dim(x, dim, entry):
+    """Constrain ONE dim's sharding, leaving every other dim UNCONSTRAINED
+    (GSPMD keeps whatever propagates there, e.g. the dp batch sharding).
+    Dispatched through `apply` so the eager tape records it — the VJP of a
+    sharding constraint is the dual constraint, handled by jax.vjp."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from . import topology as topo_mod
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+
+    mesh = topo_mod.get_mesh()
+    if mesh is None:
+        return x
+    v = x._value if isinstance(x, Tensor) else x
+    entries = [P.UNCONSTRAINED] * v.ndim
+    entries[dim] = entry
+    sharding = NamedSharding(mesh, P(*entries))
+    if isinstance(v, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(v, sharding)
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return apply("sp_constrain", _constrain_impl,
+                 (x if isinstance(x, Tensor) else Tensor(v),),
+                 {"sharding": sharding})
+
+
+def scatter(x, axis_name="mp"):
+    """Split the sequence dim across the mp group (reference ScatterOp:85:
+    forward=split, backward=all-gather). As a GSPMD constraint the
+    backward dual is the all-gather automatically."""
+    return _constrain_dim(x, _SEQ_DIM, axis_name)
+
+
+def all_gather(x, axis_name="mp"):
+    """Gather the sequence dim from the given group (reference
+    AllGatherOp:127: forward=all-gather, backward=reduce-scatter). Only
+    the sequence dim is constrained — batch stays dp-sharded."""
+    return _constrain_dim(x, _SEQ_DIM, None)
+
+
+# reference GatherOp (:106) is all-gather with concat on the seq dim too
+gather = all_gather
+
+
+def reduce_scatter(x, axis_name="mp"):
+    """Reduce partial sums over mp and scatter the sequence dim (reference
+    ReduceScatterOp:147). Constraining a partial-sum value to seq-sharded
+    lowers to one XLA reduce-scatter."""
+    return _constrain_dim(x, _SEQ_DIM, axis_name)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Tag a parameter (LayerNorm scale/bias, biases living in the
+    seq-parallel region) as needing mp-grad sync in the reference's manual
+    scheme (sequence_parallel_utils.py:180)."""
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference (:192): registers backward hooks all-reducing the grads of
+    marked parameters over mp, because with hand-written SP collectives a
+    replicated LayerNorm weight only sees its local sequence shard's grad.
+
+    TPU build: the whole step is one SPMD program — GSPMD already inserts
+    the mp psum when a replicated parameter's gradient is produced from
+    seq-sharded activations, so there is nothing to hook. Kept for API
+    parity; it only tags the marked parameters (useful for tests and for
+    the engine's sharding-spec audit)."""
+    count = 0
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (nn.LayerNorm,)) or \
+                layer.__class__.__name__ in ("LayerNorm", "RMSNorm"):
+            for p in layer.parameters(include_sublayers=False):
+                mark_as_sequence_parallel_parameter(p)
+                count += 1
+    return count
+
+
+create_fused_allreduce_gradient_hooks = register_sequence_parallel_allreduce_hooks
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """Column-parallel linear whose input arrives sequence-sharded:
+    all-gather(seq) -> x @ W[:, shard] -> output [b, s, out/mp].
+
+    Reference: sequence_parallel_utils.py:230 (forward :312 does
+    AllGatherOp.apply(x) then the column matmul)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        from jax.sharding import PartitionSpec as P
+
+        super().__init__()
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr,
+                                bias_attr=None if has_bias else False)
+        self.linear.weight.dist_spec = P(None, "mp")
+        self.linear.weight.is_distributed = True
+        if self.linear.bias is not None:
+            self.linear.bias.dist_spec = P("mp")
+            self.linear.bias.is_distributed = True
+        self.gather_output = gather_output
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    @property
+    def bias(self):
+        return self.linear.bias
+
+    def forward(self, x):
+        x = all_gather(x)                      # [b, s, in] seq un-sharded
+        y = self.linear(x)
+        if self.gather_output:
+            return _constrain_dim(y, y.ndim - 1, None)
+        return _constrain_dim(y, y.ndim - 1, "mp")   # [b, s, out/mp]
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """Row-parallel linear whose output leaves sequence-sharded:
+    x[b, s, in/mp] @ W[shard, :] -> partial -> reduce-scatter(seq).
+
+    Reference: sequence_parallel_utils.py:340 (forward :421 does the row
+    matmul then ReduceScatterOp.apply; bias is added AFTER the
+    reduce-scatter so it is applied once, not mp times)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        from jax.sharding import PartitionSpec as P
+
+        super().__init__()
+        if not input_is_parallel:
+            raise ValueError(
+                "RowSequenceParallelLinear requires input_is_parallel=True "
+                "(reference sequence_parallel_utils.py:362 asserts this)")
+        self.linear = nn.Linear(in_features, out_features,
+                                weight_attr=weight_attr, bias_attr=False)
+        self.linear.weight.dist_spec = P("mp", None)
+        self.linear.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        if self.bias is not None:
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    @property
+    def weight(self):
+        return self.linear.weight
+
+    def forward(self, x):
+        x = _constrain_dim(x, x.ndim - 1, "mp")   # [b, s, in/mp]
+        y = self.linear(x)                        # partial sums over mp
+        y = reduce_scatter(y)                     # [b, s/mp, out]
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class SegmentParallel(nn.Layer):
+    """Hybrid-parallel wrapper for the `sep` axis: shards every input's
+    sequence dim across the sep group before the wrapped model runs.
+
+    Reference: fleet/meta_parallel/segment_parallel.py SegmentParallel —
+    there it broadcasts parameters across sep and trusts the model to split
+    the sequence; here the wrapper applies the sep sharding constraint and
+    GSPMD propagates it through the model (attention over a sep-sharded
+    sequence should use context_parallel.py's ring/Ulysses attention)."""
+
+    def __init__(self, layers, hcg=None, seq_dim=_SEQ_DIM, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._seq_dim = seq_dim
+
+    def forward(self, *inputs, **kwargs):
+        from . import topology as topo_mod
+
+        mesh = topo_mod.get_mesh()
+        sep = mesh.shape.get("sep", 1) if mesh is not None else 1
+        sharded = []
+        for t in inputs:
+            # shard only genuine sequence inputs: the seq dim must exist,
+            # exceed 1, and divide by the sep degree (masks with a
+            # broadcast dim of 1, 2-D feature tensors etc. pass through)
+            if (sep > 1 and hasattr(t, "ndim") and t.ndim > self._seq_dim
+                    and t.shape[self._seq_dim] > 1
+                    and t.shape[self._seq_dim] % sep == 0):
+                sharded.append(_constrain_dim(t, self._seq_dim, "sep"))
+            else:
+                sharded.append(t)
+        return self._layers(*sharded, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

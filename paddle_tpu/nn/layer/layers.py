@@ -21,7 +21,7 @@ from ...core import dtype as dtypes
 class Parameter(Tensor):
     """Trainable tensor (reference: EagerParamBase, base/framework.py)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed", "dist_spec")
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed", "dist_spec", "sequence_parallel")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)

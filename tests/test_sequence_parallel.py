@@ -1,0 +1,151 @@
+"""Megatron-style sequence parallelism tests (reference:
+fleet/utils/sequence_parallel_utils.py) on the virtual 8-device mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+def _fresh_hcg(mesh):
+    dist.set_hybrid_communicate_group(
+        dist.HybridCommunicateGroup(mesh=mesh))
+
+
+class SPBlock(nn.Layer):
+    """LN -> ColumnSP -> gelu -> RowSP, the canonical Megatron SP MLP."""
+
+    def __init__(self, h, ffn):
+        super().__init__()
+        self.ln = nn.LayerNorm(h)
+        self.col = dist.ColumnSequenceParallelLinear(h, ffn)
+        self.row = dist.RowSequenceParallelLinear(ffn, h)
+
+    def forward(self, x):
+        y = self.ln(x)
+        y = self.col(y)
+        y = paddle.nn.functional.gelu(y)
+        return self.row(y)
+
+
+def _dense_twin(sp):
+    class Dense(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln = nn.LayerNorm(sp.ln.weight.shape[0])
+            self.fc1 = nn.Linear(*sp.col.weight.shape)
+            self.fc2 = nn.Linear(*sp.row.weight.shape)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+    d = Dense()
+    d.ln.weight._set_value(sp.ln.weight)
+    d.ln.bias._set_value(sp.ln.bias)
+    d.fc1.weight._set_value(sp.col.weight)
+    d.fc1.bias._set_value(sp.col.bias)
+    d.fc2.weight._set_value(sp.row.weight)
+    d.fc2.bias._set_value(sp.row.bias)
+    return d
+
+
+def test_sp_block_parity_mp2_sep2():
+    """SP forward == dense forward on an mp=2 x sep=2 x dp=2 mesh, with the
+    activations physically sequence-sharded between blocks."""
+    paddle.seed(0)
+    mesh = dist.build_mesh(dp=2, sep=2, mp=2)
+    _fresh_hcg(mesh)
+    try:
+        blk = SPBlock(16, 32)
+        dense = _dense_twin(blk)
+        dist.shard_params(blk, mesh)
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8, 16).astype("float32"))
+        got = blk(paddle.distributed.sequence_parallel.scatter(x))
+        want = dense(x)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+        # gradients must match the dense twins too (the reference needs a
+        # manual mp-allreduce hook for the LN params; here GSPMD's psum
+        # must deliver the same full gradient)
+        (got ** 2).mean().backward()
+        (want ** 2).mean().backward()
+        pairs = [(blk.ln.weight, dense.ln.weight),
+                 (blk.ln.bias, dense.ln.bias),
+                 (blk.col.weight, dense.fc1.weight),
+                 (blk.row.weight, dense.fc2.weight),
+                 (blk.row.bias, dense.fc2.bias)]
+        for sp_p, d_p in pairs:
+            np.testing.assert_allclose(sp_p.grad.numpy(), d_p.grad.numpy(),
+                                       rtol=1e-3, atol=1e-5)
+        # weights physically sharded over mp
+        ss = blk.col.weight._value.sharding.shard_shape(
+            blk.col.weight._value.shape)
+        assert ss[1] == 16  # 32 / mp2
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_sp_training_step_matches_dense():
+    """One jitted engine train step with SP layers == dense baseline loss +
+    grads (mp=2, sep=2)."""
+    mesh = dist.build_mesh(sep=2, mp=2, dp=2)
+    _fresh_hcg(mesh)
+    try:
+        paddle.seed(0)
+        blk = SPBlock(16, 32)
+        dense = _dense_twin(blk)
+
+        x = np.random.RandomState(1).randn(4, 8, 16).astype("float32")
+
+        def loss_of(model, xt):
+            return (model(xt) ** 2).mean()
+
+        # eager dense baseline loss
+        xt = paddle.to_tensor(x)
+        loss_d = loss_of(dense, xt)
+
+        # SP path under the engine's jitted step
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=blk.parameters())
+        eng = dist.parallelize(blk, opt,
+                               loss_fn=lambda m, xb: (m(xb) ** 2).mean(),
+                               mesh=mesh)
+        loss_sp = eng.train_batch(paddle.to_tensor(x))
+        np.testing.assert_allclose(float(loss_sp), float(loss_d), rtol=1e-4)
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_sp_hooks_and_marking():
+    mesh = dist.build_mesh(mp=2, dp=4)
+    _fresh_hcg(mesh)
+    try:
+        blk = SPBlock(8, 16)
+        n = dist.register_sequence_parallel_allreduce_hooks(blk)
+        assert n >= 2  # LN weight + bias
+        assert getattr(blk.ln.weight, "sequence_parallel", False)
+        assert getattr(blk.row.bias, "sequence_parallel", False)
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_segment_parallel_wrapper():
+    mesh = dist.build_mesh(sep=4, dp=2)
+    _fresh_hcg(mesh)
+    try:
+        inner = nn.Linear(8, 8)
+        seg = dist.SegmentParallel(inner)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, 8).astype("float32"))
+        out = seg(x)
+        want = inner(x)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        assert len(list(seg.parameters())) == 2
+    finally:
+        dist.set_hybrid_communicate_group(None)
