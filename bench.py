@@ -38,40 +38,154 @@ def _is_transient(err: Exception) -> bool:
     return any(m in msg for m in _TRANSIENT_MARKERS)
 
 
-def _measure_with_retry(make_engine, ids, steps, attempts=6):
-    """Warmup + timed loop, retried on transient PJRT-relay transport faults.
-
-    The engine donates its param/opt buffers into the step, so state is
-    poisoned once a dispatched step fails — each retry rebuilds the engine
-    via make_engine() (the program itself stays compile-cached, so rebuild
-    cost is parameter init, not recompilation). Host readback is the only
-    reliable fence through the relay (block_until_ready can return at
-    enqueue time), so we fence via float() on the final loss.
-    """
+def _retry_transient(fn, attempts=6, label="bench"):
+    """Run fn() with retry/backoff on transient PJRT-relay transport faults.
+    fn must rebuild any donated-buffer state itself on each call (a failed
+    dispatched step poisons donated engine buffers)."""
     last = None
     for attempt in range(attempts):
         try:
-            eng = make_engine()
-            float(eng.train_batch(ids))  # warmup / compile
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = eng.train_batch(ids)
-            final_loss = float(loss)  # device->host readback fences the chain
-            dt = time.perf_counter() - t0
-            return final_loss, dt
+            return fn()
         except Exception as e:  # noqa: BLE001 — classify then re-raise
             if not _is_transient(e):
                 raise
             last = e
-            eng = None  # release the poisoned engine before rebuilding
             if attempt + 1 < attempts:
                 wait = min(2.0 * (attempt + 1), 10.0)
-                print(f"bench: transient relay error (attempt {attempt + 1}/"
-                      f"{attempts}), retrying in {wait:.0f}s: {e}",
-                      file=sys.stderr)
+                print(f"{label}: transient relay error (attempt "
+                      f"{attempt + 1}/{attempts}), retrying in {wait:.0f}s: "
+                      f"{e}", file=sys.stderr)
                 time.sleep(wait)
     raise _RetriesExhausted(
-        f"bench: relay still failing after {attempts} attempts") from last
+        f"{label}: relay still failing after {attempts} attempts") from last
+
+
+def _measure_with_retry(make_engine, ids, steps, attempts=6):
+    """Warmup + timed loop. Each attempt rebuilds the engine (the compiled
+    program stays cached; rebuild cost is parameter init). Host readback is
+    the only reliable fence through the relay (block_until_ready can return
+    at enqueue time), so we fence via float() on the final loss."""
+
+    def attempt():
+        eng = make_engine()
+        float(eng.train_batch(ids))  # warmup / compile
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = eng.train_batch(ids)
+        final_loss = float(loss)  # device->host readback fences the chain
+        dt = time.perf_counter() - t0
+        return final_loss, dt
+
+    return _retry_transient(attempt, attempts=attempts)
+
+
+def _emit(payload):
+    print(json.dumps(payload))
+
+
+def bench_resnet50(on_tpu, dev):
+    """BASELINE config 1: ResNet-50 ImageNet-shape train step, images/sec."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import resnet50, resnet18
+
+    batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "2"))
+    size = 224 if on_tpu else 64
+    model_fn, train_flops_img = (
+        (resnet50, 3 * 4.1e9) if on_tpu else (resnet18, 3 * 1.8e9))
+
+    def loss_fn(m, x, y):
+        return paddle.nn.functional.cross_entropy(m(x), y).mean()
+
+    def make_engine():
+        paddle.seed(0)
+        model = model_fn(num_classes=1000)
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=model.parameters())
+        mesh = dist.build_mesh(dp=-1, devices=jax.devices()[:1])
+        return dist.parallelize(model, opt, loss_fn=loss_fn, mesh=mesh,
+                                compute_dtype="bfloat16" if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
+
+    def attempt():
+        eng = make_engine()
+        float(eng.train_batch(x, y))
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = eng.train_batch(x, y)
+        return float(loss), time.perf_counter() - t0
+
+    final_loss, dt = _retry_transient(attempt, label="resnet bench")
+    ips = batch * steps / dt
+    peak = 197e12 if on_tpu else float("inf")
+    mfu = ips * train_flops_img / peak
+    _emit({
+        "metric": f"resnet50 train images/sec ({size}px, bs={batch}, bf16)",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
+                  "platform": dev.platform},
+    })
+
+
+def bench_lora_decode(on_tpu, dev):
+    """BASELINE config 5: LoRA-adapted LLM autoregressive decode tokens/sec.
+    Decode is HBM-bandwidth-bound: the target is 40% of the
+    bandwidth-implied ceiling (param_bytes/token over v5e's 819 GB/s)."""
+    import jax
+    import numpy as _np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt, generate, GenerationConfig
+    from paddle_tpu.nn.lora import LoRAConfig, apply_lora
+
+    name = "gpt3_1p3b" if on_tpu else "gpt_tiny"
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS",
+                                    "128" if on_tpu else "8"))
+    paddle.seed(0)
+    model = gpt(name)
+    apply_lora(model, LoRAConfig(r=8))
+    model.eval()
+    if on_tpu:
+        for _, p in model.named_parameters():
+            p._value = p._value.astype("bfloat16")
+    param_bytes = sum(
+        _np.prod(p.shape) * (2 if on_tpu else 4)
+        for _, p in model.named_parameters())
+
+    rng = np.random.RandomState(0)
+    prompt = paddle.to_tensor(rng.randint(0, 256, (batch, 16)).astype("int32"))
+    cfg = GenerationConfig(max_new_tokens=new_tokens, do_sample=False,
+                           use_cache=True)
+
+    def attempt():
+        out = generate(model, prompt, cfg)  # warmup/compile
+        t0 = time.perf_counter()
+        out = generate(model, prompt, cfg)
+        _ = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+        return time.perf_counter() - t0
+
+    dt = _retry_transient(attempt, label="lora bench")
+    tps = batch * new_tokens / dt
+    bw_peak = 819e9
+    bw_frac = (tps * param_bytes / batch) / bw_peak if on_tpu else 0.0
+    _emit({
+        "metric": f"{name}+LoRA decode tokens/sec (bs={batch}, "
+                  f"{new_tokens} new tokens, KV cache)",
+        "value": round(tps, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(bw_frac / 0.40, 4) if on_tpu else 0.0,
+        "extra": {"bandwidth_frac": round(bw_frac, 4),
+                  "platform": dev.platform},
+    })
 
 
 def main():
@@ -84,6 +198,19 @@ def main():
     # one-chip bench (the driver runs on a single real TPU chip)
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "TPU" in str(dev.device_kind)
+
+    if "--model" in sys.argv:
+        i = sys.argv.index("--model")
+        if i + 1 >= len(sys.argv):
+            print("usage: bench.py [--model gpt_base|resnet50|lora_decode]",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_MODEL"] = sys.argv[i + 1]
+    mode = os.environ.get("BENCH_MODEL", "")
+    if mode.startswith("resnet"):
+        return bench_resnet50(on_tpu, dev)
+    if "lora" in mode or mode == "decode":
+        return bench_lora_decode(on_tpu, dev)
 
     name = os.environ.get("BENCH_MODEL", "gpt_base")
     seq_len = int(os.environ.get("BENCH_SEQLEN", "1024"))

@@ -47,7 +47,8 @@ from .distributed.data_parallel import DataParallel  # noqa: E402
 
 
 def disable_static(place=None):
-    """Eager mode is the default; kept for API parity."""
+    from . import static as static_mod
+    static_mod._disable()
 
 
 def enable_static():

@@ -197,6 +197,17 @@ def set_profile_hook(fn):
     _profile_hook = fn
 
 
+# Static-capture hook: set by paddle_tpu.static while static mode is on;
+# appends every dispatched op to the default Program (the reference appends
+# OpDescs to the Program block instead, python/paddle/base/framework.py).
+_static_capture_hook = None
+
+
+def set_static_capture_hook(fn):
+    global _static_capture_hook
+    _static_capture_hook = fn
+
+
 def apply(name, impl, tensor_args, statics=None, out_wrapper=None):
     hook = _profile_hook  # single read: may be unset concurrently by stop()
     if hook is None:
@@ -281,6 +292,9 @@ def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
             t._grad_node = node
             t._out_idx = i
         wrapped.append(t)
+
+    if _static_capture_hook is not None:
+        _static_capture_hook(name, impl, statics, tensor_args, wrapped)
 
     if out_is_seq:
         return tuple(wrapped)

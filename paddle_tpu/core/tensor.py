@@ -111,7 +111,14 @@ class Tensor:
         return int(np.asarray(self._value))
 
     def __bool__(self):
-        return bool(np.asarray(self._value))
+        try:
+            return bool(np.asarray(self._value))
+        except Exception as e:
+            if "racer" in type(e).__name__ or "racer" in str(e):
+                from ..jit.dy2static import Dy2StaticError, _GUIDE
+                raise Dy2StaticError(
+                    "bool() on a traced tensor: " + _GUIDE) from e
+            raise
 
     def __len__(self):
         if self._value.ndim == 0:
@@ -120,6 +127,12 @@ class Tensor:
 
     def __hash__(self):
         return id(self)
+
+    def __reduce__(self):
+        # pickle via numpy so Tensors cross process boundaries (DataLoader
+        # forkserver workers, dist.spawn); the tape does not survive
+        return (_tensor_from_numpy,
+                (np.asarray(self._value), self.stop_gradient, self.name))
 
     def __deepcopy__(self, memo):
         t = Tensor(self._value, stop_gradient=self.stop_gradient, name=self.name)
@@ -269,3 +282,10 @@ def _bind_method(name, fn):
             setattr(Tensor, name, fn)
         except (AttributeError, TypeError):
             pass
+
+
+def _tensor_from_numpy(arr, stop_gradient, name):
+    """Unpickle helper (Tensor.__reduce__)."""
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient, name=name)

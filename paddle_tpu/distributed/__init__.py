@@ -18,7 +18,14 @@ from .parallel_mode import ParallelMode  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, all_reduce, all_gather, broadcast,
     reduce_scatter, all_to_all, scatter, barrier, get_group,
+    send, recv, isend, irecv, P2POp, batch_isend_irecv, gather, reduce,
+    all_gather_object, broadcast_object_list,
 )
+from .group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)
+from .spawn import spawn  # noqa: F401
+from . import stream  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401
 from .engine import ShardedTrainStep, parallelize  # noqa: F401
 from .sharding_spec import (  # noqa: F401
@@ -55,4 +62,6 @@ from . import fleet  # noqa: F401
 from .fleet.recompute import (  # noqa: F401
     recompute, recompute_sequential, GradientMergeOptimizer,
 )
-from .ps import ShardedEmbedding, DistributedLookupTable  # noqa: F401
+from .ps import (  # noqa: F401
+    ShardedEmbedding, DistributedLookupTable, HostOffloadedEmbedding,
+)

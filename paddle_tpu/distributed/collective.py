@@ -316,16 +316,13 @@ def barrier(group=None):
     jnp.zeros(()).block_until_ready()
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv are compiled (ppermute) on TPU; use "
-        "paddle_tpu.distributed.functional.ppermute inside shard_map")
-
-
-recv = send
-isend = send
-irecv = send
-
-
 def get_group(axis="dp"):
     return new_group(axis=axis)
+
+
+# Eager point-to-point + gather/reduce live in p2p.py (host-mediated; the
+# compiled path is lax.ppermute inside shard_map / pipeline schedules).
+from .p2p import (  # noqa: E402,F401
+    send, recv, isend, irecv, P2POp, P2PTask, batch_isend_irecv, gather,
+    reduce, all_gather_object, broadcast_object_list,
+)

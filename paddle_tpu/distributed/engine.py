@@ -163,6 +163,10 @@ class ShardedTrainStep:
             if compute_dtype is not None:
                 params = {n: (v.astype(compute_dtype) if _is_float(v) else v)
                           for n, v in params.items()}
+                # float batch inputs (images, features) join the compute
+                # dtype too — conv/matmul require matching operand dtypes
+                batch = tuple(b.astype(compute_dtype) if _is_float(b) else b
+                              for b in batch)
             rng_mod.push_trace_key(key)
             try:
                 with cp_guard():

@@ -105,10 +105,18 @@ def is_initialized():
 def get_rank(group=None):
     if group is not None:
         return group.rank
+    # Launcher/spawn contract first (reference: PADDLE_TRAINER_ID): spawned
+    # children without jax.distributed all report process_index()==0.
+    env_rank = os.environ.get("PADDLE_TPU_PROCESS_ID")
+    if env_rank is not None:
+        return int(env_rank)
     return jax.process_index()
 
 
 def get_world_size(group=None):
     if group is not None:
         return group.nranks
+    env_world = os.environ.get("PADDLE_TPU_NUM_PROCESSES")
+    if env_world is not None:
+        return int(env_world)
     return jax.process_count()

@@ -1,0 +1,272 @@
+"""Eager point-to-point communication.
+
+Reference analog: python/paddle/distributed/communication/send.py /
+recv.py / batch_isend_irecv.py / reduce.py / gather.py (backed by NCCL
+send/recv, paddle/fluid/distributed/collective/process_group_nccl.cc).
+
+TPU-native stance: *compiled* p2p is `lax.ppermute` inside shard_map /
+the pipeline schedules — that is the performance path and what the
+framework's own PP/CP layers use. These eager APIs exist for the
+reference's debugging/utility workflows (parameter surgery, custom
+bootstrap exchanges) and are host-mediated: on a launched multi-process
+job the payload moves through the native coordination store
+(native/coord_store.cc) over DCN; in a single process a local mailbox
+gives the same ordered-pair semantics with world-of-one ranks.
+"""
+from __future__ import annotations
+
+import collections
+import pickle
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .env import get_rank, get_world_size, get_store
+
+
+class _LocalMailbox:
+    """Ordered (src, dst) channels inside one process."""
+
+    def __init__(self):
+        self._chans = collections.defaultdict(collections.deque)
+        self._cv = threading.Condition()
+
+    def put(self, src, dst, payload):
+        with self._cv:
+            self._chans[(src, dst)].append(payload)
+            self._cv.notify_all()
+
+    def get(self, src, dst, timeout=None):
+        with self._cv:
+            ok = self._cv.wait_for(lambda: self._chans[(src, dst)],
+                                   timeout=timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"recv from rank {src} timed out after {timeout}s")
+            return self._chans[(src, dst)].popleft()
+
+
+_mailbox = _LocalMailbox()
+_send_seq = collections.defaultdict(int)   # (src, dst) -> next seq to send
+_recv_seq = collections.defaultdict(int)   # (src, dst) -> next seq to take
+
+
+def _reset_p2p_state():
+    global _mailbox
+    _mailbox = _LocalMailbox()
+    _send_seq.clear()
+    _recv_seq.clear()
+
+
+def _to_numpy(tensor):
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    return np.asarray(v)
+
+
+def _assign(tensor, arr):
+    if isinstance(tensor, Tensor):
+        tensor._value = jnp.asarray(arr)
+        return tensor
+    return Tensor(jnp.asarray(arr))
+
+
+class P2PTask:
+    """Completed-or-joinable work handle (reference: distributed Task/Work
+    objects returned by isend/irecv)."""
+
+    def __init__(self, thread=None, result_box=None, tensor=None):
+        self._thread = thread
+        self._box = result_box
+        self._tensor = tensor
+
+    def wait(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("p2p task did not complete in time")
+            self._thread = None
+            if self._box is not None:
+                err, arr = self._box
+                if err is not None:
+                    raise err
+                if self._tensor is not None and arr is not None:
+                    _assign(self._tensor, arr)
+        return True
+
+    def is_completed(self):
+        return self._thread is None or not self._thread.is_alive()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Reference: communication/send.py. Host-mediated: the payload is
+    staged to host memory and delivered through the ordered (src, dst)
+    channel (store on multi-process, mailbox in-process)."""
+    src = get_rank()
+    arr = _to_numpy(tensor)
+    store = get_store()
+    if store is not None and get_world_size() > 1:
+        seq = _send_seq[(src, dst)]
+        _send_seq[(src, dst)] += 1
+        store.set(f"p2p/{src}->{dst}/{seq}", pickle.dumps(arr))
+    else:
+        _mailbox.put(src, dst, arr)
+    return P2PTask()
+
+
+def _recv_blocking(src, dst, timeout=None):
+    store = get_store()
+    if store is not None and get_world_size() > 1:
+        seq = _recv_seq[(src, dst)]
+        _recv_seq[(src, dst)] += 1
+        key = f"p2p/{src}->{dst}/{seq}"
+        raw = store.wait(key, timeout=timeout)
+        store.delete_key(key)
+        return pickle.loads(raw)
+    return _mailbox.get(src, dst, timeout=timeout)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, timeout=None):
+    """Reference: communication/recv.py — blocks until the matching send
+    lands, then copies into `tensor`."""
+    dst = get_rank()
+    arr = _recv_blocking(src, dst, timeout=timeout)
+    _assign(tensor, arr)
+    return P2PTask()
+
+
+def isend(tensor, dst=0, group=None):
+    """Reference: communication/send.py isend — store delivery is already
+    async on the daemon side, so the task completes immediately."""
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    """Reference: communication/recv.py irecv — the receive runs on a
+    background thread; `task.wait()` joins it and installs the payload,
+    so a posted irecv never deadlocks against the peer's own posting
+    order (the NCCL-grouped semantics batch_isend_irecv relies on)."""
+    dst = get_rank()
+    box = [None, None]
+
+    def work():
+        try:
+            box[1] = _recv_blocking(src, dst)
+        except BaseException as e:
+            box[0] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    return P2PTask(thread=t, result_box=box, tensor=tensor)
+
+
+class P2POp:
+    """Reference: communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be isend or irecv")
+        self.op = isend if op in (isend, send) else irecv
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Reference: communication/batch_isend_irecv.py — posts every op and
+    returns the task list. Sends post first (they never block), then
+    receives, mirroring the reference's grouped-launch deadlock-freedom."""
+    if not p2p_op_list:
+        return []
+    tasks = [None] * len(p2p_op_list)
+    for i, op in enumerate(p2p_op_list):
+        if op.op is isend:
+            tasks[i] = isend(op.tensor, op.peer, op.group)
+    for i, op in enumerate(p2p_op_list):
+        if op.op is irecv:
+            tasks[i] = irecv(op.tensor, op.peer, op.group)
+    return tasks
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Reference: communication/gather.py — collect every rank's tensor on
+    dst. Mesh semantics: a value sharded over the group axis contributes
+    its shards; a replicated value contributes nranks identical copies."""
+    from . import collective as C
+    if group is None:
+        group = C.new_group(axis="dp")
+    if get_rank() != dst and get_world_size() > 1:
+        # non-destination processes only feed the store path
+        send(tensor, dst=dst, group=group)
+        return
+    if get_world_size() > 1:
+        parts = []
+        for r in range(get_world_size()):
+            if r == dst:
+                parts.append(Tensor(jnp.asarray(_to_numpy(tensor))))
+            else:
+                buf = Tensor(jnp.asarray(_to_numpy(tensor)))
+                recv(buf, src=r, group=group)
+                parts.append(buf)
+    else:
+        parts = []
+        C.all_gather(parts, tensor, group=group)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(parts)
+    return parts
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True):
+    """Reference: communication/reduce.py — all_reduce with the result
+    consumed at dst; on the single controller the reduced value is the
+    controller's value."""
+    from . import collective as C
+    if op is None:
+        op = C.ReduceOp.SUM
+    return C.all_reduce(tensor, op=op, group=group)
+
+
+# Per-collective call counters: every process increments on each call, so
+# matched calls across ranks agree on the key and a second call can never
+# read the first call's stale payload.
+_obj_seq = collections.defaultdict(int)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Reference: communication/all_gather.py all_gather_object — python
+    objects move by pickle, not device buffers."""
+    world = get_world_size()
+    if world == 1:
+        object_list.clear()
+        object_list.extend([obj])
+        return
+    store, rank = get_store(), get_rank()
+    if store is None:
+        raise RuntimeError("all_gather_object needs a launched job store")
+    seq = _obj_seq["allgather"]
+    _obj_seq["allgather"] += 1
+    store.set(f"obj/allgather/{seq}/{rank}", pickle.dumps(obj))
+    object_list.clear()
+    for r in range(world):
+        object_list.append(
+            pickle.loads(store.wait(f"obj/allgather/{seq}/{r}")))
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Reference: communication/broadcast.py broadcast_object_list."""
+    world = get_world_size()
+    if world == 1:
+        return object_list
+    store, rank = get_store(), get_rank()
+    if store is None:
+        raise RuntimeError("broadcast_object_list needs a launched job store")
+    seq = _obj_seq["bcast"]
+    _obj_seq["bcast"] += 1
+    if rank == src:
+        store.set(f"obj/bcast/{seq}", pickle.dumps(list(object_list)))
+    else:
+        vals = pickle.loads(store.wait(f"obj/bcast/{seq}"))
+        object_list[:] = vals
+    return object_list

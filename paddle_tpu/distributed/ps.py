@@ -28,7 +28,8 @@ from ..core.dispatch import apply
 from ..nn.layer.layers import Layer
 from .. import nn
 
-__all__ = ["ShardedEmbedding", "DistributedLookupTable"]
+__all__ = ["ShardedEmbedding", "DistributedLookupTable",
+           "HostOffloadedEmbedding"]
 
 
 class ShardedEmbedding(Layer):
@@ -61,6 +62,294 @@ class ShardedEmbedding(Layer):
 
 def _lookup_impl(table, ids):
     return jnp.take(table, ids, axis=0)
+
+
+class HostOffloadedEmbedding(Layer):
+    """Embedding table resident in HOST memory with sparse on-table updates
+    and an optional HBM hot-row cache.
+
+    Reference analog: the PS host/SSD table tier —
+    paddle/fluid/distributed/ps/table/memory_sparse_table.cc +
+    ssd_sparse_table.h, whose capacity is host DRAM/SSD (not accelerator
+    memory) and whose optimizer (sgd/adagrad accessors,
+    table/sparse_sgd_rule.cc) lives WITH the table, applying per-row
+    sparse pushes.
+
+    TPU-native redesign:
+    - the table array is placed with the `pinned_host` memory kind (jax
+      memories API); lookups compile to a host-space gather of the
+      *deduplicated* ids followed by one host->HBM transfer of just the
+      touched rows — HBM never holds the table or a dense gradient;
+    - the backward pass delivers row cotangents to the table's own sparse
+      optimizer (sgd or adagrad), which scatter-updates the host rows in
+      place (donated buffer) — the analog of the PS async sparse push,
+      made synchronous and compiled;
+    - `cache_size` > 0 keeps an LRU cache of hot rows in device memory
+      for eval/predict flows (valid because eval never mutates rows).
+
+    The table is NOT a dense Parameter: framework optimizers skip it, the
+    table optimizes itself (exactly the reference PS contract where the
+    worker optimizer never sees sparse tables).
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, optimizer="adagrad",
+                 learning_rate=0.05, initializer_range=None, axes=None,
+                 cache_size=0, dtype=jnp.float32):
+        super().__init__()
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.optimizer = optimizer
+        self.learning_rate = float(learning_rate)
+        self.cache_size = int(cache_size)
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("optimizer must be 'sgd' or 'adagrad'")
+
+        std = (initializer_range if initializer_range is not None
+               else 1.0 / max(1.0, np.sqrt(embedding_dim)))
+        init = np.random.normal(
+            0.0, std, (self.num_embeddings, self.embedding_dim)).astype(
+                np.dtype(dtype))
+        self._host_sharding, self._dev_sharding = self._shardings(axes)
+        table = jax.device_put(init, self._host_sharding)
+        self.weight = Tensor(table, stop_gradient=True)
+        if optimizer == "adagrad":
+            self._accum = jax.device_put(
+                np.zeros((self.num_embeddings,), np.float32),
+                self._acc_host_sharding)
+        else:
+            self._accum = None
+        # LRU cache state (eval only): id -> slot, plus the HBM row store
+        self._cache_rows = None
+        self._cache_map = {}
+        self._cache_clock = []
+        self._push_probe = None
+
+    def _shardings(self, axes):
+        from . import topology as topo_mod
+        hcg = topo_mod.get_hybrid_communicate_group()
+        if axes and hcg is not None:
+            mesh = hcg.mesh
+            host = jax.sharding.NamedSharding(
+                mesh, P(tuple(axes), None)).with_memory_kind("pinned_host")
+            dev = jax.sharding.NamedSharding(
+                mesh, P()).with_memory_kind("device")
+            self._acc_host_sharding = jax.sharding.NamedSharding(
+                mesh, P(tuple(axes))).with_memory_kind("pinned_host")
+        else:
+            d = jax.devices()[0]
+            host = jax.sharding.SingleDeviceSharding(
+                d, memory_kind="pinned_host")
+            dev = jax.sharding.SingleDeviceSharding(d, memory_kind="device")
+            self._acc_host_sharding = host
+        return host, dev
+
+    # -- compiled host-space kernels ------------------------------------
+    def _pull_fn(self):
+        host, dev = self._host_sharding, self._dev_sharding
+
+        def pull(table, uids):
+            uh = jax.device_put(uids, host)
+            rows = table.at[uh].get(mode="promise_in_bounds")
+            return jax.device_put(rows, dev)
+
+        return jax.jit(pull)
+
+    def _push_fn(self):
+        """Compiled host-space scatter update (the TPU path: table rows
+        update IN host memory, only cotangents transit HBM)."""
+        host = self._host_sharding
+        acc_host = self._acc_host_sharding
+        opt = self.optimizer
+
+        def push(table, accum, uids, ct, lr):
+            # pads duplicate a live id with ZERO cotangent, so every write
+            # must be scatter-ADD (duplicate .set has an unspecified winner
+            # and can drop the real update)
+            uh = jax.device_put(uids, host)
+            ct_h = jax.device_put(ct, host)
+            lr_h = jax.device_put(lr, host)
+            if opt == "adagrad":
+                g2 = jnp.sum(ct_h * ct_h, axis=-1)
+                accum = accum.at[uh].add(g2, mode="promise_in_bounds")
+                acc_rows = accum.at[uh].get(mode="promise_in_bounds")
+                scale = (lr_h / jnp.sqrt(acc_rows + 1e-10))[:, None]
+            else:
+                scale = lr_h
+            table = table.at[uh].add(-scale * ct_h,
+                                     mode="promise_in_bounds")
+            return table, accum
+
+        return jax.jit(push, donate_argnums=(0, 1),
+                       out_shardings=(host, acc_host))
+
+    def _host_push_works(self):
+        """Probe once whether XLA can execute host-space scatter on this
+        backend (TPU: yes; CPU runtime lacks the Host
+        annotate_device_placement custom call)."""
+        if self._push_probe is None:
+            try:
+                probe_tab = jax.device_put(
+                    np.zeros((2, self.embedding_dim), np.float32),
+                    self._host_sharding)
+                probe_acc = jax.device_put(np.zeros((2,), np.float32),
+                                           self._acc_host_sharding)
+                t, a = self._push(probe_tab, probe_acc,
+                                  jnp.zeros((1,), jnp.int32),
+                                  jnp.zeros((1, self.embedding_dim)),
+                                  jnp.float32(0.0))
+                jax.block_until_ready(t)
+                self._push_probe = True
+            except Exception:
+                self._push_probe = False
+        return self._push_probe
+
+    def _numpy_push(self, uids, row_ct):
+        """Fallback sparse push: row updates via a host->host numpy pass.
+        Capacity-equivalent (the table never touches device memory); the
+        full-table host memcpy it costs is what the compiled host-space
+        path above removes on TPU."""
+        tab = np.array(self.weight._value)
+        ids = np.asarray(uids)
+        ct = np.asarray(row_ct, tab.dtype)
+        if self.optimizer == "adagrad":
+            acc = np.array(self._accum)
+            g2 = np.sum(np.asarray(row_ct, np.float32) ** 2, axis=-1)
+            np.add.at(acc, ids, g2)  # add-per-occurrence: pads add zero
+            scale = (self.learning_rate
+                     / np.sqrt(acc[ids] + 1e-10))[:, None]
+            self._accum = jax.device_put(acc, self._acc_host_sharding)
+        else:
+            scale = self.learning_rate
+        np.subtract.at(tab, ids, (scale * ct).astype(tab.dtype))
+        self.weight._value = jax.device_put(tab, self._host_sharding)
+
+    def forward(self, ids):
+        flat = ids._value.reshape(-1) if isinstance(ids, Tensor) \
+            else jnp.asarray(ids).reshape(-1)
+        orig_shape = tuple(ids.shape)
+        if not self.training and self.cache_size > 0:
+            rows = self._cached_lookup(np.asarray(flat))
+            out = rows.reshape(orig_shape + (self.embedding_dim,))
+            return Tensor(out)
+        # real host-side dedup (the forward is eager, so dynamic-size unique
+        # is fine); pad the unique set to the next power of two so the pull/
+        # push jits see a bounded set of shapes instead of one per count
+        uids_np, inv_np = np.unique(np.asarray(flat), return_inverse=True)
+        n_u = len(uids_np)
+        padded = 1 << (n_u - 1).bit_length() if n_u > 1 else 1
+        uids_np = np.concatenate(
+            [uids_np, np.full(padded - n_u, uids_np[0], uids_np.dtype)])
+        uids = jnp.asarray(uids_np.astype(np.int32))
+        inv = jnp.asarray(inv_np.astype(np.int32))
+        if not hasattr(self, "_pull"):
+            self._pull = self._pull_fn()
+            self._push = self._push_fn()
+        rows_u = self._pull(self.weight._value, uids)
+        rows = rows_u[inv].reshape(orig_shape + (self.embedding_dim,))
+        out = Tensor(rows, stop_gradient=not self.training)
+        if self.training:
+            out._grad_node = _SparsePushNode(self, uids, inv, orig_shape)
+            out._out_idx = 0
+        return out
+
+    def _apply_push(self, uids, row_ct):
+        """Sparse push: table's own optimizer updates touched rows."""
+        if self._host_push_works():
+            acc = self._accum if self._accum is not None else \
+                jax.device_put(np.zeros((1,), np.float32),
+                               self._acc_host_sharding)
+            new_table, new_acc = self._push(
+                self.weight._value, acc, uids, row_ct,
+                jnp.float32(self.learning_rate))
+            self.weight._value = new_table
+            if self._accum is not None:
+                self._accum = new_acc
+        else:
+            self._numpy_push(uids, row_ct)
+        self._cache_map.clear()  # rows changed: invalidate the HBM cache
+        self._cache_clock.clear()
+
+    # -- eval-time HBM hot-row cache ------------------------------------
+    def _cached_lookup(self, flat_np):
+        if self._cache_rows is None:
+            self._cache_rows = jnp.zeros(
+                (self.cache_size, self.embedding_dim),
+                self.weight._value.dtype)
+        uniq = np.unique(flat_np)
+        if len(uniq) > self.cache_size:
+            # working set exceeds the cache: serve this batch directly from
+            # the host table, leave the cache untouched
+            if not hasattr(self, "_pull"):
+                self._pull = self._pull_fn()
+                self._push = self._push_fn()
+            return self._pull(self.weight._value,
+                              jnp.asarray(flat_np, jnp.int32))
+        # LRU-touch this batch's hits FIRST so the miss-fill below can never
+        # evict a row the same batch still needs
+        for rid in uniq:
+            rid = int(rid)
+            if rid in self._cache_map:
+                self._cache_clock.remove(rid)
+                self._cache_clock.append(rid)
+        missing = [int(i) for i in uniq if int(i) not in self._cache_map]
+        if missing:
+            if not hasattr(self, "_pull"):
+                self._pull = self._pull_fn()
+                self._push = self._push_fn()
+            rows = self._pull(self.weight._value,
+                              jnp.asarray(missing, jnp.int32))
+            for k, rid in enumerate(missing):
+                if len(self._cache_map) >= self.cache_size:
+                    evict = self._cache_clock.pop(0)
+                    slot = self._cache_map.pop(evict)
+                else:
+                    slot = len(self._cache_map)
+                self._cache_map[rid] = slot
+                self._cache_clock.append(rid)
+                self._cache_rows = self._cache_rows.at[slot].set(rows[k])
+        slots = np.asarray([self._cache_map[int(i)] for i in flat_np],
+                           np.int32)
+        return self._cache_rows[jnp.asarray(slots)]
+
+    @property
+    def memory_kind(self):
+        return self.weight._value.sharding.memory_kind
+
+
+class _SparsePushNode:
+    """Tape node delivering row cotangents to the table's sparse optimizer
+    (the PS 'push_sparse' analog, fluid/distributed/ps/service/
+    brpc_ps_client.cc push_sparse)."""
+
+    def __init__(self, table, uids, inv, ids_shape):
+        from ..core.dispatch import GradNode
+        self.name = "host_table_push"
+        self.impl = None
+        self.statics = {}
+        self.statics_key = ()
+        self.input_arrays = []
+        self.input_metas = []
+        self.n_outputs = 1
+        self.out_is_seq = False
+        self._table = table
+        self._uids = uids
+        self._inv = inv
+        self._ids_shape = ids_shape
+        GradNode._counter[0] += 1
+        self._id = GradNode._counter[0]
+
+    def run_vjp(self, cotangents):
+        ct = cotangents[0]
+        dim = self._table.embedding_dim
+        flat_ct = ct.reshape(-1, dim)
+        # fold duplicate ids: segment-sum cotangents onto unique rows
+        row_ct = jax.ops.segment_sum(
+            flat_ct, self._inv, num_segments=self._uids.shape[0])
+        self._table._apply_push(self._uids, row_ct)
+        return []
+
+    def release(self):
+        pass
 
 
 class DistributedLookupTable(Layer):

@@ -1,0 +1,71 @@
+"""paddle.distributed.stream.* — stream-addressed collective variants.
+
+Reference: python/paddle/distributed/communication/stream/ (all_reduce.py
+etc.), whose extra knob is `use_calc_stream` — run the collective on the
+compute stream instead of the comm stream to skip an event sync.
+
+TPU-native: XLA owns stream assignment; a compiled collective is already
+scheduled on whichever stream the fusion lands on, so `use_calc_stream`
+has no independent meaning and every variant delegates to the eager API.
+The surface exists so reference call sites run unmodified.
+"""
+from __future__ import annotations
+
+from . import collective as _C
+from .p2p import gather as _gather, reduce as _reduce
+from .p2p import recv as _recv, send as _send
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _C.all_reduce(tensor, op if op is not None else _C.ReduceOp.SUM,
+                         group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _C.all_gather(tensor_or_tensor_list, tensor, group=group,
+                         sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _C.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=None, group=None,
+                   sync_op=True, use_calc_stream=False):
+    return _C.reduce_scatter(tensor, tensor_or_tensor_list,
+                             op if op is not None else _C.ReduceOp.SUM,
+                             group=group, sync_op=sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    return _C.all_to_all(out_tensor_list, in_tensor_list, group=group,
+                         sync_op=sync_op)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _C.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _gather(tensor, gather_list=gather_list, dst=dst, group=group,
+                   sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _recv(tensor, src=src, group=group, sync_op=sync_op)

@@ -187,25 +187,49 @@ class HybridCommunicateGroup:
     def get_sep_parallel_group(self):
         return self._axis_group("sep")
 
-    # data-parallel coordinate of the current *process* — single-controller
-    # processes see rank 0; per-device ranks exist only inside shard_map.
+    # Coordinate of the current *process* along each axis: the position of
+    # this process's first addressable device in the global mesh (reference:
+    # HybridCommunicateGroup rank getters over the process rank,
+    # fleet/base/topology.py). Single-controller jobs own every device, so
+    # all coords are 0; under multi-process jax (jax.distributed) each host
+    # controller reads its block's coordinates.
+    def _process_coord(self, axis):
+        # spawn children without jax.distributed: each child sees a local
+        # single-process mesh (process_index()==0 everywhere), but the env
+        # contract (PADDLE_TPU_PROCESS_ID) still defines a process-level DP
+        # rank — mirror env.get_rank()'s precedence for the dp axis
+        env_world = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+        if env_world > jax.process_count():
+            return (int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0"))
+                    if axis == "dp" else 0)
+        devs = self._mesh.devices
+        pidx = jax.process_index()
+        flat = list(devs.ravel())
+        mine = next((i for i, d in enumerate(flat)
+                     if getattr(d, "process_index", 0) == pidx), None)
+        if mine is None:
+            return 0
+        pos = np.unravel_index(mine, devs.shape)
+        axes = list(self._mesh.axis_names)
+        return int(pos[axes.index(axis)])
+
     def get_data_parallel_rank(self):
-        return 0
+        return self._process_coord("dp")
 
     def get_model_parallel_rank(self):
-        return 0
+        return self._process_coord("mp")
 
     def get_stage_id(self):
-        return 0
+        return self._process_coord("pp")
 
     def get_pipe_parallel_rank(self):
-        return 0
+        return self._process_coord("pp")
 
     def get_sharding_parallel_rank(self):
-        return 0
+        return self._process_coord("sharding")
 
     def get_sep_parallel_rank(self):
-        return 0
+        return self._process_coord("sep")
 
 
 _global_hcg = None

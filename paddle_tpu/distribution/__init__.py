@@ -24,6 +24,9 @@ from .transformed import (  # noqa: F401
     SoftmaxTransform, StickBreakingTransform, TransformedDistribution,
 )
 from .independent import Independent  # noqa: F401
+from .discrete import Poisson, Binomial, ContinuousBernoulli  # noqa: F401
+from .multivariate_normal import MultivariateNormal  # noqa: F401
+from .exponential_family import ExponentialFamily  # noqa: F401
 from .kl import kl_divergence, register_kl  # noqa: F401
 
 __all__ = [

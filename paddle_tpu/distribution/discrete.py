@@ -1,0 +1,155 @@
+"""Poisson / Binomial / ContinuousBernoulli (reference:
+distribution/poisson.py, binomial.py, continuous_bernoulli.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _broadcast_all
+
+_EPS = 1e-7
+
+
+class Poisson(Distribution):
+    """P(X=k) = exp(-rate) rate^k / k! (reference poisson.py:33)."""
+
+    def __init__(self, rate):
+        (self.rate,) = _broadcast_all(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    def _sample(self, key, shape):
+        shp = tuple(shape) + self.rate.shape
+        return jax.random.poisson(key, self.rate, shp).astype(self.rate.dtype)
+
+    _rsample = _sample  # counts are not reparameterizable
+
+    def _log_prob(self, value):
+        rate = jnp.maximum(self.rate, _EPS)
+        return value * jnp.log(rate) - rate - jax.lax.lgamma(value + 1.0)
+
+    def _entropy(self):
+        # series approximation used by the reference for large rate; exact
+        # summation over a truncated support for small rate
+        rate = self.rate
+        ks = jnp.arange(0.0, 64.0)
+        logp = (ks[:, None] * jnp.log(jnp.maximum(rate.reshape(-1), _EPS))
+                - rate.reshape(-1) - jax.lax.lgamma(ks + 1.0)[:, None])
+        small = -jnp.sum(jnp.exp(logp) * logp, axis=0).reshape(rate.shape)
+        large = 0.5 * jnp.log(2 * jnp.pi * jnp.e * rate) \
+            - 1.0 / (12.0 * rate) - 1.0 / (24.0 * rate ** 2)
+        return jnp.where(rate < 16.0, small, large)
+
+    def _mean(self):
+        return self.rate
+
+    def _variance(self):
+        return self.rate
+
+
+class Binomial(Distribution):
+    """P(X=k) = C(n,k) p^k (1-p)^(n-k) (reference binomial.py:36)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count, self.probs = _broadcast_all(total_count, probs)
+        super().__init__(batch_shape=self.probs.shape)
+
+    def _sample(self, key, shape):
+        shp = tuple(shape) + self.probs.shape
+        return jax.random.binomial(
+            key, self.total_count, self.probs, shape=shp).astype(
+                self.probs.dtype)
+
+    _rsample = _sample
+
+    def _log_prob(self, value):
+        n, p = self.total_count, jnp.clip(self.probs, _EPS, 1 - _EPS)
+        log_comb = (jax.lax.lgamma(n + 1.0) - jax.lax.lgamma(value + 1.0)
+                    - jax.lax.lgamma(n - value + 1.0))
+        return log_comb + value * jnp.log(p) + (n - value) * jnp.log1p(-p)
+
+    def _entropy(self):
+        # exact truncated-support sum (reference computes the same sum)
+        n, p = self.total_count, self.probs
+        kmax = int(jnp.max(n)) + 1
+        ks = jnp.arange(0.0, kmax)
+        nf, pf = n.reshape(-1), jnp.clip(p.reshape(-1), _EPS, 1 - _EPS)
+        log_comb = (jax.lax.lgamma(nf + 1.0)[None]
+                    - jax.lax.lgamma(ks + 1.0)[:, None]
+                    - jax.lax.lgamma(nf - ks[:, None] + 1.0))
+        logp = log_comb + ks[:, None] * jnp.log(pf) \
+            + (nf - ks[:, None]) * jnp.log1p(-pf)
+        valid = ks[:, None] <= nf
+        ent = -jnp.sum(jnp.where(valid, jnp.exp(logp) * logp, 0.0), axis=0)
+        return ent.reshape(n.shape)
+
+    def _mean(self):
+        return self.total_count * self.probs
+
+    def _variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous relaxation on [0,1] (reference
+    continuous_bernoulli.py:47; Loaiza-Ganem & Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        (self.probs,) = _broadcast_all(probs)
+        self._lims = lims
+        super().__init__(batch_shape=self.probs.shape)
+
+    def _log_norm_const(self):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        cut = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(cut, 0.25, p)
+        log_c = jnp.log(
+            (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            / jnp.maximum(1.0 - 2.0 * safe, _EPS))
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * (p - 0.5) ** 2 \
+            + 104.0 / 45.0 * (p - 0.5) ** 4
+        return jnp.where(cut, taylor, log_c)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.probs.shape
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        u = jax.random.uniform(key, shp, p.dtype, minval=_EPS,
+                               maxval=1 - _EPS)
+        cut = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(cut, 0.25, p)
+        icdf = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where(cut, u, icdf)
+
+    def _log_prob(self, value):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        return (value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+                + self._log_norm_const())
+
+    def _mean(self):
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS)
+        cut = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(cut, 0.25, p)
+        m = safe / (2.0 * safe - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        taylor = 0.5 + (p - 0.5) / 3.0 + 16.0 / 45.0 * (p - 0.5) ** 3
+        return jnp.where(cut, taylor, m)
+
+    def _variance(self):
+        # numerically-stable second moment via quadrature on [0, 1]
+        xs = jnp.linspace(0.0, 1.0, 257)
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS).reshape(-1)
+        lp = (xs[:, None] * jnp.log(p) + (1 - xs[:, None]) * jnp.log1p(-p)
+              + self._log_norm_const().reshape(-1)[None])
+        w = jnp.exp(lp) / jnp.sum(jnp.exp(lp), axis=0)
+        m1 = jnp.sum(xs[:, None] * w, axis=0)
+        m2 = jnp.sum(xs[:, None] ** 2 * w, axis=0)
+        return (m2 - m1 ** 2).reshape(self.probs.shape)
+
+    def _entropy(self):
+        # E[-log p(x)] by quadrature over the unit support
+        xs = jnp.linspace(0.0, 1.0, 257)
+        p = jnp.clip(self.probs, _EPS, 1 - _EPS).reshape(-1)
+        lp = (xs[:, None] * jnp.log(p) + (1 - xs[:, None]) * jnp.log1p(-p)
+              + self._log_norm_const().reshape(-1)[None])
+        w = jnp.exp(lp) / jnp.sum(jnp.exp(lp), axis=0)
+        return (-jnp.sum(w * lp, axis=0)).reshape(self.probs.shape)

@@ -1,0 +1,49 @@
+"""ExponentialFamily base (reference: distribution/exponential_family.py).
+
+The reference derives entropy via the Bregman divergence of the
+log-normalizer (autograd on `_log_normalizer`); here the same derivation
+uses jax.grad — subclasses supply natural parameters and the
+log-normalizer, entropy comes for free."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _wrap
+
+
+class ExponentialFamily(Distribution):
+    """Subclasses define `_natural_parameters` (tuple of arrays),
+    `_log_normalizer(*nat)`, and `_mean_carrier_measure`.
+
+    H = -E[carrier] + A(eta) - sum_i eta_i * dA/deta_i
+    (reference exponential_family.py:39)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = [jnp.asarray(p) for p in self._natural_parameters]
+
+        def log_norm_sum(*ps):
+            return jnp.sum(self._log_normalizer(*ps))
+
+        grads = jax.grad(log_norm_sum, argnums=tuple(range(len(nat))))(*nat)
+        result = -jnp.asarray(self._mean_carrier_measure) \
+            + self._log_normalizer(*nat)
+        for p, g in zip(nat, grads):
+            term = p * g
+            # reduce any event dims beyond the batch shape
+            extra = term.ndim - len(self.batch_shape)
+            if extra > 0:
+                term = jnp.sum(term, axis=tuple(range(-extra, 0)))
+            result = result - term
+        return _wrap(result)

@@ -129,3 +129,50 @@ def _kl_independent(p, q):
     if p.rank == 0:
         return inner
     return inner.sum(tuple(range(-p.rank, 0)))
+
+
+from .discrete import Poisson, Binomial  # noqa: E402
+from .multivariate_normal import MultivariateNormal  # noqa: E402
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    # KL = r_p log(r_p/r_q) + r_q - r_p
+    return (p.rate * (jnp.log(jnp.maximum(p.rate, 1e-12))
+                      - jnp.log(jnp.maximum(q.rate, 1e-12)))
+            + q.rate - p.rate)
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial(p, q):
+    # closed form n * KL(Bern(p) || Bern(q)) requires equal trial counts
+    import numpy as _np
+    if not _np.array_equal(_np.asarray(p.total_count),
+                           _np.asarray(q.total_count)):
+        raise NotImplementedError(
+            "KL(Binomial || Binomial) with different total_count has no "
+            "closed form here")
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    per_trial = pp * (jnp.log(pp) - jnp.log(qq)) \
+        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+    return p.total_count * per_trial
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    import jax
+    d = p.loc.shape[-1]
+    lq, lp = q._scale_tril, p._scale_tril
+    # tr(Sigma_q^-1 Sigma_p) via triangular solves on the cholesky factors
+    m = jax.scipy.linalg.solve_triangular(lq, lp, lower=True)
+    tr = jnp.sum(m * m, axis=(-2, -1))
+    diff = q.loc - p.loc
+    z = jax.scipy.linalg.solve_triangular(lq, diff[..., None],
+                                          lower=True)[..., 0]
+    maha = jnp.sum(z * z, axis=-1)
+    log_det = 2.0 * (jnp.sum(jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)),
+                             axis=-1)
+                     - jnp.sum(jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)),
+                               axis=-1))
+    return 0.5 * (tr + maha - d + log_det)

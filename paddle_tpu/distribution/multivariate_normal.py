@@ -1,0 +1,79 @@
+"""MultivariateNormal (reference: distribution/multivariate_normal.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _value
+
+
+class MultivariateNormal(Distribution):
+    """N(loc, Sigma) parameterized by any one of covariance_matrix /
+    precision_matrix / scale_tril (reference multivariate_normal.py:41)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "pass exactly one of covariance_matrix/precision_matrix/"
+                "scale_tril")
+        self.loc = _value(loc)
+        if scale_tril is not None:
+            self._scale_tril = _value(scale_tril)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(_value(covariance_matrix))
+        else:
+            prec = _value(precision_matrix)
+            # chol(Sigma) from chol(P): Sigma = P^-1
+            chol_p = jnp.linalg.cholesky(prec)
+            eye = jnp.eye(prec.shape[-1], dtype=prec.dtype)
+            inv_chol = jax.scipy.linalg.solve_triangular(chol_p, eye,
+                                                         lower=True)
+            self._scale_tril = jnp.linalg.cholesky(
+                jnp.swapaxes(inv_chol, -1, -2) @ inv_chol)
+        d = self.loc.shape[-1]
+        super().__init__(batch_shape=self.loc.shape[:-1], event_shape=(d,))
+
+    @property
+    def covariance_matrix(self):
+        from .distribution import _wrap
+        return _wrap(self._scale_tril
+                     @ jnp.swapaxes(self._scale_tril, -1, -2))
+
+    @property
+    def scale_tril(self):
+        from .distribution import _wrap
+        return _wrap(self._scale_tril)
+
+    def _rsample(self, key, shape):
+        shp = tuple(shape) + self.loc.shape
+        eps = jax.random.normal(key, shp, self.loc.dtype)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril,
+                                     eps)
+
+    def _log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = value - self.loc
+        # solve L z = diff  =>  z = L^-1 diff; |z|^2 is the Mahalanobis term
+        z = jax.scipy.linalg.solve_triangular(
+            self._scale_tril, diff[..., None], lower=True)[..., 0]
+        half_log_det = jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)),
+            axis=-1)
+        return (-0.5 * jnp.sum(z * z, axis=-1) - half_log_det
+                - 0.5 * d * jnp.log(2 * jnp.pi))
+
+    def _entropy(self):
+        d = self.loc.shape[-1]
+        half_log_det = jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)),
+            axis=-1)
+        return 0.5 * d * (1.0 + jnp.log(2 * jnp.pi)) + half_log_det
+
+    def _mean(self):
+        return self.loc
+
+    def _variance(self):
+        return jnp.sum(self._scale_tril ** 2, axis=-1)

@@ -303,6 +303,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -331,8 +335,17 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
-            return _PrefetchIter(self._gen(),
-                                 depth=self.prefetch_factor * max(self.num_workers, 1))
+            from .worker import MultiprocessIter
+            if self.persistent_workers and not self._iterable_mode:
+                it = getattr(self, "_persistent_iter", None)
+                if (it is not None and not it._shutdown
+                        and all(w.is_alive() for w in it._workers)):
+                    it.reset()
+                    return it
+                self._persistent_iter = MultiprocessIter(self,
+                                                         persistent=True)
+                return self._persistent_iter
+            return MultiprocessIter(self)
         return self._gen()
 
     def __len__(self):
@@ -341,10 +354,7 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
-def get_worker_info():
-    return None
-
-
+from .worker import get_worker_info, WorkerInfo, WorkerException  # noqa: F401,E402
 from .native_feeder import (  # noqa: F401,E402
     FixedRecordDataset, NativeRecordLoader, write_records,
 )

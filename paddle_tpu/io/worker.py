@@ -1,0 +1,431 @@
+"""Multiprocess DataLoader workers.
+
+Reference: python/paddle/io/dataloader/dataloader_iter.py:358
+(_DataLoaderIterMultiProcess) and worker.py (_worker_loop) — worker
+processes + shared-memory tensor transfer + ordered result reassembly.
+
+TPU-native redesign: workers are pure-numpy producers. They never touch
+jax — sample decode + collate happens in the child, the resulting arrays
+cross the process boundary either inline (small) or via POSIX shared
+memory (large), and the *parent* performs the one host->device transfer
+per batch. This keeps XLA/PJRT state out of forked children entirely
+(the reference instead moves LoDTensors through paddle's own shared
+memory allocator).
+"""
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing as mp
+import os
+import queue
+import sys
+import traceback
+
+import numpy as np
+
+# Arrays bigger than this ride shared memory instead of the queue pickle.
+_SHM_THRESHOLD = int(os.environ.get("PADDLE_TPU_SHM_THRESHOLD", 1 << 16))
+
+_worker_info = None
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    seed: int
+    dataset: object
+
+
+def get_worker_info():
+    """Inside a worker process, returns that worker's WorkerInfo; None in
+    the main process (reference: io/dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
+
+class WorkerException(RuntimeError):
+    """A worker raised; carries the formatted remote traceback."""
+
+    def __init__(self, worker_id, tb):
+        super().__init__(
+            f"DataLoader worker {worker_id} raised:\n{tb}")
+        self.worker_id = worker_id
+        self.remote_traceback = tb
+
+
+class _ShmArray:
+    """Descriptor for a numpy array parked in shared memory by a worker."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+    def materialize(self):
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(name=self.name)
+        try:
+            # Copy out so the segment can be released immediately; the copy
+            # is the staging buffer handed to the device transfer.
+            arr = np.frombuffer(shm.buf, dtype=self.dtype).reshape(self.shape).copy()
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+
+
+class _TensorLeaf:
+    """Marks a leaf that was a paddle Tensor on the worker side, so the
+    parent re-wraps exactly those leaves (and no others) as Tensors."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload):
+        self.payload = payload
+
+
+def _export_array(arr, shm_threshold):
+    arr = np.ascontiguousarray(arr)
+    if shm_threshold is not None and arr.nbytes >= shm_threshold:
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+        np.frombuffer(shm.buf, dtype=arr.dtype)[:] = arr.reshape(-1)
+        desc = _ShmArray(shm.name, arr.shape, arr.dtype)
+        shm.close()
+        return desc
+    return arr
+
+
+def _pack(obj, shm_threshold):
+    """Worker-side: Tensor -> tagged numpy (shm for large), containers
+    recursed, everything else pickled as-is."""
+    from ..core.tensor import Tensor
+    if isinstance(obj, _TensorLeaf):
+        return _TensorLeaf(_export_array(np.asarray(obj.payload), shm_threshold))
+    if isinstance(obj, Tensor):
+        return _TensorLeaf(_export_array(np.asarray(obj._value), shm_threshold))
+    if isinstance(obj, np.ndarray):
+        return _export_array(obj, shm_threshold)
+    if isinstance(obj, tuple):
+        return tuple(_pack(x, shm_threshold) for x in obj)
+    if isinstance(obj, list):
+        return [_pack(x, shm_threshold) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _pack(v, shm_threshold) for k, v in obj.items()}
+    return obj
+
+
+def _unpack(obj):
+    """Parent-side inverse of _pack; Tensor leaves become device Tensors."""
+    if isinstance(obj, _TensorLeaf):
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(_materialize(obj.payload)))
+    if isinstance(obj, _ShmArray):
+        return obj.materialize()
+    if isinstance(obj, tuple):
+        return tuple(_unpack(x) for x in obj)
+    if isinstance(obj, list):
+        return [_unpack(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    return obj
+
+
+def _materialize(payload):
+    return payload.materialize() if isinstance(payload, _ShmArray) else payload
+
+
+def _discard(obj):
+    """Release shm segments of a result that will never be consumed."""
+    if isinstance(obj, _TensorLeaf):
+        obj = obj.payload
+    if isinstance(obj, _ShmArray):
+        try:
+            obj.materialize()
+        except Exception:
+            pass
+        return
+    if isinstance(obj, (list, tuple)):
+        for x in obj:
+            _discard(x)
+    elif isinstance(obj, dict):
+        for x in obj.values():
+            _discard(x)
+
+
+def _worker_loop(dataset, iterable_mode, batch_size, drop_last, collate_fn,
+                 index_queue, result_queue, worker_id, num_workers, seed,
+                 init_fn, shm_threshold):
+    """Child process main. Reads (batch_idx, indices) tasks, emits
+    (batch_idx, packed_batch_or_error)."""
+    global _worker_info
+    _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
+                              seed=seed, dataset=dataset)
+    np.random.seed(seed % (1 << 32))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        it = iter(dataset) if iterable_mode else None
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            batch_idx, indices = task
+            try:
+                if iterable_mode:
+                    import itertools
+                    samples = list(itertools.islice(it, batch_size))
+                    if not samples or (drop_last and len(samples) < batch_size):
+                        result_queue.put((batch_idx, _IterableDone(worker_id)))
+                        continue
+                else:
+                    samples = [dataset[i] for i in indices]
+                batch = collate_fn(samples)
+                result_queue.put((batch_idx, _pack(batch, shm_threshold)))
+            except Exception:
+                result_queue.put(
+                    (batch_idx, _RemoteError(worker_id, traceback.format_exc())))
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        try:
+            result_queue.put((-1, _RemoteError(worker_id, traceback.format_exc())))
+        except Exception:
+            pass
+    finally:
+        result_queue.cancel_join_thread()
+        result_queue.close()
+
+
+def numpy_collate(batch):
+    """Worker-safe default collate: identical structure to
+    io.default_collate_fn but stacks to numpy and tags leaves as Tensor
+    payloads, so the parent (not the forked child) touches jax."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return _TensorLeaf(np.stack(batch))
+    if isinstance(sample, (int, float)):
+        return _TensorLeaf(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [numpy_collate(list(t)) for t in transposed]
+    if isinstance(sample, dict):
+        return {k: numpy_collate([b[k] for b in batch]) for k in sample}
+    # Tensor leaves (rare in workers) fall through to _pack via identity.
+    from ..core.tensor import Tensor
+    if isinstance(sample, Tensor):
+        return _TensorLeaf(np.stack([np.asarray(b._value) for b in batch]))
+    return batch
+
+
+class _RemoteError:
+    def __init__(self, worker_id, tb):
+        self.worker_id = worker_id
+        self.tb = tb
+
+
+class _IterableDone:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+class MultiprocessIter:
+    """Parent-side iterator: N workers, round-robin task assignment, ordered
+    reassembly via a reordering buffer keyed by sequential batch index
+    (map-style) or arrival order (iterable-style)."""
+
+    def __init__(self, loader, persistent=False):
+        self._loader = loader
+        self._num_workers = loader.num_workers
+        self._timeout = loader.timeout or None
+        self._iterable = loader._iterable_mode
+        self._persistent = persistent and not self._iterable
+        # forkserver: workers fork from a clean helper process with no JAX
+        # threads — plain fork of the jax-laden parent can deadlock in
+        # malloc/locale locks (observed), and spawn pays a full re-import.
+        # PADDLE_TPU_WORKER_START=fork opts back in for unpicklable datasets.
+        ctx_name = os.environ.get(
+            "PADDLE_TPU_WORKER_START",
+            "forkserver" if sys.platform.startswith("linux") else "spawn")
+        ctx = mp.get_context(ctx_name)
+        from . import default_collate_fn
+        collate = loader.collate_fn
+        if collate is default_collate_fn:
+            collate = numpy_collate
+        self._result_queue = ctx.Queue()
+        self._index_queues = []
+        self._workers = []
+        base_seed = int(np.random.randint(0, 2**31 - 1))
+        for wid in range(self._num_workers):
+            iq = ctx.Queue()
+            iq.cancel_join_thread()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._iterable, loader.batch_size
+                      if self._iterable else None, loader.drop_last
+                      if self._iterable else False, collate, iq,
+                      self._result_queue, wid, self._num_workers,
+                      base_seed + wid, loader.worker_init_fn,
+                      (_SHM_THRESHOLD if loader.use_shared_memory
+                       else None)),
+                daemon=True)
+            w.start()
+            self._index_queues.append(iq)
+            self._workers.append(w)
+
+        self._send_idx = 0          # next batch index to hand to a worker
+        self._rcvd_idx = 0          # next batch index owed to the consumer
+        self._reorder = {}          # batch_idx -> packed result
+        self._done_workers = set()  # iterable mode: exhausted workers
+        self._shutdown = False
+        if self._iterable:
+            self._sampler_iter = None
+        else:
+            self._sampler_iter = iter(loader.batch_sampler)
+        # Prime the pipeline.
+        for _ in range(loader.prefetch_factor * self._num_workers):
+            if not self._dispatch():
+                break
+
+    def _dispatch(self):
+        wid = self._send_idx % self._num_workers
+        if self._iterable:
+            if wid in self._done_workers:
+                # Skip exhausted workers but keep indices monotone.
+                live = [w for w in range(self._num_workers)
+                        if w not in self._done_workers]
+                if not live:
+                    return False
+                wid = live[self._send_idx % len(live)]
+            self._index_queues[wid].put((self._send_idx, None))
+        else:
+            try:
+                indices = next(self._sampler_iter)
+            except StopIteration:
+                return False
+            self._index_queues[wid].put((self._send_idx, indices))
+        self._send_idx += 1
+        return True
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        """Start a new epoch on the SAME worker processes
+        (persistent_workers=True; reference: _DataLoaderIterMultiProcess
+        reuse under persistent_workers). Batch indices stay monotone so
+        late results from the previous epoch can never collide."""
+        if not self._persistent or self._shutdown:
+            raise RuntimeError("reset() requires live persistent workers")
+        # drain tasks left over from an abandoned epoch
+        while self._rcvd_idx < self._send_idx:
+            if self._rcvd_idx in self._reorder:
+                _discard(self._reorder.pop(self._rcvd_idx))
+                self._rcvd_idx += 1
+                continue
+            batch_idx, data = self._get_with_watchdog()
+            self._reorder[batch_idx] = data
+        self._sampler_iter = iter(self._loader.batch_sampler)
+        for _ in range(self._loader.prefetch_factor * self._num_workers):
+            if not self._dispatch():
+                break
+
+    def __next__(self):
+        while True:
+            if not self._iterable and self._rcvd_idx >= self._send_idx:
+                if not self._persistent:
+                    self._shutdown_workers()
+                raise StopIteration
+            if self._iterable and len(self._done_workers) >= self._num_workers \
+                    and self._rcvd_idx >= self._send_idx:
+                self._shutdown_workers()
+                raise StopIteration
+            if self._rcvd_idx in self._reorder:
+                data = self._reorder.pop(self._rcvd_idx)
+                self._rcvd_idx += 1
+                result = self._consume(data)
+                if result is _SKIP:
+                    continue
+                return result
+            batch_idx, data = self._get_with_watchdog()
+            if batch_idx == -1 and isinstance(data, _RemoteError):
+                self._shutdown_workers()
+                raise WorkerException(data.worker_id, data.tb)
+            self._reorder[batch_idx] = data
+
+    _SKIP = object()
+
+    def _get_with_watchdog(self):
+        """Blocking result fetch that still notices dead workers (the
+        reference's _thread_monitor analog) and honors the user timeout."""
+        import time
+        deadline = (time.monotonic() + self._timeout) if self._timeout else None
+        while True:
+            try:
+                return self._result_queue.get(timeout=5.0 if deadline is None
+                                              else min(5.0, self._timeout))
+            except queue.Empty:
+                self._check_workers_alive()
+                if deadline is not None and time.monotonic() > deadline:
+                    self._shutdown_workers()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self._timeout}s waiting "
+                        f"on {self._num_workers} workers")
+
+    def _consume(self, data):
+        if isinstance(data, _RemoteError):
+            self._shutdown_workers()
+            raise WorkerException(data.worker_id, data.tb)
+        if isinstance(data, _IterableDone):
+            self._done_workers.add(data.worker_id)
+            self._dispatch()  # keep still-live workers' pipelines full
+            return _SKIP
+        self._dispatch()
+        return _unpack(data)
+
+    def _check_workers_alive(self):
+        for w in self._workers:
+            if not w.is_alive() and w.exitcode not in (0, None):
+                self._shutdown_workers()
+                raise RuntimeError(
+                    f"DataLoader worker pid={w.pid} died with "
+                    f"exitcode {w.exitcode} (often an OOM kill)")
+
+    def _shutdown_workers(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for d in self._reorder.values():
+            _discard(d)
+        self._reorder.clear()
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+        # Drain any stragglers so their shm segments get unlinked.
+        try:
+            while True:
+                _, d = self._result_queue.get_nowait()
+                _discard(d)
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self._shutdown_workers()
+        except Exception:
+            pass
+
+
+_SKIP = MultiprocessIter._SKIP

@@ -62,6 +62,15 @@ class TracedProgram:
 class StaticFunction:
     def __init__(self, function, layer=None, full_graph=True, backend=None,
                  input_spec=None):
+        # AST-convert python control flow (if/while/for-range on tensor
+        # values -> lax.cond/while_loop); falls back to the original
+        # function when nothing is convertible (dy2static.py).
+        from .dy2static import convert_function
+        self._source_function = function
+        try:
+            function = convert_function(function)
+        except Exception:
+            function = self._source_function
         self._function = function
         self._layer = layer
         self._cache = {}
@@ -81,12 +90,23 @@ class StaticFunction:
         if self._layer is not None:
             sources.append(self._layer)
         else:
-            fn = self._function
+            fn = self._source_function
+            fn = getattr(fn, "__func__", fn)
+            candidates = []
             for cell in (getattr(fn, "__closure__", None) or ()):
                 try:
-                    v = cell.cell_contents
+                    candidates.append(cell.cell_contents)
                 except ValueError:
                     continue
+            # module-level models referenced as globals are holders too
+            # (the reference's dy2static resolves them through the frame's
+            # global namespace the same way)
+            code = getattr(fn, "__code__", None)
+            glb = getattr(fn, "__globals__", {})
+            for name in (code.co_names if code is not None else ()):
+                if name in glb:
+                    candidates.append(glb[name])
+            for v in candidates:
                 if isinstance(v, Tensor) or (
                         not isinstance(v, type)
                         and hasattr(v, "named_parameters")):

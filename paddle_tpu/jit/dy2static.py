@@ -1,0 +1,540 @@
+"""Dynamic-to-static control-flow conversion.
+
+Reference analog: python/paddle/jit/dy2static/ (program_translator.py:773
+AST transformation of if/while/for into cond/while ops,
+convert_operators.py convert_ifelse/convert_while_loop) and the SOT
+bytecode path's guarded fallback (jit/sot/translate.py:31).
+
+TPU-native redesign: the target IR is jax, so conversion maps python
+control flow onto `lax.cond` / `lax.while_loop` — XLA's native control
+flow — instead of building Program blocks. The pipeline:
+
+1. AST pass (`convert_function`): rewrites `if` / `while` /
+   `for i in range(...)` statements whose bodies are convertible (no
+   return/break/continue inside) into calls to the runtime helpers
+   below, hoisting the names each branch/body assigns into explicit
+   loop-carried tuples.
+2. Runtime helpers (`convert_if` / `convert_while` /
+   `convert_for_range`): decide *at trace time* whether the condition
+   is tensor-dependent (a jax tracer). Python conditions keep exact
+   python semantics (the graph never breaks for static control flow);
+   traced conditions lower to lax.cond / lax.while_loop.
+3. Fallback: any function the AST pass cannot convert runs untouched;
+   if it then branches on a traced tensor, Tensor.__bool__ raises a
+   Dy2StaticError with guidance (the loud-failure contract) instead of
+   jax's raw tracer error.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import linecache
+import textwrap
+import types
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import tree_util
+
+from ..core.tensor import Tensor
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+_GUIDE = (
+    "this python control flow depends on a traced tensor value inside "
+    "to_static/jit. Convertible forms (plain if/while/for-range with no "
+    "return/break/continue in the body) are lowered to lax.cond/"
+    "while_loop automatically; rewrite the failing construct into such a "
+    "form, hoist it out of the traced region, or mark the function with "
+    "@paddle_tpu.jit.not_to_static to keep it eager."
+)
+
+
+class _Undef:
+    """Placeholder for a name unbound before a converted block (reference:
+    dy2static UndefinedVar)."""
+
+    _singleton = None
+
+    def __new__(cls):
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+        return cls._singleton
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undef()
+tree_util.register_pytree_node(
+    _Undef, lambda u: ((), None), lambda aux, ch: UNDEF)
+
+
+def _is_traced(x):
+    if isinstance(x, Tensor):
+        x = x._value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_val(cond):
+    v = cond._value if isinstance(cond, Tensor) else jnp.asarray(cond)
+    if getattr(v, "ndim", 0) != 0 and getattr(v, "size", 1) != 1:
+        raise Dy2StaticError(
+            f"converted condition must be a scalar, got shape {v.shape}")
+    return jnp.reshape(v, ()).astype(bool)
+
+
+def _to_bool(cond):
+    if isinstance(cond, Tensor):
+        return bool(np.asarray(cond._value))
+    return bool(cond)
+
+
+def _to_carry(x, what):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (bool, int, float, np.ndarray, np.generic)):
+        return jnp.asarray(x)
+    if x is None or x is UNDEF or isinstance(x, jax.Array) or _is_traced(x):
+        return x
+    raise Dy2StaticError(
+        f"{what} carries variable of type {type(x).__name__}; converted "
+        f"control flow can only carry Tensor/scalar values. " + _GUIDE)
+
+
+def _rewrap(template, leaves):
+    out = []
+    for t, v in zip(template, leaves):
+        if v is None or v is UNDEF:
+            out.append(v)
+        else:
+            out.append(Tensor(v) if not isinstance(v, Tensor) else v)
+    return tuple(out)
+
+
+def _rebind(template, carry):
+    """Rebuild the branch-local var tuple from carried values."""
+    return _rewrap(template, carry)
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (targets of the AST rewrite)
+# ---------------------------------------------------------------------------
+
+def convert_if(cond, true_fn, false_fn, init_vars):
+    if not _is_traced(cond):
+        return true_fn(init_vars) if _to_bool(cond) else false_fn(init_vars)
+
+    carry0 = tuple(_to_carry(v, "if-branch") for v in init_vars)
+
+    def mk(fn, label):
+        def branch(carry):
+            out = fn(_rebind(init_vars, carry))
+            return tuple(_to_carry(v, f"{label}-branch result") for v in out)
+        return branch
+
+    try:
+        res = lax.cond(_pred_val(cond), mk(true_fn, "true"),
+                       mk(false_fn, "false"), carry0)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "converted if-branches must assign every converted variable "
+            "to matching Tensor shapes/dtypes in BOTH branches "
+            f"(jax: {e}). " + _GUIDE) from None
+    return _rewrap(init_vars, res)
+
+
+def convert_while(cond_fn, body_fn, init_vars):
+    c = cond_fn(init_vars)
+    if not _is_traced(c):
+        vars_ = init_vars
+        while _to_bool(c):
+            vars_ = body_fn(vars_)
+            c = cond_fn(vars_)
+        return vars_
+
+    carry0 = tuple(_to_carry(v, "while-loop") for v in init_vars)
+
+    def cond_w(carry):
+        return _pred_val(cond_fn(_rebind(init_vars, carry)))
+
+    def body_w(carry):
+        out = body_fn(_rebind(init_vars, carry))
+        return tuple(_to_carry(v, "while-body result") for v in out)
+
+    try:
+        res = lax.while_loop(cond_w, body_w, carry0)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "converted while-loop carry must keep stable shapes/dtypes "
+            f"across iterations (jax: {e}). " + _GUIDE) from None
+    return _rewrap(init_vars, res)
+
+
+def convert_for_range(start, stop, step, body_fn, init_vars,
+                      prior_target=UNDEF):
+    """Returns (final_target, *converted_vars). The python path preserves
+    exact semantics (target keeps its prior binding on a zero-trip loop);
+    the traced path's zero-trip target is `start` (lax.while_loop cannot
+    carry an UNDEF that a later iteration replaces with an array)."""
+    if not any(_is_traced(b) for b in (start, stop, step)):
+        vars_ = init_vars
+        last = prior_target
+        for i in range(_as_int(start), _as_int(stop), _as_int(step)):
+            vars_ = body_fn(i, vars_)
+            last = i
+        return (last,) + tuple(vars_)
+
+    carry0 = tuple(_to_carry(v, "for-loop") for v in init_vars)
+    i0 = jnp.asarray(start._value if isinstance(start, Tensor) else start)
+    stop_v = jnp.asarray(stop._value if isinstance(stop, Tensor) else stop)
+    step_v = jnp.asarray(step._value if isinstance(step, Tensor) else step)
+
+    def cond_w(state):
+        i, _, _ = state
+        return jnp.where(step_v > 0, i < stop_v, i > stop_v)
+
+    def body_w(state):
+        i, _, carry = state
+        out = body_fn(Tensor(i), _rebind(init_vars, carry))
+        return (i + step_v, i,
+                tuple(_to_carry(v, "for-body result") for v in out))
+
+    try:
+        _, last_i, res = lax.while_loop(cond_w, body_w, (i0, i0, carry0))
+    except TypeError as e:
+        raise Dy2StaticError(
+            "converted for-loop carry must keep stable shapes/dtypes "
+            f"across iterations (jax: {e}). " + _GUIDE) from None
+    return (Tensor(last_i),) + _rewrap(init_vars, res)
+
+
+def _as_int(x):
+    return int(np.asarray(x._value)) if isinstance(x, Tensor) else int(x)
+
+
+def convert_and(a, b_fn):
+    if _is_traced(a):
+        from .. import ops
+        return Tensor(jnp.logical_and(_pred_val(a), _pred_val(b_fn())))
+    return b_fn() if _to_bool(a) else a
+
+
+def convert_or(a, b_fn):
+    if _is_traced(a):
+        return Tensor(jnp.logical_or(_pred_val(a), _pred_val(b_fn())))
+    return a if _to_bool(a) else b_fn()
+
+
+def convert_not(a):
+    if _is_traced(a):
+        return Tensor(jnp.logical_not(_pred_val(a)))
+    return not _to_bool(a)
+
+
+def undef_guard(ns, name):
+    return ns.get(name, UNDEF)
+
+
+# ---------------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------------
+
+_BREAKING = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom)
+
+
+def _has_breaking(stmts):
+    def check(node):
+        if isinstance(node, _BREAKING):
+            return True
+        # nested function/class bodies own their control flow
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return False
+        return any(check(c) for c in ast.iter_child_nodes(node))
+    return any(check(s) for s in stmts)
+
+
+def _assigned_names(stmts):
+    """Names bound by simple assignments within `stmts` (not descending
+    into nested function/class definitions)."""
+    names = []
+
+    def visit(body):
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    collect_target(t)
+            elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+                collect_target(s.target)
+            elif isinstance(s, ast.For):
+                collect_target(s.target)
+                visit(s.body)
+                visit(s.orelse)
+            elif isinstance(s, (ast.If, ast.While)):
+                visit(s.body)
+                visit(s.orelse)
+            elif isinstance(s, ast.With):
+                for item in s.items:
+                    if item.optional_vars is not None:
+                        collect_target(item.optional_vars)
+                visit(s.body)
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            if t.id not in names:
+                names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+
+    visit(stmts)
+    return names
+
+
+def _names_tuple_src(names):
+    if not names:
+        return "()"
+    return "(" + ", ".join(names) + ("," if len(names) == 1 else "") + ")"
+
+
+class _TestTransformer(ast.NodeTransformer):
+    """Rewrites `and`/`or`/`not` inside a converted test expression into
+    short-circuit-preserving helper calls."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        helper = "convert_and" if isinstance(node.op, ast.And) else "convert_or"
+        expr = node.values[0]
+        for nxt in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(value=ast.Name("__jst__", ast.Load()),
+                                   attr=helper, ctx=ast.Load()),
+                args=[expr, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                       kw_defaults=[], defaults=[]),
+                    body=nxt)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(value=ast.Name("__jst__", ast.Load()),
+                                   attr="convert_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.converted = 0
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"__jst_{kind}_{self.counter}"
+
+    def _undef_guards(self, names):
+        out = []
+        for n in names:
+            tmpl = (f"try:\n    {n}\nexcept NameError:\n"
+                    f"    {n} = __jst__.UNDEF")
+            out.extend(ast.parse(tmpl).body)
+        return out
+
+    def _mk_branch_fn(self, name, names, body):
+        nt = _names_tuple_src(names)
+        src = f"def {name}(__jst_vars):\n"
+        if names:
+            src += f"    {nt} = __jst_vars\n"
+        src += "    pass\n"
+        src += f"    return {nt}\n"
+        fn = ast.parse(src).body[0]
+        # replace the `pass` placeholder with the (already-visited) body
+        pass_idx = next(i for i, s in enumerate(fn.body)
+                        if isinstance(s, ast.Pass))
+        fn.body = fn.body[:pass_idx] + list(body) + fn.body[pass_idx + 1:]
+        return fn
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_breaking(node.body) or _has_breaking(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        tf, ff = self._fresh("true"), self._fresh("false")
+        true_fn = self._mk_branch_fn(tf, names, node.body)
+        false_fn = self._mk_branch_fn(ff, names, node.orelse or [ast.Pass()])
+        nt = _names_tuple_src(names)
+        call_src = (f"{nt} = __jst__.convert_if(__JST_COND__, {tf}, {ff}, {nt})"
+                    if names else
+                    f"__jst__.convert_if(__JST_COND__, {tf}, {ff}, ())")
+        call = ast.parse(call_src).body[0]
+        test = _TestTransformer().visit(node.test)
+        _replace_name(call, "__JST_COND__", test)
+        self.converted += 1
+        return self._undef_guards(names) + [true_fn, false_fn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_breaking(node.body) or node.orelse:
+            return node
+        names = _assigned_names(node.body)
+        cf, bf = self._fresh("cond"), self._fresh("body")
+        nt = _names_tuple_src(names)
+        cond_src = f"def {cf}(__jst_vars):\n"
+        if names:
+            cond_src += f"    {nt} = __jst_vars\n"
+        cond_src += "    return __JST_COND__\n"
+        cond_fn = ast.parse(cond_src).body[0]
+        test = _TestTransformer().visit(node.test)
+        _replace_name(cond_fn, "__JST_COND__", test)
+        body_fn = self._mk_branch_fn(bf, names, node.body)
+        call = ast.parse(
+            f"{nt} = __jst__.convert_while({cf}, {bf}, {nt})" if names else
+            f"__jst__.convert_while({cf}, {bf}, ())").body[0]
+        self.converted += 1
+        return self._undef_guards(names) + [cond_fn, body_fn, call]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (_has_breaking(node.body) or node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and not node.iter.keywords)):
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        elif len(rargs) == 3:
+            start, stop, step = rargs
+        else:
+            return node
+        target = node.target.id
+        names = [n for n in _assigned_names(node.body) if n != target]
+        bf = self._fresh("forbody")
+        nt = _names_tuple_src(names)
+        out_t = _names_tuple_src([target] + names)
+        src = f"def {bf}({target}, __jst_vars):\n"
+        if names:
+            src += f"    {nt} = __jst_vars\n"
+        src += "    pass\n"
+        src += f"    return {nt}\n"
+        body_fn = ast.parse(src).body[0]
+        pass_idx = next(i for i, s in enumerate(body_fn.body)
+                        if isinstance(s, ast.Pass))
+        body_fn.body = (body_fn.body[:pass_idx] + list(node.body)
+                        + body_fn.body[pass_idx + 1:])
+        call = ast.parse(
+            f"{out_t} = __jst__.convert_for_range(__JST_A__, __JST_B__, "
+            f"__JST_C__, {bf}, {nt}, {target})").body[0]
+        _replace_name(call, "__JST_A__", start)
+        _replace_name(call, "__JST_B__", stop)
+        _replace_name(call, "__JST_C__", step)
+        self.converted += 1
+        return self._undef_guards([target] + names) + [body_fn, call]
+
+
+def _replace_name(tree, placeholder, replacement):
+    class R(ast.NodeTransformer):
+        def visit_Name(self, n):
+            if n.id == placeholder:
+                return replacement
+            return n
+    R().visit(tree)
+
+
+_CONVERT_CACHE = {}
+
+
+def convert_function(fn):
+    """AST-convert `fn`'s control flow. Returns the converted function, or
+    `fn` unchanged when nothing is convertible / source is unavailable."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    bound_self = getattr(fn, "__self__", None)
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    key = raw
+    if key in _CONVERT_CACHE:
+        conv = _CONVERT_CACHE[key]
+    else:
+        conv = _convert_raw(raw)
+        _CONVERT_CACHE[key] = conv
+    if conv is raw:
+        return fn
+    if bound_self is not None:
+        return types.MethodType(conv, bound_self)
+    return conv
+
+
+def _convert_raw(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    tr = ControlFlowTransformer()
+    tr.visit(fdef)
+    if tr.converted == 0:
+        return fn
+    ast.fix_missing_locations(tree)
+
+    freevars = fn.__code__.co_freevars
+    closure = fn.__closure__ or ()
+    if freevars:
+        # rebuild the closure by nesting the converted def in a shim that
+        # takes the free variables as parameters
+        inner_name = fdef.name
+        shim = ast.parse(
+            f"def __jst_shim__({', '.join(freevars)}):\n"
+            f"    pass\n"
+            f"    return {inner_name}\n").body[0]
+        shim.body = [fdef, shim.body[-1]]
+        module = ast.Module(body=[shim], type_ignores=[])
+    else:
+        module = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(module)
+
+    glb = dict(fn.__globals__)
+    glb["__jst__"] = _helpers_namespace()
+    filename = f"<dy2static {fn.__qualname__}>"
+    try:
+        code = compile(module, filename, "exec")
+    except SyntaxError:
+        return fn
+    # make the generated source inspectable in tracebacks
+    gen_src = ast.unparse(module)
+    linecache.cache[filename] = (
+        len(gen_src), None, gen_src.splitlines(True), filename)
+    ns = {}
+    exec(code, glb, ns)
+    if freevars:
+        new_fn = ns["__jst_shim__"](*[c.cell_contents for c in closure])
+    else:
+        new_fn = ns[fdef.name]
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__converted_by_dy2static__ = True
+    return new_fn
+
+
+def _helpers_namespace():
+    import sys
+    return sys.modules[__name__]

@@ -130,6 +130,12 @@ class Optimizer:
             self._accumulators[id(p)] = ns
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from .. import static as static_mod
+        if static_mod._static_enabled():
+            # static build: record the training hook; Executor.run replays
+            # the captured graph, backprops, and steps (static/__init__.py)
+            static_mod.default_main_program()._register_minimize(self, loss)
+            return None, [(p, None) for p in self._parameter_list]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]

@@ -173,6 +173,53 @@ class QuantedConv2D(_QuantedBase):
                         groups=inner._groups)
 
 
+class _ConvertedBase(nn.Layer):
+    """Inference-time quantized layer: int8 weight buffer + frozen scales
+    (the runtime form the reference's convert pass emits)."""
+
+    def __init__(self, quanted: "_QuantedBase", cfg: "QuantConfig"):
+        super().__init__()
+        inner = quanted.inner
+        self.bits = cfg.weight_bits
+        self.act_bits = cfg.activation_bits
+        w_scale = quanted.w_observer.scale()
+        self.weight_scale = np.float32(w_scale)
+        self.act_scale = Tensor(np.float32(quanted.a_observer.scale()))
+        wq = quant_linear(inner.weight, Tensor(np.float32(w_scale)),
+                          self.bits)
+        self.weight_int8 = Tensor(wq._value.astype("int8"))
+        self.bias = getattr(inner, "bias", None)
+        # copy the hyperparameters and DROP the fp32 layer — keeping it
+        # registered would retain (and serialize) the weights this pass
+        # exists to shrink
+        if isinstance(inner, nn.Conv2D):
+            self._stride = inner._stride
+            self._padding = inner._padding
+            self._dilation = inner._dilation
+            self._groups = inner._groups
+
+    def _dequant_weight(self):
+        from .. import ops
+        w = ops.cast(self.weight_int8, "float32")
+        return w * float(self.weight_scale) / float(2 ** (self.bits - 1) - 1)
+
+
+class ConvertedLinear(_ConvertedBase):
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = fake_quant(x, self.act_scale, self.act_bits)
+        return F.linear(xq, self._dequant_weight(), self.bias)
+
+
+class ConvertedConv2D(_ConvertedBase):
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = fake_quant(x, self.act_scale, self.act_bits)
+        return F.conv2d(xq, self._dequant_weight(), self.bias,
+                        stride=self._stride, padding=self._padding,
+                        dilation=self._dilation, groups=self._groups)
+
+
 # ---- QAT / PTQ drivers ----------------------------------------------------
 
 def _swap_layers(model, cfg, wrap):
@@ -202,13 +249,22 @@ class QAT:
         return _swap_layers(model, self.cfg, wrap)
 
     def convert(self, model, inplace=True):
-        """Bake int8 weights + scales (simulated-int8 deploy)."""
+        """Conversion pass (reference: quantization/quantize.py convert →
+        inference program with frozen quant scales): every _QuantedBase
+        wrapper is REPLACED by a Converted* inference layer holding the
+        int8 weight buffer + frozen weight/activation scales — observers
+        are gone, weight memory is 1/4, and the dequant folds into the
+        matmul/conv under XLA fusion."""
         for name, sub in list(model.named_sublayers()):
-            if isinstance(sub, _QuantedBase):
-                w_scale = sub.w_observer.scale()
-                sub.inner.weight_int8 = quant_linear(
-                    sub.inner.weight, w_scale, self.cfg.weight_bits)
-                sub.inner.weight_scale = w_scale
+            if not isinstance(sub, _QuantedBase):
+                continue
+            parent = model
+            parts = name.split(".")
+            for p in parts[:-1]:
+                parent = getattr(parent, p)
+            cls = (ConvertedConv2D if isinstance(sub, QuantedConv2D)
+                   else ConvertedLinear)
+            setattr(parent, parts[-1], cls(sub, self.cfg))
         return model
 
 

@@ -1,29 +1,63 @@
-"""Static-graph compatibility surface (reference: python/paddle/static/).
+"""Static-graph surface: op capture + replaying Executor.
 
-The reference's Program/Executor stack (base/executor.py:1152,
-framework.py:5736, StandaloneExecutor) interprets an op-list IR. On the TPU
-stack the compiled artifact IS the program (jaxpr/StableHLO via jit), so
-`static.Executor.run` executes traced callables; `paddle.enable_static()`
-flips a flag that makes `data()` return placeholder specs consumed by a
-traced build. This module provides the data-plumbing parity used by tests
-and high-level training loops.
+Reference: python/paddle/static/ — Program (framework.py:5736) records ops
+appended by the layer calls between `enable_static()` and `Executor.run`
+(base/executor.py:1152), which then interprets the op list against a feed
+dict and returns fetches.
+
+TPU-native redesign: while static mode is on, every eager dispatch
+(core/dispatch.py:_apply) ALSO appends (impl, statics, input-refs,
+output-ids) to the default Program — the op-list IR is captured from the
+same pure-jnp impls the eager mode runs, not from a separate operator
+registry. `Executor.run` replays that list with the feed substituted:
+
+- inference fetches replay as ONE jitted program (the whole captured op
+  list traces into a single XLA executable, cached per feed signature);
+- when `optimizer.minimize(loss)` was captured, run() replays eagerly
+  through the autograd tape against the *live* parameter tensors, then
+  backprops and steps — one exe.run == one training step, reference
+  semantics (executor.py `run(main_program, feed, fetch_list)`).
+
+Anything run() cannot honor (unknown fetch, missing feed) raises loudly —
+never echoes the fetch list back.
 """
 from __future__ import annotations
 
+import contextlib
+
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..core import dtype as dtypes
+from ..core import dispatch as _dispatch
 
 _static_mode = [False]
+_capture_suspended = [0]
 
 
 def _enable():
     _static_mode[0] = True
+    _dispatch.set_static_capture_hook(_capture_op)
+
+
+def _disable():
+    _static_mode[0] = False
+    _dispatch.set_static_capture_hook(None)
 
 
 def _static_enabled():
     return _static_mode[0]
+
+
+@contextlib.contextmanager
+def _suspend_capture():
+    _capture_suspended[0] += 1
+    try:
+        yield
+    finally:
+        _capture_suspended[0] -= 1
 
 
 class InputSpec:
@@ -41,56 +75,269 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-def data(name, shape, dtype="float32", lod_level=0):
-    shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else s for s in shape]
-    return InputSpec(shape, dtype, name)
-
-
 class Program:
-    def __init__(self):
-        self._traced_fn = None
+    """Captured op-list program. `_ops` entries:
+    (name, impl, statics, in_refs, out_ids) where in_refs are
+    ('v', tensor_id) | ('c', raw_value)."""
 
+    def __init__(self):
+        self._ops = []
+        self._tensors = {}        # tensor_id -> Tensor (live handles)
+        self._feed_vars = {}      # name -> placeholder Tensor
+        self._minimize = None     # (optimizer, loss Tensor)
+
+    # -- capture --------------------------------------------------------
+    def _record(self, name, impl, statics, tensor_args, outs):
+        in_refs = []
+        for t in tensor_args:
+            if isinstance(t, Tensor):
+                in_refs.append(("v", id(t)))
+                self._tensors[id(t)] = t
+            else:
+                in_refs.append(("c", t))
+        out_ids = []
+        for o in outs:
+            out_ids.append(id(o))
+            self._tensors[id(o)] = o
+        self._ops.append((name, impl, statics, in_refs, out_ids))
+
+    def _register_minimize(self, optimizer, loss):
+        self._minimize = (optimizer, loss)
+
+    # -- reference API surface ------------------------------------------
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
-        return self
+        if not for_test:
+            return self
+        p = Program()
+        p._ops = list(self._ops)
+        p._tensors = dict(self._tensors)
+        p._feed_vars = dict(self._feed_vars)
+        p._minimize = None  # the eval clone drops the training hook
+        return p
+
+    def list_vars(self):
+        return list(self._tensors.values())
+
+    @property
+    def num_ops(self):
+        return len(self._ops)
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
 
 
 def default_main_program():
-    return Program()
+    return _default_main[-1]
 
 
 def default_startup_program():
-    return Program()
+    return _default_startup[-1]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Reference: static.program_guard (framework.py:7436)."""
+    _default_main.append(main_program)
+    _default_startup.append(startup_program or Program())
+    try:
+        yield
+    finally:
+        _default_main.pop()
+        _default_startup.pop()
+
+
+def _capture_op(name, impl, statics, tensor_args, outs):
+    if not _static_mode[0] or _capture_suspended[0]:
+        return
+    default_main_program()._record(name, impl, statics, tensor_args, outs)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Reference: static.data — a feed placeholder. The returned Tensor
+    carries a zero value at build time (shape propagation runs through the
+    real kernels); dynamic dims (None/-1) build at size 1 and re-jit per
+    fed batch size."""
+    shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else s
+             for s in shape]
+    t = Tensor(jnp.zeros(shape, dtypes.convert_dtype(dtype)))
+    t.name = name
+    t.stop_gradient = True
+    default_main_program()._feed_vars[name] = t
+    default_main_program()._tensors[id(t)] = t
+    return t
 
 
 class Executor:
+    """Reference: static.Executor (base/executor.py:1152)."""
+
     def __init__(self, place=None):
         self.place = place
+        self._jit_cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        """In the TPU build, 'programs' are traced callables registered on
-        the Program, or the caller uses eager/jit paths directly."""
-        if fetch_list is None:
+        program = program or default_main_program()
+        feed = feed or {}
+        if fetch_list is None or not fetch_list:
+            # startup-program run: parameters initialize eagerly on this
+            # stack, so there is nothing to execute.
+            if program._ops and feed:
+                raise RuntimeError(
+                    "Executor.run with feed but no fetch_list: pass the "
+                    "variables to fetch")
             return []
+        if not program._ops:
+            raise NotImplementedError(
+                "Executor.run: this Program captured no ops — build the "
+                "graph between paddle.enable_static() and run(), or use "
+                "the eager/jit path")
+        if program._minimize is not None:
+            return self._run_train(program, feed, fetch_list)
+        return self._run_jitted(program, feed, fetch_list)
+
+    # -- training replay (eager tape against live parameters) -----------
+    def _run_train(self, program, feed, fetch_list):
+        env = self._replay_eager(program, feed)
+        out = self._collect(program, env, fetch_list, numpy=False)
+        opt, loss_var = program._minimize
+        loss_t = env.get(id(loss_var))
+        if loss_t is None:
+            raise RuntimeError(
+                "Executor.run: minimize() loss is not produced by this "
+                "program's ops")
+        with _suspend_capture():
+            loss_t.backward()
+            opt.step()
+            opt.clear_grad()
+        return [np.asarray(o._value) if isinstance(o, Tensor) else o
+                for o in out]
+
+    def _replay_eager(self, program, feed):
+        env = {}
+        for name, ph in program._feed_vars.items():
+            if name not in feed:
+                raise KeyError(
+                    f"Executor.run: feed is missing '{name}' "
+                    f"(declared by static.data)")
+            v = feed[name]
+            v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            t = Tensor(v)
+            t.stop_gradient = True
+            env[id(ph)] = t
+        with _suspend_capture():
+            for op_name, impl, statics, in_refs, out_ids in program._ops:
+                args = []
+                for kind, ref in in_refs:
+                    if kind == "c":
+                        args.append(ref)
+                    elif ref in env:
+                        args.append(env[ref])
+                    else:
+                        args.append(program._tensors[ref])  # live external
+                out = _dispatch.apply(op_name, impl, args, statics)
+                outs = out if isinstance(out, tuple) else (out,)
+                for oid, o in zip(out_ids, outs):
+                    env[oid] = o
+        return env
+
+    def _collect(self, program, env, fetch_list, numpy=True):
         out = []
         for f in fetch_list:
-            if isinstance(f, Tensor):
-                out.append(f.numpy())
-            elif callable(f):
-                out.append(f(feed))
-            else:
-                out.append(f)
+            if isinstance(f, str):
+                ph = program._feed_vars.get(f)
+                named = [t for t in program._tensors.values()
+                         if getattr(t, "name", None) == f]
+                f = ph if ph is not None else (named[0] if named else None)
+            if not isinstance(f, Tensor):
+                raise TypeError(
+                    f"Executor.run: cannot fetch {f!r} — fetch_list entries "
+                    f"must be program variables")
+            t = env.get(id(f), f if id(f) in program._tensors else None)
+            if t is None:
+                raise RuntimeError(
+                    f"Executor.run: fetch variable {getattr(f, 'name', f)!r} "
+                    f"is not computed by this program")
+            out.append(np.asarray(t._value) if numpy else t)
         return out
+
+    # -- inference replay (whole op list as ONE jitted program) ----------
+    def _run_jitted(self, program, feed, fetch_list):
+        feed_names = sorted(program._feed_vars)
+        for name in feed_names:
+            if name not in feed:
+                raise KeyError(
+                    f"Executor.run: feed is missing '{name}'")
+        feed_vals = []
+        for name in feed_names:
+            v = feed[name]
+            feed_vals.append(v._value if isinstance(v, Tensor)
+                             else jnp.asarray(v))
+
+        # externals: var refs read before produced and not feeds (e.g.
+        # parameters) — passed as inputs each run so updates are visible
+        feed_ids = {id(program._feed_vars[n]) for n in feed_names}
+        produced = set(feed_ids)
+        ext_ids = []
+        for _, _, _, in_refs, out_ids in program._ops:
+            for kind, ref in in_refs:
+                if kind == "v" and ref not in produced and ref not in ext_ids:
+                    ext_ids.append(ref)
+            produced.update(out_ids)
+
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, str):
+                named = [t for t in program._tensors.values()
+                         if getattr(t, "name", None) == f]
+                if not named:
+                    raise RuntimeError(
+                        f"Executor.run: no program variable named {f!r}")
+                f = named[0]
+            if not isinstance(f, Tensor):
+                raise TypeError(
+                    f"Executor.run: cannot fetch {f!r}")
+            if id(f) not in produced and id(f) not in set(ext_ids):
+                raise RuntimeError(
+                    f"Executor.run: fetch variable "
+                    f"{getattr(f, 'name', f)!r} is not computed by this "
+                    f"program")
+            fetch_ids.append(id(f))
+
+        sig = (id(program), program.num_ops, tuple(fetch_ids),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals))
+        fn = self._jit_cache.get(sig)
+        if fn is None:
+            ops = list(program._ops)
+            f_ids = [id(program._feed_vars[n]) for n in feed_names]
+            e_ids = list(ext_ids)
+            out_ids_wanted = list(fetch_ids)
+
+            def replay(feeds, exts):
+                env = dict(zip(f_ids, feeds))
+                env.update(zip(e_ids, exts))
+                for _name, impl, statics, in_refs, out_ids in ops:
+                    args = [env[r] if k == "v" else r for k, r in in_refs]
+                    res = impl(*args, **statics)
+                    res = res if isinstance(res, (tuple, list)) else (res,)
+                    for oid, o in zip(out_ids, res):
+                        env[oid] = o
+                return [env[i] for i in out_ids_wanted]
+
+            fn = jax.jit(replay)
+            self._jit_cache[sig] = fn
+
+        ext_vals = [program._tensors[i]._value for i in ext_ids]
+        outs = fn(feed_vals, ext_vals)
+        return [np.asarray(o) for o in outs]
 
     def close(self):
         pass
 
 
 def name_scope(name):
-    import contextlib
-
     @contextlib.contextmanager
     def _ns():
         yield

@@ -222,3 +222,105 @@ def test_transformed_scalar_transform_over_event_base():
     assert lp.shape == ()  # scalar, not (3,)
     want = base.log_prob(y / 2).numpy() - 3 * np.log(2.0)
     np.testing.assert_allclose(lp, want, rtol=1e-5)
+
+
+def test_poisson_log_prob_and_moments():
+    import scipy.stats as st
+    from paddle_tpu.distribution import Poisson
+    d = Poisson(rate=paddle.to_tensor([2.0, 7.5]))
+    val = np.array([1.0, 6.0], np.float32)
+    expect = st.poisson.logpmf(val, [2.0, 7.5])
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(val)).numpy(),
+                               expect, rtol=1e-5)
+    np.testing.assert_allclose(d.mean.numpy(), [2.0, 7.5])
+    np.testing.assert_allclose(d.variance.numpy(), [2.0, 7.5])
+    s = d.sample([2000])
+    np.testing.assert_allclose(s.numpy().mean(0), [2.0, 7.5], rtol=0.15)
+    np.testing.assert_allclose(
+        d.entropy().numpy(), st.poisson.entropy([2.0, 7.5]), rtol=0.02)
+
+
+def test_binomial_log_prob_and_kl():
+    import scipy.stats as st
+    from paddle_tpu.distribution import Binomial, kl_divergence
+    d = Binomial(total_count=paddle.to_tensor([10.0]),
+                 probs=paddle.to_tensor([0.3]))
+    val = np.array([4.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(val)).numpy(),
+                               st.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+    np.testing.assert_allclose(d.entropy().numpy(),
+                               st.binom.entropy(10, 0.3), rtol=1e-4)
+    q = Binomial(total_count=paddle.to_tensor([10.0]),
+                 probs=paddle.to_tensor([0.5]))
+    kl = kl_divergence(d, q).numpy()
+    # exact: sum p(k) log(p(k)/q(k))
+    ks = np.arange(11)
+    pk = st.binom.pmf(ks, 10, 0.3)
+    qk = st.binom.pmf(ks, 10, 0.5)
+    np.testing.assert_allclose(kl, np.sum(pk * np.log(pk / qk)), rtol=1e-4)
+
+
+def test_multivariate_normal():
+    import scipy.stats as st
+    from paddle_tpu.distribution import MultivariateNormal, kl_divergence
+    loc = np.array([1.0, -1.0], np.float32)
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    d = MultivariateNormal(paddle.to_tensor(loc),
+                           covariance_matrix=paddle.to_tensor(cov))
+    x = np.array([0.5, 0.0], np.float32)
+    np.testing.assert_allclose(
+        d.log_prob(paddle.to_tensor(x)).numpy(),
+        st.multivariate_normal.logpdf(x, loc, cov), rtol=1e-4)
+    np.testing.assert_allclose(
+        d.entropy().numpy(), st.multivariate_normal.entropy(loc, cov),
+        rtol=1e-5)
+    s = d.rsample([4000]).numpy()
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.1)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.15)
+    # KL(p||p) == 0; precision parameterization round-trips
+    d2 = MultivariateNormal(paddle.to_tensor(loc),
+                            precision_matrix=paddle.to_tensor(
+                                np.linalg.inv(cov).astype(np.float32)))
+    np.testing.assert_allclose(kl_divergence(d, d2).numpy(), 0.0, atol=1e-4)
+
+
+def test_continuous_bernoulli():
+    from paddle_tpu.distribution import ContinuousBernoulli
+    d = ContinuousBernoulli(probs=paddle.to_tensor([0.3]))
+    # density integrates to ~1
+    xs = np.linspace(0, 1, 1001).astype(np.float32)
+    p = np.exp([float(d.log_prob(paddle.to_tensor(np.float32([x]))).numpy())
+                for x in xs[::50]])
+    s = d.rsample([3000]).numpy()
+    np.testing.assert_allclose(s.mean(), float(d.mean.numpy()), atol=0.03)
+    assert 0.0 <= s.min() and s.max() <= 1.0
+
+
+def test_exponential_family_entropy_via_bregman():
+    import scipy.stats as st
+    import jax.numpy as jnp
+    from paddle_tpu.distribution import ExponentialFamily
+
+    class NormalEF(ExponentialFamily):
+        """N(mu, sigma^2) in natural form, entropy from the base class."""
+
+        def __init__(self, loc, scale):
+            self.loc = jnp.asarray(loc)
+            self.scale = jnp.asarray(scale)
+            super().__init__(batch_shape=self.loc.shape)
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / self.scale ** 2,
+                    -0.5 / self.scale ** 2)
+
+        def _log_normalizer(self, n1, n2):
+            return -0.25 * n1 ** 2 / n2 + 0.5 * jnp.log(-jnp.pi / n2)
+
+        @property
+        def _mean_carrier_measure(self):
+            return jnp.zeros_like(self.loc)
+
+    d = NormalEF([0.0, 2.0], [1.0, 3.0])
+    expect = st.norm.entropy([0.0, 2.0], [1.0, 3.0])
+    np.testing.assert_allclose(d.entropy().numpy(), expect, rtol=1e-5)

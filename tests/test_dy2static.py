@@ -1,0 +1,213 @@
+"""Data-dependent control flow under to_static (reference strategy:
+test/dygraph_to_static/test_ifelse.py, test_while_op.py, test_for_in_range
+— dy2static converts if/while/for on tensor values into cond/while ops;
+here the target ops are lax.cond / lax.while_loop)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (
+    Dy2StaticError, convert_function, UNDEF,
+)
+
+
+def _relu_like(x):
+    if x.sum() > 0:
+        y = x * 2.0
+    else:
+        y = x - 1.0
+    return y
+
+
+def test_if_on_tensor_under_to_static():
+    fn = paddle.jit.to_static(_relu_like)
+    pos = paddle.to_tensor(np.float32([1.0, 2.0]))
+    neg = paddle.to_tensor(np.float32([-1.0, -2.0]))
+    np.testing.assert_allclose(fn(pos).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(fn(neg).numpy(), [-2.0, -3.0])
+
+
+def test_if_gradient_flows_through_cond():
+    fn = paddle.jit.to_static(_relu_like)
+    x = paddle.to_tensor(np.float32([1.0, 2.0]), stop_gradient=False)
+    y = fn(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_elif_chain():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 10.0:
+            out = x * 100.0
+        elif x.sum() > 0.0:
+            out = x * 10.0
+        else:
+            out = x
+        return out
+
+    t = lambda v: paddle.to_tensor(np.float32(v))
+    np.testing.assert_allclose(f(t([20.0])).numpy(), [2000.0])
+    np.testing.assert_allclose(f(t([1.0])).numpy(), [10.0])
+    np.testing.assert_allclose(f(t([-5.0])).numpy(), [-5.0])
+
+
+def test_while_on_tensor():
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.zeros([])
+        i = paddle.zeros([])
+        while i < x.sum():
+            s = s + i
+            i = i + 1.0
+        return s
+
+    # sum over 0..4 = 10
+    out = f(paddle.to_tensor(np.float32([2.0, 3.0])))
+    assert float(out.numpy()) == 10.0
+
+
+def test_for_range_tensor_bound():
+    @paddle.jit.to_static
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.float32([1.0, 2.0]))
+    n = paddle.to_tensor(np.int32(3))
+    np.testing.assert_allclose(f(x, n).numpy(), [3.0, 6.0])
+
+
+def test_python_control_flow_unchanged():
+    @paddle.jit.to_static
+    def f(x, flag=True):
+        if flag:          # python bool: stays python, no lax.cond
+            out = x + 1.0
+        else:
+            out = x - 1.0
+        total = x * 0.0
+        for i in range(3):  # python range: unrolled at trace time
+            total = total + out
+        return total
+
+    x = paddle.to_tensor(np.float32([1.0]))
+    np.testing.assert_allclose(f(x).numpy(), [6.0])
+    np.testing.assert_allclose(f(x, flag=False).numpy(), [0.0])
+
+
+def test_bool_and_or_in_condition():
+    @paddle.jit.to_static
+    def f(x):
+        if (x.sum() > 0.0) and (x.max() < 10.0):
+            y = x * 2.0
+        else:
+            y = x * 0.0
+        return y
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.float32([1.0, 2.0]))).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.float32([1.0, 20.0]))).numpy(), [0.0, 0.0])
+
+
+def test_branch_var_missing_one_side_raises_guidance():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        return y  # noqa: F821 — defined only in one branch
+
+    with pytest.raises(Exception) as ei:
+        f(paddle.to_tensor(np.float32([1.0])))
+    assert "branch" in str(ei.value) or "undefined" in str(ei.value).lower() \
+        or "UNDEF" in str(ei.value) or "leaf" in str(ei.value).lower()
+
+
+def test_unconvertible_fails_loudly_with_guidance():
+    @paddle.jit.to_static
+    def f(x):
+        # `return` inside the branch -> not convertible -> loud error
+        if x.sum() > 0:
+            return x * 2.0
+        return x
+
+    with pytest.raises(Dy2StaticError, match="not_to_static"):
+        f(paddle.to_tensor(np.float32([1.0])))
+
+
+def test_not_to_static_opt_out():
+    @paddle.jit.not_to_static
+    def helper(x):
+        if x > 0:  # relies on concrete bool; never converted
+            return 1.0
+        return -1.0
+
+    conv = convert_function(helper)
+    assert conv is helper
+
+
+def test_layer_forward_with_tensor_branching():
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                out = h * 2.0
+            else:
+                out = h * 0.5
+            return out
+
+    m = paddle.jit.to_static(Gate())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = m(x)
+    assert out.shape == [2, 4]
+    h = m.lin(x)
+    expect = h.numpy() * (2.0 if h.numpy().sum() > 0 else 0.5)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+
+def test_nested_if_in_while():
+    @paddle.jit.to_static
+    def collatz_steps(x):
+        n = x.sum()
+        steps = paddle.zeros([])
+        while n > 1.0:
+            if (n % 2.0) == 0.0:
+                n = n / 2.0
+            else:
+                n = 3.0 * n + 1.0
+            steps = steps + 1.0
+        return steps
+
+    out = collatz_steps(paddle.to_tensor(np.float32([6.0])))
+    assert float(out.numpy()) == 8.0  # 6→3→10→5→16→8→4→2→1
+
+
+def test_for_range_target_visible_after_loop():
+    @paddle.jit.to_static
+    def f(x):
+        acc = paddle.zeros_like(x)
+        for i in range(3):
+            acc = acc + x
+        return acc * i  # python semantics: i == 2 after the loop
+
+    x = paddle.to_tensor(np.float32([1.0, 2.0]))
+    np.testing.assert_allclose(f(x).numpy(), [6.0, 12.0])
+
+
+def test_for_range_traced_bound_target_after_loop():
+    @paddle.jit.to_static
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            acc = acc + x
+        return acc + i
+
+    x = paddle.to_tensor(np.float32([1.0]))
+    n = paddle.to_tensor(np.int32(4))
+    np.testing.assert_allclose(f(x, n).numpy(), [7.0])  # 4*1 + 3

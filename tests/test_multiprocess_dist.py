@@ -1,0 +1,90 @@
+"""Real multi-process distributed training (reference strategy:
+test/legacy_test/test_dist_base.py:962 — single-host multi-process
+workers, compare distributed training to single-process results).
+
+This is the only suite that exercises the DCN bootstrap path end to end:
+dist.spawn → PADDLE_TPU_* env contract → native coord store rendezvous →
+jax.distributed.initialize (the coordination-service analog of the
+reference TCPStore+NCCL-id exchange) → a per-process global mesh where
+GSPMD inserts the cross-process grad all-reduce.
+"""
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _dp_train_worker(coord_port):
+    import os
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import topology as topo
+
+    os.environ["PADDLE_TPU_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+    dist.init_parallel_env()
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    hcg = topo.HybridCommunicateGroup(mesh=topo.build_mesh(dp=-1))
+    topo.set_hybrid_communicate_group(hcg)
+    mesh = hcg.mesh
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_data_parallel_rank() == rank, (
+        hcg.get_data_parallel_rank(), rank)
+    assert hcg.get_model_parallel_rank() == 0
+
+    # deterministic dataset; each process holds HALF the global batch
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    Wt = np.arange(4, dtype=np.float32).reshape(4, 1)
+    Y = (X @ Wt).astype(np.float32)
+    xl, yl = X[rank * 8:(rank + 1) * 8], Y[rank * 8:(rank + 1) * 8]
+
+    bsh = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+    xg = jax.make_array_from_process_local_data(bsh, xl)
+    yg = jax.make_array_from_process_local_data(bsh, yl)
+    w = jax.device_put(jnp.zeros((4, 1), jnp.float32), rep)
+
+    @jax.jit
+    def step(w, x, y):
+        def loss(w):
+            return jnp.mean((x @ w - y) ** 2)
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 0.1 * g, l
+
+    for _ in range(50):
+        w, l = step(w, xg, yg)
+
+    # single-process full-batch reference
+    wr = np.zeros((4, 1), np.float32)
+    for _ in range(50):
+        g = (2.0 / 16.0) * X.T @ (X @ wr - Y)
+        wr = wr - 0.1 * g
+    np.testing.assert_allclose(np.asarray(w), wr, rtol=1e-4, atol=1e-5)
+
+    # framework control plane alongside the XLA data plane
+    store = dist.get_store()
+    assert store is not None
+    store.set(f"done/{rank}", b"1")
+    store.wait(f"done/{1 - rank}", timeout=30)
+
+
+def test_two_process_data_parallel_training():
+    port = _free_port()
+    dist.spawn(_dp_train_worker, args=(port,), nprocs=2,
+               env={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"})

@@ -1,0 +1,138 @@
+"""Eager p2p, gather/reduce, group_sharded_parallel facade, dist.spawn
+(reference strategy: test/collective/test_collective_batch_isend_irecv.py,
+test/collective/fleet/test_dygraph_group_sharded_api.py,
+test/legacy_test/test_spawn_and_init_parallel_env.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import topology as topo
+
+
+def _world1_roundtrip_payload():
+    return np.arange(6, dtype=np.float32).reshape(2, 3)
+
+
+def test_send_recv_roundtrip_single_process():
+    x = paddle.to_tensor(_world1_roundtrip_payload())
+    buf = paddle.zeros([2, 3])
+    dist.send(x, dst=0)
+    dist.recv(buf, src=0)
+    np.testing.assert_array_equal(buf.numpy(), x.numpy())
+
+
+def test_isend_irecv_and_batch():
+    x = paddle.to_tensor(np.float32([1, 2, 3]))
+    buf = paddle.zeros([3])
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.isend, x, 0),
+        dist.P2POp(dist.irecv, buf, 0),
+    ])
+    for t in tasks:
+        t.wait()
+    np.testing.assert_array_equal(buf.numpy(), [1, 2, 3])
+
+
+def test_send_recv_ordering():
+    a = paddle.to_tensor(np.float32([1.0]))
+    b = paddle.to_tensor(np.float32([2.0]))
+    dist.send(a, dst=0)
+    dist.send(b, dst=0)
+    buf = paddle.zeros([1])
+    dist.recv(buf, src=0)
+    assert float(buf.numpy()[0]) == 1.0
+    dist.recv(buf, src=0)
+    assert float(buf.numpy()[0]) == 2.0
+
+
+def test_recv_timeout():
+    buf = paddle.zeros([1])
+    with pytest.raises(TimeoutError):
+        dist.recv(buf, src=0, timeout=0.2)
+
+
+def test_gather_and_reduce_on_mesh():
+    hcg = topo.HybridCommunicateGroup(mesh=topo.build_mesh(dp=-1))
+    topo.set_hybrid_communicate_group(hcg)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    n = hcg.mesh.shape["dp"]
+    x = paddle.to_tensor(np.arange(n * 2, dtype=np.float32).reshape(n * 2, 1))
+    x._value = jax.device_put(x._value, NamedSharding(hcg.mesh, P("dp")))
+    parts = []
+    dist.gather(x, parts, dst=0)
+    assert len(parts) == n
+    np.testing.assert_array_equal(parts[0].numpy(),
+                                  x.numpy()[: 2])
+    # reduce: each rank's tensor is its shard; result = sum over shards
+    x2 = paddle.to_tensor(np.arange(n * 2, dtype=np.float32).reshape(n * 2, 1))
+    x2._value = jax.device_put(x2._value, NamedSharding(hcg.mesh, P("dp")))
+    expect = x2.numpy().reshape(n, 2, 1).sum(axis=0)
+    y = dist.reduce(x2, dst=0)
+    np.testing.assert_allclose(y.numpy(), expect)
+
+
+def test_group_sharded_parallel_levels():
+    hcg = topo.HybridCommunicateGroup(mesh=topo.build_mesh(sharding=-1))
+    topo.set_hybrid_communicate_group(hcg)
+    model = paddle.nn.Linear(16, 16)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    model, opt, _ = dist.group_sharded_parallel(model, opt, "p_g_os")
+    assert opt._group_sharded_stage == 3
+    w = dict(model.named_parameters())["weight"]
+    assert "sharding" in tuple(w._value.sharding.spec)
+    # eager forward still works on the sharded params
+    out = model(paddle.ones([4, 16]))
+    assert out.shape == [4, 16]
+
+
+def test_save_group_sharded_model(tmp_path):
+    hcg = topo.HybridCommunicateGroup(mesh=topo.build_mesh(sharding=-1))
+    topo.set_hybrid_communicate_group(hcg)
+    model = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    model, opt, _ = dist.group_sharded_parallel(model, opt, "os_g")
+    out = str(tmp_path / "gs")
+    dist.save_group_sharded_model(model, out, opt)
+    import os
+    assert os.path.exists(os.path.join(out, "model.pdparams"))
+
+
+def _spawn_worker(tag):
+    # runs in a fresh process: env contract must wire rank/world/store
+    import numpy as np
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, world
+
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": tag})
+    assert sorted(o["rank"] for o in objs) == [0, 1], objs
+    assert all(o["tag"] == tag for o in objs)
+
+    import paddle_tpu as paddle
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.float32([41.0, 1.0])), dst=1)
+    else:
+        buf = paddle.zeros([2])
+        dist.recv(buf, src=0)
+        assert float(buf.numpy().sum()) == 42.0, buf.numpy()
+
+
+def test_spawn_two_processes():
+    dist.spawn(_spawn_worker, args=("t1",), nprocs=2)
+
+
+def _spawn_failer():
+    raise RuntimeError("child exploded")
+
+
+def test_spawn_propagates_child_error():
+    with pytest.raises(RuntimeError, match="child exploded"):
+        dist.spawn(_spawn_failer, nprocs=2)

@@ -134,7 +134,7 @@ def test_qat_quantize_and_train():
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     qat.convert(model)
-    assert model[0].inner.weight_int8.numpy().dtype == np.int8
+    assert model[0].weight_int8.numpy().dtype == np.int8
 
 
 def test_ptq_observes_and_bounds_error():
@@ -152,7 +152,7 @@ def test_ptq_observes_and_bounds_error():
     rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
     assert rel < 0.1, rel
     ptq.convert(qmodel)
-    assert qmodel[0].inner.weight_int8.numpy().dtype == np.int8
+    assert qmodel[0].weight_int8.numpy().dtype == np.int8
 
 
 def test_qat_model_is_jit_exportable(tmp_path):
@@ -169,3 +169,39 @@ def test_qat_model_is_jit_exportable(tmp_path):
     loaded = paddle.jit.load(path)
     np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
                                model(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+
+def test_qat_convert_pass_swaps_to_int8_layers():
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import QAT, QuantConfig, ConvertedLinear
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(16, 4))
+    qat = QAT(QuantConfig())
+    qat.quantize(model)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    ref = model(x).numpy()  # calibrates observers
+    qat.convert(model)
+    subs = dict(model.named_sublayers())
+    assert isinstance(subs["0"], ConvertedLinear), type(subs["0"])
+    assert "int8" in str(subs["0"].weight_int8.dtype)
+    out = model(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.15)
+    # no observers remain (frozen-scale inference form)
+    assert not any(hasattr(s, "w_observer") for s in subs.values())
+
+
+def test_ptq_calibrate_then_convert():
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import PTQ, ConvertedLinear
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    ptq = PTQ()
+    ptq.quantize(model)
+    rng = np.random.RandomState(1)
+    fp_out = None
+    for _ in range(4):  # calibration batches
+        xb = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        fp_out = model(xb).numpy()
+    ptq.convert(model)
+    out = model(xb).numpy()
+    assert isinstance(dict(model.named_sublayers())["0"], ConvertedLinear)
+    np.testing.assert_allclose(out, fp_out, rtol=0.1, atol=0.2)

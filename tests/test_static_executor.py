@@ -1,0 +1,116 @@
+"""Static-graph capture + Executor replay (reference strategy:
+test/legacy_test/test_executor_and_use_program_cache.py and the classic
+fit-a-line static workflow: data → net → loss → minimize → exe.run)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_inference_replay_jitted():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        w = paddle.to_tensor(np.eye(4, dtype=np.float32) * 2.0)
+        y = paddle.matmul(x, w)
+        z = y + 1.0
+    exe = static.Executor()
+    feed = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out, = exe.run(main, feed={"x": feed}, fetch_list=[z])
+    np.testing.assert_allclose(out, feed * 2.0 + 1.0)
+
+
+def test_feed_batch_size_differs_from_build():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = x * 3.0
+    exe = static.Executor()
+    for bs in (2, 5):
+        feed = np.ones((bs, 3), np.float32)
+        out, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+        np.testing.assert_allclose(out, feed * 3.0)
+
+
+def test_missing_feed_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 1.0
+    exe = static.Executor()
+    with pytest.raises(KeyError, match="missing 'x'"):
+        exe.run(main, feed={}, fetch_list=[y])
+
+
+def test_unknown_fetch_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        _ = x + 1.0
+    stranger = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    exe = static.Executor()
+    with pytest.raises(RuntimeError, match="not computed"):
+        exe.run(main, feed={"x": np.zeros((2, 2), np.float32)},
+                fetch_list=[stranger])
+
+
+def test_empty_program_raises_not_echoes():
+    exe = static.Executor()
+    t = paddle.to_tensor(np.float32([1.0]))
+    with pytest.raises(NotImplementedError, match="captured no ops"):
+        exe.run(static.Program(), feed={}, fetch_list=[t])
+
+
+def test_static_training_fit_a_line():
+    # the canonical static workflow: one exe.run == one SGD step
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(4, 1).astype(np.float32)
+    xs = rng.randn(64, 4).astype(np.float32)
+    ys = xs @ true_w
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        lin = paddle.nn.Linear(4, 1)
+        pred = lin(x)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, losses[:3] + losses[-3:]
+
+
+def test_program_clone_for_test_drops_training():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        pred = lin(x)
+        loss = paddle.mean(pred)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog._minimize is None
+    exe = static.Executor()
+    w0 = lin.weight.numpy().copy()
+    out, = exe.run(test_prog, feed={"x": np.ones((3, 2), np.float32)},
+                   fetch_list=[pred])
+    assert out.shape == (3, 1)
+    np.testing.assert_array_equal(w0, lin.weight.numpy())  # no step ran
