@@ -152,6 +152,8 @@ def bench_lora_decode(on_tpu, dev):
                                     "128" if on_tpu else "8"))
     paddle.seed(0)
     model = gpt(name)
+    # adapters stay LIVE: the metric is LoRA-adapted decode (BASELINE
+    # config 5), not base-model decode after a merge
     apply_lora(model, LoRAConfig(r=8))
     model.eval()
     if on_tpu:
@@ -168,10 +170,14 @@ def bench_lora_decode(on_tpu, dev):
 
     def attempt():
         out = generate(model, prompt, cfg)  # warmup/compile
-        t0 = time.perf_counter()
-        out = generate(model, prompt, cfg)
-        _ = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
-        return time.perf_counter() - t0
+        np.asarray(out.numpy())  # fence: async dispatch otherwise leaks
+        best = float("inf")      # leftover work into the timed window
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = generate(model, prompt, cfg)
+            np.asarray(out.numpy())
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     dt = _retry_transient(attempt, label="lora bench")
     tps = batch * new_tokens / dt

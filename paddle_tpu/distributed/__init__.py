@@ -25,6 +25,7 @@ from .group_sharded import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,
 )
 from .spawn import spawn  # noqa: F401
+from . import rpc  # noqa: F401
 from . import stream  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401
 from .engine import ShardedTrainStep, parallelize  # noqa: F401

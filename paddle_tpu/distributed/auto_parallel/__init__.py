@@ -20,3 +20,6 @@ from .api import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
     reshard, shard_layer, get_placements, placements_to_spec,
 )
+from .planner import (  # noqa: F401,E402
+    plan, auto_parallelize, ModelStats, Plan,
+)

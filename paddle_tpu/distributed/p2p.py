@@ -27,37 +27,64 @@ from .env import get_rank, get_world_size, get_store
 
 
 class _LocalMailbox:
-    """Ordered (src, dst) channels inside one process."""
+    """Ticketed (src, dst) channels inside one process: each send gets a
+    monotonically increasing index, each receive reserves the next index
+    up front — concurrent irecv threads therefore consume messages in
+    posting order, never racing for the same payload."""
 
     def __init__(self):
-        self._chans = collections.defaultdict(collections.deque)
+        self._items = collections.defaultdict(dict)  # (src,dst) -> {idx: v}
+        self._push = collections.defaultdict(int)
         self._cv = threading.Condition()
 
     def put(self, src, dst, payload):
         with self._cv:
-            self._chans[(src, dst)].append(payload)
+            idx = self._push[(src, dst)]
+            self._push[(src, dst)] = idx + 1
+            self._items[(src, dst)][idx] = payload
             self._cv.notify_all()
 
-    def get(self, src, dst, timeout=None):
+    def get(self, src, dst, ticket, timeout=None):
         with self._cv:
-            ok = self._cv.wait_for(lambda: self._chans[(src, dst)],
-                                   timeout=timeout)
+            ok = self._cv.wait_for(
+                lambda: ticket in self._items[(src, dst)], timeout=timeout)
             if not ok:
                 raise TimeoutError(
                     f"recv from rank {src} timed out after {timeout}s")
-            return self._chans[(src, dst)].popleft()
+            return self._items[(src, dst)].pop(ticket)
 
 
 _mailbox = _LocalMailbox()
+_seq_lock = threading.Lock()
 _send_seq = collections.defaultdict(int)   # (src, dst) -> next seq to send
 _recv_seq = collections.defaultdict(int)   # (src, dst) -> next seq to take
+
+
+def _reserve_recv(src, dst):
+    """Atomically claim the next receive slot for the (src, dst) channel —
+    called on the POSTING thread so two concurrent irecvs keep order."""
+    with _seq_lock:
+        seq = _recv_seq[(src, dst)]
+        _recv_seq[(src, dst)] = seq + 1
+    return seq
+
+
+def _unreserve_recv(src, dst, ticket):
+    """Roll back a reservation whose wait timed out, so the channel does
+    not desync. Only possible while it is still the most recent claim."""
+    with _seq_lock:
+        if _recv_seq[(src, dst)] == ticket + 1:
+            _recv_seq[(src, dst)] = ticket
+            return True
+    return False
 
 
 def _reset_p2p_state():
     global _mailbox
     _mailbox = _LocalMailbox()
-    _send_seq.clear()
-    _recv_seq.clear()
+    with _seq_lock:
+        _send_seq.clear()
+        _recv_seq.clear()
 
 
 def _to_numpy(tensor):
@@ -107,31 +134,49 @@ def send(tensor, dst=0, group=None, sync_op=True):
     arr = _to_numpy(tensor)
     store = get_store()
     if store is not None and get_world_size() > 1:
-        seq = _send_seq[(src, dst)]
-        _send_seq[(src, dst)] += 1
+        with _seq_lock:
+            seq = _send_seq[(src, dst)]
+            _send_seq[(src, dst)] = seq + 1
         store.set(f"p2p/{src}->{dst}/{seq}", pickle.dumps(arr))
     else:
         _mailbox.put(src, dst, arr)
     return P2PTask()
 
 
-def _recv_blocking(src, dst, timeout=None):
+def _recv_blocking(src, dst, ticket, timeout=None, own_connection=False):
     store = get_store()
     if store is not None and get_world_size() > 1:
-        seq = _recv_seq[(src, dst)]
-        _recv_seq[(src, dst)] += 1
-        key = f"p2p/{src}->{dst}/{seq}"
-        raw = store.wait(key, timeout=timeout)
-        store.delete_key(key)
-        return pickle.loads(raw)
-    return _mailbox.get(src, dst, timeout=timeout)
+        if own_connection:
+            # irecv runs on a background thread: the native client handle
+            # is one socket whose request/response frames must not be
+            # interleaved with the main thread's store traffic
+            from .store import TCPStore
+            store = TCPStore(store.host, store.port,
+                             world_size=store.world_size)
+        try:
+            key = f"p2p/{src}->{dst}/{ticket}"
+            raw = store.wait(key, timeout=timeout)
+            store.delete_key(key)
+            return pickle.loads(raw)
+        finally:
+            if own_connection:
+                store.close()
+    return _mailbox.get(src, dst, ticket, timeout=timeout)
 
 
 def recv(tensor, src=0, group=None, sync_op=True, timeout=None):
     """Reference: communication/recv.py — blocks until the matching send
     lands, then copies into `tensor`."""
     dst = get_rank()
-    arr = _recv_blocking(src, dst, timeout=timeout)
+    ticket = _reserve_recv(src, dst)
+    try:
+        arr = _recv_blocking(src, dst, ticket, timeout=timeout)
+    except TimeoutError:
+        if not _unreserve_recv(src, dst, ticket):
+            raise RuntimeError(
+                f"recv from rank {src} timed out with later receives "
+                f"outstanding — channel order cannot be restored")
+        raise
     _assign(tensor, arr)
     return P2PTask()
 
@@ -149,10 +194,11 @@ def irecv(tensor, src=0, group=None):
     order (the NCCL-grouped semantics batch_isend_irecv relies on)."""
     dst = get_rank()
     box = [None, None]
+    ticket = _reserve_recv(src, dst)  # claim order on the POSTING thread
 
     def work():
         try:
-            box[1] = _recv_blocking(src, dst)
+            box[1] = _recv_blocking(src, dst, ticket, own_connection=True)
         except BaseException as e:
             box[0] = e
 
@@ -220,11 +266,32 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 def reduce(tensor, dst=0, op=None, group=None, sync_op=True):
     """Reference: communication/reduce.py — all_reduce with the result
-    consumed at dst; on the single controller the reduced value is the
-    controller's value."""
+    consumed at dst. Single controller: the mesh all_reduce. Multi-process
+    job (per-process local meshes): rank tensors move through the store to
+    dst, which folds them."""
     from . import collective as C
     if op is None:
         op = C.ReduceOp.SUM
+    if get_world_size() > 1 and get_store() is not None:
+        rank, world = get_rank(), get_world_size()
+        if rank != dst:
+            send(tensor, dst=dst)
+            return tensor
+        acc = _to_numpy(tensor).copy()
+        buf = Tensor(jnp.zeros_like(jnp.asarray(acc)))
+        fold = {C.ReduceOp.SUM: np.add, C.ReduceOp.AVG: np.add,
+                C.ReduceOp.MAX: np.maximum, C.ReduceOp.MIN: np.minimum,
+                C.ReduceOp.PROD: np.multiply}.get(op)
+        if fold is None:
+            raise ValueError(f"unsupported ReduceOp {op} for store reduce")
+        for r in range(world):
+            if r == dst:
+                continue
+            recv(buf, src=r)
+            acc = fold(acc, _to_numpy(buf))
+        if op == C.ReduceOp.AVG:
+            acc = acc / world
+        return _assign(tensor, acc)
     return C.all_reduce(tensor, op=op, group=group)
 
 

@@ -1,0 +1,226 @@
+"""paddle.distributed.rpc — control-plane remote procedure calls.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc, rpc_sync,
+rpc_async, shutdown over a brpc agent;
+paddle/fluid/distributed/rpc/rpc_agent.cc).
+
+TPU-native stance: tensor traffic belongs to XLA collectives — RPC here
+is the *control plane* (worker coordination, parameter surgery, metric
+collection), matching how the reference positions it. Transport is the
+native coordination store (native/coord_store.cc): each worker runs a
+serve loop polling its request keys; requests/replies are pickled
+(fn, args, kwargs) payloads. In a single process, calls loop back
+directly — same API, zero transport.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import traceback
+
+from .env import get_rank, get_world_size, get_store
+
+_state = {
+    "initialized": False,
+    "name": None,
+    "workers": {},      # name -> rank
+    "serve_thread": None,
+    "stop": False,
+    "req_seq": 0,
+    "lock": threading.Lock(),
+}
+
+
+class WorkerInfo:
+    def __init__(self, name, rank):
+        self.name = name
+        self.rank = rank
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name!r}, rank={self.rank})"
+
+
+class _Future:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._err = None
+
+    def _set(self, value=None, err=None):
+        self._value, self._err = value, err
+        self._ev.set()
+
+    def wait(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc result timed out")
+        if self._err is not None:
+            raise RuntimeError(f"rpc raised on the remote worker:\n"
+                               f"{self._err}")
+        return self._value
+
+    result = wait
+
+    def done(self):
+        return self._ev.is_set()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Register this worker and start serving requests (reference:
+    rpc.init_rpc)."""
+    if _state["initialized"]:
+        return
+    rank = get_rank() if rank is None else rank
+    world = get_world_size() if world_size is None else world_size
+    _state["name"] = name
+    store = get_store()
+    if store is not None and world > 1:
+        store.set(f"rpc/worker/{rank}", name.encode())
+        for r in range(world):
+            other = store.wait(f"rpc/worker/{r}").decode()
+            _state["workers"][other] = r
+        t = threading.Thread(target=_serve_loop, daemon=True)
+        t.start()
+        _state["serve_thread"] = t
+    else:
+        _state["workers"][name] = rank
+    _state["initialized"] = True
+
+
+def get_worker_info(name=None):
+    if name is None:
+        return WorkerInfo(_state["name"],
+                          _state["workers"].get(_state["name"], 0))
+    if name not in _state["workers"]:
+        raise ValueError(f"unknown rpc worker {name!r}")
+    return WorkerInfo(name, _state["workers"][name])
+
+
+def get_all_worker_infos():
+    return [WorkerInfo(n, r) for n, r in sorted(_state["workers"].items(),
+                                                key=lambda kv: kv[1])]
+
+
+def _open_client():
+    """Dedicated store connection for an rpc thread: the native client
+    handle is one socket with a request/response protocol — sharing it
+    across threads interleaves frames (a blocking barrier on the main
+    thread would starve the serve loop)."""
+    from .store import TCPStore
+
+    base = get_store()
+    return TCPStore(base.host, base.port, world_size=base.world_size)
+
+
+def _serve_loop():
+    import sys
+
+    store = _open_client()
+    rank = get_rank()
+    served = 0
+    while not _state["stop"]:
+        key = f"rpc/req/{rank}/{served}"
+        try:
+            raw = store.get_nowait(key)
+        except Exception:
+            # transient store fault: the serve loop must outlive it
+            print(f"rpc serve loop (rank {rank}) store fault:\n"
+                  f"{traceback.format_exc()}", file=sys.stderr)
+            time.sleep(0.05)
+            continue
+        if raw is None:
+            time.sleep(0.01)
+            continue
+        try:
+            fn, args, kwargs = pickle.loads(raw)
+            result = fn(*args, **(kwargs or {}))
+            payload = pickle.dumps(("ok", result))
+        except Exception:
+            payload = pickle.dumps(("err", traceback.format_exc()))
+        store.set(f"rpc/res/{rank}/{served}", payload)
+        store.delete_key(key)
+        served += 1
+    store.close()
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    """Reference: rpc.rpc_async — returns a Future."""
+    if not _state["initialized"]:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    args = args or ()
+    fut = _Future()
+    if to == _state["name"] or get_world_size() == 1:
+        def run_local():
+            try:
+                fut._set(value=fn(*args, **(kwargs or {})))
+            except Exception:
+                fut._set(err=traceback.format_exc())
+        threading.Thread(target=run_local, daemon=True).start()
+        return fut
+
+    store = get_store()
+    dst = get_worker_info(to).rank
+    with _state["lock"]:
+        seq_key = f"rpc/seq/{dst}"
+        seq = store.add(seq_key, 1) - 1
+    store.set(f"rpc/req/{dst}/{seq}", pickle.dumps((fn, args, kwargs)))
+
+    def wait_reply():
+        try:
+            conn = _open_client()  # own socket: never shares the handle
+            try:
+                raw = conn.wait(f"rpc/res/{dst}/{seq}", timeout=timeout)
+                status, payload = pickle.loads(raw)
+                conn.delete_key(f"rpc/res/{dst}/{seq}")
+            finally:
+                conn.close()
+            if status == "ok":
+                fut._set(value=payload)
+            else:
+                fut._set(err=payload)
+        except Exception:
+            fut._set(err=traceback.format_exc())
+
+    threading.Thread(target=wait_reply, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    """Reference: rpc.rpc_sync — blocking call, returns the result."""
+    return rpc_async(to, fn, args=args, kwargs=kwargs,
+                     timeout=timeout).wait(timeout)
+
+
+class RRef:
+    """Minimal remote-reference: owns a Future; to_here() fetches the
+    value (reference: the RRef surface of distributed/rpc)."""
+
+    def __init__(self, fut, owner):
+        self._fut = fut
+        self._owner = owner
+
+    def to_here(self, timeout=None):
+        return self._fut.wait(timeout)
+
+    def owner(self):
+        return get_worker_info(self._owner)
+
+
+def remote(to, fn, args=None, kwargs=None, timeout=None):
+    return RRef(rpc_async(to, fn, args=args, kwargs=kwargs,
+                          timeout=timeout), to)
+
+
+def shutdown(graceful=True):
+    """Reference: rpc.shutdown — barrier then stop serving."""
+    if not _state["initialized"]:
+        return
+    store = get_store()
+    if graceful and store is not None and get_world_size() > 1:
+        store.barrier("rpc_shutdown", world_size=get_world_size())
+    _state["stop"] = True
+    t = _state["serve_thread"]
+    if t is not None:
+        t.join(timeout=2)
+    _state.update(initialized=False, name=None, serve_thread=None,
+                  stop=False, workers={})

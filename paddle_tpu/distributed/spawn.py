@@ -51,20 +51,33 @@ class SpawnContext:
         self._store = store
 
     def join(self, timeout=None):
-        """Wait for all workers; raise with the remote traceback if any
-        worker failed (reference: spawn.py MultiprocessContext.join)."""
-        for p in self.processes:
-            p.join(timeout)
-        failed = [p for p in self.processes if p.exitcode not in (0, None)]
-        if failed:
-            try:
-                rank, tb = self._error_queue.get_nowait()
-                raise RuntimeError(
-                    f"spawned rank {rank} failed:\n{tb}")
-            except mp.queues.Empty:
-                raise RuntimeError(
-                    f"spawned process {failed[0].pid} exited with "
-                    f"code {failed[0].exitcode}")
+        """Wait for all workers, polling so one failed child terminates
+        its siblings instead of deadlocking ranks blocked on its store
+        keys (reference: spawn.py MultiprocessContext.join polls the
+        error queue the same way)."""
+        import time as _time
+
+        deadline = (_time.monotonic() + timeout) if timeout else None
+        while True:
+            failed = [p for p in self.processes
+                      if p.exitcode not in (0, None)]
+            if failed:
+                for p in self.processes:
+                    if p.is_alive():
+                        p.terminate()
+                try:
+                    rank, tb = self._error_queue.get(timeout=1.0)
+                    raise RuntimeError(f"spawned rank {rank} failed:\n{tb}")
+                except mp.queues.Empty:
+                    raise RuntimeError(
+                        f"spawned process {failed[0].pid} exited with "
+                        f"code {failed[0].exitcode}")
+            if all(p.exitcode == 0 for p in self.processes):
+                break
+            if deadline is not None and _time.monotonic() > deadline:
+                raise TimeoutError("spawned processes did not finish")
+            for p in self.processes:
+                p.join(timeout=0.2)
         if self._store is not None:
             self._store.close()
         return True

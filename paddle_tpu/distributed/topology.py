@@ -154,6 +154,11 @@ class HybridCommunicateGroup:
         return ParallelMode.DATA_PARALLEL
 
     def get_data_parallel_world_size(self):
+        # mirror _process_coord's env precedence: spawn children without
+        # jax.distributed are process-level DP ways the local mesh can't see
+        env_world = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+        if env_world > jax.process_count():
+            return env_world * self.axis_size("dp")
         return self.axis_size("dp")
 
     def get_model_parallel_world_size(self):

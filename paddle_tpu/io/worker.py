@@ -68,8 +68,11 @@ class _ShmArray:
         shm = shared_memory.SharedMemory(name=self.name)
         try:
             # Copy out so the segment can be released immediately; the copy
-            # is the staging buffer handed to the device transfer.
-            arr = np.frombuffer(shm.buf, dtype=self.dtype).reshape(self.shape).copy()
+            # is the staging buffer handed to the device transfer. count=
+            # guards against the allocator page-rounding the segment.
+            n = int(np.prod(self.shape)) if self.shape else 1
+            arr = np.frombuffer(shm.buf, dtype=self.dtype,
+                                count=n).reshape(self.shape).copy()
         finally:
             shm.close()
             try:
@@ -94,7 +97,8 @@ def _export_array(arr, shm_threshold):
     if shm_threshold is not None and arr.nbytes >= shm_threshold:
         from multiprocessing import shared_memory
         shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
-        np.frombuffer(shm.buf, dtype=arr.dtype)[:] = arr.reshape(-1)
+        np.frombuffer(shm.buf, dtype=arr.dtype,
+                      count=arr.size)[:] = arr.reshape(-1)
         desc = _ShmArray(shm.name, arr.shape, arr.dtype)
         shm.close()
         return desc
@@ -330,6 +334,9 @@ class MultiprocessIter:
                 self._rcvd_idx += 1
                 continue
             batch_idx, data = self._get_with_watchdog()
+            if batch_idx == -1 and isinstance(data, _RemoteError):
+                self._shutdown_workers()
+                raise WorkerException(data.worker_id, data.tb)
             self._reorder[batch_idx] = data
         self._sampler_iter = iter(self._loader.batch_sampler)
         for _ in range(self._loader.prefetch_factor * self._num_workers):

@@ -500,21 +500,21 @@ def _convert_raw(fn):
     freevars = fn.__code__.co_freevars
     closure = fn.__closure__ or ()
     if freevars:
-        # rebuild the closure by nesting the converted def in a shim that
-        # takes the free variables as parameters
-        inner_name = fdef.name
-        shim = ast.parse(
-            f"def __jst_shim__({', '.join(freevars)}):\n"
-            f"    pass\n"
-            f"    return {inner_name}\n").body[0]
-        shim.body = [fdef, shim.body[-1]]
-        module = ast.Module(body=[shim], type_ignores=[])
-    else:
-        module = ast.Module(body=[fdef], type_ignores=[])
+        # closure variables become locals refreshed from the ORIGINAL cells
+        # at every call — a conversion-time value snapshot would go stale
+        # when the enclosing scope rebinds (and breaks self-recursion,
+        # whose cell is still empty during conversion)
+        refresh = []
+        for i, name in enumerate(freevars):
+            refresh.extend(ast.parse(
+                f"{name} = __jst_cells__[{i}].cell_contents").body)
+        fdef.body = refresh + fdef.body
+    module = ast.Module(body=[fdef], type_ignores=[])
     ast.fix_missing_locations(module)
 
     glb = dict(fn.__globals__)
     glb["__jst__"] = _helpers_namespace()
+    glb["__jst_cells__"] = closure
     filename = f"<dy2static {fn.__qualname__}>"
     try:
         code = compile(module, filename, "exec")
@@ -526,10 +526,7 @@ def _convert_raw(fn):
         len(gen_src), None, gen_src.splitlines(True), filename)
     ns = {}
     exec(code, glb, ns)
-    if freevars:
-        new_fn = ns["__jst_shim__"](*[c.cell_contents for c in closure])
-    else:
-        new_fn = ns[fdef.name]
+    new_fn = ns[fdef.name]
     new_fn = functools.wraps(fn)(new_fn)
     new_fn.__converted_by_dy2static__ = True
     return new_fn

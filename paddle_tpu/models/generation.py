@@ -57,6 +57,31 @@ def _sample_logits(logits, key, cfg: GenerationConfig):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def _gen_jit_cache(model):
+    """Compiled-decode cache on the model: jax.jit caches by function
+    identity, so rebuilding the decode closure per generate() call would
+    recompile every time (30s+ at LLM scale)."""
+    cache = model.__dict__.get("_generate_jit_cache")
+    if cache is None:
+        cache = {}
+        model.__dict__["_generate_jit_cache"] = cache
+    return cache
+
+
+def _cfg_key(cfg):
+    return (cfg.max_new_tokens, cfg.do_sample, cfg.temperature, cfg.top_k,
+            cfg.top_p, cfg.eos_token_id, cfg.pad_token_id, cfg.use_cache)
+
+
+def _structure_key(model):
+    """Fingerprint of the model's module structure so structural mutation
+    between generate() calls (apply_lora, merge_lora, quantization convert,
+    module swaps) invalidates the compiled program instead of silently
+    replaying a stale one."""
+    return tuple((n, type(s).__name__, getattr(s, "merged", None))
+                 for n, s in model.named_sublayers())
+
+
 def generate(model, input_ids, generation_config=None, **kwargs):
     """Greedy / top-k / top-p decoding. input_ids: [B, S] Tensor/ndarray.
     Returns [B, S + max_new_tokens] int32 (padded with pad_token_id after
@@ -82,25 +107,40 @@ def generate(model, input_ids, generation_config=None, **kwargs):
             if was_training:
                 model.train()
 
+    jit_cache = _gen_jit_cache(model)
+    sig = ("nocache", b, s, _cfg_key(cfg), _structure_key(model))
+    cached = jit_cache.get(sig)
+    if cached is not None:
+        jitted, params, buffers = cached
+        param_vals = {n: p._value for n, p in params.items()}
+        buffer_vals = {n: v._value for n, v in buffers.items()}
+        key = jax.random.PRNGKey(cfg.seed)
+        try:
+            out = jitted(param_vals, buffer_vals, ids, key)
+        finally:
+            if was_training:
+                model.train()
+        return Tensor(out)
+
     apply_fn, params, buffers = functionalize(
         model, method=lambda t: model.forward(t))
     param_vals = {n: p._value for n, p in params.items()}
     buffer_vals = {n: v._value for n, v in buffers.items()}
 
-    def logits_fn(pv, tokens):
-        out, _ = apply_fn(pv, buffer_vals, Tensor(tokens))
+    def logits_fn(pv, bv, tokens):
+        out, _ = apply_fn(pv, bv, Tensor(tokens))
         return out._value if isinstance(out, Tensor) else out
 
     eos = -1 if cfg.eos_token_id is None else int(cfg.eos_token_id)
 
-    def decode(pv, ids0, key):
+    def decode(pv, bv, ids0, key):
         buf = jnp.full((b, total), cfg.pad_token_id, jnp.int32)
         buf = buf.at[:, :s].set(ids0)
         done0 = jnp.zeros((b,), bool)
 
         def step(carry, i):
             buf, done, key = carry
-            logits = logits_fn(pv, buf)
+            logits = logits_fn(pv, bv, buf)
             # next-token logits live at position i-1 (the last real token)
             last = jax.lax.dynamic_index_in_dim(
                 logits, i - 1, axis=1, keepdims=False)
@@ -117,8 +157,10 @@ def generate(model, input_ids, generation_config=None, **kwargs):
         return buf
 
     key = jax.random.PRNGKey(cfg.seed)
+    jitted = jax.jit(decode)
+    jit_cache[sig] = (jitted, params, buffers)
     try:
-        out = jax.jit(decode)(param_vals, ids, key)
+        out = jitted(param_vals, buffer_vals, ids, key)
     finally:
         if was_training:
             model.train()
@@ -128,7 +170,22 @@ def generate(model, input_ids, generation_config=None, **kwargs):
 def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
     """KV-cached decode: one prefill pass over the prompt, then a jitted
     scan of single-token steps against per-layer caches — O(total) attention
-    reads per new token instead of a full-prefix re-run."""
+    reads per new token instead of a full-prefix re-run. The compiled
+    program is cached on the model per (b, s, cfg) signature; cache buffers
+    are donated so each call reuses their HBM."""
+    jit_cache = _gen_jit_cache(model)
+    sig = ("cached", b, s, _cfg_key(cfg), _structure_key(model))
+    key = jax.random.PRNGKey(cfg.seed)
+
+    cached = jit_cache.get(sig)
+    if cached is not None:
+        jitted, params, buffers = cached
+        param_vals = {n: p._value for n, p in params.items()}
+        buffer_vals = {n: v._value for n, v in buffers.items()}
+        caches = model.init_cache(b, total)
+        cache_vals = [(kc._value, vc._value) for kc, vc in caches]
+        return Tensor(jitted(param_vals, buffer_vals, ids, cache_vals, key))
+
     caches = model.init_cache(b, total)
     cache_vals = [(kc._value, vc._value) for kc, vc in caches]
 
@@ -145,10 +202,10 @@ def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
 
     eos = -1 if cfg.eos_token_id is None else int(cfg.eos_token_id)
 
-    def decode(pv, ids0, cache_vals, key):
+    def decode(pv, bv, ids0, cache_vals, key):
         # prefill the whole prompt in one chunk
         (logits, cache_vals), _ = apply_fn(
-            pv, buffer_vals, ids0, cache_vals, jnp.asarray(0, jnp.int32))
+            pv, bv, ids0, cache_vals, jnp.asarray(0, jnp.int32))
         key, sub = jax.random.split(key)
         nxt = _sample_logits(logits[:, -1].astype(jnp.float32), sub, cfg)
         buf = jnp.full((b, total), cfg.pad_token_id, jnp.int32)
@@ -160,7 +217,7 @@ def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
             buf, cache_vals, done, key = carry
             tok = jax.lax.dynamic_slice_in_dim(buf, i - 1, 1, axis=1)
             (logits, cache_vals), _ = apply_fn(
-                pv, buffer_vals, tok, cache_vals,
+                pv, bv, tok, cache_vals,
                 (i - 1).astype(jnp.int32))
             key, sub = jax.random.split(key)
             nxt = _sample_logits(logits[:, -1].astype(jnp.float32), sub,
@@ -176,6 +233,7 @@ def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
                 jnp.arange(s + 1, total))
         return buf
 
-    key = jax.random.PRNGKey(cfg.seed)
-    out = jax.jit(decode)(param_vals, ids, cache_vals, key)
+    jitted = jax.jit(decode, donate_argnums=(3,))
+    jit_cache[sig] = (jitted, params, buffers)
+    out = jitted(param_vals, buffer_vals, ids, cache_vals, key)
     return Tensor(out)

@@ -300,9 +300,13 @@ class GPTForCausalLM(nn.Layer):
                               transpose_y=True)
         return self.lm_head(hidden)
 
-    def init_cache(self, batch_size, max_length, dtype="float32"):
-        """Zeroed per-layer KV caches [B, T, Hkv, D] for cached decode."""
+    def init_cache(self, batch_size, max_length, dtype=None):
+        """Zeroed per-layer KV caches [B, T, Hkv, D] for cached decode.
+        Cache dtype follows the parameters (bf16 params -> bf16 cache:
+        the KV read is the decode bandwidth bill)."""
         cfg = self.cfg
+        if dtype is None:
+            dtype = self.transformer.wte.weight.dtype
         shape = (batch_size, int(max_length), cfg.num_kv_heads, cfg.head_dim)
         from ..core.tensor import Tensor
 

@@ -126,6 +126,17 @@ class QuantConfig:
         self.types = (nn.Linear, nn.Conv2D)
 
 
+def _broadcast_scale(scale, ndim, axis):
+    """Per-channel scale vector -> shape broadcastable against a weight of
+    rank `ndim` along its observed `axis`; scalars pass through."""
+    scale = np.asarray(scale, np.float32)
+    if scale.ndim == 1:
+        bshape = [1] * ndim
+        bshape[axis] = scale.shape[0]
+        return scale.reshape(bshape)
+    return scale
+
+
 class _QuantedBase(nn.Layer):
     def __init__(self, layer, cfg: QuantConfig):
         super().__init__()
@@ -151,7 +162,9 @@ class _QuantedBase(nn.Layer):
         w = self.inner.weight
         if self._concrete(w):
             self.w_observer.observe(w)
-        w_scale = Tensor(np.float32(self.w_observer.scale()))
+        w_scale = Tensor(_broadcast_scale(
+            self.w_observer.scale(), w.ndim,
+            getattr(self.w_observer, "axis", 0)))
         wq = fake_quant(w, w_scale, self.cfg.weight_bits)
         return self._call_inner(xq, wq)
 
@@ -182,12 +195,17 @@ class _ConvertedBase(nn.Layer):
         inner = quanted.inner
         self.bits = cfg.weight_bits
         self.act_bits = cfg.activation_bits
-        w_scale = quanted.w_observer.scale()
-        self.weight_scale = np.float32(w_scale)
-        self.act_scale = Tensor(np.float32(quanted.a_observer.scale()))
-        wq = quant_linear(inner.weight, Tensor(np.float32(w_scale)),
-                          self.bits)
-        self.weight_int8 = Tensor(wq._value.astype("int8"))
+        w_scale = _broadcast_scale(quanted.w_observer.scale(),
+                                   inner.weight.ndim,
+                                   getattr(quanted.w_observer, "axis", 0))
+        # registered buffers: state_dict/save must carry the deploy-form
+        # weights (int8 + scales), not silently drop them
+        self.register_buffer("weight_scale", Tensor(w_scale))
+        self.register_buffer(
+            "act_scale", Tensor(np.float32(quanted.a_observer.scale())))
+        wq = quant_linear(inner.weight, Tensor(w_scale), self.bits)
+        self.register_buffer("weight_int8",
+                             Tensor(wq._value.astype("int8")))
         self.bias = getattr(inner, "bias", None)
         # copy the hyperparameters and DROP the fp32 layer — keeping it
         # registered would retain (and serialize) the weights this pass
@@ -201,7 +219,8 @@ class _ConvertedBase(nn.Layer):
     def _dequant_weight(self):
         from .. import ops
         w = ops.cast(self.weight_int8, "float32")
-        return w * float(self.weight_scale) / float(2 ** (self.bits - 1) - 1)
+        scale = Tensor(self.weight_scale)  # broadcasts per-channel scales
+        return w * scale / float(2 ** (self.bits - 1) - 1)
 
 
 class ConvertedLinear(_ConvertedBase):

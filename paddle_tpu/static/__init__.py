@@ -277,25 +277,43 @@ class Executor:
                              else jnp.asarray(v))
 
         # externals: var refs read before produced and not feeds (e.g.
-        # parameters) — passed as inputs each run so updates are visible
+        # parameters) — passed as inputs each run so updates are visible.
+        # The op-list walk is memoized per program version: serving loops
+        # must not pay an O(num_ops) python pass per request.
         feed_ids = {id(program._feed_vars[n]) for n in feed_names}
-        produced = set(feed_ids)
-        ext_ids = []
-        for _, _, _, in_refs, out_ids in program._ops:
-            for kind, ref in in_refs:
-                if kind == "v" and ref not in produced and ref not in ext_ids:
-                    ext_ids.append(ref)
-            produced.update(out_ids)
+        akey = (id(program), program.num_ops, tuple(sorted(feed_ids)))
+        analysis = self._jit_cache.get(("analysis", akey))
+        if analysis is None:
+            produced = set(feed_ids)
+            ext_ids = []
+            ext_seen = set()
+            for _, _, _, in_refs, out_ids in program._ops:
+                for kind, ref in in_refs:
+                    if kind == "v" and ref not in produced \
+                            and ref not in ext_seen:
+                        ext_seen.add(ref)
+                        ext_ids.append(ref)
+                produced.update(out_ids)
+            analysis = (ext_ids, produced)
+            self._jit_cache[("analysis", akey)] = analysis
+        ext_ids, produced = analysis
 
+        names_key = ("names", id(program), program.num_ops)
+        name_map = self._jit_cache.get(names_key)
+        if name_map is None:
+            name_map = {}
+            for t in program._tensors.values():
+                n = getattr(t, "name", None)
+                if n is not None and n not in name_map:
+                    name_map[n] = t
+            self._jit_cache[names_key] = name_map
         fetch_ids = []
         for f in fetch_list:
             if isinstance(f, str):
-                named = [t for t in program._tensors.values()
-                         if getattr(t, "name", None) == f]
-                if not named:
+                if f not in name_map:
                     raise RuntimeError(
                         f"Executor.run: no program variable named {f!r}")
-                f = named[0]
+                f = name_map[f]
             if not isinstance(f, Tensor):
                 raise TypeError(
                     f"Executor.run: cannot fetch {f!r}")

@@ -211,3 +211,26 @@ def test_for_range_traced_bound_target_after_loop():
     x = paddle.to_tensor(np.float32([1.0]))
     n = paddle.to_tensor(np.int32(4))
     np.testing.assert_allclose(f(x, n).numpy(), [7.0])  # 4*1 + 3
+
+
+def test_closure_rebinding_visible_after_conversion():
+    def outer():
+        n = [paddle.to_tensor(np.float32([1.0]))]
+        thresh = 0.0
+
+        def f(x):
+            if x.sum() > thresh:
+                y = x + n[0]
+            else:
+                y = x - n[0]
+            return y
+
+        return f, n
+
+    f, n = outer()
+    conv = convert_function(f)
+    assert getattr(conv, "__converted_by_dy2static__", False)
+    x = paddle.to_tensor(np.float32([2.0]))
+    np.testing.assert_allclose(conv(x).numpy(), [3.0])
+    n[0] = paddle.to_tensor(np.float32([10.0]))  # rebind via container
+    np.testing.assert_allclose(conv(x).numpy(), [12.0])

@@ -124,6 +124,12 @@ def _spawn_worker(tag):
         dist.recv(buf, src=0)
         assert float(buf.numpy().sum()) == 42.0, buf.numpy()
 
+    # cross-process reduce through the store path
+    t = paddle.to_tensor(np.float32([float(rank + 1)]))
+    out = dist.reduce(t, dst=0)
+    if rank == 0:
+        assert float(out.numpy()[0]) == 3.0, out.numpy()
+
 
 def test_spawn_two_processes():
     dist.spawn(_spawn_worker, args=("t1",), nprocs=2)
@@ -136,3 +142,41 @@ def _spawn_failer():
 def test_spawn_propagates_child_error():
     with pytest.raises(RuntimeError, match="child exploded"):
         dist.spawn(_spawn_failer, nprocs=2)
+
+
+def test_concurrent_irecv_preserve_posting_order():
+    a = paddle.to_tensor(np.float32([10.0]))
+    b = paddle.to_tensor(np.float32([20.0]))
+    r1 = paddle.zeros([1])
+    r2 = paddle.zeros([1])
+    # post two irecvs FIRST, then send two ordered messages
+    t1 = dist.isend(a, dst=0)
+    t2 = dist.isend(b, dst=0)
+    g1 = dist.irecv(r1, src=0)
+    g2 = dist.irecv(r2, src=0)
+    for t in (t1, t2, g1, g2):
+        t.wait()
+    assert float(r1.numpy()[0]) == 10.0
+    assert float(r2.numpy()[0]) == 20.0
+
+
+def test_generation_cache_invalidated_by_structure_change():
+    from paddle_tpu.models import gpt, generate, GenerationConfig
+    from paddle_tpu.nn.lora import LoRAConfig, apply_lora, merge_lora
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    model = gpt("gpt_tiny")
+    model.eval()
+    prompt = paddle.to_tensor(np.zeros((1, 4), np.int32))
+    cfg = GenerationConfig(max_new_tokens=4, do_sample=False, use_cache=True)
+    out0 = generate(model, prompt, cfg).numpy()
+    apply_lora(model, LoRAConfig(r=2))
+    # B initialized to zero -> adapters are a no-op; but the cache must
+    # recompile (new structure), not replay the old program
+    out1 = generate(model, prompt, cfg).numpy()
+    np.testing.assert_array_equal(out0, out1)
+    merge_lora(model)
+    out2 = generate(model, prompt, cfg).numpy()
+    np.testing.assert_array_equal(out0, out2)
+    assert len(model._generate_jit_cache) == 3  # three distinct structures

@@ -1,0 +1,59 @@
+"""paddle.distributed.rpc (reference strategy: test/legacy_test/test_rpc*.py
+— init_rpc, sync/async calls, remote refs, error propagation, shutdown)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import rpc
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("rpc boom")
+
+
+def test_loopback_sync_async_remote():
+    rpc.init_rpc("worker0")
+    try:
+        assert rpc.rpc_sync("worker0", _add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("worker0", _add, args=(10, 20))
+        assert fut.wait(timeout=10) == 30
+        ref = rpc.remote("worker0", _add, args=(1, 1))
+        assert ref.to_here(timeout=10) == 2
+        info = rpc.get_worker_info()
+        assert info.name == "worker0"
+    finally:
+        rpc.shutdown()
+
+
+def test_loopback_error_propagates():
+    rpc.init_rpc("worker0")
+    try:
+        with pytest.raises(RuntimeError, match="rpc boom"):
+            rpc.rpc_sync("worker0", _boom, timeout=10)
+    finally:
+        rpc.shutdown()
+
+
+def _rpc_worker():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import rpc as R
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    R.init_rpc(f"w{rank}")
+    try:
+        peer = f"w{1 - rank}"
+        out = R.rpc_sync(peer, _add, args=(rank, 100), timeout=60)
+        assert out == rank + 100, out  # remote runs _add(rank, 100)
+        infos = R.get_all_worker_infos()
+        assert [i.name for i in infos] == ["w0", "w1"]
+    finally:
+        R.shutdown()
+
+
+def test_two_process_rpc():
+    dist.spawn(_rpc_worker, nprocs=2)

@@ -205,3 +205,43 @@ def test_ptq_calibrate_then_convert():
     out = model(xb).numpy()
     assert isinstance(dict(model.named_sublayers())["0"], ConvertedLinear)
     np.testing.assert_allclose(out, fp_out, rtol=0.1, atol=0.2)
+
+
+def test_qat_convert_per_channel_observer():
+    from paddle_tpu.quantization import (
+        QAT, QuantConfig, PerChannelAbsmaxObserver, ConvertedLinear,
+    )
+    model = nn.Sequential(nn.Linear(6, 10))
+    qat = Q.QAT(QuantConfig(weight=PerChannelAbsmaxObserver))
+    qat.quantize(model)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 6).astype("float32"))
+    ref = model(x).numpy()
+    qat.convert(model)
+    lay = dict(model.named_sublayers())["0"]
+    assert isinstance(lay, ConvertedLinear)
+    assert np.asarray(lay.weight_scale).ndim >= 1  # per-channel
+    np.testing.assert_allclose(model(x).numpy(), ref, rtol=0.1, atol=0.2)
+
+
+def test_converted_model_state_dict_roundtrip(tmp_path):
+    from paddle_tpu.quantization import QAT, ConvertedLinear
+    model = nn.Sequential(nn.Linear(4, 4))
+    qat = Q.QAT()
+    qat.quantize(model)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    model(x)
+    qat.convert(model)
+    sd = model.state_dict()
+    # deploy-form weights survive serialization
+    assert any("weight_int8" in k for k in sd), list(sd)
+    assert any("weight_scale" in k for k in sd)
+    path = str(tmp_path / "q.pdparams")
+    paddle.save(sd, path)
+    ref = model(x).numpy()
+    model2 = nn.Sequential(nn.Linear(4, 4))
+    qat2 = Q.QAT()
+    qat2.quantize(model2)
+    model2(x)
+    qat2.convert(model2)
+    model2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(model2(x).numpy(), ref, rtol=1e-5)

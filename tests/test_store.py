@@ -130,7 +130,7 @@ def test_multiprocess_rendezvous(store):
              for r in range(world)]
     for p in procs:
         p.start()
-    results = [q.get(timeout=30) for _ in range(world)]
+    results = [q.get(timeout=120) for _ in range(world)]  # spawn+jax import is slow under load
     for p in procs:
         p.join(timeout=10)
     assert sorted(r for r, _ in results) == list(range(world))
