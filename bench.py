@@ -60,24 +60,26 @@ def _retry_transient(fn, attempts=6, label="bench"):
         f"{label}: relay still failing after {attempts} attempts") from last
 
 
-def _measure_with_retry(make_engine, ids, steps, attempts=6):
+def _measure_with_retry(make_engine, batch, steps, attempts=6,
+                        label="bench"):
     """Warmup + timed loop. Each attempt rebuilds the engine (the compiled
     program stays cached; rebuild cost is parameter init). Host readback is
     the only reliable fence through the relay (block_until_ready can return
-    at enqueue time), so we fence via float() on the final loss."""
+    at enqueue time), so we fence via float() on the final loss.
+    `batch` is the tuple of train_batch arguments."""
 
     def attempt():
         eng = make_engine()
-        float(eng.train_batch(ids))  # warmup / compile
+        float(eng.train_batch(*batch))  # warmup/compile + readback fence
         t0 = time.perf_counter()
         loss = None
         for _ in range(steps):
-            loss = eng.train_batch(ids)
+            loss = eng.train_batch(*batch)
         final_loss = float(loss)  # device->host readback fences the chain
         dt = time.perf_counter() - t0
         return final_loss, dt
 
-    return _retry_transient(attempt, attempts=attempts)
+    return _retry_transient(attempt, attempts=attempts, label=label)
 
 
 def _emit(payload):
@@ -113,16 +115,8 @@ def bench_resnet50(on_tpu, dev):
     x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
 
-    def attempt():
-        eng = make_engine()
-        float(eng.train_batch(x, y))
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(steps):
-            loss = eng.train_batch(x, y)
-        return float(loss), time.perf_counter() - t0
-
-    final_loss, dt = _retry_transient(attempt, label="resnet bench")
+    final_loss, dt = _measure_with_retry(make_engine, (x, y), steps,
+                                         label="resnet bench")
     ips = batch * steps / dt
     peak = 197e12 if on_tpu else float("inf")
     mfu = ips * train_flops_img / peak
@@ -132,6 +126,60 @@ def bench_resnet50(on_tpu, dev):
         "unit": "images/sec/chip",
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
         "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
+                  "platform": dev.platform},
+    })
+
+
+def bench_bert_finetune(on_tpu, dev):
+    """BASELINE config 2: BERT-base sequence-classification fine-tune step
+    (AMP-O2-equivalent bf16 compute), sequences/sec."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.bert import (
+        bert_for_sequence_classification, CONFIGS,
+    )
+
+    name = "bert_base" if on_tpu else "bert_tiny"
+    seq = int(os.environ.get("BENCH_SEQLEN", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "2"))
+
+    def loss_fn(m, ids, labels):
+        return paddle.nn.functional.cross_entropy(m(ids), labels).mean()
+
+    def make_engine():
+        paddle.seed(0)
+        model = bert_for_sequence_classification(name, num_labels=2)
+        opt = paddle.optimizer.AdamW(learning_rate=2e-5,
+                                     parameters=model.parameters())
+        mesh = dist.build_mesh(dp=-1, devices=jax.devices()[:1])
+        return dist.parallelize(model, opt, loss_fn=loss_fn, mesh=mesh,
+                                compute_dtype="bfloat16" if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    from paddle_tpu.models.bert import BertConfig
+    vocab = BertConfig(**CONFIGS[name]).vocab_size
+    ids = paddle.to_tensor(
+        rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    labels = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype("int64"))
+
+    final_loss, dt = _measure_with_retry(make_engine, (ids, labels), steps,
+                                         label="bert bench")
+    sps = batch * steps / dt
+    # fwd+bwd ~ 6*N FLOPs/token; bert_base ~110M params
+    n_params = dict(bert_base=110e6, bert_tiny=4e6)[name]
+    flops_seq = 6.0 * n_params * seq
+    peak = 197e12 if on_tpu else float("inf")
+    mfu = sps * flops_seq / peak
+    _emit({
+        "metric": f"{name} fine-tune sequences/sec (seq={seq}, bs={batch}, "
+                  f"bf16)",
+        "value": round(sps, 2),
+        "unit": "sequences/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
+                  "tokens_per_sec": round(sps * seq, 1),
                   "platform": dev.platform},
     })
 
@@ -208,13 +256,15 @@ def main():
     if "--model" in sys.argv:
         i = sys.argv.index("--model")
         if i + 1 >= len(sys.argv):
-            print("usage: bench.py [--model gpt_base|resnet50|lora_decode]",
-                  file=sys.stderr)
+            print("usage: bench.py [--model gpt_base|resnet50|bert|"
+                  "lora_decode]", file=sys.stderr)
             sys.exit(2)
         os.environ["BENCH_MODEL"] = sys.argv[i + 1]
     mode = os.environ.get("BENCH_MODEL", "")
     if mode.startswith("resnet"):
         return bench_resnet50(on_tpu, dev)
+    if mode.startswith("bert"):
+        return bench_bert_finetune(on_tpu, dev)
     if "lora" in mode or mode == "decode":
         return bench_lora_decode(on_tpu, dev)
 
@@ -251,7 +301,7 @@ def main():
     # ("INTERNAL ... response body closed"); these are transient transport
     # faults, not program errors — retry with backoff, rebuilding the engine
     # each attempt (donated buffers are poisoned by a failed step).
-    final_loss, dt = _measure_with_retry(make_engine, ids, steps)
+    final_loss, dt = _measure_with_retry(make_engine, (ids,), steps)
 
     tokens = batch * seq_len * steps
     tps = tokens / dt

@@ -67,7 +67,11 @@ def pick_block(s):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_k):
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    # matmul operands stay in the INPUT dtype (bf16 in prod) with fp32
+    # accumulation — casting operands to fp32 would run the MXU at its
+    # fp32 rate (~4x slower on v5e); softmax statistics stay fp32
+    q = q_ref[0]                                      # [bq, d]
+    mm_dtype = q.dtype
     bq, d = q.shape
     s_k = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -79,10 +83,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(j, carry):
         o, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             rows = q_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
@@ -96,7 +100,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(-1, keepdims=True)
         o = o * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(mm_dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return o, m_new, l
 
@@ -152,8 +156,9 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                scale, causal, block_k):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
+    mm_dtype = q.dtype
     lse = lse_ref[0]                                   # [bq, 1]
     delta = delta_ref[0]
     bq, d = q.shape
@@ -161,8 +166,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     q_lo = pl.program_id(1) * bq
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
@@ -173,7 +178,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             p = jnp.where(rows >= cols, p, 0.0)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(mm_dtype)
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -189,16 +194,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, *, scale, causal, block_q):
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
+    mm_dtype = k.dtype
     bk, d = k.shape
     s_q = q_ref.shape[1]
     k_lo = pl.program_id(1) * bk
 
     def body(i, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [bq, 1]
         delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), :]
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
@@ -210,11 +216,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             cols = k_lo + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
             p = jnp.where(rows >= cols, p, 0.0)
-        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
+        p_mm = p.astype(mm_dtype)
+        dv = dv + jax.lax.dot_general(p_mm, do_blk, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk) * scale
+        ds = (p * (dp - delta_blk) * scale).astype(mm_dtype)
         dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -308,6 +315,12 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=None,
     b, s, h, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    # one operand dtype: the kernels run matmuls in the input dtype (fp32
+    # accumulation), so mixed-precision callers normalize to q's dtype here
+    if k.dtype != q.dtype:
+        k = k.astype(q.dtype)
+    if v.dtype != q.dtype:
+        v = v.astype(q.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = block_q or min(DEFAULT_BLOCK_Q, pick_block(s))

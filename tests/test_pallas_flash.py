@@ -52,3 +52,47 @@ def test_flash_uneven_blocks():
     out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_bf16_path():
+    """The production dtype: bf16 operands, fp32 accumulation (fwd+bwd)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    b, s, h, d = 2, 256, 4, 64
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)).astype(jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)).astype(jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)).astype(jnp.bfloat16)
+
+    def naive(q, k, v):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+    out = flash_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = naive(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+    # gradients flow through the bf16 kernels
+    def loss(q):
+        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    g = jax.grad(loss)(q)
+    def ref_loss(q):
+        return naive(q, k, v).sum()
+    gr = jax.grad(ref_loss)(q)
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(gr, np.float32), rtol=0.1, atol=0.3)
+
+    # mixed-dtype inputs normalize instead of failing
+    out2 = flash_attention(q, k, v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out2, np.float32),
+                               np.asarray(out, np.float32), rtol=0.05,
+                               atol=0.05)
