@@ -137,12 +137,16 @@ def bench_bert_finetune(on_tpu, dev):
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
     from paddle_tpu.models.bert import (
-        bert_for_sequence_classification, CONFIGS,
+        bert_for_sequence_classification, BertConfig, CONFIGS,
     )
 
-    name = "bert_base" if on_tpu else "bert_tiny"
+    mode = os.environ.get("BENCH_MODEL", "")
+    if mode in CONFIGS:
+        name = mode
+    else:
+        name = "bert_base" if on_tpu else "bert_tiny"
     seq = int(os.environ.get("BENCH_SEQLEN", "128"))
-    batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "4"))
+    batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "4"))
     steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "2"))
 
     def loss_fn(m, ids, labels):
@@ -158,7 +162,6 @@ def bench_bert_finetune(on_tpu, dev):
                                 compute_dtype="bfloat16" if on_tpu else None)
 
     rng = np.random.RandomState(0)
-    from paddle_tpu.models.bert import BertConfig
     vocab = BertConfig(**CONFIGS[name]).vocab_size
     ids = paddle.to_tensor(
         rng.randint(0, vocab, (batch, seq)).astype("int32"))
@@ -167,9 +170,13 @@ def bench_bert_finetune(on_tpu, dev):
     final_loss, dt = _measure_with_retry(make_engine, (ids, labels), steps,
                                          label="bert bench")
     sps = batch * steps / dt
-    # fwd+bwd ~ 6*N FLOPs/token; bert_base ~110M params
-    n_params = dict(bert_base=110e6, bert_tiny=4e6)[name]
-    flops_seq = 6.0 * n_params * seq
+    # fwd+bwd ~ 6*N FLOPs/token over MATMUL-BEARING params only: the
+    # embedding tables are gathers with no matmul (no tied LM head in a
+    # classification fine-tune), so they must not inflate MFU
+    bc = BertConfig(**CONFIGS[name])
+    h, i, L = bc.hidden_size, bc.intermediate_size, bc.num_hidden_layers
+    n_matmul = L * (4 * h * h + 2 * h * i) + h * h  # blocks + pooler
+    flops_seq = 6.0 * n_matmul * seq
     peak = 197e12 if on_tpu else float("inf")
     mfu = sps * flops_seq / peak
     _emit({

@@ -1,0 +1,54 @@
+"""Multi-process data-parallel training via dist.spawn.
+
+Reference workflow: paddle.distributed.spawn launching N trainers that
+init_parallel_env and train with DDP semantics. Here each process is a
+controller; the parent hosts the native coordination store, and the
+cross-process gradient all-reduce comes from GSPMD once jax.distributed
+joins the processes into one mesh (see tests/test_multiprocess_dist.py
+for that full path). This example shows the spawn + store control plane
+with an explicit p2p/object exchange.
+"""
+import numpy as np
+
+
+def worker(tag):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import rpc
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+
+    # object collective over the store
+    infos = []
+    dist.all_gather_object(infos, {"rank": rank, "tag": tag})
+    if rank == 0:
+        print("gathered:", sorted(i["rank"] for i in infos))
+
+    # p2p tensor exchange
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.float32([3.14])), dst=1)
+    else:
+        buf = paddle.zeros([1])
+        dist.recv(buf, src=0)
+        print(f"rank {rank} received {float(buf.numpy()[0]):.2f}")
+
+    # control-plane rpc between workers
+    rpc.init_rpc(f"trainer{rank}")
+    try:
+        peer = f"trainer{1 - rank}"
+        out = rpc.rpc_sync(peer, sum, args=([rank, 10],), timeout=60)
+        print(f"rank {rank}: rpc_sync({peer}) -> {out}")
+    finally:
+        rpc.shutdown()
+
+
+def main():
+    import paddle_tpu.distributed as dist
+    dist.spawn(worker, args=("demo",), nprocs=2)
+    print("spawn finished")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""QAT -> convert -> export: the quantized deployment pipeline.
+
+Reference workflow: paddle.quantization QAT training, the convert pass
+to an inference program, then jit.save for the Predictor. The converted
+model holds int8 weights + frozen scales as buffers (1/4 the weight
+memory) and serializes through state_dict/jit.save unchanged.
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import QAT, QuantConfig
+
+SMOKE = os.environ.get("EXAMPLES_SMOKE") == "1"
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    qat = QAT(QuantConfig(weight_bits=8, activation_bits=8))
+    qat.quantize(model)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 16).astype("float32")
+    y = rng.randint(0, 4, 256).astype("int64")
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    lf = nn.CrossEntropyLoss()
+    steps = 5 if SMOKE else 40
+    for step in range(steps):
+        loss = lf(model(paddle.to_tensor(X)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    print(f"QAT final loss: {float(loss):.4f}")
+
+    fp_out = model(paddle.to_tensor(X[:8])).numpy()
+    qat.convert(model)          # frozen-scale int8 inference layers
+    model.eval()
+    q_out = model(paddle.to_tensor(X[:8])).numpy()
+    err = np.abs(q_out - fp_out).max() / (np.abs(fp_out).max() + 1e-9)
+    print(f"int8 vs fake-quant relative error: {err:.4f}")
+    sub = dict(model.named_sublayers())["0"]
+    print("deployed weight dtype:", sub.weight_int8.dtype)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "quant_infer")
+        paddle.jit.save(model, path,
+                        input_spec=[paddle.to_tensor(X[:8])])
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(
+            loaded(paddle.to_tensor(X[:8])).numpy(), q_out, rtol=1e-5)
+        print("jit.save/load round-trip ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Static-graph workflow: build once, Executor.run per step.
+
+Reference: the classic fit-a-line static program
+(enable_static -> static.data -> net -> minimize -> exe.run(feed,
+fetch_list)). Here the Program captures ops at build time and the
+Executor replays them — one exe.run == one training step.
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+SMOKE = os.environ.get("EXAMPLES_SMOKE") == "1"
+
+
+def main():
+    paddle.enable_static()
+    try:
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(13, 1).astype("float32")
+        xs = rng.randn(128, 13).astype("float32")
+        ys = xs @ true_w
+
+        main_prog = static.Program()
+        with static.program_guard(main_prog):
+            x = static.data("x", [None, 13], "float32")
+            y = static.data("y", [None, 1], "float32")
+            lin = paddle.nn.Linear(13, 1)
+            pred = lin(x)
+            loss = paddle.nn.functional.mse_loss(pred, y)
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=lin.parameters())
+            opt.minimize(loss)
+
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        steps = 10 if SMOKE else 60
+        for step in range(steps):
+            lv, = exe.run(main_prog, feed={"x": xs, "y": ys},
+                          fetch_list=[loss])
+            if step % 20 == 0:
+                print(f"step {step}: loss {float(lv):.5f}")
+
+        # inference clone: same graph, training hook dropped
+        test_prog = main_prog.clone(for_test=True)
+        out, = exe.run(test_prog, feed={"x": xs[:4], "y": ys[:4]},
+                       fetch_list=[pred])
+        print("predictions:", out[:2].ravel())
+    finally:
+        paddle.disable_static()
+
+
+if __name__ == "__main__":
+    main()

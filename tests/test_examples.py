@@ -1,0 +1,32 @@
+"""Every example must actually run (reference strategy: the docs' code
+samples are CI-executed via sampcd_processor in tools/)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = [
+    "quickstart_train.py",
+    "static_graph.py",
+    "hybrid_parallel_gpt.py",
+    "lora_finetune_generate.py",
+    "recsys_host_embedding.py",
+    "quantization_deploy.py",
+    "distributed_data_parallel.py",
+]
+
+
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_runs(script):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(EXAMPLES_SMOKE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=root)
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", script)],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, (
+        f"{script} failed:\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}")
