@@ -14,3 +14,6 @@ from .bert import (  # noqa: F401
     bert, bert_for_sequence_classification, bert_for_masked_lm,
 )
 from .generation import generate, GenerationConfig  # noqa: F401
+from .conformer import (  # noqa: F401
+    ConformerCTC, conformer_tiny, conformer_s,
+)

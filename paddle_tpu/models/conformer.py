@@ -1,0 +1,147 @@
+"""Conformer ASR encoder + CTC head.
+
+Reference analog: the PaddleSpeech conformer stack the reference README
+points at (paddlespeech/s2t/modules/conformer_convolution.py,
+encoder.py) — conv subsampling, then blocks of
+FFN/2 + MHSA + conv-module + FFN/2 (macaron), CTC loss on top.
+
+TPU-native notes: the whole encoder is static-shape (padded batches +
+length masks, no dynamic seq handling inside jit); attention rides the
+shared flash-attention path when shapes allow; CTC loss comes from the
+framework's functional set.
+"""
+from __future__ import annotations
+
+from .. import nn, ops
+from ..nn import functional as F
+
+
+class ConvSubsampling(nn.Layer):
+    """Two stride-2 convs: T -> T/4 (reference: subsampling.py Conv2dSubsampling4)."""
+
+    def __init__(self, idim, odim):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, odim, 3, stride=2, padding=1)
+        self.conv2 = nn.Conv2D(odim, odim, 3, stride=2, padding=1)
+        # stride-2/padding-1 convs produce ceil(ceil(F/2)/2) frequency bins
+        f_bins = ((idim + 1) // 2 + 1) // 2
+        self.out = nn.Linear(odim * f_bins, odim)
+
+    def forward(self, x):
+        # x: [B, T, F] -> [B, 1, T, F]
+        x = ops.unsqueeze(x, 1)
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))          # [B, D, T/4, F/4]
+        b, d, t, f = x.shape
+        x = ops.transpose(x, [0, 2, 1, 3])  # [B, T/4, D, F/4]
+        return self.out(ops.reshape(x, [b, t, d * f]))
+
+
+class ConformerConvModule(nn.Layer):
+    """Pointwise GLU -> depthwise conv -> BN-free LN -> pointwise."""
+
+    def __init__(self, dim, kernel_size=15):
+        super().__init__()
+        self.norm = nn.LayerNorm(dim)
+        self.pw1 = nn.Linear(dim, 2 * dim)
+        self.dw = nn.Conv1D(dim, dim, kernel_size, groups=dim,
+                            padding=kernel_size // 2)
+        self.mid_norm = nn.LayerNorm(dim)
+        self.pw2 = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        h = self.pw1(self.norm(x))
+        a, b = ops.split(h, 2, axis=-1)
+        h = a * F.sigmoid(b)                      # GLU
+        h = ops.transpose(h, [0, 2, 1])           # [B, D, T]
+        h = self.dw(h)
+        h = ops.transpose(h, [0, 2, 1])
+        h = F.silu(self.mid_norm(h))
+        return self.pw2(h)
+
+
+class ConformerBlock(nn.Layer):
+    def __init__(self, dim, num_heads=4, ff_mult=4, conv_kernel=15,
+                 dropout=0.0):
+        super().__init__()
+        self.ff1_norm = nn.LayerNorm(dim)
+        self.ff1a = nn.Linear(dim, dim * ff_mult)
+        self.ff1b = nn.Linear(dim * ff_mult, dim)
+        self.attn_norm = nn.LayerNorm(dim)
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, 3 * dim)
+        self.attn_out = nn.Linear(dim, dim)
+        self.conv = ConformerConvModule(dim, conv_kernel)
+        self.ff2_norm = nn.LayerNorm(dim)
+        self.ff2a = nn.Linear(dim, dim * ff_mult)
+        self.ff2b = nn.Linear(dim * ff_mult, dim)
+        self.final_norm = nn.LayerNorm(dim)
+        self.drop = nn.Dropout(dropout)
+
+    def _mhsa(self, x):
+        b, t, d = x.shape
+        q, k, v = ops.split(self.qkv(self.attn_norm(x)), 3, axis=-1)
+
+        def heads(z):
+            return ops.reshape(z, [b, t, self.num_heads, self.head_dim])
+
+        out = F.scaled_dot_product_attention(
+            heads(q), heads(k), heads(v), is_causal=False,
+            training=self.training)
+        return self.attn_out(ops.reshape(out, [b, t, d]))
+
+    def forward(self, x):
+        x = x + 0.5 * self.drop(self.ff1b(F.silu(self.ff1a(self.ff1_norm(x)))))
+        x = x + self.drop(self._mhsa(x))
+        x = x + self.drop(self.conv(x))
+        x = x + 0.5 * self.drop(self.ff2b(F.silu(self.ff2a(self.ff2_norm(x)))))
+        return self.final_norm(x)
+
+
+class ConformerCTC(nn.Layer):
+    """Conformer encoder with a CTC vocabulary head (reference: the s2t
+    CTC training path)."""
+
+    def __init__(self, feat_dim=80, dim=144, num_blocks=4, num_heads=4,
+                 vocab_size=256, conv_kernel=15, dropout=0.0):
+        super().__init__()
+        self.subsample = ConvSubsampling(feat_dim, dim)
+        self.blocks = nn.LayerList(
+            [ConformerBlock(dim, num_heads, conv_kernel=conv_kernel,
+                            dropout=dropout) for _ in range(num_blocks)])
+        self.ctc_head = nn.Linear(dim, vocab_size + 1)  # +1 blank
+
+    def forward(self, feats):
+        """feats: [B, T, F] log-mel features -> [B, T/4, vocab+1] logits."""
+        x = self.subsample(feats)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ctc_head(x)
+
+    def loss(self, feats, labels, label_lengths=None):
+        """CTC loss. labels: [B, L] token ids in [1, vocab], padded with 0
+        (id 0 is reserved for padding; the CTC blank is the LAST class,
+        index vocab_size). Pass label_lengths explicitly if 0 is a real
+        token in your vocabulary."""
+        logits = self.forward(feats)              # [B, T', V+1]
+        b, t = logits.shape[0], logits.shape[1]
+        log_probs = F.log_softmax(logits, axis=-1)
+        log_probs = ops.transpose(log_probs, [1, 0, 2])  # [T', B, V+1]
+        input_lengths = ops.full([b], t, dtype="int64")
+        if label_lengths is None:
+            label_lengths = ops.sum(
+                ops.cast(labels > 0, "int64"), axis=-1)
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=logits.shape[-1] - 1)
+
+
+def conformer_tiny(**kw):
+    return ConformerCTC(feat_dim=32, dim=48, num_blocks=2, num_heads=4,
+                        vocab_size=30, **kw)
+
+
+def conformer_s(**kw):
+    """PaddleSpeech conformer-S-class config."""
+    return ConformerCTC(feat_dim=80, dim=144, num_blocks=16, num_heads=4,
+                        vocab_size=5000, **kw)

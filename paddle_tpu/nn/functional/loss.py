@@ -352,9 +352,14 @@ def _ctc_loss_impl(log_probs, labels, input_lengths, label_lengths, *, blank):
     def logsumexp3(a, b, c):
         m = jnp.maximum(jnp.maximum(a, b), c)
         m_safe = jnp.where(m == neg_inf, 0.0, m)
+        # clamp the sum away from 0: jnp.where still differentiates the
+        # unselected branch, and d/dx log(0) poisons every grad with NaN.
+        # The floor must be a NORMAL f32 (1e-38 is subnormal; flush-to-zero
+        # turns 1/floor into inf and the zero cotangent into NaN)
+        s = jnp.exp(a - m_safe) + jnp.exp(b - m_safe) + jnp.exp(c - m_safe)
         return jnp.where(
             m == neg_inf, neg_inf,
-            m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe) + jnp.exp(c - m_safe)))
+            m_safe + jnp.log(jnp.maximum(s, 1e-30)))
 
     same = jnp.concatenate([jnp.full((B, 2), False),
                             ext[:, 2:] == ext[:, :-2]], axis=1)
@@ -382,7 +387,11 @@ def _ctc_loss_impl(log_probs, labels, input_lengths, label_lengths, *, blank):
     a_prev = jnp.take_along_axis(alpha, jnp.maximum(idx_last - 1, 0), axis=1)[:, 0]
     m = jnp.maximum(a_last, a_prev)
     m_safe = jnp.where(m == neg_inf, 0.0, m)
-    total = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_prev - m_safe))
+    s = jnp.exp(a_last - m_safe) + jnp.exp(a_prev - m_safe)
+    # infeasible alignment (input shorter than 2L+1) must surface as a huge
+    # loss, not a silent finite value; the where keeps its gradient NaN-free
+    total = jnp.where(m == neg_inf, neg_inf,
+                      m_safe + jnp.log(jnp.maximum(s, 1e-30)))
     return -total
 
 

@@ -12,3 +12,6 @@ from .mobilenet import (  # noqa: F401
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .yolo import PPYOLOE, ppyoloe_s  # noqa: F401
+from .vit import (  # noqa: F401
+    VisionTransformer, vit_b_16, vit_l_16, vit_s_16, vit_tiny,
+)

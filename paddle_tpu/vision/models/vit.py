@@ -1,0 +1,124 @@
+"""Vision Transformer (ViT) family.
+
+Reference analog: the PaddleClas ViT implementation surfaced through the
+vision model zoo (ppcls/arch/backbone/model_zoo/vision_transformer.py in
+the PaddleClas suite the reference README points at).
+
+TPU-native notes: patch embedding is one conv (stride = patch) that XLA
+maps onto the MXU; encoder blocks reuse the framework's flash-attention
+functional path when shapes allow, so ViT training shares the tuned
+attention kernel with the language models.
+"""
+from __future__ import annotations
+
+from ... import nn, ops
+from ...nn import functional as F
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, kernel_size=patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                     # [B, D, H/p, W/p]
+        b, d = x.shape[0], x.shape[1]
+        x = ops.reshape(x, [b, d, -1])       # [B, D, N]
+        return ops.transpose(x, [0, 2, 1])   # [B, N, D]
+
+
+class ViTBlock(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, dropout=0.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, dim * 3)
+        self.proj = nn.Linear(dim, dim)
+        self.norm2 = nn.LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, dim)
+        self.drop = nn.Dropout(dropout)
+
+    def _attn(self, x):
+        b, n, d = x.shape
+        qkv = self.qkv(x)
+        q, k, v = ops.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return ops.reshape(t, [b, n, self.num_heads, self.head_dim])
+
+        q, k, v = heads(q), heads(k), heads(v)
+        out, _ = F.flash_attention(q, k, v, causal=False,
+                                   training=self.training)
+        return self.proj(ops.reshape(out, [b, n, d]))
+
+    def forward(self, x):
+        x = x + self.drop(self._attn(self.norm1(x)))
+        h = self.fc2(F.gelu(self.fc1(self.norm2(x))))
+        return x + self.drop(h)
+
+
+class VisionTransformer(nn.Layer):
+    """ViT encoder + classification head (class token)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, dropout=0.0):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        std = 0.02
+        init = nn.initializer.TruncatedNormal(std=std)
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], default_initializer=init)
+        self.pos_embed = self.create_parameter(
+            [1, n + 1, embed_dim], default_initializer=init)
+        self.pos_drop = nn.Dropout(dropout)
+        self.blocks = nn.LayerList(
+            [ViTBlock(embed_dim, num_heads, mlp_ratio, dropout)
+             for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim)
+        self.head = nn.Linear(embed_dim, num_classes) \
+            if num_classes > 0 else None
+
+    def forward_features(self, x):
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = ops.expand(self.cls_token, [b, 1, x.shape[-1]])
+        x = ops.concat([cls, x], axis=1) + self.pos_embed
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.norm(x)
+
+    def forward(self, x):
+        feats = self.forward_features(x)
+        cls = feats[:, 0]
+        return self.head(cls) if self.head is not None else cls
+
+
+def vit_b_16(num_classes=1000, **kw):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, num_classes=num_classes, **kw)
+
+
+def vit_l_16(num_classes=1000, **kw):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, num_classes=num_classes, **kw)
+
+
+def vit_s_16(num_classes=1000, **kw):
+    return VisionTransformer(patch_size=16, embed_dim=384, depth=12,
+                             num_heads=6, num_classes=num_classes, **kw)
+
+
+def vit_tiny(num_classes=10, img_size=32, patch_size=8, **kw):
+    """Test-scale ViT."""
+    return VisionTransformer(img_size=img_size, patch_size=patch_size,
+                             embed_dim=64, depth=2, num_heads=4,
+                             num_classes=num_classes, **kw)

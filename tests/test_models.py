@@ -77,3 +77,53 @@ def test_rope_rotation_property():
     np.testing.assert_allclose(
         np.linalg.norm(q.numpy(), axis=-1),
         np.linalg.norm(qr.numpy(), axis=-1), rtol=1e-5)
+
+
+def test_conformer_ctc_trains():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import conformer_tiny
+
+    paddle.seed(0)
+    model = conformer_tiny()
+    rng = np.random.RandomState(0)
+    feats = paddle.to_tensor(rng.randn(2, 64, 32).astype("float32"))
+    labels = paddle.to_tensor(rng.randint(1, 29, (2, 4)).astype("int64"))
+    # T'=16 >= 2L+1=9: every alignment feasible, loss stays finite
+
+    logits = model(feats)
+    assert logits.shape == [2, 16, 31]  # T/4, vocab+blank
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    losses = []
+    for _ in range(6):
+        loss = model.loss(feats, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_conformer_nondiv4_feat_dim():
+    from paddle_tpu.models.conformer import ConformerCTC
+    m = ConformerCTC(feat_dim=30, dim=32, num_blocks=1, num_heads=4,
+                     vocab_size=20)
+    feats = paddle.to_tensor(np.random.RandomState(0).randn(2, 32, 30)
+                             .astype("float32"))
+    assert m(feats).shape == [2, 8, 21]
+
+
+def test_ctc_infeasible_alignment_is_huge_loss():
+    import paddle_tpu as paddle
+    import jax.numpy as jnp
+    import jax
+    T, B, C = 4, 1, 6
+    lp = paddle.to_tensor(np.asarray(
+        jax.nn.log_softmax(jnp.zeros((T, B, C)), -1)))
+    labels = paddle.to_tensor(np.array([[1, 1, 1, 1]], np.int64))  # repeats need blanks: min path 2L-1=7 > T
+    il = paddle.to_tensor(np.array([T], np.int64))
+    ll = paddle.to_tensor(np.array([4], np.int64))
+    out = paddle.nn.functional.ctc_loss(lp, labels, il, ll, blank=0,
+                                        reduction="none")
+    assert float(out.numpy()[0]) > 1e20  # unmissable signal, not silent 69

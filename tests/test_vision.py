@@ -166,3 +166,27 @@ def test_box_coder_roundtrip():
     enc = vops.box_coder(prior, var, target, "encode_center_size").numpy()
     dec = vops.box_coder(prior, var, enc, "decode_center_size").numpy()
     np.testing.assert_allclose(dec, target, rtol=1e-4, atol=1e-4)
+
+
+def test_vit_forward_and_trains():
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import vit_tiny
+
+    paddle.seed(0)
+    model = vit_tiny(num_classes=10, img_size=32, patch_size=8)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 3, 32, 32)
+                         .astype("float32"))
+    out = model(x)
+    assert out.shape == [4, 10]
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    y = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64) % 10)
+    losses = []
+    for _ in range(8):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
