@@ -214,9 +214,19 @@ def bench_lora_decode(on_tpu, dev):
     if on_tpu:
         for _, p in model.named_parameters():
             p._value = p._value.astype("bfloat16")
-    param_bytes = sum(
-        _np.prod(p.shape) * (2 if on_tpu else 4)
-        for _, p in model.named_parameters())
+    wdtype = os.environ.get("BENCH_WEIGHT_DTYPE", "")
+    if wdtype and wdtype not in ("int8", "int4"):
+        raise SystemExit(
+            f"BENCH_WEIGHT_DTYPE={wdtype!r} unsupported (int8|int4)")
+    from paddle_tpu.nn.quant import quantize_for_inference, WeightOnlyLinear
+    if wdtype:
+        quantize_for_inference(model, weight_dtype=wdtype)
+    param_bytes = 0.0
+    for _, sub in model.named_sublayers():
+        if isinstance(sub, WeightOnlyLinear):
+            param_bytes += float(_np.prod(sub.quant_weight.shape))  # 1B/el
+    for n, p in model.named_parameters():
+        param_bytes += float(_np.prod(p.shape)) * (2 if on_tpu else 4)
 
     rng = np.random.RandomState(0)
     prompt = paddle.to_tensor(rng.randint(0, 256, (batch, 16)).astype("int32"))
@@ -240,7 +250,8 @@ def bench_lora_decode(on_tpu, dev):
     bw_frac = (tps * param_bytes / batch) / bw_peak if on_tpu else 0.0
     _emit({
         "metric": f"{name}+LoRA decode tokens/sec (bs={batch}, "
-                  f"{new_tokens} new tokens, KV cache)",
+                  f"{new_tokens} new tokens, KV cache"
+                  + (f", weight-only {wdtype}" if wdtype else "") + ")",
         "value": round(tps, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(bw_frac / 0.40, 4) if on_tpu else 0.0,

@@ -79,7 +79,7 @@ class ConformerBlock(nn.Layer):
         self.final_norm = nn.LayerNorm(dim)
         self.drop = nn.Dropout(dropout)
 
-    def _mhsa(self, x):
+    def _mhsa(self, x, attn_mask=None):
         b, t, d = x.shape
         q, k, v = ops.split(self.qkv(self.attn_norm(x)), 3, axis=-1)
 
@@ -87,14 +87,18 @@ class ConformerBlock(nn.Layer):
             return ops.reshape(z, [b, t, self.num_heads, self.head_dim])
 
         out = F.scaled_dot_product_attention(
-            heads(q), heads(k), heads(v), is_causal=False,
-            training=self.training)
+            heads(q), heads(k), heads(v), attn_mask=attn_mask,
+            is_causal=False, training=self.training)
         return self.attn_out(ops.reshape(out, [b, t, d]))
 
-    def forward(self, x):
+    def forward(self, x, attn_mask=None, pad_mask=None):
         x = x + 0.5 * self.drop(self.ff1b(F.silu(self.ff1a(self.ff1_norm(x)))))
-        x = x + self.drop(self._mhsa(x))
-        x = x + self.drop(self.conv(x))
+        x = x + self.drop(self._mhsa(x, attn_mask))
+        # depthwise conv mixes across time: padded positions (nonzero after
+        # the residual branches above) must be re-zeroed before its window
+        # slides over the pad boundary
+        conv_in = x if pad_mask is None else x * pad_mask
+        x = x + self.drop(self.conv(conv_in))
         x = x + 0.5 * self.drop(self.ff2b(F.silu(self.ff2a(self.ff2_norm(x)))))
         return self.final_norm(x)
 
@@ -112,23 +116,54 @@ class ConformerCTC(nn.Layer):
                             dropout=dropout) for _ in range(num_blocks)])
         self.ctc_head = nn.Linear(dim, vocab_size + 1)  # +1 blank
 
-    def forward(self, feats):
-        """feats: [B, T, F] log-mel features -> [B, T/4, vocab+1] logits."""
+    def forward(self, feats, feat_lengths=None):
+        """feats: [B, T, F] log-mel features -> [B, T', vocab+1] logits
+        with T' = ceil(T/4). `feat_lengths` [B]: true pre-subsampling
+        lengths of zero-padded batches — padded frames are zeroed between
+        blocks so conv/attention context never leaks across the pad
+        boundary."""
         x = self.subsample(feats)
+        mask = attn_mask = None
+        if feat_lengths is not None:
+            tl = self.subsampled_lengths(feat_lengths)
+            t = x.shape[1]
+            pos = ops.unsqueeze(ops.arange(t, dtype="int64"), 0)
+            valid = pos < ops.unsqueeze(tl, -1)            # [B, T'] bool
+            mask = ops.unsqueeze(ops.cast(valid, x.dtype), -1)
+            # softmax must not place weight on padded KEYS:
+            # [B, 1, 1, T'] additive mask broadcast over heads and queries
+            attn_mask = ops.unsqueeze(ops.unsqueeze(
+                (1.0 - ops.cast(valid, x.dtype)) * -1e9, 1), 1)
         for blk in self.blocks:
-            x = blk(x)
+            if mask is not None:
+                x = x * mask
+            x = blk(x, attn_mask, mask)
+        if mask is not None:
+            x = x * mask
         return self.ctc_head(x)
 
-    def loss(self, feats, labels, label_lengths=None):
-        """CTC loss. labels: [B, L] token ids in [1, vocab], padded with 0
-        (id 0 is reserved for padding; the CTC blank is the LAST class,
-        index vocab_size). Pass label_lengths explicitly if 0 is a real
-        token in your vocabulary."""
-        logits = self.forward(feats)              # [B, T', V+1]
+    @staticmethod
+    def subsampled_lengths(feat_lengths):
+        """Pre- to post-subsampling length map (two stride-2 convs with
+        padding 1): T' = ceil(ceil(T/2)/2)."""
+        t1 = (feat_lengths + 1) // 2
+        return (t1 + 1) // 2
+
+    def loss(self, feats, labels, label_lengths=None, feat_lengths=None):
+        """CTC loss. labels: [B, L] token ids in [1, vocab_size - 1],
+        padded with 0 (id 0 is reserved for padding; the CTC blank is the
+        LAST class, index vocab_size — do not use it as a token). Pass
+        label_lengths explicitly if 0 is a real token; pass feat_lengths
+        for zero-padded variable-length batches."""
+        logits = self.forward(feats, feat_lengths)  # [B, T', V+1]
         b, t = logits.shape[0], logits.shape[1]
         log_probs = F.log_softmax(logits, axis=-1)
         log_probs = ops.transpose(log_probs, [1, 0, 2])  # [T', B, V+1]
-        input_lengths = ops.full([b], t, dtype="int64")
+        if feat_lengths is not None:
+            input_lengths = ops.cast(self.subsampled_lengths(feat_lengths),
+                                     "int64")
+        else:
+            input_lengths = ops.full([b], t, dtype="int64")
         if label_lengths is None:
             label_lengths = ops.sum(
                 ops.cast(labels > 0, "int64"), axis=-1)

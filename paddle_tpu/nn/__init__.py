@@ -48,3 +48,4 @@ from . import functional
 from . import initializer
 from . import lora  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401

@@ -127,3 +127,32 @@ def test_ctc_infeasible_alignment_is_huge_loss():
     out = paddle.nn.functional.ctc_loss(lp, labels, il, ll, blank=0,
                                         reduction="none")
     assert float(out.numpy()[0]) > 1e20  # unmissable signal, not silent 69
+
+
+def test_conformer_length_masking():
+    """Padding must not change a short utterance's loss/logits."""
+    from paddle_tpu.models.conformer import ConformerCTC
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    m = ConformerCTC(feat_dim=16, dim=32, num_blocks=1, num_heads=4,
+                     vocab_size=20)
+    m.eval()
+    rng = np.random.RandomState(0)
+    feats_short = rng.randn(1, 32, 16).astype("float32")
+    # same content zero-padded to 64 frames, with true length 32
+    feats_padded = np.concatenate(
+        [feats_short, np.zeros((1, 32, 16), np.float32)], axis=1)
+    lens = paddle.to_tensor(np.array([32], np.int64))
+
+    lo_short = m(paddle.to_tensor(feats_short)).numpy()
+    lo_padded = m(paddle.to_tensor(feats_padded),
+                  feat_lengths=lens).numpy()
+    np.testing.assert_allclose(lo_padded[:, :8], lo_short[:, :8],
+                               rtol=1e-4, atol=1e-4)
+
+    labels = paddle.to_tensor(np.array([[3, 5]], np.int64))
+    l1 = float(m.loss(paddle.to_tensor(feats_short), labels).numpy())
+    l2 = float(m.loss(paddle.to_tensor(feats_padded), labels,
+                      feat_lengths=lens).numpy())
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
