@@ -246,6 +246,12 @@ def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
     for t in tensor_args:
         if isinstance(t, Tensor):
             v = t._value
+            # host-offloaded operands (pinned_host params from
+            # group_sharded_parallel(offload=True) etc.) stream to device
+            # memory on use — XLA cannot mix memory spaces in one op
+            mk = getattr(getattr(v, "sharding", None), "memory_kind", None)
+            if mk in ("pinned_host", "unpinned_host"):
+                v = jax.device_put(v, v.sharding.with_memory_kind("device"))
             if cast_to is not None and v.dtype != cast_to and jnp.issubdtype(v.dtype, jnp.floating):
                 v = v.astype(cast_to)
             arrays.append(v)

@@ -49,10 +49,14 @@ class ConformerConvModule(nn.Layer):
         self.mid_norm = nn.LayerNorm(dim)
         self.pw2 = nn.Linear(dim, dim)
 
-    def forward(self, x):
+    def forward(self, x, pad_mask=None):
         h = self.pw1(self.norm(x))
         a, b = ops.split(h, 2, axis=-1)
         h = a * F.sigmoid(b)                      # GLU
+        if pad_mask is not None:
+            # LN/pw1 biases make padded rows nonzero again; the mask must
+            # land immediately before the depthwise conv window slides
+            h = h * pad_mask
         h = ops.transpose(h, [0, 2, 1])           # [B, D, T]
         h = self.dw(h)
         h = ops.transpose(h, [0, 2, 1])
@@ -94,11 +98,10 @@ class ConformerBlock(nn.Layer):
     def forward(self, x, attn_mask=None, pad_mask=None):
         x = x + 0.5 * self.drop(self.ff1b(F.silu(self.ff1a(self.ff1_norm(x)))))
         x = x + self.drop(self._mhsa(x, attn_mask))
-        # depthwise conv mixes across time: padded positions (nonzero after
-        # the residual branches above) must be re-zeroed before its window
-        # slides over the pad boundary
-        conv_in = x if pad_mask is None else x * pad_mask
-        x = x + self.drop(self.conv(conv_in))
+        # depthwise conv mixes across time: padding is masked INSIDE the
+        # conv module (post-GLU), since its own LN/pointwise biases would
+        # otherwise re-populate padded rows before the window slides
+        x = x + self.drop(self.conv(x, pad_mask))
         x = x + 0.5 * self.drop(self.ff2b(F.silu(self.ff2a(self.ff2_norm(x)))))
         return self.final_norm(x)
 

@@ -118,16 +118,44 @@ class Optimizer:
         if self._grad_clip is not None:
             pgs = self._grad_clip(pgs)
         self._ensure_state(params)
-        p_vals = [p._value for p, _ in pgs]
+
+        # host-offloaded params/moments stream to device for the update and
+        # return to their host residency after (group_sharded offload=True)
+        def _host_sharding(x):
+            sh = getattr(x, "sharding", None)
+            if getattr(sh, "memory_kind", None) in ("pinned_host",
+                                                    "unpinned_host"):
+                return sh
+            return None
+
+        def _to_device(x):
+            sh = _host_sharding(x)
+            return x if sh is None else jax.device_put(
+                x, sh.with_memory_kind("device"))
+
+        host_sh = [_host_sharding(p._value) for p, _ in pgs]
+        p_vals = [_to_device(p._value) for p, _ in pgs]
         g_vals = [g._value.astype(p._value.dtype) for p, g in pgs]
-        states = [self._accumulators[id(p)] for p, _ in pgs]
+        states = [jax.tree_util.tree_map(_to_device,
+                                         self._accumulators[id(p)])
+                  for p, _ in pgs]
         self._step_count += 1
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
         new_p, new_s = self._get_jitted()(p_vals, g_vals, states, lr, step)
-        for (p, _), np_, ns in zip(pgs, new_p, new_s):
-            p._value = np_
-            self._accumulators[id(p)] = ns
+        for (p, _), np_, ns, hs in zip(pgs, new_p, new_s, host_sh):
+            if hs is None:
+                p._value = np_
+                self._accumulators[id(p)] = ns
+            else:
+                # offloaded param: the update AND its optimizer moments
+                # return to host residency (adam-offload semantics)
+                p._value = jax.device_put(np_, hs)
+                self._accumulators[id(p)] = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(
+                        x, x.sharding.with_memory_kind(hs.memory_kind))
+                    if hasattr(x, "sharding") else x,
+                    ns)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         from .. import static as static_mod

@@ -138,6 +138,12 @@ def test_conformer_length_masking():
     m = ConformerCTC(feat_dim=16, dim=32, num_blocks=1, num_heads=4,
                      vocab_size=20)
     m.eval()
+    # trained models have nonzero biases; zero-init would hide conv-module
+    # padding leaks (the GLU re-populates padded rows via LN/pw1 biases)
+    import jax.numpy as jnp
+    for n, p in m.named_parameters():
+        if n.endswith("bias") or "norm" in n:
+            p._value = jnp.full_like(p._value, 0.5)
     rng = np.random.RandomState(0)
     feats_short = rng.randn(1, 32, 16).astype("float32")
     # same content zero-padded to 64 frames, with true length 32

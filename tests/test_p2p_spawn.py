@@ -180,3 +180,21 @@ def test_generation_cache_invalidated_by_structure_change():
     out2 = generate(model, prompt, cfg).numpy()
     np.testing.assert_array_equal(out0, out2)
     assert len(model._generate_jit_cache) == 3  # three distinct structures
+
+
+def test_group_sharded_offload_trains():
+    """offload=True keeps params resident in host memory; ops stream them
+    to device on use and the optimizer returns updates to host."""
+    hcg = topo.HybridCommunicateGroup(mesh=topo.build_mesh(sharding=-1))
+    topo.set_hybrid_communicate_group(hcg)
+    model = paddle.nn.Linear(16, 16)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    model, opt, _ = dist.group_sharded_parallel(model, opt, "os_g",
+                                                offload=True)
+    w0 = np.array(model.weight.numpy())
+    loss = model(paddle.ones([4, 16])).sum()
+    loss.backward()
+    opt.step()
+    assert model.weight._value.sharding.memory_kind == "pinned_host"
+    assert not np.allclose(w0, model.weight.numpy())
