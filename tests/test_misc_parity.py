@@ -165,3 +165,59 @@ def test_amp_debugging_operator_stats(capsys):
         with pytest.raises(RuntimeError, match="NaN/Inf"):
             _ = x / paddle.to_tensor(np.zeros((4, 4), np.float32))
     _ = x / x  # flag restored after the context
+
+
+def test_text_datasets_synthetic_schema():
+    import tarfile, io, os, tempfile
+    from paddle_tpu.text import Imdb, Imikolov, UCIHousing
+    from paddle_tpu.io import DataLoader
+
+    ds = Imdb(synthetic=32)
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert len(ds) == 32 and "<unk>" in ds.word_idx
+
+    ng = Imikolov(synthetic=16, data_type="NGRAM", window_size=5)
+    sample = ng[0]
+    assert isinstance(sample, tuple) and len(sample) == 5  # flat window
+    assert sample[0] == ng.word_idx["<s>"]  # boundary marker included
+
+    uci = UCIHousing(synthetic=50, mode="train")
+    x, y = uci[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(uci) == 40  # 80% split
+    # trains through the standard loop
+    import paddle_tpu as paddle
+    model = paddle.nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    loader = DataLoader(uci, batch_size=10)
+    for xb, yb in loader:
+        loss = paddle.nn.functional.mse_loss(model(xb), yb)
+        loss.backward(); opt.step(); opt.clear_grad()
+    assert np.isfinite(float(loss))
+
+    # archive path: build a tiny aclImdb-shaped tar and parse it
+    with tempfile.TemporaryDirectory() as td:
+        tar_path = os.path.join(td, "imdb.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for i, (split, pol, text) in enumerate([
+                ("train", "pos", "good great good movie"),
+                ("train", "neg", "bad awful bad movie"),
+                ("test", "pos", "splendid unseen words movie"),
+            ]):
+                data = text.encode()
+                info = tarfile.TarInfo(f"aclImdb/{split}/{pol}/{i}.txt")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        real = Imdb(data_file=tar_path, mode="train", cutoff=1)
+        assert len(real) == 2
+        assert {lbl for _, lbl in [real[0], real[1]]} == {0, 1}
+        # vocab is built over BOTH splits: ids consistent across modes
+        test_split = Imdb(data_file=tar_path, mode="test", cutoff=1)
+        assert test_split.word_idx == real.word_idx
+
+    # zero-egress contract: download=True raises with guidance
+    import pytest
+    with pytest.raises(NotImplementedError, match="zero egress"):
+        Imdb(download=True)
