@@ -97,28 +97,43 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def _conv_transpose_impl(x, w, *, stride, padding, output_padding, dilation,
                          groups, n_spatial, channel_last):
+    """Gradient-of-conv transpose convolution (paddle/torch semantics:
+    out[i*stride + k*dilation - pad] += x[i] * w[ci, co, k]), expressed as a
+    correlation over the lhs-dilated input with the spatially-flipped kernel
+    so XLA lowers it onto the MXU like any other conv.
+
+    Reference: phi/kernels/impl/conv_transpose_kernel_impl.h (paddle weight
+    layout [in, out//groups, *k])."""
+    nd = n_spatial
     if channel_last:
-        lhs_spec = "N" + "DHW"[3 - n_spatial:] + "C"
+        lhs_spec = "N" + "DHW"[3 - nd:] + "C"
     else:
-        lhs_spec = "NC" + "DHW"[3 - n_spatial:]
-    # paddle transpose-conv weight layout: [in, out//groups, *k]
-    rhs_spec = "IO" + "DHW"[3 - n_spatial:]
-    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
-                                        (lhs_spec, rhs_spec, lhs_spec))
+        lhs_spec = "NC" + "DHW"[3 - nd:]
+    spatial = "DHW"[3 - nd:]
+    # flip spatial dims: scatter == correlate with the reversed kernel
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    cin, coutg = w.shape[0], w.shape[1]
+    # (Cin, Cout//g, *k) -> (Cout, Cin//g, *k), output channels grouped so
+    # feature_group_count=g pairs input group i with filters [i*coutg:...]
+    w = w.reshape((groups, cin // groups, coutg) + w.shape[2:])
+    w = jnp.moveaxis(w, 2, 1).reshape((groups * coutg, cin // groups)
+                                      + w.shape[3:])
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, (lhs_spec, "OI" + spatial, lhs_spec))
     if isinstance(padding, str):
         pad_cfg = padding
     else:
-        # convert forward-conv padding to transpose padding per spatial dim
+        # convert forward-conv padding to transpose (full-correlation) padding
         pad_cfg = []
         for i, (lo, hi) in enumerate(padding):
             k = (w.shape[2 + i] - 1) * dilation[i] + 1
             pad_cfg.append((k - 1 - lo, k - 1 - hi + output_padding[i]))
-    return jax.lax.conv_transpose(
-        x, w, strides=stride,
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd,
         padding=pad_cfg,
+        lhs_dilation=stride,
         rhs_dilation=dilation,
         dimension_numbers=dn,
-        transpose_kernel=False,
         feature_group_count=groups,
     )
 

@@ -71,8 +71,10 @@ def _pool(kind, x, kernel_size, stride, padding, n_spatial, data_format,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    out = _pool("max", x, kernel_size, stride, padding, 1,
-                "NCW" if data_format == "NCL" else "NWC", ceil_mode)
+    df = "NCW" if data_format == "NCL" else "NWC"
+    out = _pool("max", x, kernel_size, stride, padding, 1, df, ceil_mode)
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding, df)
     return out
 
 
@@ -87,7 +89,12 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool("max", x, kernel_size, stride, padding, 3, data_format, ceil_mode)
+    out = _pool("max", x, kernel_size, stride, padding, 3, data_format,
+                ceil_mode)
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding,
+                                      data_format)
+    return out
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -109,11 +109,18 @@ for _nm, _f in (("tensor_split", tensor_split), ("hsplit", hsplit),
                 tensor_method=(_nm == "tensor_split"))
 
 
+_ATLEAST_IMPLS = {
+    1: lambda x: jnp.atleast_1d(x),
+    2: lambda x: jnp.atleast_2d(x),
+    3: lambda x: jnp.atleast_3d(x),
+}
+
+
 def _atleast(nd):
-    jfn = {1: jnp.atleast_1d, 2: jnp.atleast_2d, 3: jnp.atleast_3d}[nd]
+    jfn = _ATLEAST_IMPLS[nd]  # stable fn object -> per-op jit cache + tape
 
     def op(*inputs, name=None):
-        outs = [Tensor(jfn(wrap(t)._value)) for t in inputs]
+        outs = [apply(f"atleast_{nd}d", jfn, (wrap(t),)) for t in inputs]
         return outs if len(outs) > 1 else outs[0]
 
     op.__name__ = f"atleast_{nd}d"
@@ -872,15 +879,21 @@ for _nm, _f in (("create_array", create_array),
 # einops-style rearrange + print options
 # ---------------------------------------------------------------------------
 
-def rearrange(tensor, pattern, **axes_lengths):
-    """einops rearrange over Tensors (reference:
-    python/paddle/tensor/einsum.py rearrange, itself einops-backed)."""
+def _rearrange_impl(*xs, pattern, axes_lengths):
     import einops
-    if isinstance(tensor, (list, tuple)):
-        arrs = [wrap(t)._value for t in tensor]
-        return Tensor(einops.rearrange(arrs, pattern, **axes_lengths))
-    return Tensor(einops.rearrange(wrap(tensor)._value, pattern,
-                                   **axes_lengths))
+    arrs = list(xs) if len(xs) > 1 else xs[0]
+    return einops.rearrange(arrs, pattern, **dict(axes_lengths))
+
+
+def rearrange(tensor, pattern, **axes_lengths):
+    """einops rearrange over Tensors, dispatched through the tape so the
+    gradient is the inverse rearrangement (reference:
+    python/paddle/tensor/einsum.py rearrange, itself einops-backed)."""
+    tensors = (tuple(wrap(t) for t in tensor)
+               if isinstance(tensor, (list, tuple)) else (wrap(tensor),))
+    return apply("rearrange", _rearrange_impl, tensors,
+                 {"pattern": pattern,
+                  "axes_lengths": tuple(sorted(axes_lengths.items()))})
 
 
 register_op("rearrange", rearrange, category="manipulation", generated=True,

@@ -302,10 +302,14 @@ def _hh(a, tau):
     q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
 
     def body(i, q):
-        v = jnp.where(jnp.arange(m)[:, None] > i, a[..., :, i:i + 1], 0.0)
-        v = v.at[..., i, 0].set(1.0)
-        t = tau[..., i]
-        h = jnp.eye(m, dtype=a.dtype) - t[..., None, None] * (v @ jnp.swapaxes(v, -2, -1))
+        # dynamic column extraction (slices with a loop-carried index don't
+        # trace; gather does)
+        col = jnp.take(a, i, axis=-1)
+        v = jnp.where(jnp.arange(m) > i, col, 0.0)
+        v = jnp.where(jnp.arange(m) == i, 1.0, v)
+        t = jnp.take(tau, i, axis=-1)
+        h = jnp.eye(m, dtype=a.dtype) - t[..., None, None] * (
+            v[..., :, None] * v[..., None, :])
         return q @ h
 
     q = jax.lax.fori_loop(0, tau.shape[-1], body, q)

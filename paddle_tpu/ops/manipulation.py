@@ -9,6 +9,8 @@ import numpy as np
 
 from ._helpers import apply, wrap, Tensor, norm_axis
 
+_builtin_slice = slice  # `def slice(...)` below shadows the builtin
+
 
 def _int_list(v):
     if isinstance(v, Tensor):
@@ -417,9 +419,14 @@ def _masked_select_impl(x, mask):
 
 
 def masked_select(x, mask, name=None):
+    # The output length is data-dependent, so the nnz/indices are resolved on
+    # the host (eager semantics, mask carries no gradient) — but the values
+    # are then gathered through the tape so d(out)/d(x) scatters back
+    # (reference: phi/kernels masked_select_grad scatters into x).
     xx, mm = wrap(x), wrap(mask)
-    out = np.asarray(xx._value)[np.asarray(mm._value)]
-    return Tensor(jnp.asarray(out))
+    m_np = np.broadcast_to(np.asarray(mm._value), tuple(xx.shape))
+    flat_idx = np.flatnonzero(m_np)
+    return gather(reshape(xx, (-1,)), Tensor(jnp.asarray(flat_idx)), axis=0)
 
 
 def _masked_fill_impl(x, mask, value):
@@ -486,12 +493,14 @@ def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None, pad_fro
 
 
 def _slice_impl(x, *, axes, starts, ends):
-    idx = [slice(None)] * x.ndim
+    idx = [_builtin_slice(None)] * x.ndim
     for a, s, e in zip(axes, starts, ends):
-        idx[a] = slice(s, e)
+        idx[a] = _builtin_slice(s, e)
     return x[tuple(idx)]
 
 
+# `slice` below shadows the builtin at module scope; the impls above/below
+# must keep using the real builtin (caught by the schema OpTest).
 def slice(input, axes, starts, ends):
     starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
     ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
@@ -501,9 +510,9 @@ def slice(input, axes, starts, ends):
 
 
 def _strided_slice_impl(x, *, axes, starts, ends, strides):
-    idx = [slice(None)] * x.ndim
+    idx = [_builtin_slice(None)] * x.ndim
     for a, s, e, st in zip(axes, starts, ends, strides):
-        idx[a] = slice(s, e, st)
+        idx[a] = _builtin_slice(s, e, st)
     return x[tuple(idx)]
 
 
@@ -514,7 +523,7 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
 
 
 def _crop_impl(x, *, shape, offsets):
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    idx = tuple(_builtin_slice(o, o + s) for o, s in zip(offsets, shape))
     return x[idx]
 
 

@@ -48,6 +48,13 @@ class OpSpec:
     np_ref: Optional[Callable] = None       # numpy reference implementation
     tol: float = 1e-5
     generated: bool = False        # True if stamped by defop (vs migrated)
+    # OpTest-grade metadata (≈ op_test.py check_grad/check_output dtype grid,
+    # /root/reference/test/legacy_test/op_test.py:2755,2963):
+    grad: Any = None               # None = no grad check; True = all float
+                                   # ndarray args; tuple = arg indices
+    grad_tol: float = 5e-2         # max-relative-error bound (fp32 central diff)
+    bf16: bool = False             # include in the bf16 dtype sweep
+    bf16_tol: float = 8e-2
 
     @property
     def public_names(self):
@@ -70,7 +77,8 @@ def register_op(name, fn, **kw) -> OpSpec:
     return spec
 
 
-def attach_sample(name, sample, np_ref=None, tol=None):
+def attach_sample(name, sample, np_ref=None, tol=None, grad=None,
+                  grad_tol=None, bf16=None, bf16_tol=None):
     """Attach a parity-test sample to an already-registered (migrated) op."""
     spec = OPS.get(name)
     if spec is None:
@@ -80,6 +88,14 @@ def attach_sample(name, sample, np_ref=None, tol=None):
         spec.np_ref = np_ref
     if tol is not None:
         spec.tol = tol
+    if grad is not None:
+        spec.grad = grad
+    if grad_tol is not None:
+        spec.grad_tol = grad_tol
+    if bf16 is not None:
+        spec.bf16 = bf16
+    if bf16_tol is not None:
+        spec.bf16_tol = bf16_tol
     return spec
 
 
