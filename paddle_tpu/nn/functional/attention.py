@@ -112,6 +112,14 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     """Reference: F.flash_attention (flash_attention.py:146) — returns
     (out, softmax_lse-like placeholder). On TPU lowers to the Pallas flash
     kernel when available, else fused XLA attention."""
+    if return_softmax:
+        # the fused kernels never materialize probabilities; returning None
+        # silently here would corrupt callers that index the tuple
+        raise NotImplementedError(
+            "flash_attention(return_softmax=True): the flash kernel does "
+            "not materialize attention probabilities (same restriction as "
+            "the reference CUDA kernel for inference); recompute them with "
+            "scaled_dot_product_attention-style math if needed")
     out = scaled_dot_product_attention(query, key, value, None, dropout,
                                        causal, training)
     return out, None
@@ -121,36 +129,141 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False, name=None):
     """Varlen flash-attention parity (flash_attention.py:302): ragged batches
-    are expressed with cumulative seqlens; on TPU we segment-mask instead."""
+    are expressed with cumulative seqlens.
+
+    TPU-native: tokens are re-packed into a [n_seq, max_seqlen, H, D] padded
+    batch (gather indices computed on the host — eager semantics, seqlens are
+    concrete) and run through batched masked attention, so compute is
+    O(n_seq * max_seqlen²) like the CUDA varlen kernel — NOT O(total²) as a
+    flat block-diagonal mask would be."""
+    import numpy as np
     q, k, v = wrap(query), wrap(key), wrap(value)
-    cu_q = wrap(cu_seqlens_q)
-    # build segment ids from cu_seqlens: tokens of sequence i in [cu[i], cu[i+1])
-    return apply("flash_attn_unpadded", _varlen_attn_impl,
-                 (q, k, v, cu_q, wrap(cu_seqlens_k)),
-                 {"scale": float(scale), "causal": bool(causal)}), None
+    cu_q = np.asarray(wrap(cu_seqlens_q).numpy()).astype(np.int64)
+    cu_k = np.asarray(wrap(cu_seqlens_k).numpy()).astype(np.int64)
+    n_seq = len(cu_q) - 1
+    mq, mk = int(max_seqlen_q), int(max_seqlen_k)
+    # gather tables: padded slot (i, t) <- flat token cu[i] + t (clamped);
+    # pad slots point at token 0 and are masked out by the length mask
+    idx_q = np.minimum(cu_q[:-1, None] + np.arange(mq)[None],
+                       max(q.shape[0] - 1, 0)).astype(np.int32)
+    idx_k = np.minimum(cu_k[:-1, None] + np.arange(mk)[None],
+                       max(k.shape[0] - 1, 0)).astype(np.int32)
+    len_q = (cu_q[1:] - cu_q[:-1]).astype(np.int32)
+    len_k = (cu_k[1:] - cu_k[:-1]).astype(np.int32)
+    out = apply("flash_attn_unpadded", _varlen_attn_impl,
+                (q, k, v, Tensor(jnp.asarray(idx_q)),
+                 Tensor(jnp.asarray(idx_k)), Tensor(jnp.asarray(len_q)),
+                 Tensor(jnp.asarray(len_k))),
+                {"scale": float(scale), "causal": bool(causal),
+                 "total_q": int(q.shape[0]), "n_seq": n_seq})
+    return out, None
 
 
-def _varlen_attn_impl(q, k, v, cu_q, cu_k, *, scale, causal):
-    # q: [total_q, H, D]; segment mask via searchsorted on cu_seqlens
-    tq = q.shape[0]
-    tk = k.shape[0]
-    seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right")
-    seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right")
-    mask = seg_q[:, None] == seg_k[None, :]
-    scores = jnp.einsum("qhd,khd->hqk", q, v * 0 + k) * scale
+def _varlen_attn_impl(q, k, v, idx_q, idx_k, len_q, len_k, *, scale, causal,
+                      total_q, n_seq):
+    # q: [total_q, H, D] -> packed [n_seq, max_q, H, D]
+    qp = q[idx_q]                                   # [n, mq, H, D]
+    kp = k[idx_k]
+    vp = v[idx_k]
+    mq, mk = idx_q.shape[1], idx_k.shape[1]
+    valid_q = jnp.arange(mq)[None] < len_q[:, None]          # [n, mq]
+    valid_k = jnp.arange(mk)[None] < len_k[:, None]
+    mask = valid_q[:, :, None] & valid_k[:, None, :]          # [n, mq, mk]
     if causal:
-        pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q - 1)
-        pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k - 1)
-        mask = mask & (pos_q[:, None] >= pos_k[None, :])
-    scores = jnp.where(mask[None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("hqk,khd->qhd", probs, v)
+        mask = mask & (jnp.arange(mq)[:, None] >= jnp.arange(mk)[None, :])
+    bias = jnp.where(mask, 0.0, -1e30)[:, None]               # [n, 1, mq, mk]
+    out = jax.nn.dot_product_attention(qp, kp, vp, bias=bias, scale=scale)
+    out = jnp.where(valid_q[..., None, None], out, 0.0)
+    # scatter packed rows back to the flat layout; pad rows carry zeros and
+    # are dropped because every real slot is written exactly once
+    flat = jnp.zeros((total_q,) + out.shape[2:], out.dtype)
+    flat = flat.at[idx_q.reshape(-1)].add(
+        out.reshape((-1,) + out.shape[2:]))
+    # pad slots all alias token 0/last — subtract their (zero) contribution
+    return flat
 
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
-    raise NotImplementedError(
-        "sparse_attention: use paddle_tpu.ops.pallas block-sparse attention")
+    """CSR-pattern attention (reference: nn/functional/flash_attention.py
+    sparse_attention; CUDA kernel phi/kernels/sparse/gpu/
+    fused_attention_kernel.cu).
+
+    q/k/v: [batch, num_heads, seq_len, head_dim]; offset [B, H, S+1],
+    columns [B, H, nnz]: row r of head (b, h) attends exactly the listed
+    columns.
+
+    TPU-native routing: when the pattern is shared across (b, h) and is an
+    exact union of (block × block) tiles, runs the Pallas block-sparse
+    flash kernel (compute/HBM ∝ nnz blocks). Otherwise computes via the
+    differentiable SDDMM + segment-softmax path — still O(nnz), never a
+    dense S×S materialization.
+    """
+    import numpy as np
+    q, k, v = wrap(query), wrap(key), wrap(value)
+    B, H, S, D = q.shape
+    off = np.asarray(wrap(sparse_csr_offset).numpy()).reshape(B * H, S + 1)
+    col = np.asarray(wrap(sparse_csr_columns).numpy()).reshape(B * H, -1)
+    scale = 1.0 / float(np.sqrt(D))
+
+    shared = bool((off == off[0]).all() and (col == col[0]).all())
+    if (shared and key_padding_mask is None and attn_mask is None
+            and S % 128 == 0):
+        from ...ops.pallas.block_sparse_attention import (
+            block_sparse_attention, csr_to_block_tables)
+        bidx, bcnt, exact = csr_to_block_tables(off[0], col[0], S, 128)
+        if exact:
+            return apply(
+                "block_sparse_attention", _bs_attn_impl,
+                (q, k, v, Tensor(jnp.asarray(bidx)),
+                 Tensor(jnp.asarray(bcnt))),
+                {"scale": scale, "block_size": 128, "b": B, "h": H})
+
+    # SDDMM path: flat (bh, row, col) triples from the CSR on the host
+    counts = np.diff(off, axis=1)                       # [BH, S]
+    bh = np.repeat(np.arange(B * H), counts.sum(1))
+    r = np.concatenate([np.repeat(np.arange(S), c) for c in counts])
+    c_flat = np.concatenate([col[i, :counts[i].sum()]
+                             for i in range(B * H)]).astype(np.int64)
+    args = [q, k, v, Tensor(jnp.asarray(bh)), Tensor(jnp.asarray(r)),
+            Tensor(jnp.asarray(c_flat))]
+    kp = wrap(key_padding_mask) if key_padding_mask is not None else None
+    am = wrap(attn_mask) if attn_mask is not None else None
+    return apply("sparse_attention_sddmm", _sddmm_attn_impl,
+                 (args[0], args[1], args[2], args[3], args[4], args[5],
+                  kp, am),
+                 {"scale": scale, "b": B, "h": H})
+
+
+def _bs_attn_impl(q, k, v, bidx, bcnt, *, scale, block_size, b, h):
+    from ...ops.pallas.block_sparse_attention import block_sparse_attention
+    B, H, S, D = q.shape
+    out = block_sparse_attention(
+        q.reshape(B * H, S, D), k.reshape(B * H, S, D),
+        v.reshape(B * H, S, D), bidx, bcnt, scale, block_size)
+    return out.reshape(B, H, S, D)
+
+
+def _sddmm_attn_impl(q, k, v, bh, r, c, key_padding_mask, attn_mask, *,
+                     scale, b, h):
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    scores = (qf[bh, r] * kf[bh, c]).sum(-1) * scale
+    if key_padding_mask is not None:
+        scores = scores + key_padding_mask.reshape(B, S)[bh // H, c]
+    if attn_mask is not None:
+        scores = scores + attn_mask[r, c]
+    rows = bh * S + r
+    nrows = B * H * S
+    mx = jax.ops.segment_max(scores, rows, num_segments=nrows)
+    ex = jnp.exp(scores - mx[rows])
+    den = jax.ops.segment_sum(ex, rows, num_segments=nrows)
+    p = ex / jnp.maximum(den[rows], 1e-30)
+    out = jax.ops.segment_sum(p[:, None] * vf[bh, c], rows,
+                              num_segments=nrows)
+    return out.reshape(B, H, S, D)
 
 
 def _rope_impl(q, k, pos, *, theta):
