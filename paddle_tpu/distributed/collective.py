@@ -132,10 +132,63 @@ def _unwrap(t):
     return t._value if isinstance(t, Tensor) else jnp.asarray(t)
 
 
+def _spawned_store(group):
+    """(rank, world, store) when the env contract declares MORE processes
+    than the local jax world (dist.spawn / launch children without
+    jax.distributed) and the caller didn't name a local mesh group.
+
+    In that regime the local mesh has no cross-process identity, so the
+    mesh path would silently reduce over a world of one — the silent-no-op
+    bug flagged by the round-2 advisor (env.py get_world_size reports the
+    env contract). Dense collectives must ride the coordination store (like
+    p2p.reduce) or fail loudly."""
+    if group is not None:
+        return None
+    from .env import get_rank, get_world_size, get_store
+    world = get_world_size()
+    if world <= jax.process_count():
+        return None
+    store = get_store()
+    if store is None:
+        raise RuntimeError(
+            f"distributed env declares world_size={world} but this process "
+            f"has no coordination store and no multi-process jax runtime — "
+            "a mesh collective here would silently act on this process "
+            "alone. Initialize the store (dist.init_parallel_env / spawn "
+            "context) before calling dense collectives.")
+    return get_rank(), world, store
+
+
+def _store_all_gather_arrays(x_np):
+    from .p2p import all_gather_object
+    objs = []
+    all_gather_object(objs, np.asarray(x_np))
+    return [np.asarray(o) for o in objs]
+
+
+_NP_FOLD = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.PROD: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.AVG: lambda arrs: np.mean(arrs, axis=0),
+}
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (reference: communication/all_reduce.py). On a
     value sharded over the group axis: psum across shards (result replicated
-    on that axis). On a replicated value: identity (world of one)."""
+    on that axis). On a replicated value: identity (world of one). On a
+    spawned multi-process job (env world > local jax world): folds through
+    the coordination store so gradients really sync across processes."""
+    sp = _spawned_store(group)
+    if sp is not None:
+        arrs = _store_all_gather_arrays(_unwrap(tensor))
+        out = jnp.asarray(_NP_FOLD[op](np.stack(arrs)))
+        if isinstance(tensor, Tensor):
+            tensor._value = out
+            return tensor
+        return Tensor(out)
     if group is None:
         group = new_group(axis="dp")
     v = _unwrap(tensor)
@@ -162,6 +215,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Reference: communication/all_gather.py — gathers shards along the
     group axis into tensor_list (one entry per shard)."""
+    sp = _spawned_store(group)
+    if sp is not None:
+        arrs = _store_all_gather_arrays(_unwrap(tensor))
+        tensor_list.clear()
+        tensor_list.extend(Tensor(jnp.asarray(a)) for a in arrs)
+        return
     if group is None:
         group = new_group(axis="dp")
     v = _unwrap(tensor)
@@ -186,6 +245,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Reference: communication/broadcast.py. Mesh semantics: make the value
     replicated along the group axis, taking shard `src`."""
+    sp = _spawned_store(group)
+    if sp is not None:
+        from .p2p import broadcast_object_list
+        box = [np.asarray(_unwrap(tensor))]
+        broadcast_object_list(box, src=src)
+        v = jnp.asarray(box[0])
+        if isinstance(tensor, Tensor):
+            tensor._value = v
+            return tensor
+        return Tensor(v)
     if group is None:
         group = new_group(axis="dp")
     v = _unwrap(tensor)
@@ -214,6 +283,24 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     replicated over the axis (every rank holds the same data) that is
     nranks * chunk_r, computed with no collective at all; with inputs
     sharded over the axis (true per-rank values) it is a psum_scatter."""
+    sp = _spawned_store(group)
+    if sp is not None:
+        rank, world, _ = sp
+        src_t = tensor_list if tensor_list is not None else tensor
+        if isinstance(src_t, (list, tuple)):
+            mine = np.stack([np.asarray(_unwrap(t)) for t in src_t])
+        else:
+            mine = np.asarray(_unwrap(src_t))
+        arrs = _store_all_gather_arrays(mine)
+        total = _NP_FOLD[op](np.stack(arrs))
+        chunk = total.shape[0] // world
+        out = jnp.asarray(total[rank * chunk:(rank + 1) * chunk])
+        if isinstance(src_t, (list, tuple)) and chunk == 1:
+            out = out[0]
+        if isinstance(tensor, Tensor):
+            tensor._value = out
+            return tensor
+        return Tensor(out)
     if group is None:
         group = new_group(axis="dp")
     src = tensor_list if tensor_list is not None else tensor
@@ -261,6 +348,19 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Reference: communication/all_to_all.py. Controller semantics: each
     in_tensor_list[i] is sharded over the group axis (shard r = rank r's
     i-th tensor); out[j]'s shard r = in[r]'s shard j."""
+    sp = _spawned_store(group)
+    if sp is not None:
+        rank, world, _ = sp
+        if len(in_tensor_list) != world:
+            raise ValueError(
+                f"all_to_all needs one tensor per rank ({world}), got "
+                f"{len(in_tensor_list)}")
+        mine = np.stack([np.asarray(_unwrap(t)) for t in in_tensor_list])
+        arrs = _store_all_gather_arrays(mine)
+        out_tensor_list.clear()
+        out_tensor_list.extend(
+            Tensor(jnp.asarray(arrs[r][rank])) for r in range(world))
+        return
     if group is None:
         group = new_group(axis="dp")
     vals = [_unwrap(t) for t in in_tensor_list]
@@ -294,26 +394,22 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out_tensor_list.append(Tensor(out[i]))
 
 
-def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    """Reference: communication/scatter.py. Only the world-size-1 case has
-    controller semantics today (per-rank destinations need shard addressing
-    — use auto_parallel.shard_tensor instead)."""
-    if group is None:
-        group = new_group(axis="dp")
-    if group.nranks == 1:
-        if tensor_list:
-            v = _unwrap(tensor_list[0])
-            if isinstance(tensor, Tensor):
-                tensor._value = v
-        return tensor
-    raise NotImplementedError(
-        "scatter across mesh axes: use paddle_tpu.distributed.shard_tensor")
-
-
 def barrier(group=None):
-    """Reference: communication/barrier.py — on the single controller all
-    issued work is ordered; block_until_ready on a token is the barrier."""
-    jnp.zeros(()).block_until_ready()
+    """Reference: communication/barrier.py.
+
+    Multi-process job: a REAL cross-process barrier over the native
+    coordination store (native/coord_store.cc) — `block_until_ready` says
+    nothing about other processes (and can return at enqueue time through a
+    PJRT relay). Single controller: a host readback fences locally-issued
+    work."""
+    from .env import get_store, get_world_size, get_rank
+    store = get_store()
+    if store is not None and get_world_size() > 1:
+        store.barrier(name="dist_barrier", world_size=get_world_size())
+        return
+    # fence via host readback, not block_until_ready (see bench discipline)
+    import numpy as _np
+    _np.asarray(jnp.zeros(()))
 
 
 def get_group(axis="dp"):
@@ -324,5 +420,5 @@ def get_group(axis="dp"):
 # compiled path is lax.ppermute inside shard_map / pipeline schedules).
 from .p2p import (  # noqa: E402,F401
     send, recv, isend, irecv, P2POp, P2PTask, batch_isend_irecv, gather,
-    reduce, all_gather_object, broadcast_object_list,
+    scatter, reduce, all_gather_object, broadcast_object_list,
 )

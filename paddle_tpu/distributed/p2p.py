@@ -235,6 +235,41 @@ def batch_isend_irecv(p2p_op_list):
     return tasks
 
 
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Reference: communication/scatter.py — src distributes tensor_list[r]
+    to rank r, received into `tensor`.
+
+    Multi-process job: src sends each slice through the store (the gather
+    pattern reversed). Single controller: every "rank" lives here, so the
+    receive is tensor_list[rank] directly."""
+    from . import collective as C
+    if group is None:
+        group = C.new_group(axis="dp")
+    rank, world = get_rank(), get_world_size()
+    if world > 1 and get_store() is not None:
+        if rank == src:
+            if tensor_list is None or len(tensor_list) != world:
+                raise ValueError(
+                    f"scatter src rank needs tensor_list of len {world}")
+            for r in range(world):
+                if r == src:
+                    continue
+                send(tensor_list[r], dst=r, group=group)
+            chosen = tensor_list[src]
+        else:
+            recv(tensor, src=src, group=group)
+            return tensor
+    else:
+        if tensor_list is None or len(tensor_list) <= rank:
+            raise ValueError("scatter needs tensor_list on the src rank")
+        chosen = tensor_list[rank]
+    v = jnp.asarray(_to_numpy(chosen))
+    if isinstance(tensor, Tensor):
+        tensor._value = v
+        return tensor
+    return Tensor(v)
+
+
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """Reference: communication/gather.py — collect every rank's tensor on
     dst. Mesh semantics: a value sharded over the group axis contributes
@@ -319,6 +354,13 @@ def all_gather_object(object_list, obj, group=None):
     for r in range(world):
         object_list.append(
             pickle.loads(store.wait(f"obj/allgather/{seq}/{r}")))
+    # bound store memory: the LAST rank to finish reading deletes the
+    # payloads (no rank can delete earlier — all must read every key)
+    done = store.add(f"obj/allgather/{seq}/done", 1)
+    if done == world:
+        for r in range(world):
+            store.delete_key(f"obj/allgather/{seq}/{r}")
+        store.delete_key(f"obj/allgather/{seq}/done")
 
 
 def broadcast_object_list(object_list, src=0, group=None):
@@ -336,4 +378,9 @@ def broadcast_object_list(object_list, src=0, group=None):
     else:
         vals = pickle.loads(store.wait(f"obj/bcast/{seq}"))
         object_list[:] = vals
+    # last reader deletes the payload (src counts itself as a reader)
+    done = store.add(f"obj/bcast/{seq}/done", 1)
+    if done == world:
+        store.delete_key(f"obj/bcast/{seq}")
+        store.delete_key(f"obj/bcast/{seq}/done")
     return object_list

@@ -73,11 +73,17 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     rank = get_rank() if rank is None else rank
     world = get_world_size() if world_size is None else world_size
     _state["name"] = name
+    # epoch-namespace all request/response/seq keys: after shutdown()+
+    # init_rpc() in the same job, the fresh serve loop reads epoch-local
+    # keys, so a persisted rpc/seq counter can't make callers enqueue at
+    # sequence numbers the server never polls (advisor r2 finding)
+    _state["epoch"] = _state.get("epoch", -1) + 1
     store = get_store()
     if store is not None and world > 1:
-        store.set(f"rpc/worker/{rank}", name.encode())
+        ep = _state["epoch"]
+        store.set(f"rpc/{ep}/worker/{rank}", name.encode())
         for r in range(world):
-            other = store.wait(f"rpc/worker/{r}").decode()
+            other = store.wait(f"rpc/{ep}/worker/{r}").decode()
             _state["workers"][other] = r
         t = threading.Thread(target=_serve_loop, daemon=True)
         t.start()
@@ -117,9 +123,10 @@ def _serve_loop():
 
     store = _open_client()
     rank = get_rank()
+    ep = _state["epoch"]
     served = 0
     while not _state["stop"]:
-        key = f"rpc/req/{rank}/{served}"
+        key = f"rpc/{ep}/req/{rank}/{served}"
         try:
             raw = store.get_nowait(key)
         except Exception:
@@ -137,7 +144,7 @@ def _serve_loop():
             payload = pickle.dumps(("ok", result))
         except Exception:
             payload = pickle.dumps(("err", traceback.format_exc()))
-        store.set(f"rpc/res/{rank}/{served}", payload)
+        store.set(f"rpc/{ep}/res/{rank}/{served}", payload)
         store.delete_key(key)
         served += 1
     store.close()
@@ -160,18 +167,20 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
 
     store = get_store()
     dst = get_worker_info(to).rank
+    ep = _state["epoch"]
     with _state["lock"]:
-        seq_key = f"rpc/seq/{dst}"
+        seq_key = f"rpc/{ep}/seq/{dst}"
         seq = store.add(seq_key, 1) - 1
-    store.set(f"rpc/req/{dst}/{seq}", pickle.dumps((fn, args, kwargs)))
+    store.set(f"rpc/{ep}/req/{dst}/{seq}", pickle.dumps((fn, args, kwargs)))
 
     def wait_reply():
         try:
             conn = _open_client()  # own socket: never shares the handle
             try:
-                raw = conn.wait(f"rpc/res/{dst}/{seq}", timeout=timeout)
+                raw = conn.wait(f"rpc/{ep}/res/{dst}/{seq}",
+                                timeout=timeout)
                 status, payload = pickle.loads(raw)
-                conn.delete_key(f"rpc/res/{dst}/{seq}")
+                conn.delete_key(f"rpc/{ep}/res/{dst}/{seq}")
             finally:
                 conn.close()
             if status == "ok":
@@ -224,3 +233,4 @@ def shutdown(graceful=True):
         t.join(timeout=2)
     _state.update(initialized=False, name=None, serve_thread=None,
                   stop=False, workers={})
+    # epoch survives the reset: the next init_rpc starts a new key space

@@ -68,9 +68,18 @@ class Binomial(Distribution):
         return log_comb + value * jnp.log(p) + (n - value) * jnp.log1p(-p)
 
     def _entropy(self):
-        # exact truncated-support sum (reference computes the same sum)
+        # exact truncated-support sum (reference computes the same sum).
+        # The sum length is data-dependent, so total_count must be concrete:
+        # entropy() is eager-only (calling it under jit/to_static tracing
+        # gets a clear error instead of a ConcretizationTypeError).
         n, p = self.total_count, self.probs
-        kmax = int(jnp.max(n)) + 1
+        nmax = jax.core.concrete_or_error(
+            None, jnp.max(n),
+            "Binomial.entropy() needs a concrete total_count — its "
+            "truncated-support sum length is data-dependent. Call it "
+            "outside jit/to_static, or hoist entropy() out of the traced "
+            "region.")
+        kmax = int(nmax) + 1
         ks = jnp.arange(0.0, kmax)
         nf, pf = n.reshape(-1), jnp.clip(p.reshape(-1), _EPS, 1 - _EPS)
         log_comb = (jax.lax.lgamma(nf + 1.0)[None]

@@ -252,10 +252,69 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                                quant_round_type=1, quant_max_bound=127.0,
                                quant_min_bound=-127.0, name=None):
     """Single-token decode attention over a running KV cache (reference:
-    incubate/nn/functional/masked_multihead_attention.py). The TPU decode
-    path lives in models/generation (KV-cached jit decode); this shim keeps
-    API parity for incubate callers."""
-    raise NotImplementedError(
-        "masked_multihead_attention: use paddle_tpu.models generation "
-        "(KV-cached decode) — the incubate fused-kernel signature has no "
-        "TPU equivalent")
+    incubate/nn/functional/masked_multihead_attention.py — x is the fused
+    qkv [B, 3*H*D] for the current step; cache_kv [2, B, H, max_len, D]).
+
+    TPU-native: one jitted step — scatter k/v into the cache at the current
+    position, attend over the valid prefix. The same math the models/
+    generation KV-decode loop uses, exposed under the incubate signature.
+    Returns (out [B, H*D], cache_kv_out); cache_kv is updated in place like
+    the reference ("cache_kvs_out is inplace with input")."""
+    if beam_cache_offset is not None or rotary_tensor is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam search offsets / fused rotary "
+            "tensors are not supported; apply rotary embedding to x first "
+            "(nn.functional.apply_rotary_pos_emb)")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv "
+                         "[2, B, H, max_len, D]")
+    cache = wrap(cache_kv)
+    _, B, H, M, D = cache.shape
+    if sequence_lengths is None:
+        # reference convention: mask length encodes the step position
+        pos_static = (wrap(src_mask).shape[-1] - 1 if src_mask is not None
+                      else 0)
+        seq_t = None
+    else:
+        seq_t = wrap(sequence_lengths)
+        pos_static = -1
+    out, new_cache = apply(
+        "masked_multihead_attention", _mmha_impl,
+        (wrap(x), cache, wrap(bias) if bias is not None else None,
+         wrap(src_mask) if src_mask is not None else None, seq_t),
+        {"num_heads": int(H), "head_dim": int(D),
+         "pos_static": int(pos_static)})
+    if isinstance(cache_kv, Tensor):
+        cache_kv._value = new_cache._value
+    return out, new_cache
+
+
+def _mmha_impl(x, cache_kv, bias, src_mask, seq_lens, *, num_heads,
+               head_dim, pos_static):
+    H, D = num_heads, head_dim
+    B = x.shape[0]
+    M = cache_kv.shape[3]
+    qkv = x.reshape(B, 3, H, D)
+    if bias is not None:
+        qkv = qkv + bias.reshape(1, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, H, D]
+    if seq_lens is not None:
+        pos = seq_lens.reshape(B).astype(jnp.int32)
+    else:
+        pos = jnp.full((B,), pos_static, jnp.int32)
+    onehot = (jnp.arange(M)[None, :] == pos[:, None])  # [B, M]
+    oh = onehot[:, None, :, None]
+    new_k = jnp.where(oh, k[:, :, None, :], cache_kv[0])
+    new_v = jnp.where(oh, v[:, :, None, :], cache_kv[1])
+    scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                        new_k.astype(jnp.float32)) / jnp.sqrt(float(D))
+    valid = jnp.arange(M)[None, :] <= pos[:, None]     # [B, M]
+    if src_mask is not None:
+        L = src_mask.shape[-1]
+        scores = scores.at[..., :L].add(
+            src_mask.reshape(B, 1, L).astype(jnp.float32))
+    scores = jnp.where(valid[:, None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhm,bhmd->bhd", p,
+                     new_v.astype(jnp.float32)).astype(x.dtype)
+    return out.reshape(B, H * D), jnp.stack([new_k, new_v])

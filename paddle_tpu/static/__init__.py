@@ -176,7 +176,6 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place
-        self._jit_cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
         program = program or default_main_program()
@@ -280,9 +279,13 @@ class Executor:
         # parameters) — passed as inputs each run so updates are visible.
         # The op-list walk is memoized per program version: serving loops
         # must not pay an O(num_ops) python pass per request.
+        # caches live ON the Program so entries die with it — an executor-
+        # held dict keyed by id(program) would grow unboundedly and could
+        # replay a stale compiled op list after id reuse (advisor r2)
+        _cache = program.__dict__.setdefault("_executor_cache", {})
         feed_ids = {id(program._feed_vars[n]) for n in feed_names}
-        akey = (id(program), program.num_ops, tuple(sorted(feed_ids)))
-        analysis = self._jit_cache.get(("analysis", akey))
+        akey = (program.num_ops, tuple(sorted(feed_ids)))
+        analysis = _cache.get(("analysis", akey))
         if analysis is None:
             produced = set(feed_ids)
             ext_ids = []
@@ -295,18 +298,18 @@ class Executor:
                         ext_ids.append(ref)
                 produced.update(out_ids)
             analysis = (ext_ids, produced)
-            self._jit_cache[("analysis", akey)] = analysis
+            _cache[("analysis", akey)] = analysis
         ext_ids, produced = analysis
 
-        names_key = ("names", id(program), program.num_ops)
-        name_map = self._jit_cache.get(names_key)
+        names_key = ("names", program.num_ops)
+        name_map = _cache.get(names_key)
         if name_map is None:
             name_map = {}
             for t in program._tensors.values():
                 n = getattr(t, "name", None)
                 if n is not None and n not in name_map:
                     name_map[n] = t
-            self._jit_cache[names_key] = name_map
+            _cache[names_key] = name_map
         fetch_ids = []
         for f in fetch_list:
             if isinstance(f, str):
@@ -324,9 +327,9 @@ class Executor:
                     f"program")
             fetch_ids.append(id(f))
 
-        sig = (id(program), program.num_ops, tuple(fetch_ids),
+        sig = (program.num_ops, tuple(fetch_ids),
                tuple((v.shape, str(v.dtype)) for v in feed_vals))
-        fn = self._jit_cache.get(sig)
+        fn = _cache.get(sig)
         if fn is None:
             ops = list(program._ops)
             f_ids = [id(program._feed_vars[n]) for n in feed_names]
@@ -345,7 +348,7 @@ class Executor:
                 return [env[i] for i in out_ids_wanted]
 
             fn = jax.jit(replay)
-            self._jit_cache[sig] = fn
+            _cache[sig] = fn
 
         ext_vals = [program._tensors[i]._value for i in ext_ids]
         outs = fn(feed_vals, ext_vals)

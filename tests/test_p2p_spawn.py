@@ -130,6 +130,32 @@ def _spawn_worker(tag):
     if rank == 0:
         assert float(out.numpy()[0]) == 3.0, out.numpy()
 
+    # DENSE collectives must really sync across spawned processes (advisor
+    # r2 medium: these used to silently reduce over the local mesh)
+    t = paddle.to_tensor(np.float32([float(rank + 1), 10.0]))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), [3.0, 20.0])
+
+    parts = []
+    dist.all_gather(parts, paddle.to_tensor(np.float32([rank])))
+    assert sorted(float(p.numpy()[0]) for p in parts) == [0.0, 1.0]
+
+    b = paddle.to_tensor(np.float32([rank + 7.0]))
+    dist.broadcast(b, src=1)
+    assert float(b.numpy()[0]) == 8.0, b.numpy()
+
+    recv_buf = paddle.zeros([1])
+    if rank == 0:
+        dist.scatter(recv_buf,
+                     [paddle.to_tensor(np.float32([100.0])),
+                      paddle.to_tensor(np.float32([200.0]))], src=0)
+        assert float(recv_buf.numpy()[0]) == 100.0
+    else:
+        dist.scatter(recv_buf, None, src=0)
+        assert float(recv_buf.numpy()[0]) == 200.0
+
+    dist.barrier()          # store-backed cross-process barrier
+
 
 def test_spawn_two_processes():
     dist.spawn(_spawn_worker, args=("t1",), nprocs=2)

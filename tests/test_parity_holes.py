@@ -1,0 +1,102 @@
+"""Round-3 parity-hole closures (VERDICT r2 item 8): dist.scatter,
+store-backed barrier, MoE dense-fallback warning, and a real
+masked_multihead_attention decode step.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def test_scatter_single_controller():
+    t = paddle.zeros([3])
+    parts = [paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))]
+    out = dist.scatter(t, parts, src=0)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])  # received into t
+
+
+def test_scatter_requires_tensor_list():
+    with pytest.raises(ValueError):
+        dist.scatter(paddle.zeros([2]), None, src=0)
+
+
+def test_barrier_local_noop():
+    dist.barrier()  # single controller: host fence, must not raise
+
+
+def test_moe_dense_fallback_warns_once():
+    from paddle_tpu.distributed.moe import MoELayer
+    mesh = dist.build_mesh(mp=8)
+    dist.set_hybrid_communicate_group(dist.HybridCommunicateGroup(mesh=mesh))
+    try:
+        layer = MoELayer(8, 16, 8, gate="gshard", capacity_factor=4.0,
+                         dispatch_mode="auto")
+        x = paddle.randn([63, 8])          # 63 % 8 != 0 -> dense fallback
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            layer(x)
+            layer(x)
+        msgs = [w for w in rec if issubclass(w.category, RuntimeWarning)
+                and "DENSE dispatch" in str(w.message)]
+        assert len(msgs) == 1, "must warn exactly once per layer"
+    finally:
+        dist.set_hybrid_communicate_group(None)
+
+
+def test_masked_multihead_attention_decode_step():
+    import paddle_tpu.incubate.nn.functional as IF
+    B, H, M, D = 2, 2, 8, 4
+    rng = np.random.default_rng(0)
+    cache = np.zeros((2, B, H, M, D), "float32")
+    hist_k = rng.normal(size=(B, H, 3, D)).astype("float32")
+    hist_v = rng.normal(size=(B, H, 3, D)).astype("float32")
+    cache[0, :, :, :3] = hist_k
+    cache[1, :, :, :3] = hist_v
+    x = rng.normal(size=(B, 3 * H * D)).astype("float32")
+    seq = np.full((B, 1), 3, "int32")
+    cache_t = paddle.to_tensor(cache)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_t,
+        sequence_lengths=paddle.to_tensor(seq))
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    ref = np.zeros((B, H, D), "float32")
+    for b in range(B):
+        for h in range(H):
+            ks = np.concatenate([hist_k[b, h], k[b, h][None]], 0)
+            vs = np.concatenate([hist_v[b, h], v[b, h][None]], 0)
+            s = ks @ q[b, h] / np.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            ref[b, h] = p @ vs
+    np.testing.assert_allclose(out.numpy().reshape(B, H, D), ref,
+                               rtol=1e-5, atol=1e-5)
+    # cache updated in place at the write position
+    np.testing.assert_allclose(cache_t.numpy()[0, :, :, 3], k, rtol=1e-6)
+    # history untouched
+    np.testing.assert_allclose(cache_t.numpy()[0, :, :, :3], hist_k)
+
+
+def test_masked_multihead_attention_mask_and_bias():
+    import paddle_tpu.incubate.nn.functional as IF
+    B, H, M, D = 1, 1, 4, 4
+    rng = np.random.default_rng(1)
+    cache = np.zeros((2, B, H, M, D), "float32")
+    cache[0, :, :, 0] = rng.normal(size=(B, H, D))
+    cache[1, :, :, 0] = rng.normal(size=(B, H, D))
+    x = rng.normal(size=(B, 3 * H * D)).astype("float32")
+    bias = rng.normal(size=(3, H, D)).astype("float32")
+    # mask length 2 == position 1 + 1; block history position 0
+    mask = np.array([[[[-1e9, 0.0]]]], "float32")
+    out, _ = IF.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        bias=paddle.to_tensor(bias), src_mask=paddle.to_tensor(mask))
+    qkv = x.reshape(B, 3, H, D) + bias[None]
+    v_cur = qkv[0, 2, 0]
+    # with history masked out, output must be exactly current v
+    np.testing.assert_allclose(out.numpy().reshape(D), v_cur, rtol=1e-5,
+                               atol=1e-5)
