@@ -26,6 +26,26 @@ from ..core.dispatch import no_grad, is_grad_enabled, GradNode
 from ..ops import random as rnd
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _resolve_break_errors():
+    """Error classes that mean "this construct can't live inside the traced
+    graph" — the SOT graph-break set: tensor-dependent python control flow,
+    host conversions of tracers (print/.numpy()/int()), and dy2static's own
+    conversion failures. Resolved lazily (circular import with dy2static)."""
+    from .dy2static import Dy2StaticError
+    errs = [Dy2StaticError]
+    for name in ("TracerArrayConversionError", "TracerBoolConversionError",
+                 "ConcretizationTypeError", "TracerIntegerConversionError",
+                 "UnexpectedTracerError"):
+        e = getattr(jax.errors, name, None)
+        if e is not None:
+            errs.append(e)
+    return tuple(errs)
+
+
 class _TraceState(threading.local):
     def __init__(self):
         self.depth = 0
@@ -60,7 +80,7 @@ class TracedProgram:
 
 
 class StaticFunction:
-    def __init__(self, function, layer=None, full_graph=True, backend=None,
+    def __init__(self, function, layer=None, full_graph=False, backend=None,
                  input_spec=None):
         # AST-convert python control flow (if/while/for-range on tensor
         # values -> lax.cond/while_loop); falls back to the original
@@ -76,6 +96,14 @@ class StaticFunction:
         self._cache = {}
         self._donate_inputs = False
         self.concrete_programs = self._cache  # parity-ish surface
+        # SOT-style degradation contract (reference jit/sot/translate.py:31):
+        # full_graph=False means an unconvertible construct BREAKS THE GRAPH
+        # and the call runs eagerly instead of raising; per-signature guards
+        # (shapes/dtypes/python-arg values) decide compiled-vs-eager, so a
+        # new signature re-attempts compilation.
+        self._full_graph = bool(full_graph)
+        self._fallback_sigs = set()
+        self._warned_break = False
 
     # -- holder discovery -------------------------------------------------
     def _holders(self):
@@ -184,19 +212,52 @@ class StaticFunction:
         kw_static = tuple(sorted(
             (k, v) for k, v in kwargs.items()
             if isinstance(v, (int, float, str, bool, type(None)))))
-        sig = self._sig(arg_tensors, kw_static, training)
+        # guard on python POSITIONAL values too: a python scalar that steers
+        # a branch must key the cache (the SOT guard-set analog — without it
+        # a compiled graph traced under one branch value would be replayed
+        # for another)
+        pos_static = tuple(
+            (i, v) for i, v in enumerate(args)
+            if isinstance(v, (int, float, str, bool, type(None))))
+        sig = self._sig(arg_tensors, (pos_static, kw_static), training)
 
-        entry = self._cache.get(sig)
-        if entry is None:
-            pure = self._build(args, kwargs, arg_tensors, holders, training)
-            entry = _compile_entry(pure, holders, arg_tensors)
-            self._cache[sig] = entry
-        else:
-            # rebind: entry's pure fn closes over THIS call's tensors only if
-            # rebuilt; instead we rebuild pure each call but reuse jit cache via
-            # stable wrapper — handled inside _compile_entry.
-            entry.rebind(args, kwargs, arg_tensors, self)
-        return entry.run(holders, arg_tensors)
+        if sig in self._fallback_sigs:
+            # graph previously broke for this signature: stay eager
+            return self._source_function(*args, **kwargs)
+
+        try:
+            entry = self._cache.get(sig)
+            if entry is None:
+                pure = self._build(args, kwargs, arg_tensors, holders,
+                                   training)
+                entry = _compile_entry(pure, holders, arg_tensors)
+                self._cache[sig] = entry
+            else:
+                # rebind: entry's pure fn closes over THIS call's tensors
+                # only if rebuilt; instead we rebuild pure each call but
+                # reuse jit cache via stable wrapper — handled inside
+                # _compile_entry.
+                entry.rebind(args, kwargs, arg_tensors, self)
+            return entry.run(holders, arg_tensors)
+        except _resolve_break_errors() as e:
+            if self._full_graph:
+                raise
+            self._cache.pop(sig, None)
+            self._fallback_sigs.add(sig)
+            if not self._warned_break:
+                self._warned_break = True
+                import warnings
+                name = getattr(self._source_function, "__qualname__",
+                               repr(self._source_function))
+                warnings.warn(
+                    f"to_static: graph break in {name} — "
+                    f"{type(e).__name__}: {str(e).splitlines()[0][:160]}. "
+                    "Falling back to EAGER execution for this input "
+                    "signature (still correct, not compiled). Rewrite the "
+                    "construct into convertible control flow or pass "
+                    "full_graph=True to make this an error.",
+                    RuntimeWarning, stacklevel=2)
+            return self._source_function(*args, **kwargs)
 
 
 class _CompiledEntry:
@@ -351,8 +412,11 @@ def _compile_entry(pure, holders, arg_tensors):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """Reference: paddle.jit.to_static (jit/api.py:171)."""
+              backend=None, full_graph=False, **kwargs):
+    """Reference: paddle.jit.to_static (jit/api.py:171). Matching the
+    reference default, full_graph=False degrades unconvertible constructs
+    into eager graph breaks (the SOT contract, jit/sot/translate.py:31);
+    full_graph=True makes them errors."""
     from ..nn.layer.layers import Layer
 
     def decorate(obj):

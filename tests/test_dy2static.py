@@ -112,8 +112,8 @@ def test_bool_and_or_in_condition():
         f(paddle.to_tensor(np.float32([1.0, 20.0]))).numpy(), [0.0, 0.0])
 
 
-def test_branch_var_missing_one_side_raises_guidance():
-    @paddle.jit.to_static
+def test_branch_var_missing_one_side_full_graph_raises_guidance():
+    @paddle.jit.to_static(full_graph=True)
     def f(x):
         if x.sum() > 0:
             y = x * 2.0
@@ -125,8 +125,27 @@ def test_branch_var_missing_one_side_raises_guidance():
         or "UNDEF" in str(ei.value) or "leaf" in str(ei.value).lower()
 
 
-def test_unconvertible_fails_loudly_with_guidance():
+def test_branch_var_missing_one_side_default_breaks_graph():
+    # default full_graph=False: the SOT contract — break the graph, run
+    # eagerly, produce the right answer (the eager path sees a concrete
+    # condition, so `y` is simply bound)
+    import warnings
+
     @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        return y  # noqa: F821
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = f(paddle.to_tensor(np.float32([1.0])))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    assert any("graph break" in str(w.message) for w in rec)
+
+
+def test_unconvertible_full_graph_fails_loudly_with_guidance():
+    @paddle.jit.to_static(full_graph=True)
     def f(x):
         # `return` inside the branch -> not convertible -> loud error
         if x.sum() > 0:
