@@ -22,4 +22,5 @@ from .api import (  # noqa: F401
 )
 from .planner import (  # noqa: F401,E402
     plan, auto_parallelize, ModelStats, Plan,
+    tune, auto_parallelize_tuned, TunedPlan, Measurement,
 )
