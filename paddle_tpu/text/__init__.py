@@ -1,5 +1,7 @@
 """paddle_tpu.text (reference: python/paddle/text/ — viterbi_decode +
 dataset loaders; datasets need local files in this zero-egress build)."""
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
-from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, UCIHousing, WMT14, WMT16, Conll05st, Movielens,
+)
 from . import datasets  # noqa: F401

