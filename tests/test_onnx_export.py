@@ -1,0 +1,104 @@
+"""Real ONNX export round-trip (VERDICT r2 item 10; reference:
+python/paddle/onnx/export.py). The emitted protobuf is re-parsed with the
+in-repo reader and numerically executed with the numpy reference runner —
+outputs must match the live model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+class LeNetish(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.c1 = nn.Conv2D(1, 4, 3, padding=1)
+        self.fc = nn.Linear(4 * 7 * 7, 10)
+
+    def forward(self, x):
+        x = paddle.nn.functional.relu(self.c1(x))
+        x = paddle.nn.functional.max_pool2d(x, 2)
+        x = paddle.reshape(x, [2, -1])
+        return paddle.nn.functional.softmax(self.fc(x), axis=-1)
+
+
+class MiniEncoder(nn.Layer):
+    def __init__(self, d=16):
+        super().__init__()
+        self.ln = nn.LayerNorm(d)
+        self.q = nn.Linear(d, d)
+        self.k = nn.Linear(d, d)
+        self.v = nn.Linear(d, d)
+        self.o = nn.Linear(d, d)
+        self.scale = 1.0 / np.sqrt(d)
+
+    def forward(self, x):
+        h = self.ln(x)
+        att = paddle.matmul(self.q(h), self.k(h), transpose_y=True)
+        att = paddle.nn.functional.softmax(att * self.scale, axis=-1)
+        ctx = paddle.matmul(att, self.v(h))
+        return x + paddle.nn.functional.gelu(self.o(ctx))
+
+
+def _roundtrip(model, spec, feed):
+    import paddle_tpu.onnx as onnx
+    import tempfile
+    import os
+
+    model.eval()
+    want = model(paddle.to_tensor(feed)).numpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.onnx")
+        onnx.export(model, path, input_spec=[spec])
+        assert os.path.getsize(path) > 100
+        parsed = onnx.load(path)
+        assert parsed.opset == onnx.OPSET
+        assert parsed.inputs and parsed.outputs
+        got = onnx.reference_run(parsed, {parsed.inputs[0][0]: feed})[0]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    return parsed
+
+
+def test_lenet_conv_roundtrip():
+    paddle.seed(0)
+    model = LeNetish()
+    feed = np.random.RandomState(0).randn(2, 1, 14, 14).astype("float32")
+    parsed = _roundtrip(model, InputSpec([2, 1, 14, 14], "float32"), feed)
+    ops = [n.op_type for n in parsed.nodes]
+    assert "Conv" in ops and "MaxPool" in ops and "Softmax" in ops
+    # weights travel as initializers
+    assert any(a.ndim == 4 for a in parsed.initializers.values())
+
+
+def test_encoder_attention_roundtrip():
+    paddle.seed(1)
+    model = MiniEncoder()
+    feed = np.random.RandomState(1).randn(2, 6, 16).astype("float32")
+    parsed = _roundtrip(model, InputSpec([2, 6, 16], "float32"), feed)
+    ops = [n.op_type for n in parsed.nodes]
+    assert "LayerNormalization" in ops
+    assert "Einsum" in ops or "MatMul" in ops
+    assert "Erf" in ops               # exact gelu decomposition
+
+
+def test_unsupported_op_raises_with_guidance():
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, axis=0)
+
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        import paddle_tpu.onnx as onnx
+        onnx.export(Weird(), "/tmp/_weird.onnx",
+                    input_spec=[InputSpec([2, 3], "float32")])
+
+
+def test_stablehlo_path_unchanged(tmp_path):
+    import paddle_tpu.onnx as onnx
+    paddle.seed(0)
+    model = LeNetish()
+    model.eval()
+    out = onnx.export(model, str(tmp_path / "artifact"),
+                      input_spec=[InputSpec([2, 1, 14, 14], "float32")])
+    assert out is not None
