@@ -16,18 +16,21 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1):
+                 base_width=64, dilation=1, data_format="NCHW"):
         super().__init__()
+        df = data_format
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=df)
+        self.bn1 = nn.BatchNorm2D(width, data_format=df)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups,
-                               dilation=dilation, bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(width)
+                               dilation=dilation, bias_attr=False,
+                               data_format=df)
+        self.bn2 = nn.BatchNorm2D(width, data_format=df)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = nn.BatchNorm2D(planes * self.expansion)
+                               bias_attr=False, data_format=df)
+        self.bn3 = nn.BatchNorm2D(planes * self.expansion, data_format=df)
         self.relu = nn.ReLU()
         self.downsample = downsample
         self.stride = stride
@@ -46,13 +49,15 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1):
+                 base_width=64, dilation=1, data_format="NCHW"):
         super().__init__()
+        df = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
+                               bias_attr=False, data_format=df)
+        self.bn1 = nn.BatchNorm2D(planes, data_format=df)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=df)
+        self.bn2 = nn.BatchNorm2D(planes, data_format=df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -66,36 +71,46 @@ class BasicBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True):
+    """data_format="NHWC" runs the whole network channels-last — on TPU the
+    MXU-native conv layout (lane dim = channels), saving the relayout
+    transposes XLA inserts around NCHW convs (BASELINE config 1 MFU work)."""
+
+    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
+        df = self.data_format = data_format
         self.inplanes = 64
-        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(64)
+        self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
+                               data_format=df)
+        self.bn1 = nn.BatchNorm2D(64, data_format=df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1,
+                                    data_format=df)
         self.layer1 = self._make_layer(block, 64, depth_cfg[0])
         self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
         self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
         self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
         self.with_pool = with_pool
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=df)
         self.num_classes = num_classes
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                          stride=stride, bias_attr=False, data_format=df),
+                nn.BatchNorm2D(planes * block.expansion, data_format=df),
             )
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, data_format=df))
         return nn.Sequential(*layers)
 
     def forward(self, x):
