@@ -18,6 +18,9 @@ jax.device_put with host memory kinds when needed.
 """
 from __future__ import annotations
 
+import collections
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -64,6 +67,76 @@ def _lookup_impl(table, ids):
     return jnp.take(table, ids, axis=0)
 
 
+class AsyncPushCommunicator:
+    """Background sparse-push worker with bounded staleness (reference:
+    fluid/distributed/ps/service/communicator/communicator.h AsyncCommunicator
+    — trainer threads enqueue gradient segments, send threads merge and push,
+    `max_merge_var_num`/queue size bound the staleness window).
+
+    TPU-native shape: the dense step (compiled, on-chip) never waits for the
+    host-table scatter; pushes ride a queue drained by one worker thread.
+    The staleness bound is `max_pending` outstanding pushes — when the queue
+    is full the trainer blocks, so a row can be at most `max_pending` pushes
+    stale when read. flush() is the barrier (checkpointing, eval)."""
+
+    def __init__(self, apply_fn, max_pending=8):
+        self._apply = apply_fn
+        self.max_pending = int(max_pending)
+        self._q = collections.deque()
+        self._cv = threading.Condition()
+        self._busy = False
+        self._stop = False
+        self.pushed = 0          # applied by the worker
+        self.enqueued = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def put(self, uids, row_ct):
+        with self._cv:
+            while len(self._q) >= self.max_pending:   # staleness bound
+                self._cv.wait()
+            self._q.append((uids, row_ct))
+            self.enqueued += 1
+            self._cv.notify_all()
+
+    def _loop(self):
+        from .. import profiler as _prof
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._q:
+                    return
+                uids, row_ct = self._q.popleft()
+                self._busy = True
+                self._cv.notify_all()
+            try:
+                with _prof.RecordEvent("ps_async_push"):
+                    self._apply(uids, row_ct)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self.pushed += 1
+                    self._cv.notify_all()
+
+    def flush(self):
+        """Barrier: wait until every enqueued push has been applied."""
+        with self._cv:
+            while self._q or self._busy:
+                self._cv.wait()
+
+    @property
+    def pending(self):
+        with self._cv:
+            return len(self._q) + (1 if self._busy else 0)
+
+    def shutdown(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5)
+
+
 class HostOffloadedEmbedding(Layer):
     """Embedding table resident in HOST memory with sparse on-table updates
     and an optional HBM hot-row cache.
@@ -94,7 +167,8 @@ class HostOffloadedEmbedding(Layer):
 
     def __init__(self, num_embeddings, embedding_dim, optimizer="adagrad",
                  learning_rate=0.05, initializer_range=None, axes=None,
-                 cache_size=0, dtype=jnp.float32):
+                 cache_size=0, dtype=jnp.float32, async_push=False,
+                 max_pending=8):
         super().__init__()
         self.num_embeddings = int(num_embeddings)
         self.embedding_dim = int(embedding_dim)
@@ -123,6 +197,14 @@ class HostOffloadedEmbedding(Layer):
         self._cache_map = {}
         self._cache_clock = []
         self._push_probe = None
+        # async communicator (reference communicator.h semantics)
+        self._lock = threading.Lock()
+        self._comm = AsyncPushCommunicator(
+            self._apply_push_sync, max_pending) if async_push else None
+        # per-row liveness for the eviction/TTL story (reference
+        # memory_sparse_table shrink): step counter + last-touched step
+        self._step = 0
+        self._last_seen = np.zeros((self.num_embeddings,), np.int64)
 
     def _shardings(self, axes):
         from . import topology as topo_mod
@@ -244,7 +326,9 @@ class HostOffloadedEmbedding(Layer):
         if not hasattr(self, "_pull"):
             self._pull = self._pull_fn()
             self._push = self._push_fn()
-        rows_u = self._pull(self.weight._value, uids)
+        with self._lock:
+            table_ref = self.weight._value   # consistent snapshot vs worker
+        rows_u = self._pull(table_ref, uids)
         rows = rows_u[inv].reshape(orig_shape + (self.embedding_dim,))
         out = Tensor(rows, stop_gradient=not self.training)
         if self.training:
@@ -253,7 +337,51 @@ class HostOffloadedEmbedding(Layer):
         return out
 
     def _apply_push(self, uids, row_ct):
+        """Sparse push entry. Sync mode applies inline; async mode enqueues
+        and returns — the dense step proceeds while the worker thread
+        scatters into the host table (bounded staleness)."""
+        self._step += 1
+        self._last_seen[np.asarray(uids)] = self._step
+        if self._comm is not None:
+            self._comm.put(uids, row_ct)
+            return
+        self._apply_push_sync(uids, row_ct)
+
+    def flush(self):
+        """Drain pending async pushes (call before eval/checkpoint)."""
+        if self._comm is not None:
+            self._comm.flush()
+
+    def evict_stale(self, max_age):
+        """TTL eviction (reference: memory_sparse_table.cc shrink / SSD
+        tier demotion): rows untouched for `max_age` pushes are reset to
+        fresh init values and their optimizer state cleared — bounding the
+        effective hot set the way the reference bounds table growth."""
+        self.flush()
+        with self._lock:
+            stale = np.nonzero((self._step - self._last_seen)
+                               > int(max_age))[0]
+            if len(stale) == 0:
+                return 0
+            tab = np.array(self.weight._value)
+            std = 1.0 / max(1.0, np.sqrt(self.embedding_dim))
+            tab[stale] = np.random.normal(
+                0.0, std, (len(stale), self.embedding_dim)).astype(tab.dtype)
+            self.weight._value = jax.device_put(tab, self._host_sharding)
+            if self._accum is not None:
+                acc = np.array(self._accum)
+                acc[stale] = 0.0
+                self._accum = jax.device_put(acc, self._acc_host_sharding)
+            self._cache_map.clear()
+            self._cache_clock.clear()
+            return int(len(stale))
+
+    def _apply_push_sync(self, uids, row_ct):
         """Sparse push: table's own optimizer updates touched rows."""
+        with self._lock:
+            self._apply_push_locked(uids, row_ct)
+
+    def _apply_push_locked(self, uids, row_ct):
         if self._host_push_works():
             acc = self._accum if self._accum is not None else \
                 jax.device_put(np.zeros((1,), np.float32),
