@@ -10,6 +10,9 @@ from .ops.linalg import (  # noqa: F401
 inv = inverse  # reference alias
 
 from .ops.extra import lu_unpack, pca_lowrank  # noqa: E402,F401
+from .ops.extra import (  # noqa: E402,F401
+    svdvals, svd_lowrank, lu_solve, cholesky_inverse,
+)
 from .ops.extra import cdist  # noqa: E402,F401
 from .ops.reduction import histogram  # noqa: E402,F401
 from .ops.extra import histogramdd  # noqa: E402,F401
