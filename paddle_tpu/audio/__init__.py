@@ -1,4 +1,7 @@
 """paddle_tpu.audio (reference: python/paddle/audio/ — functional mel/
-spectrogram features + feature layers)."""
+spectrogram features, feature layers, wave IO backend, datasets)."""
 from . import functional  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC  # noqa: F401
+from .backends import load, save, info  # noqa: F401

@@ -1,0 +1,110 @@
+"""Audio IO backend (reference: python/paddle/audio/backends/
+wave_backend.py — the stdlib-`wave` backend paddle ships when paddleaudio
+is absent; same load/save/info surface).
+
+Zero-egress TPU build: PCM WAV via the stdlib, float32 normalization
+matching the reference (int PCM scaled to [-1, 1])."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    """Signal metadata (reference: backends/backend.py AudioInfo)."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def info(filepath):
+    """Reference: wave_backend.info."""
+    with wave.open(str(filepath), "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+_PCM_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Read a PCM WAV file -> (Tensor [C, T] float32 in [-1, 1], sr)
+    (reference: wave_backend.load)."""
+    with wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(int(frame_offset))
+        n = f.getnframes() - int(frame_offset) if num_frames < 0 \
+            else int(num_frames)
+        raw = f.readframes(n)
+    dt = _PCM_DTYPE.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported PCM sample width {width}")
+    data = np.frombuffer(raw, dt).reshape(-1, nch)
+    if width == 1:                       # unsigned 8-bit
+        x = (data.astype(np.float32) - 128.0) / 128.0
+    else:
+        x = data.astype(np.float32) / float(1 << (8 * width - 1))
+    if not normalize:
+        x = data.astype(np.float32)
+    wavef = x.T if channels_first else x
+    return Tensor(wavef), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Write float32 [-1, 1] samples as PCM WAV (reference:
+    wave_backend.save)."""
+    x = np.asarray(src.numpy() if isinstance(src, Tensor) else src,
+                   np.float32)
+    if channels_first:
+        x = x.T                            # -> [T, C]
+    if x.ndim == 1:
+        x = x[:, None]
+    if bits_per_sample != 16:
+        raise ValueError("wave backend writes PCM_16 only "
+                         "(reference wave_backend.save:203 same limit)")
+    pcm = np.clip(x, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with wave.open(str(filepath), "wb") as f:
+        f.setnchannels(x.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r}: only the stdlib wave backend ships "
+            "in this zero-egress build (the reference falls back to the "
+            "same backend without paddleaudio)")

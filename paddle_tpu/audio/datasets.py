@@ -1,0 +1,194 @@
+"""Audio classification datasets (reference: python/paddle/audio/datasets/
+— AudioClassificationDataset base, TESS, ESC50).
+
+Zero-egress contract (same as text/vision datasets): pass the local archive
+the reference would have downloaded, or synthetic=N for a schema-compatible
+random dataset; download=True raises with instructions.
+"""
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+def _no_download(name):
+    raise NotImplementedError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(zero egress). Pass archive_path= pointing at the reference's "
+        f"cached archive, or synthetic=N for a schema-compatible random "
+        f"dataset.")
+
+
+class AudioClassificationDataset(Dataset):
+    """Base: (waveform-or-feature, label) records (reference:
+    audio/datasets/dataset.py:29). feat_type 'raw' returns the waveform;
+    'mfcc'/'melspectrogram'/'logmelspectrogram'/'spectrogram' run the
+    corresponding feature layer from paddle_tpu.audio.features."""
+
+    def __init__(self, files=None, labels=None, waveforms=None,
+                 feat_type="raw", sample_rate=16000, **feat_config):
+        super().__init__()
+        known = ("raw", "mfcc", "melspectrogram", "logmelspectrogram",
+                 "spectrogram")
+        if feat_type not in known:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(known)}")
+        self.files = files or []
+        self.labels = labels or []
+        self.waveforms = waveforms          # optional in-memory samples
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = feat_config
+        self._feat_layer = None
+
+    def _waveform(self, idx):
+        if self.waveforms is not None:
+            return self.waveforms[idx]
+        from .backends import load
+        wav, sr = load(self.files[idx])
+        w = wav.numpy()
+        return w[0] if w.ndim == 2 else w
+
+    def _features(self, wave_np):
+        if self.feat_type == "raw":
+            return wave_np.astype("float32")
+        if self._feat_layer is None:
+            from . import features as feat_mod
+            cls = {"mfcc": feat_mod.MFCC,
+                   "melspectrogram": feat_mod.MelSpectrogram,
+                   "logmelspectrogram": feat_mod.LogMelSpectrogram,
+                   "spectrogram": feat_mod.Spectrogram}[self.feat_type]
+            self._feat_layer = cls(sr=self.sample_rate, **self.feat_config) \
+                if self.feat_type != "spectrogram" \
+                else cls(**self.feat_config)
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        out = self._feat_layer(Tensor(jnp.asarray(wave_np[None])))
+        return np.asarray(out._value)[0]
+
+    def __getitem__(self, idx):
+        feat = self._features(self._waveform(idx))
+        return feat, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.waveforms if self.waveforms is not None
+                   else self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set (reference: audio/datasets/tess.py) —
+    7 emotion classes, n-fold split by speaker/word hash."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive_path=None, download=False, synthetic=0, seed=0,
+                 sample_rate=16000, **kw):
+        assert mode in ("train", "dev")
+        assert 1 <= split <= n_folds
+        if synthetic:
+            waves, labels = _synth_audio(int(synthetic), len(
+                self.label_list), seed, sample_rate)
+            super().__init__(waveforms=waves, labels=labels,
+                             feat_type=feat_type, sample_rate=sample_rate,
+                             **kw)
+            return
+        if archive_path:
+            files, labels = self._load_archive(archive_path, mode, n_folds,
+                                               split)
+            super().__init__(files=files, labels=labels,
+                             feat_type=feat_type, sample_rate=sample_rate,
+                             **kw)
+            return
+        if download:
+            _no_download("TESS")
+        raise ValueError("pass archive_path=, or synthetic=N")
+
+    def _load_archive(self, archive_path, mode, n_folds, split):
+        root = os.path.dirname(os.path.abspath(archive_path))
+        with zipfile.ZipFile(archive_path) as zf:
+            names = [n for n in zf.namelist() if n.endswith(".wav")]
+            zf.extractall(root)
+        files, labels = [], []
+        for i, n in enumerate(sorted(names)):
+            emotion = os.path.basename(n).split("_")[-1][:-4].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(os.path.join(root, n))
+                labels.append(self.label_list.index(emotion))
+        return files, labels
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference: audio/datasets/esc50.py) —
+    50 classes, 5 predefined folds from the meta csv."""
+
+    n_class = 50
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive_path=None, download=False, synthetic=0, seed=0,
+                 sample_rate=44100, **kw):
+        assert mode in ("train", "dev")
+        if synthetic:
+            waves, labels = _synth_audio(int(synthetic), self.n_class,
+                                         seed, sample_rate)
+            super().__init__(waveforms=waves, labels=labels,
+                             feat_type=feat_type, sample_rate=sample_rate,
+                             **kw)
+            return
+        if archive_path:
+            files, labels = self._load_archive(archive_path, mode, split)
+            super().__init__(files=files, labels=labels,
+                             feat_type=feat_type, sample_rate=sample_rate,
+                             **kw)
+            return
+        if download:
+            _no_download("ESC50")
+        raise ValueError("pass archive_path=, or synthetic=N")
+
+    def _load_archive(self, archive_path, mode, split):
+        root = os.path.dirname(os.path.abspath(archive_path))
+        with zipfile.ZipFile(archive_path) as zf:
+            zf.extractall(root)
+            meta = [n for n in zf.namelist() if n.endswith("esc50.csv")]
+            audio_names = {os.path.basename(n): n for n in zf.namelist()
+                           if n.endswith(".wav")}
+        files, labels = [], []
+        with open(os.path.join(root, meta[0])) as f:
+            header = f.readline().strip().split(",")
+            fi = {k: i for i, k in enumerate(header)}
+            for line in f:
+                row = line.strip().split(",")
+                fold = int(row[fi["fold"]])
+                keep = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if keep and row[fi["filename"]] in audio_names:
+                    files.append(os.path.join(
+                        root, audio_names[row[fi["filename"]]]))
+                    labels.append(int(row[fi["target"]]))
+        return files, labels
+
+
+def _synth_audio(n, n_class, seed, sample_rate):
+    rng = np.random.RandomState(seed)
+    waves, labels = [], []
+    for _ in range(n):
+        dur = sample_rate // 10            # 100 ms clips
+        t = np.arange(dur) / sample_rate
+        f0 = rng.uniform(100, 2000)
+        w = (0.3 * np.sin(2 * np.pi * f0 * t)
+             + 0.05 * rng.standard_normal(dur)).astype("float32")
+        waves.append(w)
+        labels.append(int(rng.randint(0, n_class)))
+    return waves, labels

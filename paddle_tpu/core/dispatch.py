@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import monitor
+
 __all__ = [
     "apply",
     "no_grad",
@@ -85,6 +87,7 @@ def _get_fwd(impl, statics_key, statics):
     if fn is None:
         fn = jax.jit(partial(impl, **statics))
         _jit_cache[key] = fn
+        monitor.increment("op_jit_program_total")
     return fn
 
 
@@ -235,6 +238,7 @@ def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
     """
     from .tensor import Tensor  # circular-safe
 
+    monitor.increment("op_dispatch_total")
     statics = statics or {}
     statics_key = _hashable(statics)
 

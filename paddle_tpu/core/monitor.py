@@ -1,0 +1,50 @@
+"""Runtime monitor counters (reference: paddle/fluid/platform/monitor.h —
+the `Monitor` singleton of named int64 stats, `STAT_INT` registration and
+python `get_int_stats`-style readout used for fleet/PS observability).
+
+TPU-native shape: a process-local thread-safe registry of named integer
+counters; framework subsystems increment a handful of built-ins (op
+dispatches, jit compiles, dataloader batches, async PS pushes) and user
+code can register its own. Cheap by construction — one dict add under the
+GIL per event."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["increment", "get", "get_all", "reset", "counter_names"]
+
+_lock = threading.Lock()
+_counters: dict = {}
+
+
+def increment(name, delta=1):
+    """Add `delta` to counter `name` (auto-registers on first use,
+    like STAT_INT's lazy registry)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + int(delta)
+
+
+def get(name):
+    """Current value (0 for never-incremented counters, matching the
+    reference's default-constructed stats)."""
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def get_all():
+    """Snapshot of every counter (reference: monitor's stat map dump)."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset(name=None):
+    with _lock:
+        if name is None:
+            _counters.clear()
+        else:
+            _counters.pop(name, None)
+
+
+def counter_names():
+    with _lock:
+        return sorted(_counters)

@@ -118,6 +118,8 @@ class AsyncPushCommunicator:
                     self._busy = False
                     self.pushed += 1
                     self._cv.notify_all()
+                from ..core import monitor
+                monitor.increment("ps_async_push_total")
 
     def flush(self):
         """Barrier: wait until every enqueued push has been applied."""

@@ -285,6 +285,8 @@ class _PrefetchIter:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        from ..core import monitor
+        monitor.increment("dataloader_batches_total")
         return item
 
 

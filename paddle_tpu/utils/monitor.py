@@ -1,0 +1,6 @@
+"""Re-export of the runtime monitor counters (implementation lives in
+core/monitor.py so the dispatch hot path can import it without touching
+the heavier utils package)."""
+from ..core.monitor import (  # noqa: F401
+    increment, get, get_all, reset, counter_names,
+)

@@ -227,3 +227,22 @@ def test_text_datasets_synthetic_schema():
     import pytest
     with pytest.raises(NotImplementedError, match="zero egress"):
         Imdb(download=True)
+
+
+def test_monitor_counters():
+    """Runtime monitor counters (reference: platform/monitor.h STAT_INT
+    registry — named int64 stats with lazy registration)."""
+    from paddle_tpu.utils import monitor
+    monitor.reset()
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    ((x * 2.0) + 1.0).sum()
+    assert monitor.get("op_dispatch_total") >= 3
+    assert monitor.get("op_jit_program_total") >= 1
+    # user counters auto-register, get_all snapshots, reset clears
+    monitor.increment("my_counter", 5)
+    assert monitor.get("my_counter") == 5
+    assert "my_counter" in monitor.counter_names()
+    snap = monitor.get_all()
+    assert snap["my_counter"] == 5
+    monitor.reset("my_counter")
+    assert monitor.get("my_counter") == 0
