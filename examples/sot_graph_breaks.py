@@ -1,0 +1,74 @@
+"""to_static with graph breaks (reference journey: @to_static just works —
+the SOT fallback runs unsupported constructs eagerly instead of erroring,
+jit/sot/translate.py contract).
+
+Shows: a convertible branch compiling to lax.cond, a generator-driven loop
+breaking the graph (warn once, run eagerly, still train), and
+full_graph=True turning the same break into a loud error.
+"""
+import os
+import warnings
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+SMOKE = os.environ.get("EXAMPLES_SMOKE") == "1"
+
+
+def main():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    def chunks(x):          # generator with a data-dependent stop:
+        i = 0               # unconvertible -> graph break -> eager
+        while float((x[i:] ** 2).sum()) > 1e-6 and i < 4:
+            yield x[i:i + 2]
+            i += 2
+
+    @paddle.jit.to_static
+    def step(x, y):
+        acc = paddle.zeros([1])
+        for c in chunks(x.reshape([-1])):
+            acc = acc + c.sum()
+        pred = lin(x)
+        return ((pred - y) ** 2).mean() + 0.0 * acc
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype("float32")
+    Y = (X @ np.array([[1.0], [2.0], [-1.0], [0.5]], "float32"))
+    steps = 10 if SMOKE else 40
+    losses = []
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for _ in range(steps):
+            loss = step(paddle.to_tensor(X), paddle.to_tensor(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+    breaks = [w for w in rec if "graph break" in str(w.message)]
+    print(f"graph break warned once: {len(breaks) == 1}; "
+          f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    assert losses[-1] < losses[0]
+
+    # the same construct under full_graph=True is a loud error
+    @paddle.jit.to_static(full_graph=True)
+    def strict(x):
+        if float(x.sum()) > 0:
+            return x + 1.0
+        return x
+
+    try:
+        strict(paddle.to_tensor(np.float32([1.0])))
+        raise SystemExit("expected full_graph=True to raise")
+    except Exception as e:
+        print("full_graph=True raises:", type(e).__name__)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,8 @@ _EXAMPLES = [
     "recsys_host_embedding.py",
     "quantization_deploy.py",
     "distributed_data_parallel.py",
+    "onnx_export_deploy.py",
+    "sot_graph_breaks.py",
 ]
 
 
