@@ -1,10 +1,14 @@
 """paddle_tpu.nn — mirrors python/paddle/nn/__init__.py surface."""
 from .layer.layers import Layer, Parameter
+from .decode import (  # noqa: F401
+    Decoder, BeamSearchDecoder, dynamic_decode, RNNCellBase,
+)
 from .layer.common import (
     ParamAttr, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
     Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     Pad1D, Pad2D, Pad3D, ZeroPad2D, CosineSimilarity, Bilinear, PixelShuffle,
-    PixelUnshuffle, Unfold, Fold,
+    PixelUnshuffle, Unfold, Fold, ChannelShuffle, Unflatten,
+    PairwiseDistance, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.conv import (
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -18,7 +22,7 @@ from .layer.activation import (
     ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, Softsign, Tanhshrink,
     LogSigmoid, Hardswish, Hardsigmoid, GELU, LeakyReLU, ELU, CELU, SELU,
     PReLU, RReLU, Hardtanh, Hardshrink, Softshrink, Softplus, ThresholdedReLU,
-    Softmax, LogSoftmax, Maxout, GLU,
+    Softmax, LogSoftmax, Maxout, GLU, Softmax2D,
 )
 from .layer.container import Sequential, LayerList, ParameterList, LayerDict
 from .layer.pooling import (
@@ -30,6 +34,8 @@ from .layer.loss import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, HuberLoss, MarginRankingLoss, CTCLoss,
     CosineEmbeddingLoss, TripletMarginLoss, SoftMarginLoss, PoissonNLLLoss,
+    GaussianNLLLoss, MultiMarginLoss, TripletMarginWithDistanceLoss,
+    HSigmoidLoss, RNNTLoss,
     MultiLabelSoftMarginLoss, HingeEmbeddingLoss,
 )
 from .layer.transformer import (

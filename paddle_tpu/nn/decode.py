@@ -1,0 +1,150 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode (reference:
+python/paddle/nn/decode.py:153 BeamSearchDecoder, dynamic_decode).
+
+TPU-native shape: the beam bookkeeping is pure jnp over a fused
+[batch*beam] axis (one cell call per step for ALL beams — the MXU sees one
+batched matmul); the step loop runs eagerly (generation is a host loop in
+the reference too) and every per-step op is the usual cached-jit dispatch.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .layer.layers import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (reference: nn/layer/rnn.py
+    RNNCellBase) — provides zero initial states from a batch reference."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        hidden = shape if shape is not None else [self.hidden_size]
+        v = jnp.full((b, *hidden), float(init_value))
+        return Tensor(v)
+
+
+class Decoder:
+    """Abstract decoder interface (reference: nn/decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _tile_beam(x, beam_size):
+    v = _v(x)
+    v = jnp.repeat(v, beam_size, axis=0)     # [B, ...] -> [B*K, ...]
+    return v
+
+
+def _gather_beams(v, parent, batch, beam):
+    # v: [B*K, ...]; parent: [B, K] indices into the old beam axis
+    v = v.reshape((batch, beam) + v.shape[1:])
+    out = jnp.take_along_axis(
+        v, parent.reshape((batch, beam) + (1,) * (v.ndim - 2)), axis=1)
+    return out.reshape((batch * beam,) + v.shape[2:])
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search wrapper over a cell (reference: nn/decode.py:153).
+
+    cell(inputs, states) -> (output, new_states); `output_fn` maps the
+    cell output to vocab logits; `embedding_fn` maps token ids to the next
+    step's inputs."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*K, ...] for side inputs (encoder outputs etc.)."""
+        return Tensor(_tile_beam(x, beam_size))
+
+    def initialize(self, inits):
+        states = jax.tree_util.tree_map(
+            lambda s: _tile_beam(s, self.beam_size), inits,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] \
+            // self.beam_size
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int32)
+        # only beam 0 is live at t=0 (all beams are identical copies)
+        log_probs = jnp.where(
+            jnp.arange(self.beam_size)[None, :] == 0, 0.0, -1e9)
+        log_probs = jnp.broadcast_to(log_probs, (batch, self.beam_size))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, time, ids, states, log_probs, finished):
+        batch, beam = ids.shape
+        flat_ids = Tensor(ids.reshape(-1))
+        inputs = (self.embedding_fn(flat_ids) if self.embedding_fn
+                  else flat_ids)
+        out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        step_lp = jax.nn.log_softmax(_v(logits), axis=-1)   # [B*K, V]
+        vocab = step_lp.shape[-1]
+        step_lp = step_lp.reshape(batch, beam, vocab)
+        # finished beams emit only end_token at probability 1
+        keep = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], keep[None, None, :],
+                            step_lp)
+        scores = log_probs[..., None] + step_lp                # [B, K, V]
+        flat = scores.reshape(batch, beam * vocab)
+        top_scores, top_idx = jax.lax.top_k(flat, beam)
+        parent = top_idx // vocab                              # [B, K]
+        token = (top_idx % vocab).astype(jnp.int32)
+        new_states = jax.tree_util.tree_map(
+            lambda s: _gather_beams(_v(s), parent, batch, beam), new_states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        new_states = jax.tree_util.tree_map(
+            lambda s: Tensor(s) if not isinstance(s, Tensor) else s,
+            new_states)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) \
+            | (token == self.end_token)
+        return token, new_states, top_scores, new_finished, parent
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run the decoder until every beam finishes or max_step_num
+    (reference: nn/decode.py dynamic_decode). Returns (ids [B, T, K],
+    scores [B, K])."""
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    batch, beam = ids.shape
+    step_tokens = []
+    parents = []
+    for t in range(int(max_step_num)):
+        ids, states, log_probs, finished, parent = decoder.step(
+            t, ids, states, log_probs, finished)
+        step_tokens.append(ids)
+        parents.append(parent)
+        if bool(np.asarray(finished.all())):
+            break
+    # backtrace beams (gather_tree): follow parents from the last step
+    T = len(step_tokens)
+    out = np.zeros((batch, T, beam), np.int64)
+    cur = np.tile(np.arange(beam), (batch, 1))
+    for t in range(T - 1, -1, -1):
+        tok = np.asarray(step_tokens[t])
+        par = np.asarray(parents[t])
+        out[:, t, :] = np.take_along_axis(tok, cur, axis=1)
+        cur = np.take_along_axis(par, cur, axis=1)
+    return Tensor(jnp.asarray(out)), Tensor(log_probs)

@@ -261,3 +261,92 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class ChannelShuffle(Layer):
+    """Reference: nn/layer/vision.py ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ..functional import channel_shuffle
+        return channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """Reference: nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ...ops.extra import unflatten
+        return unflatten(x, axis=self.axis, shape=tuple(self.shape))
+
+
+class PairwiseDistance(Layer):
+    """Reference: nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ..functional import pairwise_distance
+        return pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class MaxUnPool1D(Layer):
+    """Reference: nn/layer/pooling.py MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        from ..functional import max_unpool1d
+        return max_unpool1d(x, indices, self.kernel_size, self.stride,
+                            self.padding, self.data_format,
+                            self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    """Reference: nn/layer/pooling.py MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        from ..functional import max_unpool2d
+        return max_unpool2d(x, indices, self.kernel_size, self.stride,
+                            self.padding, self.data_format,
+                            self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    """Reference: nn/layer/pooling.py MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        from ..functional import max_unpool3d
+        return max_unpool3d(x, indices, self.kernel_size, self.stride,
+                            self.padding, self.data_format,
+                            self.output_size)

@@ -1,0 +1,133 @@
+"""nn class-surface completeness + BeamSearchDecoder/dynamic_decode
+(reference: python/paddle/nn/__init__.py __all__; nn/decode.py:153).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_reference_nn_class_surface_complete():
+    import os
+    path = "/root/reference/python/paddle/nn/__init__.py"
+    if not os.path.exists(path):
+        pytest.skip("reference tree not present")
+    src = open(path, errors="replace").read()
+    ref = set(re.findall(r"^\s+'([A-Z][A-Za-z0-9]*)',", src, re.M))
+    missing = sorted(n for n in ref if not hasattr(nn, n))
+    assert not missing, f"nn classes missing: {missing}"
+
+
+def test_new_layer_wrappers_run():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 6, 4, 4).astype("float32"))
+    assert nn.ChannelShuffle(2)(x).shape == [1, 6, 4, 4]
+    assert nn.Softmax2D()(x).shape == [1, 6, 4, 4]
+    np.testing.assert_allclose(
+        np.asarray(nn.Softmax2D()(x)._value).sum(1), 1.0, rtol=1e-5)
+    assert nn.Unflatten(1, [2, 3])(x).shape == [1, 2, 3, 4, 4]
+    a = paddle.to_tensor(np.random.randn(3, 5).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(3, 5).astype("float32"))
+    assert nn.PairwiseDistance()(a, b).shape == [3]
+    pooled, idx = paddle.nn.functional.max_pool2d(
+        x, 2, return_mask=True)
+    unpooled = nn.MaxUnPool2D(2)(pooled, idx)
+    assert unpooled.shape == [1, 6, 4, 4]
+    lab = paddle.to_tensor(np.array([1], "int64"))
+    logits = paddle.to_tensor(np.random.randn(1, 4).astype("float32"))
+    assert np.isfinite(float(nn.MultiMarginLoss()(logits, lab)))
+
+
+def _make_lm_cell(vocab, hidden, seed=0):
+    """Tiny deterministic LM: GRUCell + embedding + output projection."""
+    paddle.seed(seed)
+    cell = nn.GRUCell(hidden, hidden)
+    emb = nn.Embedding(vocab, hidden)
+    proj = nn.Linear(hidden, vocab)
+    return cell, emb, proj
+
+
+def test_beam_search_beam1_matches_greedy():
+    vocab, hidden, batch = 12, 8, 2
+    cell, emb, proj = _make_lm_cell(vocab, hidden)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=vocab - 1,
+                               beam_size=1, embedding_fn=emb,
+                               output_fn=proj)
+    h0 = paddle.to_tensor(np.random.RandomState(1)
+                          .randn(batch, hidden).astype("float32"))
+    ids, scores = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+    assert ids.shape[0] == batch and ids.shape[2] == 1
+
+    # greedy rollout with the same cell must produce the same tokens
+    state = h0
+    tok = paddle.to_tensor(np.zeros((batch,), "int32"))
+    greedy = []
+    for _ in range(ids.shape[1]):
+        out, state = cell(emb(tok), state)
+        logits = proj(out)
+        tok = paddle.to_tensor(np.argmax(logits.numpy(), -1).astype("int32"))
+        greedy.append(tok.numpy())
+    got = ids.numpy()[:, :, 0]
+    want = np.array(greedy).T
+    for b in range(batch):
+        # after the first end_token the decoder pads with end_token while
+        # the naive greedy rollout keeps sampling — compare the real prefix
+        seq = got[b]
+        end_pos = np.nonzero(seq == vocab - 1)[0]
+        upto = (end_pos[0] + 1) if len(end_pos) else len(seq)
+        np.testing.assert_array_equal(seq[:upto], want[b][:upto])
+
+
+def test_beam_search_wider_beam_scores_no_worse():
+    vocab, hidden, batch = 16, 8, 3
+    cell, emb, proj = _make_lm_cell(vocab, hidden, seed=3)
+    h0 = paddle.to_tensor(np.random.RandomState(2)
+                          .randn(batch, hidden).astype("float32"))
+    _, s1 = nn.dynamic_decode(
+        nn.BeamSearchDecoder(cell, 0, vocab - 1, 1, emb, proj),
+        inits=h0, max_step_num=5)
+    _, s4 = nn.dynamic_decode(
+        nn.BeamSearchDecoder(cell, 0, vocab - 1, 4, emb, proj),
+        inits=h0, max_step_num=5)
+    # the best of 4 beams is at least as good as the single greedy beam
+    assert (s4.numpy()[:, 0] >= s1.numpy()[:, 0] - 1e-5).all()
+
+
+def test_beam_search_end_token_terminates():
+    vocab, hidden = 6, 4
+
+    class EndCell(nn.Layer):
+        """Always emits end_token with overwhelming probability."""
+
+        def __init__(self):
+            super().__init__()
+            self.hidden_size = hidden
+
+        def forward(self, inputs, states):
+            logits = np.full((inputs.shape[0], vocab), -10.0, "float32")
+            logits[:, vocab - 1] = 10.0
+            return paddle.to_tensor(logits), states
+
+    dec = nn.BeamSearchDecoder(EndCell(), 0, vocab - 1, 2,
+                               embedding_fn=nn.Embedding(vocab, hidden))
+    h0 = paddle.to_tensor(np.zeros((2, hidden), "float32"))
+    ids, _ = nn.dynamic_decode(dec, inits=h0, max_step_num=20)
+    # the best beam ends immediately; the runner-up beam needs one more
+    # step, so decode stops after <=2 steps (never runs to max_step_num)
+    assert ids.shape[1] <= 2
+    assert (ids.numpy()[:, :, 0] == vocab - 1).all()
+
+
+def test_rnn_cell_base_initial_states():
+    class MyCell(nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.hidden_size = 7
+
+    x = paddle.to_tensor(np.zeros((5, 3), "float32"))
+    s = MyCell().get_initial_states(x)
+    assert s.shape == [5, 7]
+    assert float(s.numpy().sum()) == 0.0
