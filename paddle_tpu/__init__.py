@@ -15,6 +15,13 @@ from .core.dtype import (
     get_default_dtype,
 )
 from .core.dispatch import no_grad, is_grad_enabled, set_grad_enabled
+from .hapi.dynamic_flops import flops  # noqa: F401
+from .nn.functional import pdist  # noqa: F401
+from .framework_misc import (  # noqa: F401
+    enable_grad, finfo, iinfo, batch, reverse, disable_signal_handler,
+    get_cuda_rng_state, set_cuda_rng_state, check_shape, LazyGuard,
+    CUDAPinnedPlace, dtype,
+)
 
 from .ops import *  # noqa: F401,F403
 from .ops import random as _random_mod

@@ -1,5 +1,7 @@
 """Autograd user API (reference: python/paddle/autograd/)."""
-from ..core.dispatch import no_grad, is_grad_enabled, set_grad_enabled
+from ..core.dispatch import (no_grad, is_grad_enabled, set_grad_enabled,
+                              saved_tensors_hooks)
+from ..incubate.autograd import hessian, jacobian
 from .backward_engine import run_backward
 from .functional import grad, backward
 from .py_layer import PyLayer, PyLayerContext

@@ -53,6 +53,22 @@ def set_grad_enabled(mode: bool):
     _state.grad_enabled = bool(mode)
 
 
+_saved_tensors_hooks: list = []
+
+
+@contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """Intercept tensors the tape saves for backward (reference:
+    paddle.autograd.saved_tensors_hooks — e.g. offload-to-host packs).
+    pack_hook(array) runs when an op records its inputs; unpack_hook runs
+    once when the node's VJP first needs them."""
+    _saved_tensors_hooks.append((pack_hook, unpack_hook))
+    try:
+        yield
+    finally:
+        _saved_tensors_hooks.pop()
+
+
 @contextlib.contextmanager
 def no_grad():
     """Context manager disabling tape recording (reference: paddle.no_grad)."""
@@ -144,6 +160,7 @@ class GradNode:
         "n_outputs",
         "out_is_seq",
         "_id",
+        "_unpack_hook",
     )
 
     _counter = [0]
@@ -154,6 +171,7 @@ class GradNode:
         self.statics = statics
         self.statics_key = statics_key
         self.input_arrays = input_arrays
+        self._unpack_hook = None
         self.input_metas = input_metas  # list of (producer GradNode|None, out_idx, leaf Tensor|None, needs_grad)
         self.n_outputs = n_outputs
         self.out_is_seq = out_is_seq
@@ -162,6 +180,10 @@ class GradNode:
 
     def run_vjp(self, cotangents):
         """cotangents: list aligned with outputs (None entries filled with zeros)."""
+        unpack = getattr(self, "_unpack_hook", None)
+        if unpack is not None and self.input_arrays is not None:
+            self.input_arrays = [unpack(a) for a in self.input_arrays]
+            self._unpack_hook = None
         if self.input_arrays is None:
             raise RuntimeError(
                 f"Trying to backward through op '{self.name}' a second time; "
@@ -293,7 +315,13 @@ def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
 
     node = None
     if any_grad:
-        node = GradNode(name, impl, statics, statics_key, arrays, metas, len(outs), out_is_seq)
+        saved = arrays
+        if _saved_tensors_hooks:
+            pack, _ = _saved_tensors_hooks[-1]
+            saved = [pack(a) for a in arrays]
+        node = GradNode(name, impl, statics, statics_key, saved, metas, len(outs), out_is_seq)
+        if _saved_tensors_hooks:
+            node._unpack_hook = _saved_tensors_hooks[-1][1]
 
     wrapped = []
     for i, o in enumerate(outs):

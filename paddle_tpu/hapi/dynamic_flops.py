@@ -1,0 +1,86 @@
+"""Model FLOPs counter (reference: python/paddle/hapi/dynamic_flops.py
+flops() — walks the layer tree with forward hooks and per-layer-type
+counting rules)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+
+__all__ = ["flops"]
+
+
+def _count_linear(layer, x, y):
+    return int(np.prod(layer.weight.shape))
+
+
+def _count_conv(layer, x, y):
+    # output elements * (kernel volume * in_channels / groups)
+    w = layer.weight
+    out_elems = int(np.prod(y.shape[1:]))
+    kernel = int(np.prod(w.shape[1:]))      # Cin/g * prod(k)
+    return out_elems * kernel
+
+
+def _count_norm(layer, x, y):
+    return 2 * int(np.prod(x.shape[1:]))
+
+
+def _count_act(layer, x, y):
+    return int(np.prod(x.shape[1:]))
+
+
+_RULES = [
+    (nn.Conv1D, _count_conv), (nn.Conv2D, _count_conv),
+    (nn.Conv3D, _count_conv), (nn.Linear, _count_linear),
+    (nn.BatchNorm1D, _count_norm), (nn.BatchNorm2D, _count_norm),
+    (nn.BatchNorm3D, _count_norm), (nn.LayerNorm, _count_norm),
+    (nn.ReLU, _count_act), (nn.GELU, _count_act), (nn.Sigmoid, _count_act),
+]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count multiply-accumulates of one forward pass (reference:
+    hapi/dynamic_flops.py flops; same per-layer rule style, batch dim of
+    input_size treated as given)."""
+    import paddle_tpu as paddle
+
+    rules = list(_RULES)
+    if custom_ops:
+        rules = [(k, v) for k, v in custom_ops.items()] + rules
+
+    totals = {}
+    hooks = []
+
+    def make_hook(name, layer, fn):
+        def hook(lyr, inputs, output):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            y = output[0] if isinstance(output, (tuple, list)) else output
+            totals[name] = totals.get(name, 0) + int(fn(lyr, x, y))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        for cls, fn in rules:
+            if type(sub) is cls or (custom_ops and type(sub) in
+                                    (custom_ops or {})):
+                hooks.append(sub.register_forward_post_hook(
+                    make_hook(name or type(sub).__name__, sub, fn)))
+                break
+
+    was_training = net.training
+    net.eval()
+    x = paddle.zeros(list(input_size))
+    try:
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(totals.values())
+    if print_detail:
+        for k, v in sorted(totals.items(), key=lambda kv: -kv[1]):
+            print(f"{k:40s} {v:>14,d}")
+        print(f"{'TOTAL (MACs)':40s} {total:>14,d}")
+    return total

@@ -150,6 +150,22 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (reference:
+    io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+        order = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, dtype=np.float64)

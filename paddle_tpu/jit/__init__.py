@@ -1,3 +1,4 @@
 """paddle_tpu.jit (reference: python/paddle/jit/)."""
-from .api import to_static, not_to_static, ignore_module, StaticFunction
+from .api import (to_static, not_to_static, ignore_module, StaticFunction,
+                  enable_to_static, set_code_level, set_verbosity)
 from .save_load import save, load, TranslatedLayer

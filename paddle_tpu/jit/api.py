@@ -206,6 +206,8 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled[0]:
+            return self._source_function(*args, **kwargs)
         holders = self._holders()
         arg_tensors = _tensor_leaves((args, kwargs), [])
         training = bool(getattr(self._layer, "training", False))
@@ -442,3 +444,27 @@ def not_to_static(fn):
 
 def ignore_module(modules):
     pass
+
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag=True):
+    """Global to_static switch (reference: jit.enable_to_static) — when
+    off, StaticFunction calls run the original eager function."""
+    _to_static_enabled[0] = bool(flag)
+
+
+_SOT_LOG_LEVEL = [0]
+
+
+def set_code_level(level=100, also_to_stderr=False):
+    """Reference: jit.set_code_level — controls SOT generated-code logging.
+    Converted sources are already placed in linecache; level>0 also prints
+    them when a function converts."""
+    _SOT_LOG_LEVEL[0] = int(level)
+
+
+def set_verbosity(level=0, also_to_stderr=False):
+    """Reference: jit.set_verbosity (dy2static translator logs)."""
+    _SOT_LOG_LEVEL[0] = int(level)

@@ -11,7 +11,21 @@ import abc
 
 import numpy as np
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: metric/metrics.py accuracy)."""
+    import numpy as _np
+    from ..core.tensor import Tensor
+    import jax.numpy as _jnp
+    logits = _np.asarray(input._value if isinstance(input, Tensor)
+                         else input)
+    lab = _np.asarray(label._value if isinstance(label, Tensor)
+                      else label).reshape(-1)
+    topk = _np.argsort(-logits, axis=-1)[:, :k]
+    hit = (topk == lab[:, None]).any(axis=1)
+    return Tensor(_jnp.asarray(_np.float32(hit.mean())))
 
 
 def _to_numpy(x):

@@ -38,6 +38,17 @@ def _root_key():
 def seed(s: int):
     """Reference: paddle.seed."""
     _gen.key = jax.random.PRNGKey(int(s))
+
+
+def get_state():
+    """Snapshot of the root PRNG key (reference: generator state get)."""
+    return _root_key()
+
+
+def set_state(key):
+    """Restore a snapshot taken by get_state."""
+    import jax.numpy as _jnp
+    _gen.key = _jnp.asarray(key)
     return _gen
 
 

@@ -1,0 +1,185 @@
+"""Round-3 namespace surface completions: every name in the reference's
+__all__ lists resolves here, and the substantive additions behave
+(append_backward/gradients, EMA, saved_tensors_hooks, finfo/iinfo,
+RNG-state round-trip, flops, metric.accuracy, SubsetRandomSampler).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+REF = "/root/reference/python/paddle"
+
+
+def _ref_all(path):
+    import os
+    p = f"{REF}/{path}"
+    if not os.path.exists(p):
+        pytest.skip("reference tree not present")
+    src = open(p, errors="replace").read()
+    return set(re.findall(r"^\s+'([A-Za-z_][A-Za-z0-9_]*)',", src, re.M))
+
+
+@pytest.mark.parametrize("path,mod", [
+    ("__init__.py", lambda: paddle),
+    ("static/__init__.py", lambda: static),
+    ("jit/__init__.py", lambda: paddle.jit),
+    ("io/__init__.py", lambda: paddle.io),
+    ("metric/__init__.py", lambda: paddle.metric),
+    ("autograd/__init__.py", lambda: paddle.autograd),
+    ("amp/__init__.py", lambda: paddle.amp),
+    ("sparse/__init__.py", lambda: paddle.sparse),
+])
+def test_namespace_surface_complete(path, mod):
+    missing = sorted(n for n in _ref_all(path) if not hasattr(mod(), n))
+    assert not missing, f"{path} missing: {missing}"
+
+
+def test_static_append_backward_gradients():
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 3], "float32")
+            w = static.create_parameter([3, 1], "float32")
+            loss = (paddle.matmul(x, w) ** 2).mean()
+            pairs = static.append_backward(loss)
+        exe = static.Executor()
+        feed_x = np.random.RandomState(0).randn(4, 3).astype("float32")
+        out = exe.run(prog, feed={"x": feed_x},
+                      fetch_list=[loss, pairs[0][1]])
+    finally:
+        paddle.disable_static()
+    wv = np.asarray(pairs[0][0]._value)
+    ref_g = 2.0 / 4.0 * feed_x.T @ (feed_x @ wv)
+    np.testing.assert_allclose(out[1], ref_g, atol=1e-5)
+
+
+def test_static_gradients_wrt_feed_var():
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [3], "float32")
+            loss = (x ** 2).sum()
+            (gx,) = static.gradients(loss, x)
+        exe = static.Executor()
+        feed_x = np.array([1.0, -2.0, 3.0], "float32")
+        out = exe.run(prog, feed={"x": feed_x}, fetch_list=[gx])
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(out[0], 2 * feed_x, atol=1e-6)
+
+
+def test_exponential_moving_average():
+    p = paddle.to_tensor(np.ones(2, "float32"))
+    p.stop_gradient = False
+    ema = static.ExponentialMovingAverage(decay=0.5)
+    ema.update([p])
+    p._value = p._value * 3.0
+    ema.update([p])
+    orig = p.numpy().copy()
+    with ema.apply():
+        applied = p.numpy().copy()
+    np.testing.assert_allclose(p.numpy(), orig)         # restored
+    # ema = 0.5*1 + 0.5*3 = 2, bias-corrected by 1 - 0.5^2 = 0.75
+    np.testing.assert_allclose(applied, 2.0 / 0.75, rtol=1e-6)
+
+
+def test_saved_tensors_hooks_offload_roundtrip():
+    import jax.numpy as jnp
+    packed, unpacked = [], []
+
+    def pack(a):
+        packed.append(a.shape)
+        return np.asarray(a)                    # device -> host
+
+    def unpack(a):
+        unpacked.append(a.shape)
+        return jnp.asarray(a)                   # host -> device
+
+    x = paddle.to_tensor(np.arange(3.0, dtype="float32"))
+    x.stop_gradient = False
+    with paddle.autograd.saved_tensors_hooks(pack, unpack):
+        y = (x * x).sum()
+    assert packed and not unpacked              # packed at record time
+    y.backward()
+    assert unpacked                             # unpacked at backward
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.arange(3.0))
+
+
+def test_finfo_iinfo_and_rng_state():
+    assert paddle.finfo("float32").bits == 32
+    assert paddle.finfo("bfloat16").max > 1e38
+    assert paddle.iinfo("int16").max == 32767
+    st = paddle.get_cuda_rng_state()
+    a = paddle.randn([4]).numpy()
+    paddle.set_cuda_rng_state(st)
+    np.testing.assert_array_equal(paddle.randn([4]).numpy(), a)
+
+
+def test_flops_counts_linear_and_conv():
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(1, 2, 3, padding=1),
+                               paddle.nn.Flatten(),
+                               paddle.nn.Linear(2 * 4 * 4, 5))
+    total = paddle.flops(net, [1, 1, 4, 4])
+    # conv: 2*4*4 outputs * 9 kernel = 288; linear: 32*5 = 160
+    assert total == 288 + 160, total
+
+
+def test_metric_accuracy_topk():
+    logits = paddle.to_tensor(np.array(
+        [[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], "float32"))
+    label = paddle.to_tensor(np.array([1, 2], "int64"))
+    assert float(paddle.metric.accuracy(logits, label, k=1)) == 0.5
+    assert float(paddle.metric.accuracy(logits, label, k=2)) == 0.5
+    assert float(paddle.metric.accuracy(logits, label, k=3)) == 1.0
+
+
+def test_subset_random_sampler():
+    from paddle_tpu.io import SubsetRandomSampler
+    s = SubsetRandomSampler([3, 5, 7])
+    got = sorted(list(iter(s)))
+    assert got == [3, 5, 7] and len(s) == 3
+
+
+def test_enable_to_static_switch():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)
+        return x * 2.0
+
+    x = paddle.to_tensor(np.float32([1.0]))
+    f(x)
+    paddle.jit.enable_to_static(False)
+    try:
+        out = f(x)
+        np.testing.assert_allclose(out.numpy(), [2.0])
+    finally:
+        paddle.jit.enable_to_static(True)
+
+
+def test_static_save_load_roundtrip(tmp_path):
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 2], "float32")
+            w = static.create_parameter([2, 2], "float32", name="w0")
+            _ = paddle.matmul(x, w)      # registers w in the program
+        w.name = "w0"
+        w._value = w._value * 0 + 7.0
+        static.save(prog, str(tmp_path / "model"))
+        w._value = w._value * 0
+        static.load(prog, str(tmp_path / "model"))
+        np.testing.assert_allclose(np.asarray(w._value), 7.0)
+        state = static.load_program_state(str(tmp_path / "model"))
+        assert "w0" in state
+    finally:
+        paddle.disable_static()
