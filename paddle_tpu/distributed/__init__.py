@@ -66,3 +66,17 @@ from .fleet.recompute import (  # noqa: F401
 from .ps import (  # noqa: F401
     ShardedEmbedding, DistributedLookupTable, HostOffloadedEmbedding,
 )
+from .misc_api import (  # noqa: F401,E402
+    alltoall, alltoall_single, scatter_object_list, wait, get_backend,
+    is_available, destroy_process_group, gloo_init_parallel_env,
+    gloo_barrier, gloo_release, ReduceType, DistAttr, split,
+    shard_optimizer, unshard_dtensor, Strategy, DistModel, to_static,
+    InMemoryDataset, QueueDataset, CountFilterEntry, ProbabilityEntry,
+    ShowClickEntry,
+)
+from .auto_parallel.api import Placement  # noqa: F401,E402
+from .checkpoint.api import (  # noqa: F401,E402
+    save_state_dict, load_state_dict,
+)
+from . import launch  # noqa: F401,E402
+from . import io  # noqa: F401,E402

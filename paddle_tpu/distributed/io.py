@@ -1,0 +1,23 @@
+"""Reference: python/paddle/distributed/io.py — persistable save/load
+helpers for distributed jobs (thin over the framework io here)."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["is_persistable", "save_persistables", "load_persistables"]
+
+
+def is_persistable(var):
+    return isinstance(var, Tensor) and not var.stop_gradient
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import save, default_main_program
+    save(main_program or default_main_program(),
+         f"{dirname}/{filename or 'persistables'}")
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import load, default_main_program
+    load(main_program or default_main_program(),
+         f"{dirname}/{filename or 'persistables'}")
