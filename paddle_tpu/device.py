@@ -115,3 +115,134 @@ class cuda:
     @staticmethod
     def synchronize(device=None):
         jax.effects_barrier()
+
+
+# ---------------------------------------------------------------------------
+# round-3 device-surface completions (reference: python/paddle/device/
+# __init__.py — streams/events, device enumeration, build introspection)
+# ---------------------------------------------------------------------------
+
+
+class Stream:
+    """Reference: device.Stream. PJRT owns real streams; this handle keeps
+    the API contract (creation, priority, synchronize via host fence) for
+    code structured around stream scoping."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def __repr__(self):
+        return f"Stream(device={self.device}, priority={self.priority})"
+
+
+class Event:
+    """Reference: device.Event — record/synchronize/query over a stream."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True          # all prior work observable after host fence
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    """Reference: device.stream_guard context manager."""
+
+    def __init__(self, stream):
+        self._stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def synchronize(device=None):
+    """Block until all queued device work is observable (host fence —
+    reliable through a PJRT relay, unlike stream queries)."""
+    import numpy as _np
+    import jax.numpy as _jnp
+    _np.asarray(_jnp.zeros(()))
+
+
+def get_cudnn_version():
+    """Reference returns None when not compiled with CUDA."""
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+class IPUPlace(Place):
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU support is not provided in the TPU build (reference "
+            "gates it behind WITH_IPU)")
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+FLAGS_selected_xpus = ""   # reference exports the env-flag name

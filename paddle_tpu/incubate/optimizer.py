@@ -1,0 +1,113 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py:27 LookAhead, modelaverage.py:28 ModelAverage)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead (arXiv:1907.08610): the inner optimizer updates fast
+    weights every step; every k steps the slow weights interpolate toward
+    the fast ones and the fast weights reset to the slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for group in ([self.inner_optimizer._parameter_list]
+                                if hasattr(self.inner_optimizer,
+                                           "_parameter_list") else [])
+                for p in group] or list(
+                    getattr(self.inner_optimizer, "_parameter_list", []))
+
+    def step(self):
+        self.inner_optimizer.step()
+        params = self._params()
+        if self._slow is None:
+            self._slow = {id(p): np.asarray(p._value) for p in params}
+        self._step += 1
+        if self._step % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (np.asarray(p._value) - slow)
+                self._slow[id(p)] = slow
+                p._value = jnp.asarray(slow, p._value.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        return {"step": self._step,
+                "slow": {k: v for k, v in (self._slow or {}).items()}}
+
+
+class ModelAverage:
+    """Accumulate parameter history; apply()/restore() swap the running
+    average in for evaluation (reference: modelaverage.py — the
+    average_window_rate/min_average_window/max_average_window contract)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._params = list(parameters or [])
+        self._sum = {id(p): np.zeros_like(np.asarray(p._value))
+                     for p in self._params}
+        self._count = 0
+        self._backup = {}
+
+    def step(self):
+        self._count += 1
+        window = max(self.min_window,
+                     min(self.max_window,
+                         int(self._count * self.avg_rate) or 1))
+        for p in self._params:
+            s = self._sum[id(p)]
+            # exponential window approximation of the reference's
+            # sum_1/sum_2/sum_3 rotation
+            decay = max(0.0, 1.0 - 1.0 / window)
+            self._sum[id(p)] = decay * s + np.asarray(p._value)
+
+    def _average(self, p):
+        window = max(1, min(self._count, self.max_window))
+        norm = sum((max(0.0, 1.0 - 1.0 / window)) ** i
+                   for i in range(self._count)) or 1.0
+        return self._sum[id(p)] / norm
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            p._value = jnp.asarray(self._average(p), p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))

@@ -146,3 +146,14 @@ def resnet101(num_classes=1000, **kw):
 
 def resnet152(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes=num_classes, **kw)
+
+
+def wide_resnet50_2(num_classes=1000, **kw):
+    """Reference: vision/models/resnet.py wide_resnet50_2 (2x width)."""
+    m = ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes=num_classes, **kw)
+    return m
+
+
+def wide_resnet101_2(num_classes=1000, **kw):
+    m = ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes=num_classes, **kw)
+    return m

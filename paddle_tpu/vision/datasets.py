@@ -155,6 +155,48 @@ class DatasetFolder(Dataset):
         return len(self.samples)
 
 
+class ImageFolder(Dataset):
+    """Flat-folder image/array listing (reference:
+    vision/datasets/folder.py ImageFolder — samples without labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.transform = transform
+        self.loader = loader or _default_loader
+        exts = tuple(extensions or (".npy", ".jpg", ".jpeg", ".png"))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                if is_valid_file is not None:
+                    if is_valid_file(path):
+                        self.samples.append(path)
+                elif fn.lower().endswith(exts):
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"),
+                          dtype="float32").transpose(2, 0, 1) / 255.0
+    except ImportError as e:
+        raise NotImplementedError(
+            f"loading {path} needs PIL; store arrays as .npy instead") \
+            from e
+
+
 def _no_download(name):
     raise NotImplementedError(
         f"{name}: automatic download is unavailable in this environment "

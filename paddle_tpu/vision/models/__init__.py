@@ -15,3 +15,22 @@ from .yolo import PPYOLOE, ppyoloe_s  # noqa: F401
 from .vit import (  # noqa: F401
     VisionTransformer, vit_b_16, vit_l_16, vit_s_16, vit_tiny,
 )
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264,
+)
+from .googlenet import (  # noqa: F401
+    GoogLeNet, googlenet, InceptionV3, inception_v3,
+)
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish, MobileNetV3,
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from .resnext import (  # noqa: F401
+    ResNeXt, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+)
+from ...models.resnet import wide_resnet50_2, wide_resnet101_2  # noqa: F401

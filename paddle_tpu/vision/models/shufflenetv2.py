@@ -1,0 +1,235 @@
+"""ShuffleNetV2 + MobileNetV3 (reference: python/paddle/vision/models/
+shufflenetv2.py, mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ... import ops
+
+
+def _cbr(cin, cout, k, stride=1, padding=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.right = nn.Sequential(
+                _cbr(cin // 2, branch, 1, act=act),
+                _cbr(branch, branch, 3, stride=1, padding=1, groups=branch,
+                     act="none"),
+                _cbr(branch, branch, 1, act=act))
+            self.left = None
+        else:
+            self.left = nn.Sequential(
+                _cbr(cin, cin, 3, stride=stride, padding=1, groups=cin,
+                     act="none"),
+                _cbr(cin, branch, 1, act=act))
+            self.right = nn.Sequential(
+                _cbr(cin, branch, 1, act=act),
+                _cbr(branch, branch, 3, stride=stride, padding=1,
+                     groups=branch, act="none"),
+                _cbr(branch, branch, 1, act=act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            l, r = x[:, :c], x[:, c:]
+            out = ops.concat([l, self.right(r)], axis=1)
+        else:
+            out = ops.concat([self.left(x), self.right(x)], axis=1)
+        return self.shuffle(out)
+
+
+_SHUFFLE_CH = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference: vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        chs = _SHUFFLE_CH[scale]
+        self.stem = nn.Sequential(_cbr(3, chs[0], 3, stride=2, padding=1,
+                                       act=act),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        cin = chs[0]
+        for stage_idx, repeats in enumerate((4, 8, 4)):
+            cout = chs[stage_idx + 1]
+            stages.append(_ShuffleUnit(cin, cout, 2, act))
+            for _ in range(repeats - 1):
+                stages.append(_ShuffleUnit(cout, cout, 1, act))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.tail = _cbr(cin, chs[4], 1, act=act)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(chs[4], num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def _shuffle(scale, act="relu", name=None):
+    def fn(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError(
+                "pretrained weights are not bundled (zero egress)")
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    fn.__name__ = name or f"shufflenet_v2_x{scale}"
+    return fn
+
+
+shufflenet_v2_x0_25 = _shuffle(0.25, name="shufflenet_v2_x0_25")
+shufflenet_v2_x0_33 = _shuffle(0.33, name="shufflenet_v2_x0_33")
+shufflenet_v2_x0_5 = _shuffle(0.5, name="shufflenet_v2_x0_5")
+shufflenet_v2_x1_0 = _shuffle(1.0, name="shufflenet_v2_x1_0")
+shufflenet_v2_x1_5 = _shuffle(1.5, name="shufflenet_v2_x1_5")
+shufflenet_v2_x2_0 = _shuffle(2.0, name="shufflenet_v2_x2_0")
+shufflenet_v2_swish = _shuffle(1.0, act="swish",
+                               name="shufflenet_v2_swish")
+
+
+class _SEModule(nn.Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, ch // reduction, 1)
+        self.fc2 = nn.Conv2D(ch // reduction, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, cin, mid, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = [_cbr(cin, mid, 1, act=act)] if mid != cin else []
+        layers.append(_cbr(mid, mid, k, stride=stride, padding=k // 2,
+                           groups=mid, act=act))
+        self.features = nn.Sequential(*layers)
+        self.se = _SEModule(mid) if use_se else None
+        self.project = _cbr(mid, cout, 1, act="none")
+
+    def forward(self, x):
+        out = self.features(x)
+        if self.se is not None:
+            out = self.se(out)
+        out = self.project(out)
+        return x + out if self.use_res else out
+
+
+_MBV3_LARGE = [
+    # k, mid, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    """Reference: vision/models/mobilenetv3.py (large/small configs)."""
+
+    def __init__(self, config, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+
+        def c(v):
+            return max(8, int(v * scale + 4) // 8 * 8)
+
+        self.stem = _cbr(3, c(16), 3, stride=2, padding=1, act="hardswish")
+        blocks = []
+        cin = c(16)
+        for k, mid, cout, se, act, stride in config:
+            blocks.append(_MBV3Block(cin, c(mid), c(cout), k, stride, se,
+                                     act))
+            cin = c(cout)
+        self.blocks = nn.Sequential(*blocks)
+        mid_ch = c(config[-1][1])
+        self.tail = _cbr(cin, mid_ch, 1, act="hardswish")
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.head = nn.Sequential(nn.Linear(mid_ch, last_ch),
+                                      nn.Hardswish(),
+                                      nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.tail(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.head(ops.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (zero egress)")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (zero egress)")
+    return MobileNetV3Small(scale=scale, **kwargs)

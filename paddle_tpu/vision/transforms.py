@@ -13,6 +13,8 @@ import random
 import numpy as np
 
 __all__ = [
+    "affine", "perspective", "erase", "RandomAffine", "RandomPerspective",
+    "RandomErasing",
     "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
     "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
     "RandomResizedCrop", "Pad", "Grayscale", "Transpose",
@@ -216,6 +218,82 @@ def rotate(img, angle, fill=0):
     return out
 
 
+def _inverse_affine_matrix(angle, translate, scale, shear, center):
+    # torchvision/paddle convention: M = T(center) R(angle) Sh(shear)
+    # S(scale) T(-center) T(translate); we invert it for output->input
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward 2x2 part
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1.0]])
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1.0]])
+    return np.linalg.inv(pre @ m @ post)
+
+
+def _warp(img, inv3, fill=0):
+    """Inverse-map warp with nearest sampling (same contract as rotate)."""
+    h, w = img.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w]
+    ones = np.ones_like(xx, dtype=np.float64)
+    pts = np.stack([xx.astype(np.float64), yy.astype(np.float64), ones])
+    src_pts = inv3 @ pts.reshape(3, -1)
+    denom = np.where(np.abs(src_pts[2]) < 1e-9, 1e-9, src_pts[2])
+    xs = (src_pts[0] / denom).reshape(h, w)
+    ys = (src_pts[1] / denom).reshape(h, w)
+    xi = np.rint(xs).astype(np.int64)
+    yi = np.rint(ys).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference: vision/transforms/functional.py affine)."""
+    if isinstance(shear, numbers.Number):
+        shear = [shear, 0.0]
+    h, w = img.shape[:2]
+    if center is None:
+        center = ((w - 1) / 2.0, (h - 1) / 2.0)
+    inv3 = _inverse_affine_matrix(angle, translate, float(scale), shear,
+                                  center)
+    return _warp(img, inv3, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp from 4 point pairs (reference: functional
+    perspective — homography via the 8-dof DLT solve)."""
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec += [sx, sy]
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(bvec, np.float64))
+    inv3 = np.array([[coeffs[0], coeffs[1], coeffs[2]],
+                     [coeffs[3], coeffs[4], coeffs[5]],
+                     [coeffs[6], coeffs[7], 1.0]])
+    return _warp(img, inv3, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v (reference: functional erase)."""
+    out = img if inplace else np.array(img)
+    out[i:i + h, j:j + w] = v
+    return out
+
+
 # ---- class transforms -----------------------------------------------------
 
 class BaseTransform:
@@ -402,6 +480,102 @@ class ColorJitter(BaseTransform):
         random.shuffle(order)
         for t in order:
             img = t(img)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    """Reference: transforms/transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = 1.0 if self.scale is None else np.random.uniform(*self.scale)
+        sh = [0.0, 0.0]
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                s = (-abs(s), abs(s))
+            sh = [np.random.uniform(s[0], s[1]), 0.0]
+            if len(s) == 4:
+                sh[1] = np.random.uniform(s[2], s[3])
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Reference: transforms/transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Reference: transforms/transforms.py RandomErasing (arXiv
+    1708.04896): erase a random rectangle with value/random noise."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    v = np.random.uniform(
+                        0, 1, (eh, ew) + img.shape[2:]).astype(img.dtype)
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v, self.inplace)
         return img
 
 
