@@ -237,6 +237,9 @@ def test_monitor_counters():
     x = paddle.to_tensor(np.ones(4, np.float32))
     ((x * 2.0) + 1.0).sum()
     assert monitor.get("op_dispatch_total") >= 3
+    # a jit compile only registers on cache miss: force one with an op
+    # signature unique to this test
+    paddle.scale(x, scale=1.2345678, bias=0.777)
     assert monitor.get("op_jit_program_total") >= 1
     # user counters auto-register, get_all snapshots, reset clears
     monitor.increment("my_counter", 5)
