@@ -181,3 +181,25 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _global_weight_init = None
 _global_bias_init = None
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference:
+    nn/initializer/Bilinear — deconv weights that perform bilinear
+    interpolation)."""
+
+    def __call__(self, shape, dtype="float32"):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        c_out, c_in, kh, kw = shape
+        f = math.ceil(kw / 2.0)
+        center = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f - center))
+                * (1 - abs(og[1] / f - center)))
+        w = np.zeros(shape, dtype=np.float32)
+        for i in range(c_out):
+            for j in range(c_in):
+                w[i, j] = filt
+        return jnp.asarray(w, dtype=jnp.dtype(dtype) if dtype else None)

@@ -236,6 +236,72 @@ def _export_registry():
 _export_registry()
 
 
+def _bind_extra_tensor_methods():
+    """Reference binds these as Tensor methods too (tensor/__init__.py
+    method list) even though they live in namespaced modules here."""
+    from ..core.tensor import Tensor as _T
+
+    def _m(name, fn):
+        if getattr(_T, name, None) is None:
+            setattr(_T, name, fn)
+
+    from .extra import (tensor_split, hsplit, vsplit, dsplit, atleast_1d,
+                        atleast_2d, atleast_3d, histogramdd, pca_lowrank,
+                        lu_unpack)
+    for nm, f in (("hsplit", hsplit), ("vsplit", vsplit),
+                  ("dsplit", dsplit), ("atleast_1d", atleast_1d),
+                  ("atleast_2d", atleast_2d), ("atleast_3d", atleast_3d),
+                  ("histogramdd", histogramdd), ("pca_lowrank", pca_lowrank),
+                  ("lu_unpack", lu_unpack)):
+        _m(nm, f)
+    _m("add_n", lambda self, name=None: globals()["add_n"]([self]))
+    _m("rank", globals()["rank"])
+
+    def _reverse(self, axis, name=None):
+        from .manipulation import flip
+        return flip(self, axis)
+    _m("reverse", _reverse)
+
+    def _cond(self, p=None, name=None):
+        from ..linalg import cond as _c
+        return _c(self, p=p)
+    _m("cond", _cond)
+
+    def _stft(self, n_fft, hop_length=None, win_length=None, window=None,
+              center=True, pad_mode="reflect", normalized=False,
+              onesided=True, name=None):
+        from ..signal import stft as _s
+        return _s(self, n_fft, hop_length, win_length, window, center,
+                  pad_mode, normalized, onesided)
+    _m("stft", _stft)
+
+    def _istft(self, n_fft, hop_length=None, win_length=None, window=None,
+               center=True, normalized=False, onesided=True, length=None,
+               return_complex=False, name=None):
+        from ..signal import istft as _i
+        return _i(self, n_fft, hop_length, win_length, window, center,
+                  normalized, onesided, length, return_complex)
+    _m("istft", _istft)
+
+    def _transpose_(self, perm, name=None):
+        from .manipulation import transpose
+        out = transpose(self, perm)
+        self._value = out._value
+        self._grad_node = out._grad_node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient
+        return self
+    _m("transpose_", _transpose_)
+
+    from .extra import create_parameter as _cp
+    _m("create_parameter", staticmethod(_cp))
+    from .extra import create_tensor as _ct
+    _m("create_tensor", staticmethod(_ct))
+
+
+_bind_extra_tensor_methods()
+
+
 def register_namespaces():
     """Pull the non-tensor namespaces (nn.functional, linalg, fft, signal,
     sparse) into the registry so the whole public op surface is schema-

@@ -263,3 +263,35 @@ def test_inmemory_dataset_slot_records(tmp_path):
     assert dist.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
     with pytest.raises(ValueError):
         dist.ProbabilityEntry(1.5)
+
+
+def test_tensor_method_surface_complete():
+    import os
+    from paddle_tpu.core.tensor import Tensor
+    p = f"{REF}/tensor/tensor.prototype.pyi"
+    if not os.path.exists(p):
+        pytest.skip("reference prototype not present")
+    src = open(p, errors="replace").read()
+    meths = set(re.findall(r"^\s+def ([a-z_][a-zA-Z0-9_]*)\(", src, re.M))
+    missing = sorted(m for m in meths
+                     if not m.startswith("_") and not hasattr(Tensor, m))
+    assert not missing, f"Tensor methods missing: {missing}"
+
+
+def test_tensor_extra_methods_behave():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    np.testing.assert_array_equal(x.reverse([1]).numpy()[:, 0], [2.0, 5.0])
+    halves = x.hsplit(3)
+    assert len(halves) == 3
+    y = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    y.transpose_([1, 0])
+    assert y.shape == [3, 2]
+    assert int(paddle.to_tensor(np.zeros((2, 2), "float32")).rank()) == 2
+
+
+def test_bilinear_initializer_interpolates():
+    from paddle_tpu.nn.initializer import Bilinear
+    w = np.asarray(Bilinear()((1, 1, 4, 4)))
+    # symmetric separable kernel, peak in the center block
+    np.testing.assert_allclose(w[0, 0], w[0, 0].T, rtol=1e-6)
+    assert w[0, 0, 1:3, 1:3].min() > w[0, 0, 0, 0]
