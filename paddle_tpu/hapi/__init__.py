@@ -4,4 +4,5 @@ from .model_summary import summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )

@@ -198,3 +198,113 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce model LR when a monitored metric stalls (reference:
+    hapi/callbacks.py:1172)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = "min" if mode == "auto" else mode
+        self.best = None
+        self.cooldown_counter = 0
+        self.num_bad_epochs = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        better = (self.best is None
+                  or (self.mode == "min"
+                      and cur < self.best - self.min_delta)
+                  or (self.mode == "max"
+                      and cur > self.best + self.min_delta))
+        if better:
+            self.best = cur
+            self.num_bad_epochs = 0
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            return
+        self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                from ..optimizer.lr import LRScheduler
+                lr = opt.get_lr() if hasattr(opt, "get_lr") else None
+                if lr is not None:
+                    new_lr = max(lr * self.factor, self.min_lr)
+                    if hasattr(opt, "set_lr"):
+                        opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self.cooldown_counter = self.cooldown
+            self.num_bad_epochs = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: hapi/callbacks.py:883 writes
+    VisualDL records). The visualdl package is not vendored (zero
+    egress); scalars append to a plain JSONL the reference UI can
+    ingest offline."""
+
+    def __init__(self, log_dir="./log"):
+        import os
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = None
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+        if self._f is None:
+            self._f = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                           "a")
+        for k, v in (logs or {}).items():
+            try:
+                v = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            self._f.write(json.dumps({"tag": f"{tag}/{k}",
+                                      "step": self._step,
+                                      "value": v}) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class WandbCallback(Callback):
+    """Weights&Biases logging (reference: hapi/callbacks.py:999). wandb is
+    not vendored (zero egress): with the package absent this raises at
+    construction, matching the reference's `ModuleNotFoundError` path."""
+
+    def __init__(self, project=None, **kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ModuleNotFoundError(
+                "WandbCallback requires the wandb package, which is not "
+                "available in this environment (zero egress)") from e

@@ -770,3 +770,74 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+class RoIAlign(object):
+    """Layer wrapper over roi_align (reference: vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(object):
+    """Layer wrapper over roi_pool (reference: vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(object):
+    """Layer wrapper over psroi_pool (reference: vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class DeformConv2D(object):
+    """Layer wrapper over deform_conv2d (reference: vision/ops.py
+    DeformConv2D) — owns the weight/bias parameters."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        import numpy as _np
+        from ..core.tensor import Tensor
+        import jax.numpy as _jnp
+        k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size, kernel_size)
+        std = 1.0 / _np.sqrt(in_channels * k[0] * k[1])
+        rng = _np.random.RandomState(0)
+        self.weight = Tensor(_jnp.asarray(
+            rng.uniform(-std, std,
+                        (out_channels, in_channels // groups, *k))
+            .astype("float32")), stop_gradient=False)
+        self.bias = None if bias_attr is False else Tensor(
+            _jnp.asarray(rng.uniform(-std, std, (out_channels,))
+                         .astype("float32")), stop_gradient=False)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+def generate_proposals_v2(*args, **kwargs):
+    """Reference alias of generate_proposals."""
+    return generate_proposals(*args, **kwargs)
