@@ -1,2 +1,7 @@
 """incubate.nn (reference: python/paddle/incubate/nn/)."""
 from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer, FusedMultiTransformer, FusedEcMoe,
+)

@@ -1,0 +1,257 @@
+"""incubate.nn fused layer classes (reference: python/paddle/incubate/nn/
+layer/fused_transformer.py etc.) — parameter-owning wrappers over the
+incubate.nn.functional surface."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn.initializer import XavierUniform, Constant
+from .. import nn_functional as IF
+
+__all__ = [
+    "FusedLinear", "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer", "FusedEcMoe",
+]
+
+
+class FusedLinear(Layer):
+    """Reference: incubate/nn/layer/fused_linear.py."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        return IF.fused_linear(x, self.weight, self.bias,
+                               self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """Reference: incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return IF.fused_dropout_add(x, y, self.p, self.training, self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x, residual):
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedMultiHeadAttention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim],
+            attr=qkv_weight_attr, default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate
+                                 if act_dropout_rate is not None
+                                 else dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter(
+            [d_model], attr=ln2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        return IF.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedTransformerEncoderLayer = FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate
+                               if attn_dropout_rate is not None
+                               else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer — n stacked pre-LN blocks (generation path)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5, name=None, **kw):
+        super().__init__()
+        from ...nn.layer.container import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate,
+                activation, normalize_before=True)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        h = src
+        for layer in self.layers:
+            h = layer(h, src_mask=attn_mask)
+        return h
+
+
+class FusedEcMoe(Layer):
+    """Reference: incubate/nn/layer/fused_ec_moe.py FusedEcMoe."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bmm0_bias = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bmm1_bias = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x, gate):
+        return IF.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
+                               self.bmm1_weight, self.bmm1_bias,
+                               self.act_type)

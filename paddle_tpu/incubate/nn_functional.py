@@ -21,6 +21,9 @@ __all__ = [
     "fused_rotary_position_embedding", "fused_matmul_bias", "fused_linear",
     "fused_linear_activation", "swiglu", "fused_bias_act",
     "fused_bias_dropout_residual_layer_norm", "masked_multihead_attention",
+    "fused_feedforward", "fused_multi_head_attention", "fused_ec_moe",
+    "fused_multi_transformer", "variable_length_memory_efficient_attention",
+    "block_multihead_attention",
 ]
 
 
@@ -318,3 +321,191 @@ def _mmha_impl(x, cache_kv, bias, src_mask, seq_lens, *, num_heads,
     out = jnp.einsum("bhm,bhmd->bhd", p,
                      new_v.astype(jnp.float32)).astype(x.dtype)
     return out.reshape(B, H * D), jnp.stack([new_k, new_v])
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """Transformer FFN block in one call (reference:
+    incubate/nn/functional/fused_transformer.py:36) — XLA fuses the chain;
+    this wrapper provides the exact reference composition (pre/post LN,
+    two dropouts, residual)."""
+    from ..nn import functional as F
+
+    def ln(v, scale, bias, eps):
+        shp = (v.shape[-1],)
+        return F.layer_norm(v, shp, scale, bias, eps)
+
+    residual = x
+    h = ln(x, ln1_scale, ln1_bias, ln1_epsilon) if pre_layer_norm else x
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = ln(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True,
+                               num_heads=-1, transpose_qkv_wb=False,
+                               name=None):
+    """Fused MHA block (reference: fused_transformer.py:514). qkv_weight
+    [3, H, Dh, D] (or [D, 3D] when transpose_qkv_wb)."""
+    from ..nn import functional as F
+    from ..ops.manipulation import reshape, transpose
+    from ..ops.linalg import matmul
+
+    D = x.shape[-1]
+    residual = x
+    h = F.layer_norm(x, (D,), pre_ln_scale, pre_ln_bias, pre_ln_epsilon) \
+        if pre_layer_norm else x
+    qw = wrap(qkv_weight)
+    if transpose_qkv_wb:
+        nh = int(num_heads)
+        qkv = matmul(h, qw)                      # [B, S, 3D]
+        if qkv_bias is not None:
+            qkv = qkv + wrap(qkv_bias)
+        B, S = x.shape[0], x.shape[1]
+        qkv = reshape(qkv, [B, S, 3, nh, D // nh])
+    else:
+        three, nh, dh, _ = qw.shape
+        w2 = reshape(qw, [3 * nh * dh, D])
+        qkv = matmul(h, w2, transpose_y=True)    # [B, S, 3*nh*dh]
+        if qkv_bias is not None:
+            qkv = qkv + reshape(wrap(qkv_bias), [3 * nh * dh])
+        B, S = x.shape[0], x.shape[1]
+        qkv = reshape(qkv, [B, S, 3, nh, dh])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]                             # [B, S, H, Dh]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        is_causal=False, training=training)
+    out = reshape(out, [B, S, D])
+    out = matmul(out, wrap(linear_weight))
+    if linear_bias is not None:
+        out = out + wrap(linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (D,), ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Expert-choice MoE block (reference: fused_ec_moe.py:18): softmax
+    gate over experts, every expert computes every token (the fused
+    kernel's dense formulation), gate-weighted sum."""
+    from ..ops._helpers import apply as _apply
+
+    def impl(xv, gv, w0, b0, w1, b1, *, act):
+        probs = jax.nn.softmax(gv, axis=-1)          # [B, S, E]
+        h = jnp.einsum("bsd,edf->bsef", xv, w0) + b0[:, 0][None, None]
+        h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("bsef,efd->bsed", h, w1) + b1[:, 0][None, None]
+        return jnp.einsum("bsed,bse->bsd", o, probs)
+
+    return _apply("fused_ec_moe", impl,
+                  (wrap(x), wrap(gate), wrap(bmm0_weight), wrap(bmm0_bias),
+                   wrap(bmm1_weight), wrap(bmm1_bias)),
+                  {"act": act_type})
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """Stacked fused transformer blocks (reference: fused_transformer.py
+    fused_multi_transformer — the generation fast path). Composition of
+    fused_multi_head_attention + fused_feedforward per layer."""
+    h = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i], pre_layer_norm=True,
+            pre_ln_scale=ln_scales[i], pre_ln_bias=ln_biases[i],
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i], ln1_bias=ffn_ln_biases[i],
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=True, training=training,
+            mode=mode)
+    return h
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Varlen attention (reference:
+    variable_length_memory_efficient_attention.py:28 — the cutlass kernel).
+    q/k/v: [B, H, S, D]; per-batch valid lengths mask the attention."""
+    from ..ops._helpers import apply as _apply
+
+    def impl(q, k, v, sl, kvl, m, *, scale_, causal_):
+        B, H, S, D = q.shape
+        Sk = k.shape[2]
+        sc = scale_ if scale_ is not None else 1.0 / jnp.sqrt(D)
+        logits = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sc
+        valid_q = jnp.arange(S)[None, :] < sl.reshape(-1)[:, None]
+        valid_k = jnp.arange(Sk)[None, :] < kvl.reshape(-1)[:, None]
+        maskv = valid_q[:, None, :, None] & valid_k[:, None, None, :]
+        if causal_:
+            maskv = maskv & (jnp.arange(S)[:, None]
+                             >= jnp.arange(Sk)[None, :])[None, None]
+        logits = jnp.where(maskv, logits, -1e30)
+        if m is not None:
+            logits = logits + m.astype(jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        p = jnp.where(maskv, p, 0.0)
+        out = jnp.einsum("bhst,bhtd->bhsd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    return _apply("varlen_mem_eff_attention", impl,
+                  (wrap(query), wrap(key), wrap(value), wrap(seq_lens),
+                   wrap(kv_seq_lens),
+                   wrap(mask) if mask is not None else None),
+                  {"scale_": scale, "causal_": bool(causal)})
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, *args, **kwargs):
+    """PagedAttention-style blocked-KV decode (reference:
+    block_multihead_attention.py — a serving kernel bound to the CUDA
+    paged cache layout). The TPU serving path uses the contiguous
+    KV-cache decode in models/generation + masked_multihead_attention;
+    a paged-block cache has no XLA-native layout here."""
+    raise NotImplementedError(
+        "block_multihead_attention: the paged-KV serving kernel is CUDA-"
+        "layout-specific; use masked_multihead_attention or the "
+        "models.generation KV-cache decode on TPU")

@@ -1,0 +1,119 @@
+"""incubate.nn fused-transformer family (reference: python/paddle/
+incubate/nn/layer/fused_transformer.py, functional/fused_transformer.py).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def test_incubate_nn_surface_complete():
+    import os
+    p = "/root/reference/python/paddle/incubate/nn/__init__.py"
+    if not os.path.exists(p):
+        pytest.skip("no reference")
+    src = open(p, errors="replace").read()
+    ref = set(re.findall(r"^\s+'([A-Za-z_][A-Za-z0-9_]*)',", src, re.M))
+    missing = sorted(n for n in ref if not hasattr(inn, n))
+    assert not missing, missing
+
+
+def test_fused_mha_matches_manual_composition():
+    paddle.seed(0)
+    D, H, B, S = 16, 2, 2, 5
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(B, S, D).astype("float32"))
+    qkv_w = paddle.to_tensor(rng.randn(3, H, D // H, D).astype("float32")
+                             * 0.1)
+    lin_w = paddle.to_tensor(rng.randn(D, D).astype("float32") * 0.1)
+    out = IF.fused_multi_head_attention(
+        x, qkv_w, lin_w, pre_layer_norm=True,
+        pre_ln_scale=paddle.ones([D]), pre_ln_bias=paddle.zeros([D]),
+        dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+    # manual composition
+    import paddle_tpu.nn.functional as F
+    h = F.layer_norm(x, (D,), paddle.ones([D]), paddle.zeros([D]))
+    w2 = paddle.reshape(qkv_w, [3 * D, D])
+    qkv = paddle.matmul(h, w2, transpose_y=True)
+    qkv = paddle.reshape(qkv, [B, S, 3, H, D // H])
+    att = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                         qkv[:, :, 2], training=False)
+    want = x + paddle.matmul(paddle.reshape(att, [B, S, D]), lin_w)
+    np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_fused_feedforward_pre_vs_post_ln():
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+    w1 = paddle.to_tensor(rng.randn(8, 16).astype("float32") * 0.1)
+    w2 = paddle.to_tensor(rng.randn(16, 8).astype("float32") * 0.1)
+    sc, b = paddle.ones([8]), paddle.zeros([8])
+    pre = IF.fused_feedforward(x, w1, w2, ln1_scale=sc, ln1_bias=b,
+                               dropout1_rate=0.0, dropout2_rate=0.0,
+                               pre_layer_norm=True, training=False)
+    post = IF.fused_feedforward(x, w1, w2, ln2_scale=sc, ln2_bias=b,
+                                dropout1_rate=0.0, dropout2_rate=0.0,
+                                pre_layer_norm=False, training=False)
+    assert pre.shape == post.shape == [2, 4, 8]
+    assert not np.allclose(pre.numpy(), post.numpy())
+    # post-LN output is normalized over the last dim
+    np.testing.assert_allclose(post.numpy().mean(-1), 0.0, atol=1e-5)
+
+
+def test_fused_encoder_layer_trains():
+    paddle.seed(0)
+    layer = inn.FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=layer.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 5, 16).astype("float32"))
+    tgt = paddle.to_tensor(rng.randn(2, 5, 16).astype("float32"))
+    losses = []
+    for _ in range(15):
+        loss = ((layer(x) - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_fused_ec_moe_gate_weighting():
+    paddle.seed(0)
+    moe = inn.FusedEcMoe(8, 16, 3, "gelu")
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 4, 8).astype("float32"))
+    # one-hot gate on expert 0 vs expert 1 give different outputs
+    g0 = np.full((1, 4, 3), -1e9, "float32")
+    g0[..., 0] = 0
+    g1 = np.full((1, 4, 3), -1e9, "float32")
+    g1[..., 1] = 0
+    o0 = moe(x, paddle.to_tensor(g0)).numpy()
+    o1 = moe(x, paddle.to_tensor(g1)).numpy()
+    assert not np.allclose(o0, o1)
+
+
+def test_varlen_mem_efficient_attention_masks_tail():
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype("float32"))
+    k = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype("float32"))
+    v = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype("float32"))
+    full = IF.variable_length_memory_efficient_attention(
+        q, k, v, paddle.to_tensor(np.array([4], "int32")),
+        paddle.to_tensor(np.array([4], "int32")))
+    short = IF.variable_length_memory_efficient_attention(
+        q, k, v, paddle.to_tensor(np.array([4], "int32")),
+        paddle.to_tensor(np.array([2], "int32")))
+    # restricting kv length changes attention output
+    assert not np.allclose(full.numpy()[0, 0, 0], short.numpy()[0, 0, 0])
+
+
+def test_block_mha_raises_with_tpu_guidance():
+    with pytest.raises(NotImplementedError, match="masked_multihead"):
+        IF.block_multihead_attention(*([None] * 11))
