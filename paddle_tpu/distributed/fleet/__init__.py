@@ -87,3 +87,137 @@ def worker_index():
 
 def worker_num():
     return get_world_size()
+
+
+class Role:
+    """Reference: fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Reference: fleet/base/role_maker.py PaddleCloudRoleMaker — derives
+    the process role from the launch env contract (here the PADDLE_TPU_*
+    contract; every process is a collective worker on the mesh runtime)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+    def _worker_index(self):
+        from ..env import get_rank
+        return get_rank()
+
+    def _worker_num(self):
+        from ..env import get_world_size
+        return get_world_size()
+
+    def _role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Reference: role_maker.py UserDefinedRoleMaker — explicit role/rank."""
+
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective)
+        self._current_id = current_id
+        self._role_val = role
+        self._worker_num_val = worker_num
+
+    def _worker_index(self):
+        return self._current_id
+
+    def _worker_num(self):
+        return self._worker_num_val
+
+    def _role(self):
+        return self._role_val
+
+
+class UtilBase:
+    """Reference: fleet/base/util_factory.py UtilBase — small cross-rank
+    utilities over the collective surface."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        from .. import collective as C
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+        t = Tensor(jnp.asarray(np.asarray(input)))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        C.all_reduce(t, op=op)
+        return np.asarray(t._value)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..p2p import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+
+class _FleetFacade:
+    """Reference: fleet.Fleet (fleet/fleet.py) — the singleton facade
+    class; module-level functions here are its bound methods."""
+
+    def __init__(self):
+        import sys
+        self._mod = sys.modules[__name__]
+        self.util = UtilBase()
+
+    def __getattr__(self, name):
+        return getattr(self._mod, name)
+
+
+Fleet = _FleetFacade
+
+
+class MultiSlotDataGenerator:
+    """Reference: distributed/fleet/data_generator — stdin->slot-record
+    pipe for the PS data feed. generate_sample yields
+    [(slot_name, [ids...]), ...]; run_from_stdin prints the slot-record
+    line format InMemoryDataset parses."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement generate_sample")
+
+    def _format(self, record):
+        toks = []
+        for slot, vals in record:
+            for v in vals:
+                toks.append(f"{slot}:{v}")
+        return " ".join(toks)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for record in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(record) + "\n")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for record in (gen() if callable(gen) else gen):
+                out.append(self._format(record))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots variant (reference: data_generator)."""

@@ -375,3 +375,48 @@ _benchmark = _Benchmark()
 
 def benchmark():
     return _benchmark
+
+
+class SortedKeys:
+    """Reference: profiler/profiler_statistic.py SortedKeys — summary sort
+    orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Reference: profiler/profiler.py SummaryView — which summary tables
+    to print."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(path):
+    """Reference: profiler.export_protobuf — the chrome-trace JSON is this
+    runtime's interchange format; protobuf emission delegates to it with
+    the same file contract."""
+    raise NotImplementedError(
+        "export_protobuf: this runtime exports chrome-trace JSON "
+        "(Profiler.export / chrome_trace); load it with the same tooling "
+        "that consumes the reference's exported traces")
+
+
+def load_profiler_result(path):
+    """Reference: profiler.load_profiler_result — reload an exported
+    trace. Loads the chrome-trace JSON this profiler exports."""
+    import json
+    with open(path) as f:
+        return json.load(f)

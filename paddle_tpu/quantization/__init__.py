@@ -18,6 +18,7 @@ from ..core.dispatch import apply
 from .. import nn
 
 __all__ = [
+    "BaseQuanter", "BaseObserver", "quanter",
     "fake_quant", "quant_linear", "dequant_linear",
     "AbsmaxObserver", "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
     "QuantConfig", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
@@ -301,3 +302,43 @@ class PTQ:
 
     def convert(self, model, inplace=True):
         return self._qat.convert(model, inplace)
+
+
+class BaseQuanter:
+    """Reference: quantization/factory.py BaseQuanter — the trainable
+    fake-quant node interface QAT layers call."""
+
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+class BaseObserver(BaseQuanter):
+    """Reference: quantization/factory.py BaseObserver — a quanter that
+    only collects statistics (PTQ calibration)."""
+
+
+def quanter(class_name):
+    """Reference: quantization/factory.py quanter decorator — registers a
+    Quanter config class for a BaseQuanter implementation."""
+    def decorator(cls):
+        import sys
+        mod = sys.modules[__name__]
+
+        class _Config:
+            def __init__(self, *args, **kwargs):
+                self._args = args
+                self._kwargs = kwargs
+
+            def _instance(self, layer=None):
+                return cls(*self._args, **self._kwargs)
+
+        _Config.__name__ = class_name
+        setattr(mod, class_name, _Config)
+        return cls
+    return decorator

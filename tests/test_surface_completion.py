@@ -295,3 +295,29 @@ def test_bilinear_initializer_interpolates():
     # symmetric separable kernel, peak in the center block
     np.testing.assert_allclose(w[0, 0], w[0, 0].T, rtol=1e-6)
     assert w[0, 0, 1:3, 1:3].min() > w[0, 0, 0, 0]
+
+
+def test_fleet_quant_profiler_surfaces():
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.quantization as q
+    import paddle_tpu.profiler as prof
+    for path, mod in (("distributed/fleet/__init__.py", fleet),
+                      ("quantization/__init__.py", q),
+                      ("profiler/__init__.py", prof)):
+        missing = sorted(n for n in _ref_all(path)
+                         if not hasattr(mod, n))
+        assert not missing, f"{path}: {missing}"
+    # role maker + util behave
+    rm = fleet.PaddleCloudRoleMaker()
+    assert rm._is_worker() and rm._worker_num() >= 1
+    util = fleet.UtilBase()
+    out = util.all_reduce(np.float32([1.0, 2.0]))
+    np.testing.assert_allclose(out, [1.0, 2.0])     # world of one
+
+    class G(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("s1", [3]), ("label", [0])]
+            return gen
+
+    assert G().run_from_memory(["x"]) == ["s1:3 label:0"]
