@@ -127,6 +127,55 @@ def _rebind(template, carry):
 # runtime helpers (targets of the AST rewrite)
 # ---------------------------------------------------------------------------
 
+def convert_ifexp(cond, true_fn, false_fn):
+    """Ternary `a if cond else b` with a possibly-traced condition
+    (reference: convert_operators.py convert_ifelse on expressions)."""
+    if not _is_traced(cond):
+        return true_fn() if _to_bool(cond) else false_fn()
+    a = _to_carry(true_fn(), "ternary")
+    b = _to_carry(false_fn(), "ternary")
+    try:
+        out = lax.cond(_pred_val(cond), lambda _: a, lambda _: b, 0)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "both arms of a converted ternary must produce matching "
+            f"Tensor shapes/dtypes (jax: {e}). " + _GUIDE) from None
+    return Tensor(out) if not isinstance(out, Tensor) else out
+
+
+def convert_bool_op(op, *arm_fns):
+    """`and`/`or` chains whose operands may be tensors (reference:
+    convert_operators.py convert_logical_and/or — preserves python
+    short-circuiting for plain values, lowers to logical_and/or for
+    traced operands)."""
+    import numpy as _np
+
+    vals = []
+    for fn in arm_fns:
+        v = fn()
+        if not (isinstance(v, Tensor) or _is_traced(v)):
+            # plain python value: keep short-circuit semantics
+            if op == "and" and not v:
+                return v
+            if op == "or" and v:
+                return v
+            vals.append(v)
+            continue
+        vals.append(v)
+    tensorish = [v for v in vals if isinstance(v, Tensor) or _is_traced(v)]
+    if not tensorish:
+        return vals[-1] if vals else (op == "and")
+    acc = None
+    for v in vals:
+        arr = v._value if isinstance(v, Tensor) else jnp.asarray(
+            _np.asarray(v) if not _is_traced(v) else v)
+        arr = arr.astype(bool) if hasattr(arr, "astype") else arr
+        acc = arr if acc is None else (
+            jnp.logical_and(acc, arr) if op == "and"
+            else jnp.logical_or(acc, arr))
+    return Tensor(acc)
+
+
 def convert_if(cond, true_fn, false_fn, init_vars):
     if not _is_traced(cond):
         return true_fn(init_vars) if _to_bool(cond) else false_fn(init_vars)
@@ -366,6 +415,30 @@ class ControlFlowTransformer(ast.NodeTransformer):
                         if isinstance(s, ast.Pass))
         fn.body = fn.body[:pass_idx] + list(body) + fn.body[pass_idx + 1:]
         return fn
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        call = ast.parse(
+            "__jst__.convert_ifexp(__JST_C__, lambda: __JST_T__, "
+            "lambda: __JST_F__)", mode="eval").body
+        _replace_name(call, "__JST_C__", node.test)
+        _replace_name(call, "__JST_T__", node.body)
+        _replace_name(call, "__JST_F__", node.orelse)
+        self.converted += 1
+        return ast.copy_location(call, node)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        opname = "and" if isinstance(node.op, ast.And) else "or"
+        lambdas = ", ".join(f"lambda: __JST_V{i}__"
+                            for i in range(len(node.values)))
+        call = ast.parse(
+            f"__jst__.convert_bool_op('{opname}', {lambdas})",
+            mode="eval").body
+        for i, v in enumerate(node.values):
+            _replace_name(call, f"__JST_V{i}__", v)
+        self.converted += 1
+        return ast.copy_location(call, node)
 
     def visit_If(self, node):
         self.generic_visit(node)

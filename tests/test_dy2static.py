@@ -253,3 +253,59 @@ def test_closure_rebinding_visible_after_conversion():
     np.testing.assert_allclose(conv(x).numpy(), [3.0])
     n[0] = paddle.to_tensor(np.float32([10.0]))  # rebind via container
     np.testing.assert_allclose(conv(x).numpy(), [12.0])
+
+
+def test_ternary_on_tensor_condition_compiles():
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2.0 if x.sum() > 0 else x * 3.0
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.float32([1.0, 2.0]))).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.float32([-1.0]))).numpy(), [-3.0])
+
+
+def test_bool_op_on_tensor_conditions():
+    @paddle.jit.to_static
+    def f(x, y):
+        if (x.sum() > 0) and (y.sum() > 0):
+            return x + y
+        return x - y
+
+    a = paddle.to_tensor(np.float32([1.0]))
+    b = paddle.to_tensor(np.float32([2.0]))
+    np.testing.assert_allclose(f(a, b).numpy(), [3.0])
+    np.testing.assert_allclose(
+        f(a, paddle.to_tensor(np.float32([-2.0]))).numpy(), [3.0])
+
+
+def test_bool_op_short_circuit_python_values():
+    calls = []
+
+    def side_effect():
+        calls.append(1)
+        return True
+
+    @paddle.jit.to_static
+    def f(x, flag):
+        if flag and side_effect():
+            return x * 2.0
+        return x
+
+    out = f(paddle.to_tensor(np.float32([1.0])), False)
+    np.testing.assert_allclose(out.numpy(), [1.0])
+    assert not calls          # short-circuit preserved for python values
+
+
+def test_bool_op_or_on_tensors():
+    @paddle.jit.to_static
+    def f(x):
+        if (x.sum() > 10) or (x.min() < 0):
+            return x * 0.0
+        return x
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.float32([-1.0, 2.0]))).numpy(), [0.0, 0.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.float32([1.0, 2.0]))).numpy(), [1.0, 2.0])
