@@ -94,3 +94,107 @@ def check_numerics(level=0):
         yield
     finally:
         _flags.set_flags(prev)
+
+
+class DebugMode:
+    """Reference: amp/debugging.py DebugMode — what the tensor checker does
+    on a NaN/Inf hit."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    CHECK_ALL_AND_ABORT = 4
+    DUMP_ALL = 5
+
+
+class TensorCheckerConfig:
+    """Reference: amp/debugging.py TensorCheckerConfig — scope/mode for the
+    model-level numeric checker (driven here by the dispatch NaN scan)."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = bool(enable)
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+
+_checker_state = {"prev": None}
+
+
+def enable_tensor_checker(checker_config):
+    """Turn on per-op NaN/Inf checking for every dispatched op (reference:
+    amp/debugging.py:634 — model-level accuracy check; here the dispatch
+    layer's FLAGS_check_nan_inf scan is the checker)."""
+    from .. import flags as _flags
+    if checker_config.enable:
+        _checker_state["prev"] = _flags.get_flags(
+            "FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    """Reference: amp/debugging.py disable_tensor_checker."""
+    from .. import flags as _flags
+    prev = _checker_state.pop("prev", None)
+    _flags.set_flags({"FLAGS_check_nan_inf": bool(prev)
+                      if prev is not None else False})
+
+
+def check_layer_numerics(func):
+    """Decorator: NaN/Inf-scan a layer's forward inputs and outputs
+    (reference: amp/debugging.py:64)."""
+    import functools
+
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    def _scan(vs, what, name):
+        for v in vs:
+            if isinstance(v, Tensor):
+                a = np.asarray(v._value)
+                if np.issubdtype(a.dtype, np.floating) \
+                        and not np.isfinite(a).all():
+                    raise RuntimeError(
+                        f"check_layer_numerics: NaN/Inf in {what} of "
+                        f"{name}")
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        _scan(args, "inputs", type(self).__name__)
+        out = func(self, *args, **kwargs)
+        _scan(out if isinstance(out, (tuple, list)) else [out], "outputs",
+              type(self).__name__)
+        return out
+
+    return wrapper
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Compare two operator-stats dumps (reference: amp/debugging.py:575
+    compares workerlog NaN/Inf dumps). Consumes the JSONL files this
+    module's collectors write and reports ops whose counts differ."""
+    import json
+
+    def load(p):
+        out = {}
+        with open(p) as f:
+            for line in f:
+                rec = json.loads(line)
+                out[rec["op"]] = rec
+        return out
+
+    a, b = load(dump_path), load(another_dump_path)
+    rows = []
+    for op in sorted(set(a) | set(b)):
+        ra, rb = a.get(op, {}), b.get(op, {})
+        if ra != rb:
+            rows.append({"op": op, "a": ra, "b": rb})
+    with open(output_filename, "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows

@@ -321,3 +321,29 @@ def test_fleet_quant_profiler_surfaces():
             return gen
 
     assert G().run_from_memory(["x"]) == ["s1:3 label:0"]
+
+
+def test_amp_debugging_surface_and_tensor_checker():
+    from paddle_tpu.amp import debugging as dbg
+    missing = sorted(n for n in _ref_all("amp/debugging.py")
+                     if not hasattr(dbg, n))
+    assert not missing, missing
+    cfg = dbg.TensorCheckerConfig(enable=True)
+    dbg.enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.ones(2, "float32"))
+        with pytest.raises(RuntimeError):
+            x / paddle.to_tensor(np.zeros(2, "float32"))
+    finally:
+        dbg.disable_tensor_checker()
+    _ = paddle.to_tensor(np.ones(2, "float32")) / 1.0  # checker off
+
+    class L(paddle.nn.Layer):
+        @dbg.check_layer_numerics
+        def forward(self, v):
+            return v * 2.0
+
+    out = L()(paddle.to_tensor(np.ones(2, "float32")))
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+    with pytest.raises(RuntimeError, match="inputs"):
+        L()(paddle.to_tensor(np.float32([np.nan, 1.0])))
