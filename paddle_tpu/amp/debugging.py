@@ -16,7 +16,10 @@ from .. import flags as _flags
 
 __all__ = ["collect_operator_stats", "enable_operator_stats_collection",
            "disable_operator_stats_collection", "check_numerics",
-           "operator_stats"]
+           "operator_stats", "dump_operator_stats", "DebugMode",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_layer_numerics",
+           "compare_accuracy"]
 
 _counts: Counter = Counter()
 _prev_hook = None
@@ -120,28 +123,36 @@ class TensorCheckerConfig:
         self.checked_op_list = checked_op_list
         self.skipped_op_list = skipped_op_list
         self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
 
 
-_checker_state = {"prev": None}
+_checker_stack: list = []
+
+_ABORT_MODES = (DebugMode.CHECK_NAN_INF_AND_ABORT,
+                DebugMode.CHECK_ALL_AND_ABORT)
 
 
 def enable_tensor_checker(checker_config):
     """Turn on per-op NaN/Inf checking for every dispatched op (reference:
     amp/debugging.py:634 — model-level accuracy check; here the dispatch
-    layer's FLAGS_check_nan_inf scan is the checker)."""
-    from .. import flags as _flags
-    if checker_config.enable:
-        _checker_state["prev"] = _flags.get_flags(
-            "FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
-        _flags.set_flags({"FLAGS_check_nan_inf": True})
+    layer's FLAGS_check_nan_inf scan is the checker). Calls balance with
+    disable_tensor_checker like a stack, and non-abort DebugModes map to
+    the warn level of the sanitizer."""
+    prev = _flags.get_flags(["FLAGS_check_nan_inf",
+                             "FLAGS_check_nan_inf_level"])
+    _checker_stack.append(prev)
+    if not checker_config.enable:
+        return
+    level = 0 if checker_config.debug_mode in _ABORT_MODES else 1
+    _flags.set_flags({"FLAGS_check_nan_inf": True,
+                      "FLAGS_check_nan_inf_level": level})
 
 
 def disable_tensor_checker():
-    """Reference: amp/debugging.py disable_tensor_checker."""
-    from .. import flags as _flags
-    prev = _checker_state.pop("prev", None)
-    _flags.set_flags({"FLAGS_check_nan_inf": bool(prev)
-                      if prev is not None else False})
+    """Restore the flags saved by the matching enable_tensor_checker
+    (reference: amp/debugging.py disable_tensor_checker)."""
+    if _checker_stack:
+        _flags.set_flags(_checker_stack.pop())
 
 
 def check_layer_numerics(func):
@@ -153,8 +164,18 @@ def check_layer_numerics(func):
 
     from ..core.tensor import Tensor
 
+    def _flatten(v):
+        if isinstance(v, (tuple, list)):
+            for x in v:
+                yield from _flatten(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                yield from _flatten(x)
+        else:
+            yield v
+
     def _scan(vs, what, name):
-        for v in vs:
+        for v in _flatten(vs):
             if isinstance(v, Tensor):
                 a = np.asarray(v._value)
                 if np.issubdtype(a.dtype, np.floating) \
@@ -165,20 +186,29 @@ def check_layer_numerics(func):
 
     @functools.wraps(func)
     def wrapper(self, *args, **kwargs):
-        _scan(args, "inputs", type(self).__name__)
+        _scan((args, kwargs), "inputs", type(self).__name__)
         out = func(self, *args, **kwargs)
-        _scan(out if isinstance(out, (tuple, list)) else [out], "outputs",
-              type(self).__name__)
+        _scan(out, "outputs", type(self).__name__)
         return out
 
     return wrapper
 
 
+def dump_operator_stats(path):
+    """Write the current collector counts as the JSONL dump
+    compare_accuracy consumes (one {"op", "calls"} record per op)."""
+    import json
+    with open(path, "w") as f:
+        for op, n in sorted(_counts.items()):
+            f.write(json.dumps({"op": op, "calls": int(n)}) + "\n")
+
+
 def compare_accuracy(dump_path, another_dump_path, output_filename,
                      loss_scale=1, dump_all_tensors=False):
     """Compare two operator-stats dumps (reference: amp/debugging.py:575
-    compares workerlog NaN/Inf dumps). Consumes the JSONL files this
-    module's collectors write and reports ops whose counts differ."""
+    compares workerlog NaN/Inf dumps). Consumes JSONL files written by
+    dump_operator_stats (collect stats for each run, dump, compare) and
+    reports ops whose records differ."""
     import json
 
     def load(p):
@@ -186,7 +216,7 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
         with open(p) as f:
             for line in f:
                 rec = json.loads(line)
-                out[rec["op"]] = rec
+                out[rec.get("op", "?")] = rec
         return out
 
     a, b = load(dump_path), load(another_dump_path)

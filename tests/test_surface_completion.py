@@ -347,3 +347,57 @@ def test_amp_debugging_surface_and_tensor_checker():
     np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
     with pytest.raises(RuntimeError, match="inputs"):
         L()(paddle.to_tensor(np.float32([np.nan, 1.0])))
+
+
+def test_tensor_checker_balanced_and_modes():
+    from paddle_tpu.amp import debugging as dbg
+    from paddle_tpu import flags as fl
+    fl.set_flags({"FLAGS_check_nan_inf": True})   # user-set state
+    try:
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(enable=False))
+        dbg.disable_tensor_checker()
+        assert fl.get_flags("FLAGS_check_nan_inf")[
+            "FLAGS_check_nan_inf"] is True        # restored, not clobbered
+    finally:
+        fl.set_flags({"FLAGS_check_nan_inf": False})
+    # non-abort mode warns instead of raising
+    dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+        enable=True, debug_mode=dbg.DebugMode.CHECK_NAN_INF))
+    try:
+        import warnings
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            x = paddle.to_tensor(np.ones(2, "float32"))
+            _ = x / paddle.to_tensor(np.zeros(2, "float32"))  # no raise
+    finally:
+        dbg.disable_tensor_checker()
+    assert fl.get_flags("FLAGS_check_nan_inf")[
+        "FLAGS_check_nan_inf"] is False
+    cfg = dbg.TensorCheckerConfig(enable=True, stack_height_limit=3)
+    assert cfg.stack_height_limit == 3
+
+
+def test_check_layer_numerics_kwargs_and_dump_compare(tmp_path):
+    from paddle_tpu.amp import debugging as dbg
+
+    class L(paddle.nn.Layer):
+        @dbg.check_layer_numerics
+        def forward(self, x, mask=None):
+            return {"out": x * 2.0}
+
+    bad = paddle.to_tensor(np.float32([np.nan]))
+    with pytest.raises(RuntimeError, match="inputs"):
+        L()(paddle.to_tensor(np.ones(1, "float32")), mask=bad)
+
+    with dbg.collect_operator_stats():
+        x = paddle.to_tensor(np.ones(2, "float32"))
+        _ = x + x
+    p1 = str(tmp_path / "a.jsonl")
+    dbg.dump_operator_stats(p1)
+    with dbg.collect_operator_stats():
+        _ = x + x
+        _ = x * x
+    p2 = str(tmp_path / "b.jsonl")
+    dbg.dump_operator_stats(p2)
+    rows = dbg.compare_accuracy(p1, p2, str(tmp_path / "cmp.json"))
+    assert any(r["op"] == "multiply" for r in rows)
