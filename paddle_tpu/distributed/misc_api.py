@@ -170,7 +170,6 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     Mesh-native: the weight is shard_tensor'd over 'mp'; GSPMD inserts the
     partial-sum all-reduce (linear) or gather (embedding)."""
     import paddle_tpu as paddle
-    from .auto_parallel import shard_tensor, Shard
     from . import topology as topo_mod
 
     hcg = topo_mod.get_hybrid_communicate_group()
@@ -179,15 +178,10 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
         in_f, out_f = size
         w = paddle.randn([in_f, out_f]) * (1.0 / np.sqrt(in_f))
         if mesh is not None and mesh.shape.get("mp", 1) > 1:
-            w = shard_tensor(w, topo_mod.get_process_mesh()
-                             if hasattr(topo_mod, "get_process_mesh")
-                             else mesh, [Shard(1 - axis)]) \
-                if False else w  # GSPMD route below
             from jax.sharding import NamedSharding, PartitionSpec as P
             spec = P(None, "mp") if axis == 1 else P("mp", None)
             w._value = jax.device_put(w._value, NamedSharding(mesh, spec))
-        out = paddle.matmul(x, w)
-        return out
+        return paddle.matmul(x, w)
     if operation == "embedding":
         vocab, dim = size
         w = paddle.randn([vocab, dim]) * 0.02
