@@ -2221,6 +2221,36 @@ def _strings(att):
 # gradient check. Kept as an explicit roster so a failing op is a one-line
 # change, mirroring the reference's check_grad whitelists
 # (/root/reference/test/white_list/op_accuracy_white_list.py).
+# proven-parity float ops enrolled in the bf16 dtype sweep beyond the
+# per-table flags (same whitelist idea as _EXTRA_GRAD below)
+_EXTRA_BF16 = [
+    "squeeze", "unsqueeze", "flip", "roll", "tile", "expand", "flatten",
+    "gather", "index_select", "where", "masked_fill", "diagonal", "tril",
+    "triu", "t", "moveaxis", "swapaxes", "split", "chunk", "pad",
+    "hstack", "vstack", "dstack", "add_n", "take_along_axis",
+    "amax", "amin", "std", "var", "cumsum", "cumprod", "sort", "topk",
+    "median", "clip", "trace", "diff", "lerp", "kron",
+    "mv", "dot", "cross", "tensordot", "multi_dot", "dist", "norm",
+    "nn.functional.elu", "nn.functional.celu", "nn.functional.selu",
+    "nn.functional.hardtanh", "nn.functional.hardshrink",
+    "nn.functional.softshrink", "nn.functional.glu",
+    "nn.functional.l1_loss", "nn.functional.huber_loss",
+    "nn.functional.smooth_l1_loss", "nn.functional.cross_entropy",
+    "nn.functional.nll_loss", "nn.functional.cosine_similarity",
+    "nn.functional.embedding", "nn.functional.one_hot",
+    "nn.functional.batch_norm", "nn.functional.group_norm",
+    "nn.functional.instance_norm", "nn.functional.dropout",
+    "nn.functional.interpolate", "nn.functional.pixel_shuffle",
+    "nn.functional.sequence_mask", "nn.functional.label_smooth",
+    "incubate.nn.functional.fused_matmul_bias",
+    "incubate.nn.functional.fused_layer_norm",
+    "incubate.nn.functional.fused_rms_norm",
+    "incubate.softmax_mask_fuse_upper_triangle",
+    "geometric.segment_sum", "geometric.segment_mean",
+    "geometric.send_u_recv",
+]
+
+
 _EXTRA_GRAD = [
     # manipulation (linear in x)
     "hstack", "vstack", "dstack", "column_stack", "tensor_split", "hsplit",
@@ -2280,3 +2310,8 @@ def _install_extra_grad():
         if spec is not None and spec.grad is None \
                 and spec.sample is not None:
             spec.grad = True
+    for name in _EXTRA_BF16:
+        spec = schema.OPS.get(name)
+        if spec is not None and spec.sample is not None \
+                and spec.np_ref is not None:
+            spec.bf16 = True

@@ -220,6 +220,7 @@ def test_coverage_floor():
     assert sampled >= 500, sampled
     assert with_ref >= 440, with_ref
     assert grad_checked >= 300, grad_checked
+    assert len(BF16) >= 180, len(BF16)
     # tensor-method artifacts generated from the same rows
     method_count = sum(
         1 for s in schema.OPS.values() if s.tensor_method
