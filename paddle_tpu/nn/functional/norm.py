@@ -57,13 +57,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     out, mean, var = apply("batch_norm_train", _bn_train_impl, (xx, w, b),
                            {"epsilon": float(epsilon), "channel_axis": channel_axis})
     if running_mean is not None:
-        rm = wrap(running_mean)
         n = xx.size // xx.shape[channel_axis]
-        unbiased = var._value * (n / max(n - 1, 1))
-        rm._value = rm._value * momentum + mean._value * (1 - momentum)
-        rv = wrap(running_var)
-        rv._value = rv._value * momentum + unbiased * (1 - momentum)
+        update_running_stats(wrap(running_mean), wrap(running_var),
+                             mean, var, momentum, n)
     return out
+
+
+def update_running_stats(running_mean, running_var, mean, var, momentum, n):
+    """Reference BN running-stat blend (momentum + unbiased variance) —
+    shared by F.batch_norm and the fused resblock path (models/resnet.py)."""
+    unbiased = var._value * (n / max(n - 1, 1))
+    running_mean._value = (running_mean._value * momentum
+                           + mean._value * (1 - momentum))
+    running_var._value = (running_var._value * momentum
+                          + unbiased * (1 - momentum))
 
 
 def _layer_norm_impl(x, w, b, *, epsilon, begin_axis):
