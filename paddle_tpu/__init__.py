@@ -8,6 +8,17 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_PRNG", "rbg") == "rbg":
+    # XLA RngBitGenerator keys: ~10x cheaper dropout-mask generation on TPU
+    # than threefry (measured 17ms/step of the BERT fine-tune bench), same
+    # determinism-under-seed contract. PADDLE_TPU_PRNG=threefry restores
+    # the jax default (e.g. to reproduce old checkpointed RNG streams).
+    import jax as _jax
+
+    _jax.config.update("jax_default_prng_impl", "rbg")
+
 from .core.tensor import Tensor, to_tensor
 from .core.dtype import (
     bool_ as bool8, uint8, int8, int16, int32, int64, float16, bfloat16,

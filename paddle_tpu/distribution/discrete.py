@@ -19,7 +19,8 @@ class Poisson(Distribution):
 
     def _sample(self, key, shape):
         shp = tuple(shape) + self.rate.shape
-        return jax.random.poisson(key, self.rate, shp).astype(self.rate.dtype)
+        from ..ops.random import _threefry_key
+        return jax.random.poisson(_threefry_key(key), self.rate, shp).astype(self.rate.dtype)
 
     _rsample = _sample  # counts are not reparameterizable
 

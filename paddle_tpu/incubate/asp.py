@@ -1,0 +1,369 @@
+"""Automatic SParsity (ASP): n:m structured sparsity for training and
+inference.
+
+Reference: python/paddle/incubate/asp/asp.py (decorate:216,
+prune_model:302), supported_layer_list.py:33 (_default_pruning — prune
+along the k/input dimension via the double-transpose convention),
+utils.py (mask_1d/mask_2d_greedy/mask_2d_best generators + checkers).
+
+TPU notes: masks are plain jnp 0/1 tensors multiplied into the weights —
+XLA folds the multiply into the consumer matmul's operand load, and on
+sparse-core TPU generations the 2:4 pattern is directly exploitable.
+`decorate(optimizer)` re-applies the masks after every `step()`, so the
+n:m pattern survives dense optimizer updates (same contract as the
+reference's OptimizerWithSparsityGuarantee.step: asp.py:957).
+"""
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+
+import numpy as np
+
+__all__ = [
+    "calculate_density",
+    "decorate",
+    "prune_model",
+    "set_excluded_layers",
+    "reset_excluded_layers",
+    "add_supported_layer",
+    "MaskAlgo",
+    "CheckMethod",
+    "create_mask",
+    "check_sparsity",
+    "get_mask_1d",
+    "check_mask_1d",
+    "get_mask_2d_greedy",
+    "get_mask_2d_best",
+    "check_mask_2d",
+]
+
+
+class MaskAlgo(Enum):
+    """Reference: utils.py:30."""
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    """Reference: utils.py:40."""
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        if mask_algo == MaskAlgo.MASK_1D:
+            return CheckMethod.CHECK_1D
+        return CheckMethod.CHECK_2D
+
+
+def calculate_density(x):
+    """Fraction of nonzeros (reference utils.py:78)."""
+    a = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(a)) / a.size
+
+
+def _reshape_1d(mat, m):
+    """Pad cols to a multiple of m and view as [-1, m] (utils.py:106)."""
+    h, w = mat.shape
+    pad = (m - w % m) % m
+    if pad:
+        mat = np.concatenate([mat, np.zeros((h, pad), mat.dtype)], axis=1)
+    return mat.reshape(-1, m), mat.shape
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest |values| in every m-length row chunk
+    (utils.py:184)."""
+    mat = np.asarray(mat)
+    flat, padded_shape = _reshape_1d(mat, m)
+    idx = np.argsort(np.abs(flat), axis=1)[:, m - n:]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    mask = mask.reshape(padded_shape)[:, : mat.shape[1]]
+    return mask
+
+
+def check_mask_1d(mat, n, m):
+    """Every m-chunk of every row has at most n nonzeros (utils.py:134)."""
+    mat = np.asarray(mat)
+    flat, _ = _reshape_1d(mat, m)
+    return bool(np.all(np.count_nonzero(flat, axis=1) <= n))
+
+
+def _reshape_2d(mat, m):
+    """Pad both dims to multiples of m and view as m x m blocks
+    (utils.py:226): returns [-1, m*m] where each row is one block."""
+    h, w = mat.shape
+    ph, pw = (m - h % m) % m, (m - w % m) % m
+    if ph or pw:
+        mat = np.pad(mat, ((0, ph), (0, pw)))
+    H, W = mat.shape
+    blocks = mat.reshape(H // m, m, W // m, m).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, m * m), (H, W)
+
+
+def check_mask_2d(mat, n, m):
+    """Every m x m block has at most n nonzeros per row AND per column
+    (utils.py:269)."""
+    mat = np.asarray(mat)
+    blocks, _ = _reshape_2d(mat, m)
+    b = blocks.reshape(-1, m, m) != 0
+    return bool(np.all(b.sum(axis=2) <= n) and np.all(b.sum(axis=1) <= n))
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy per-block 2D n:m mask (utils.py:326): repeatedly take the
+    largest remaining |value| whose row and column budgets are free."""
+    mat = np.asarray(mat)
+    blocks, (H, W) = _reshape_2d(mat, m)
+    masks = np.zeros_like(blocks)
+    for bi in range(blocks.shape[0]):
+        blk = np.abs(blocks[bi].reshape(m, m))
+        order = np.argsort(-blk, axis=None)
+        rows = np.zeros(m, np.int64)
+        cols = np.zeros(m, np.int64)
+        mk = np.zeros((m, m))
+        for o in order:
+            r, c = divmod(int(o), m)
+            if rows[r] < n and cols[c] < n:
+                mk[r, c] = 1.0
+                rows[r] += 1
+                cols[c] += 1
+        masks[bi] = mk.reshape(-1)
+    out = masks.reshape(H // m, W // m, m, m).transpose(0, 2, 1, 3)
+    out = out.reshape(H, W)[: mat.shape[0], : mat.shape[1]]
+    return out
+
+
+_valid_2d_patterns_cache: dict = {}
+
+
+def _compute_valid_2d_patterns(n, m):
+    """All m x m 0/1 patterns with exactly n per row and per column
+    (utils.py:401)."""
+    key = (n, m)
+    if key in _valid_2d_patterns_cache:
+        return _valid_2d_patterns_cache[key]
+    row_patterns = [p for p in itertools.product((0.0, 1.0), repeat=m)
+                    if sum(p) == n]
+    valid = []
+    for rows in itertools.product(row_patterns, repeat=m):
+        a = np.array(rows)
+        if np.all(a.sum(axis=0) == n):
+            valid.append(a)
+    pats = np.stack(valid)
+    _valid_2d_patterns_cache[key] = pats
+    return pats
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive best per-block 2D mask: the valid pattern maximizing the
+    kept |weight| mass (utils.py:442)."""
+    mat = np.asarray(mat)
+    pats = _compute_valid_2d_patterns(n, m)  # [P, m, m]
+    blocks, (H, W) = _reshape_2d(mat, m)
+    absb = np.abs(blocks.reshape(-1, m, m))
+    scores = np.einsum("bij,pij->bp", absb, pats)
+    best = pats[np.argmax(scores, axis=1)]  # [B, m, m]
+    out = best.reshape(H // m, W // m, m, m).transpose(0, 2, 1, 3)
+    out = out.reshape(H, W)[: mat.shape[0], : mat.shape[1]]
+    return out
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """Reference utils.py:498: rank-2/3/4 tensors are viewed as 2D (conv
+    [o,i,h,w] -> [o, i*h*w]-style flattening per the reference)."""
+    if isinstance(func_name, str):
+        func_name = MaskAlgo(func_name) if func_name.startswith("get_") \
+            else MaskAlgo[func_name.upper()]
+    t = np.asarray(tensor.numpy() if hasattr(tensor, "numpy") else tensor)
+    shape = t.shape
+    dtype = t.dtype
+    if t.ndim == 1:
+        t2 = t.reshape(1, -1)
+    elif t.ndim == 2:
+        t2 = t
+    elif t.ndim == 3:
+        t2 = t.reshape(shape[0] * shape[1], shape[2])
+    elif t.ndim == 4:
+        # conv weight [o, i, h, w] -> [h*w*o, i] grouping matches the
+        # reference's transpose-to-[.., i] convention
+        t2 = t.transpose(2, 3, 0, 1).reshape(-1, shape[1])
+    else:
+        raise ValueError(
+            f"create_mask: unsupported rank {t.ndim} (expect 1-4)")
+    fn = globals()[func_name.value]
+    mask2 = fn(t2, n, m)
+    if t.ndim == 1:
+        mask = mask2.reshape(shape)
+    elif t.ndim == 2:
+        mask = mask2
+    elif t.ndim == 3:
+        mask = mask2.reshape(shape)
+    else:
+        mask = mask2.reshape(shape[2], shape[3], shape[0],
+                             shape[1]).transpose(2, 3, 0, 1)
+    return mask.astype(dtype)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    """Reference utils.py:569."""
+    if isinstance(func_name, str):
+        func_name = CheckMethod(func_name) if func_name.startswith("check_") \
+            else CheckMethod[func_name.upper()]
+    t = np.asarray(tensor.numpy() if hasattr(tensor, "numpy") else tensor)
+    if t.ndim == 1:
+        t2 = t.reshape(1, -1)
+    elif t.ndim == 2:
+        t2 = t
+    elif t.ndim == 3:
+        t2 = t.reshape(t.shape[0] * t.shape[1], t.shape[2])
+    elif t.ndim == 4:
+        t2 = t.transpose(2, 3, 0, 1).reshape(-1, t.shape[1])
+    else:
+        raise ValueError(f"check_sparsity: unsupported rank {t.ndim}")
+    return bool(globals()[func_name.value](t2, n, m))
+
+
+# ----------------------------------------------------------------- helper
+
+
+_excluded_param_names: set = set()
+_custom_supported: dict = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Reference asp.py:40 (dynamic-graph path; main_program accepted for
+    API parity)."""
+    for n in param_names:
+        _excluded_param_names.add(str(n))
+
+
+def reset_excluded_layers(main_program=None):
+    """Reference asp.py:127."""
+    _excluded_param_names.clear()
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Reference supported_layer_list.py add_supported_layer: register a
+    layer class (or type name) whose `weight` should be pruned, with an
+    optional custom (weight_np, m, n, func_name, name) -> (pruned, mask)
+    function."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _custom_supported[name] = pruning_func
+
+
+def _supported(layer) -> bool:
+    from ..nn import Linear, Conv2D
+    if type(layer).__name__ in _custom_supported:
+        return True
+    return isinstance(layer, (Linear, Conv2D))
+
+
+def _default_pruning(weight_np, m, n, func_name, param_name):
+    """Reference supported_layer_list.py:33 — prune along the k dimension
+    (the double-transpose convention: masks are generated row-major on
+    W^T so the n:m groups run along the input/contraction axis)."""
+    shape = weight_np.shape
+    if (weight_np.ndim == 2 and shape[0] < m) or \
+            (weight_np.ndim == 4 and shape[1] < m):
+        return weight_np, np.ones_like(weight_np)
+    if weight_np.ndim == 2:
+        mask = create_mask(weight_np.T, func_name=func_name, n=n, m=m).T
+    else:
+        mask = create_mask(weight_np, func_name=func_name, n=n, m=m)
+    pruned = weight_np * mask
+    checker = CheckMethod.get_checking_method(func_name)
+    target = pruned.T if weight_np.ndim == 2 else pruned
+    assert check_sparsity(target, n=n, m=m, func_name=checker), \
+        f"Pruning {param_name} weight matrix failure"
+    return pruned, mask
+
+
+class ASPInfo:
+    """Per-process registry of (parameter -> mask Tensor)."""
+
+    def __init__(self):
+        self.masks = {}  # param name -> Tensor mask
+
+    def clear(self):
+        self.masks.clear()
+
+
+_asp_info = ASPInfo()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Reference asp.py:302: prune supported layers of `model` to the n:m
+    pattern; returns {param_name: mask Tensor}. with_mask=True records
+    masks so a decorated optimizer keeps re-applying them."""
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    algo = MaskAlgo[mask_algo.upper()] if not mask_algo.startswith("get_") \
+        else MaskAlgo(mask_algo)
+    masks = {}
+    for lname, sub in model.named_sublayers(include_self=True):
+        if not _supported(sub):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None:
+            continue
+        pname = getattr(w, "name", None) or f"{lname}.weight"
+        if pname in _excluded_param_names or lname in _excluded_param_names:
+            continue
+        fn = _custom_supported.get(type(sub).__name__) or _default_pruning
+        w_np = np.asarray(w.numpy(), dtype=np.float32)
+        pruned, mask = fn(w_np, m, n, algo, pname)
+        w._value = jnp.asarray(pruned).astype(w._value.dtype)
+        mask_t = Tensor(jnp.asarray(mask, dtype=jnp.float32))
+        mask_t.stop_gradient = True
+        masks[pname] = mask_t
+        if with_mask:
+            _asp_info.masks[pname] = (w, mask_t)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Reference asp.py:918: step() = inner step, then re-mask params so
+    dense updates cannot break the n:m pattern."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        _apply_masks()
+
+    def state_dict(self):
+        sd = self._optimizer.state_dict()
+        for name, (_, mask) in _asp_info.masks.items():
+            sd[f"asp_mask::{name}"] = mask
+        return sd
+
+    def set_state_dict(self, state_dict):
+        from ..core.tensor import Tensor
+        for key in [k for k in state_dict if k.startswith("asp_mask::")]:
+            name = key[len("asp_mask::"):]
+            val = state_dict.pop(key)
+            if name in _asp_info.masks:
+                w, _ = _asp_info.masks[name]
+                _asp_info.masks[name] = (
+                    w, val if isinstance(val, Tensor) else Tensor(val))
+        return self._optimizer.set_state_dict(state_dict)
+
+
+def _apply_masks():
+    for _, (w, mask) in _asp_info.masks.items():
+        w._value = w._value * mask._value.astype(w._value.dtype)
+
+
+def decorate(optimizer):
+    """Reference asp.py:216."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -175,23 +175,48 @@ def _pads(padding):
     return begins + ends
 
 
+def _to_nchw(em, x, n_spatial):
+    """NHWC -> NCHW transpose node (ONNX Conv/Pool are channels-first)."""
+    perm = [0, n_spatial + 1] + list(range(1, n_spatial + 1))
+    t = em.fresh("nchw")
+    em.add("Transpose", [x], [t], perm=perm)
+    return t
+
+
+def _from_nchw(em, x, out, n_spatial):
+    perm = [0] + list(range(2, n_spatial + 2)) + [1]
+    em.add("Transpose", [x], [out], perm=perm)
+
+
 def _emit_op(em, name, statics, ins, outs):
     o = outs[0]
     if name in ("conv_bias", "conv"):
-        if statics.get("channel_last"):
-            raise NotImplementedError("onnx export: NHWC conv")
-        em.add("Conv", ins, [o],
+        nsp = statics.get("n_spatial", 2)
+        cl = statics.get("channel_last")
+        x_in = _to_nchw(em, ins[0], nsp) if cl else ins[0]
+        conv_out = em.fresh("conv_nchw") if cl else o
+        # weight stays OIHW in both layouts (the layer's native layout)
+        em.add("Conv", [x_in] + list(ins[1:]), [conv_out],
                strides=list(statics["stride"]),
                pads=_pads(statics["padding"]),
                dilations=list(statics["dilation"]),
                group=statics.get("groups", 1))
+        if cl:
+            _from_nchw(em, conv_out, o, nsp)
     elif name in ("max_pool", "avg_pool", "pool"):
         kind = statics.get("kind", "max" if name == "max_pool" else "avg")
-        em.add("MaxPool" if kind == "max" else "AveragePool", ins[:1], [o],
+        nsp = statics.get("n_spatial", 2)
+        cl = statics.get("channel_last")
+        x_in = _to_nchw(em, ins[0], nsp) if cl else ins[0]
+        pool_out = em.fresh("pool_nchw") if cl else o
+        em.add("MaxPool" if kind == "max" else "AveragePool", [x_in],
+               [pool_out],
                kernel_shape=list(statics["kernel_size"]),
                strides=list(statics["stride"]),
                pads=_pads(statics["padding"]),
                ceil_mode=int(statics.get("ceil_mode", False)))
+        if cl:
+            _from_nchw(em, pool_out, o, nsp)
     elif name == "linear":
         has_bias = len(ins) > 2 and ins[2]
         mm = em.fresh("mm") if has_bias else o
@@ -235,6 +260,23 @@ def _emit_op(em, name, statics, ins, outs):
         em.add("Add", [e, one], [p])
         em.add("Mul", [x, p], [m])
         em.add("Mul", [m, h], [o])
+    elif name == "batch_norm_infer":
+        # _bn_infer_impl input order: (x, mean, var, w, b); ONNX
+        # BatchNormalization wants (X, scale, B, mean, var), NCHW only —
+        # channels-last wraps in transposes (rank = channel_axis+1 there)
+        ca = statics.get("channel_axis", 1)
+        x, mean, var, w, b = ins[:5]
+        eps = float(statics.get("epsilon", 1e-5))
+        if ca == 1:
+            em.add("BatchNormalization", [x, w, b, mean, var], [o],
+                   epsilon=eps)
+        else:
+            nsp = ca - 1
+            xin = _to_nchw(em, x, nsp)
+            bn = em.fresh("bn_nchw")
+            em.add("BatchNormalization", [xin, w, b, mean, var], [bn],
+                   epsilon=eps)
+            _from_nchw(em, bn, o, nsp)
     elif name == "layer_norm":
         em.add("LayerNormalization", ins, [o],
                axis=statics.get("begin_axis", -1),
@@ -551,6 +593,13 @@ def reference_run(model: OnnxModel, feeds):
             out = out * ival[1]
             if len(ival) > 2 and ival[2] is not None:
                 out = out + ival[2]
+        elif t == "BatchNormalization":
+            x, w, b, mean, var = ival[:5]
+            shp = [1] * x.ndim
+            shp[1] = -1
+            out = (x - mean.reshape(shp)) / np.sqrt(
+                var.reshape(shp) + a.get("epsilon", 1e-5))
+            out = out * w.reshape(shp) + b.reshape(shp)
         elif t == "Conv":
             nsp = ival[0].ndim - 2
             pads = a.get("pads", [0] * (2 * nsp))

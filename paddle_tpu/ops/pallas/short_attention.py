@@ -1,0 +1,193 @@
+"""Fused short-sequence attention with in-kernel dropout (Pallas TPU).
+
+Reference analog: phi/kernels/fusion flash_attn with dropout (the
+reference's flash kernel draws its dropout mask inside the kernel from a
+Philox counter; ours uses the TPU PRNG via pltpu.prng_random_bits).
+
+Why: at BERT-class shapes (seq<=256) the composed SDPA path materializes
+[B, H, S, S] probabilities through HBM four times per layer (fwd probs,
+saved-for-bwd read, dprobs, plus the dropout mask) and pays q/k/v
+head-transpose relayouts. This kernel keeps the whole [S, S] score matrix
+per (batch, head) in VMEM, applies softmax + dropout + the value matmul
+in one pass, and saves NOTHING for backward: the backward kernel
+recomputes scores/probs and replays the identical PRNG stream (same
+seed, same program_id, same draw order) to rebuild the mask — flash
+attention's memory-free dropout trick.
+
+Layout is the model's native [B, S, H, D] (no head transpose); one grid
+step processes all H heads of one batch element with an unrolled loop of
+2-D MXU matmuls.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _mm(a, b):
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _mm_t(a, b):
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _mm_tn(a, b):
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _probs(q, k, scale, causal, S):
+    s = _mm_t(q, k) * scale                      # [S, S] f32
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where(col <= row, s, -1e30)
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    return e / jnp.sum(e, axis=1, keepdims=True)
+
+
+def _drop_mask(S, p):
+    """Multiplicative keep-mask drawn from the in-kernel PRNG stream:
+    keep with prob 1-p, scaled by 1/(1-p). Caller must have seeded."""
+    bits = pltpu.prng_random_bits((S, S))        # int32
+    # uniform in [0, 2^32) via unsigned view
+    u = bits.astype(jnp.uint32)  # wrap-mod convert == bit pattern
+    thresh = np.uint32(min(int(p * 2.0 ** 32), 0xFFFFFFFF))
+    keep = u >= thresh
+    return jnp.where(keep, 1.0 / (1.0 - p), 0.0)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, scale, p, causal):
+    _, H, S, D = q_ref.shape
+    if p > 0.0:
+        pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    for h in range(H):
+        q = q_ref[0, h]
+        k = k_ref[0, h]
+        v = v_ref[0, h]
+        probs = _probs(q, k, scale, causal, S)
+        if p > 0.0:
+            probs = probs * _drop_mask(S, p)
+        o_ref[0, h] = _mm(probs.astype(q.dtype), v).astype(o_ref.dtype)
+
+
+def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, *, scale, p, causal):
+    _, H, S, D = q_ref.shape
+    if p > 0.0:
+        # identical seeding + draw order as the forward -> identical masks
+        pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    for h in range(H):
+        q = q_ref[0, h]
+        k = k_ref[0, h]
+        v = v_ref[0, h]
+        do = do_ref[0, h].astype(jnp.float32)
+        probs = _probs(q, k, scale, causal, S)
+        if p > 0.0:
+            mask = _drop_mask(S, p)
+            pm = probs * mask
+        else:
+            mask = None
+            pm = probs
+        pmb = pm.astype(q.dtype)
+        dob = do.astype(q.dtype)
+        dv_ref[0, h] = _mm_tn(pmb, dob).astype(dv_ref.dtype)
+        dpm = _mm_t(dob, v)                      # [S, S] f32
+        dprobs = dpm * mask if mask is not None else dpm
+        row = jnp.sum(dprobs * probs, axis=1, keepdims=True)
+        ds = (probs * (dprobs - row)).astype(q.dtype)
+        dq_ref[0, h] = (_mm(ds, k) * scale).astype(dq_ref.dtype)
+        dk_ref[0, h] = (_mm_tn(ds, q) * scale).astype(dk_ref.dtype)
+
+
+def _specs(B, S, H, D):
+    # kernel-internal layout [B, H, S, D]: per-head slices index leading
+    # dims only (Mosaic cannot store through a middle-dim slice)
+    blk = pl.BlockSpec((1, H, S, D), lambda i: (i, 0, 0, 0),
+                       memory_space=pltpu.VMEM)
+    seed = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return seed, blk
+
+
+def _to_hsd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def short_attention(q, k, v, seed, p=0.0, causal=False, interpret=None):
+    """q/k/v [B, S, H, D] (model layout, no head transpose); seed int32[1].
+    Returns [B, S, H, D]. Dropout (p>0) is drawn in-kernel; gradients
+    replay the stream, so nothing is saved but q/k/v."""
+    out, _ = _fwd_rule(q, k, v, seed, p, causal, interpret)
+    return out
+
+
+def _fwd_call(q, k, v, seed, p, causal, interpret):
+    if interpret is None:
+        interpret = _default_interpret()
+    B, S, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    seed_spec, blk = _specs(B, S, H, D)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, p=p, causal=causal),
+        grid=(B,),
+        in_specs=[seed_spec, blk, blk, blk],
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_specs=blk,
+        interpret=interpret,
+    )(seed, _to_hsd(q), _to_hsd(k), _to_hsd(v))
+    return _to_hsd(out)
+
+
+def _fwd_rule(q, k, v, seed, p, causal, interpret):
+    out = _fwd_call(q, k, v, seed, p, causal, interpret)
+    return out, (q, k, v, seed)
+
+
+def _bwd_rule(p, causal, interpret, res, do):
+    q, k, v, seed = res
+    if interpret is None:
+        interpret = _default_interpret()
+    B, S, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    seed_spec, blk = _specs(B, S, H, D)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, p=p, causal=causal),
+        grid=(B,),
+        in_specs=[seed_spec, blk, blk, blk, blk],
+        out_shape=(jax.ShapeDtypeStruct((B, H, S, D), q.dtype),) * 3,
+        out_specs=(blk,) * 3,
+        interpret=interpret,
+    )(seed, _to_hsd(q), _to_hsd(k), _to_hsd(v), _to_hsd(do))
+    dq, dk, dv = _to_hsd(dq), _to_hsd(dk), _to_hsd(dv)
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseed
+
+
+short_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+def supported(q_shape, attn_mask, dtype) -> bool:
+    """Kernel applicability: short seq, no additive mask (the composed
+    path handles masks), head_dim lane-friendly, TPU-sized dims."""
+    B, S, H, D = q_shape
+    return S <= 512 and S % 8 == 0 and D % 8 == 0 and attn_mask is None
+
+
+def supports_p(p) -> bool:
+    """p=1.0 would divide by zero in the keep-mask scale; the composed
+    path handles that degenerate case."""
+    return 0.0 <= p < 1.0

@@ -201,9 +201,24 @@ def bernoulli_(x, p=0.5, name=None):
         next_key(), p, tuple(x.shape)).astype(x.dtype))
 
 
+def _threefry_key(k):
+    """jax.random.poisson supports only the threefry impl; derive a
+    threefry key from whatever the session default (e.g. rbg) produced."""
+    import jax.numpy as _jnp
+    if jnp.issubdtype(k.dtype, jax.dtypes.prng_key):
+        kd = jax.random.key_data(k)
+    else:
+        kd = k
+    kd = _jnp.ravel(kd)
+    if kd.shape[0] < 2:
+        kd = _jnp.concatenate([kd, kd])
+    return jax.random.wrap_key_data(kd[:2].astype(_jnp.uint32),
+                                    impl="threefry2x32")
+
+
 def poisson(x, name=None):
     xx = wrap(x)
-    return Tensor(jax.random.poisson(next_key(), xx._value).astype(xx.dtype))
+    return Tensor(jax.random.poisson(_threefry_key(next_key()), xx._value).astype(xx.dtype))
 
 
 def binomial(count, prob, name=None):

@@ -1,0 +1,101 @@
+"""Automatic SParsity (incubate.asp) — reference parity:
+python/paddle/incubate/asp/asp.py:216 (decorate), :302 (prune_model),
+utils.py mask generators/checkers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+def test_mask_1d_pattern():
+    rng = np.random.RandomState(0)
+    mat = rng.randn(8, 16)
+    mask = asp.get_mask_1d(mat, 2, 4)
+    assert asp.check_mask_1d(mask, 2, 4)
+    # keeps exactly the 2 largest |values| per 4-chunk
+    chunk = np.abs(mat[0, :4])
+    kept = mask[0, :4].astype(bool)
+    assert set(np.argsort(chunk)[-2:]) == set(np.where(kept)[0])
+
+
+def test_mask_2d_best_and_greedy():
+    rng = np.random.RandomState(1)
+    mat = rng.randn(8, 8)
+    for fn in (asp.get_mask_2d_greedy, asp.get_mask_2d_best):
+        mask = fn(mat, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4), fn.__name__
+    # best >= greedy in kept weight mass
+    g = np.abs(mat * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+    b = np.abs(mat * asp.get_mask_2d_best(mat, 2, 4)).sum()
+    assert b >= g - 1e-9
+
+
+def test_density():
+    x = np.zeros((4, 4)); x[0, 0] = 1.0
+    assert asp.calculate_density(x) == 1 / 16
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _weight_is_nm(w, n=2, m=4):
+    # pruning runs along the input (k) dim: check columns of W [in, out]
+    return asp.check_sparsity(np.asarray(w.numpy()).T, n=n, m=m,
+                              func_name=asp.CheckMethod.CHECK_1D)
+
+
+def test_prune_train_keeps_pattern_and_learns():
+    paddle.seed(0)
+    asp.reset_excluded_layers()
+    net = TinyNet()
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(32, 16).astype("float32"))
+    y = paddle.to_tensor((rng.rand(32) * 4).astype("int64"))
+
+    # few dense steps, then prune, then sparse fine-tune
+    for _ in range(3):
+        loss = paddle.nn.functional.cross_entropy(net(x), y).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+    masks = asp.prune_model(net, n=2, m=4, mask_algo="mask_1d")
+    assert len(masks) == 2
+    assert _weight_is_nm(net.fc1.weight)
+    losses = []
+    for _ in range(20):
+        loss = paddle.nn.functional.cross_entropy(net(x), y).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    # pattern survives dense optimizer updates
+    assert _weight_is_nm(net.fc1.weight)
+    assert _weight_is_nm(net.fc2.weight)
+    assert losses[-1] < losses[0]
+
+
+def test_excluded_layers():
+    paddle.seed(0)
+    asp.reset_excluded_layers()
+    net = TinyNet()
+    asp.set_excluded_layers(["fc2.weight"])
+    try:
+        masks = asp.prune_model(net, n=2, m=4)
+        assert not any("fc2" in k for k in masks)
+        assert any("fc1" in k for k in masks)
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_small_dim_not_pruned():
+    w = np.random.randn(2, 8)  # first dim < m on [in,out] layout
+    pruned, mask = asp._default_pruning(w, 4, 2, asp.MaskAlgo.MASK_1D, "w")
+    assert np.all(mask == 1)

@@ -1,0 +1,59 @@
+"""Fused short-sequence attention kernel (ops/pallas/short_attention.py):
+interpret-mode parity with composed attention at p=0 (the in-kernel PRNG
+has no CPU lowering, so dropout>0 is exercised on real TPU only — the
+BERT bench path)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.ops.pallas import short_attention as sa  # noqa: E402
+
+
+def _ref(q, k, v, causal):
+    B, S, H, D = q.shape
+    qt, kt, vt = (jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v))
+    s = qt @ jnp.swapaxes(kt, -1, -2) / np.sqrt(D)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    return jnp.transpose(jax.nn.softmax(s, axis=-1) @ vt, (0, 2, 1, 3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_backward_parity(causal):
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 16, 3, 8
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+               for _ in range(3))
+    seed = jnp.zeros((1,), jnp.int32)
+    out = sa.short_attention(q, k, v, seed, 0.0, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v,
+                                                                causal)),
+                               atol=1e-5)
+    cot = jnp.cos(jnp.arange(q.size).reshape(q.shape))
+    g1 = jax.grad(lambda a, b, c: jnp.sum(
+        sa.short_attention(a, b, c, seed, 0.0, causal) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda a, b, c: jnp.sum(_ref(a, b, c, causal) * cot),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_supported_gate():
+    assert sa.supported((8, 128, 12, 64), None, None)
+    assert not sa.supported((8, 1024, 12, 64), None, None)  # long seq
+    assert not sa.supported((8, 130, 12, 64), None, None)   # ragged seq
+
+
+def test_sdpa_route_is_gated_off_by_default():
+    """PADDLE_TPU_SHORT_ATTENTION defaults off (measured slower in-model
+    than the XLA-fused composed path on v5e; kept as the in-kernel-dropout
+    capability, reference flash_attn-with-dropout analog)."""
+    import os
+
+    from paddle_tpu.nn.functional import attention as A
+    if os.environ.get("PADDLE_TPU_SHORT_ATTENTION"):
+        pytest.skip("explicitly enabled in this environment")
+    assert A._SHORT_ATTN is False

@@ -1,0 +1,106 @@
+"""On-chip ablation of the BERT fine-tune bench step (BASELINE config 2):
+which parts of the step cost the MFU gap vs the matmul-only ideal."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(name, overrides=None, patch=None, batch=128, steps=15, seq=128):
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import importlib
+    B = importlib.import_module("paddle_tpu.models.bert")
+
+    overrides = dict(overrides or {})
+
+    def loss_fn(m, ids, labels):
+        return paddle.nn.functional.cross_entropy(m(ids), labels).mean()
+
+    paddle.seed(0)
+    undo = patch(B) if patch else None
+    try:
+        model = B.bert_for_sequence_classification(
+            "bert_base", num_labels=2, **overrides)
+        opt = paddle.optimizer.AdamW(learning_rate=2e-5,
+                                     parameters=model.parameters())
+        mesh = dist.build_mesh(dp=-1, devices=jax.devices()[:1])
+        eng = dist.parallelize(model, opt, loss_fn=loss_fn, mesh=mesh,
+                               compute_dtype="bfloat16")
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, 30522, (batch, seq)).astype("int32"))
+        labels = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype("int64"))
+        float(eng.train_batch(ids, labels))  # compile+fence
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            loss = None
+            for _ in range(steps):
+                loss = eng.train_batch(ids, labels)
+            float(loss)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        sps = batch / best
+        print(f"{name:42s}: {best*1e3:7.2f} ms/step  {sps:8.1f} seq/s")
+        return best
+    finally:
+        if undo:
+            undo()
+
+
+def patch_no_attention(B):
+    orig = B.BertSelfAttention.forward
+
+    def fwd(self, x, attn_bias=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x)
+        return self.out(qkv[:, :, :h])
+
+    B.BertSelfAttention.forward = fwd
+    return lambda: setattr(B.BertSelfAttention, "forward", orig)
+
+
+def patch_no_embeddings(B):
+    orig = B.BertEmbeddings.forward
+
+    def fwd(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as paddle
+        h = self.word_embeddings.weight.shape[1]
+        x = (input_ids.astype("float32") * 0.001).unsqueeze(-1) \
+            * paddle.ones([h])
+        return self.dropout(self.layer_norm(x))
+
+    B.BertEmbeddings.forward = fwd
+    return lambda: setattr(B.BertEmbeddings, "forward", orig)
+
+
+def patch_no_layernorm(B):
+    import paddle_tpu.nn as nn
+    orig = nn.LayerNorm.forward
+    nn.LayerNorm.forward = lambda self, x: x
+    return lambda: setattr(nn.LayerNorm, "forward", orig)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["base", "nodrop", "noattn", "noln", "bs256"]
+    if "base" in which:
+        run("baseline (bs=128)")
+    if "nodrop" in which:
+        run("dropout=0", {"hidden_dropout_prob": 0.0,
+                          "attention_probs_dropout_prob": 0.0})
+    if "noattn" in which:
+        run("attention core removed", patch=patch_no_attention)
+    if "noln" in which:
+        run("layernorm removed", patch=patch_no_layernorm)
+    if "bs256" in which:
+        run("bs=256", batch=256)
+    if "noemb" in which:
+        run("embedding lookups removed", patch=patch_no_embeddings)
+    if "bs256nodrop" in which:
+        run("bs=256 + dropout=0", {"hidden_dropout_prob": 0.0,
+                                   "attention_probs_dropout_prob": 0.0},
+            batch=256)
