@@ -363,6 +363,12 @@ def main():
         for fn in (bench_resnet50, bench_bert_finetune, bench_lora_decode):
             os.environ.pop("BENCH_MODEL", None)
             payloads.append(fn(on_tpu, dev))
+        for wdtype in ("int8", "int4"):       # weight-only decode variants
+            os.environ["BENCH_WEIGHT_DTYPE"] = wdtype
+            try:
+                payloads.append(bench_lora_decode(on_tpu, dev))
+            finally:
+                os.environ.pop("BENCH_WEIGHT_DTYPE", None)
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_ALL.json"), "w") as f:
             json.dump(payloads, f, indent=1)

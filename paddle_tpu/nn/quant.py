@@ -7,11 +7,11 @@ CUTLASS mixed-dtype kernels gated on SM architecture.
 TPU-native redesign: the weight lives in HBM as int8 with
 per-output-channel scales; a Pallas kernel (ops/pallas/weight_only.py)
 DMAs the int8 block to VMEM and dequantizes there, halving the weight
-HBM traffic of bandwidth-bound decode. 'int4' mode clips to the int4
-range for the extra-accuracy-loss/robustness tradeoff but keeps the
-int8 container (no nibble packing yet — bandwidth equals int8). No
-SM-architecture gating: every TPU (and the CPU interpreter) runs the
-same program.
+HBM traffic of bandwidth-bound decode. 'int4' packs two nibbles per
+byte (halves packing: w[:, :k/2] in the low nibble, w[:, k/2:] in the
+high — the kernel unpacks with two half-K matmuls, no lane interleave),
+quartering the weight traffic. No SM-architecture gating: every TPU
+(and the CPU interpreter) runs the same program.
 """
 from __future__ import annotations
 
@@ -33,10 +33,11 @@ _QMAX = {"int8": 127.0, "int4": 7.0}
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     """Per-output-channel absmax quantization.
 
-    x: [in, out] float weight. Returns (quantized [out, in] int8 Tensor —
-    the reference's transposed layout — and per-channel scale [out]
-    float32). `algo`: 'weight_only_int8' or 'weight_only_int4' (int4
-    values live in an int8 container, range [-7, 7])."""
+    x: [in, out] float weight. Returns (quantized int8 Tensor — the
+    reference's transposed [out, in] layout for int8; for
+    'weight_only_int4' a HALVES-PACKED [out, in//2] nibble container
+    (see _pack_int4 for the bit layout) — and per-channel scale [out]
+    float32)."""
     dtype = algo.rsplit("_", 1)[-1]
     if dtype not in _QMAX:
         raise ValueError(f"unsupported algo {algo!r}")
@@ -56,14 +57,41 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
         scale = jnp.max(jnp.abs(w), axis=0) / qmax        # [out]
         q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-8)[None, :]),
                      -qmax, qmax).T.astype(jnp.int8)
+    if dtype == "int4":
+        q = _pack_int4(q)
     return Tensor(q), Tensor(scale.astype(jnp.float32))
+
+
+def _pack_int4(q):
+    """[out, in] int8 in [-7, 7] -> [out, in//2] halves-packed nibbles.
+    The LOW nibble stores w+8 (biased to [1, 15]) so the kernel unpacks
+    without a sign fixup; the HIGH nibble stores w (signed, recovered by
+    an arithmetic >>4). See ops/pallas/weight_only.py _kernel_int4."""
+    if q.shape[1] % 2:
+        raise ValueError(
+            f"int4 packing needs an even in-dim, got {q.shape[1]}")
+    k2 = q.shape[1] // 2
+    low = jnp.bitwise_and(q[:, :k2] + 8, 15)
+    high = jnp.left_shift(q[:, k2:], 4)
+    return jnp.bitwise_or(low, high).astype(jnp.int8)
+
+
+def _unpack_int4(p):
+    """[out, in//2] packed -> [out, in] int8 (inverse of _pack_int4)."""
+    p32 = p.astype(jnp.int32)
+    high = p32 >> 4
+    low = jnp.bitwise_and(p32, 15) - 8
+    return jnp.concatenate([low, high], axis=1).astype(jnp.int8)
 
 
 def weight_dequantize(weight, scale, algo="weight_only_int8",
                       group_size=-1, out_dtype="float32"):
-    """Inverse of weight_quantize: [out, in] int8 -> [in, out] float."""
+    """Inverse of weight_quantize: [out, in] int8 (or packed int4)
+    -> [in, out] float."""
     q = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
     s = scale._value if isinstance(scale, Tensor) else jnp.asarray(scale)
+    if algo.endswith("int4"):
+        q = _unpack_int4(q)
     w = q.T.astype(jnp.dtype(out_dtype))
     if group_size != -1:
         g = w.reshape(w.shape[0] // group_size, group_size, w.shape[1])
@@ -73,18 +101,22 @@ def weight_dequantize(weight, scale, algo="weight_only_int8",
     return Tensor(w)
 
 
-def _wol_impl(x, qweight, scale, bias, *, group_size, has_bias):
-    # Per-channel path: Pallas kernel keeps the int8->float convert in
-    # VMEM so HBM traffic stays int8 even inside a decode scan (XLA hoists
-    # a jnp dequant out of the loop and materializes bf16 weights).
+def _wol_impl(x, qweight, scale, bias, *, group_size, has_bias,
+              weight_dtype="int8"):
+    # Per-channel path: Pallas kernel keeps the int8/int4->float convert
+    # in VMEM so HBM traffic stays quantized even inside a decode scan
+    # (XLA hoists a jnp dequant out of the loop, materializing bf16).
     if group_size == -1:
         from ..ops.pallas.weight_only import weight_only_matmul_nd
-        out = weight_only_matmul_nd(x, qweight, scale)
+        out = weight_only_matmul_nd(x, qweight, scale,
+                                    weight_dtype=weight_dtype)
         if out is not None:
             if has_bias:
                 out = out + bias.astype(x.dtype)
             return out
     # fallback (grouped scales, large m, odd shapes): jnp dequant + matmul
+    if weight_dtype == "int4" and qweight.shape[1] * 2 == x.shape[-1]:
+        qweight = _unpack_int4(qweight)
     w = qweight.T.astype(x.dtype)
     if group_size != -1:
         g = w.reshape(w.shape[0] // group_size, group_size, w.shape[1])
@@ -107,7 +139,8 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     has_bias = bias is not None
     args.append(bias if has_bias else Tensor(jnp.zeros((1,), jnp.float32)))
     return apply("weight_only_linear", _wol_impl, args,
-                 {"group_size": int(group_size), "has_bias": has_bias})
+                 {"group_size": int(group_size), "has_bias": has_bias,
+                  "weight_dtype": str(weight_dtype)})
 
 
 def llm_int8_linear(x, weight, bias=None, weight_scale=None,
@@ -130,9 +163,11 @@ class WeightOnlyLinear(Layer):
         self.out_features = int(out_features)
         self.weight_dtype = weight_dtype
         self.group_size = int(group_size)
+        qw_cols = in_features // 2 if weight_dtype == "int4" \
+            else in_features
         self.register_buffer(
             "quant_weight",
-            Tensor(jnp.zeros((out_features, in_features), jnp.int8)))
+            Tensor(jnp.zeros((out_features, qw_cols), jnp.int8)))
         n_scale = (in_features // group_size if group_size != -1 else 1,
                    out_features)
         self.register_buffer(

@@ -24,11 +24,38 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# v5e scoped-VMEM default is 16MB; the 8MB double-buffered weight blocks
+# sit right at (and for k=8192, 168KB past) that line — raise it.
+_VMEM_LIMIT = 64 * (1 << 20)
+
+
 def _kernel(x_ref, qw_ref, scale_ref, o_ref):
     x = x_ref[...].astype(jnp.float32)            # [m, k]
     w = qw_ref[...].astype(jnp.float32)           # [bn, k] int8 -> f32 in VMEM
     out = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
+    o_ref[...] = (out * scale_ref[...][None, :]).astype(o_ref.dtype)
+
+
+def _kernel_int4(x_ref, qw_ref, scale_ref, o_ref):
+    """Nibble-packed int4: qw [bn, k//2] int8 holds (w[:, :k/2] + 8) in
+    the low nibble (biased to [1,15] so unpacking needs no sign fixup —
+    the -8 folds into a rank-1 rowsum correction) and w[:, k/2:] in the
+    high nibble (arithmetic >>4 sign-extends it for free). Halves packing:
+    no lane interleave, just two half-K matmuls. The nibble ops run on an
+    int32 promotion of the block (Mosaic lowers no int8 shift/and)."""
+    k2 = qw_ref.shape[1]
+    x = x_ref[...].astype(jnp.float32)
+    p = qw_ref[...].astype(jnp.int32)   # Mosaic has no int8 shift/and
+    high = (p >> 4).astype(jnp.float32)
+    low_b = jnp.bitwise_and(p, 15).astype(jnp.float32)  # w_low+8 in [1,15]
+    xl = jax.lax.slice(x, (0, 0), (x.shape[0], k2))
+    xh = jax.lax.slice(x, (0, k2), (x.shape[0], 2 * k2))
+    out = jax.lax.dot_general(xl, low_b, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32) \
+        + jax.lax.dot_general(xh, high, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32) \
+        - 8.0 * jnp.sum(xl, axis=1, keepdims=True)
     o_ref[...] = (out * scale_ref[...][None, :]).astype(o_ref.dtype)
 
 
@@ -46,26 +73,33 @@ def _pick_block(n, k, m):
     return None
 
 
-def weight_only_matmul(x, qweight, scale, out_dtype=None, interpret=None):
-    """x [m, k] float; qweight [n, k] int8; scale [n] f32 -> [m, n].
+def weight_only_matmul(x, qweight, scale, out_dtype=None, interpret=None,
+                       weight_dtype="int8"):
+    """x [m, k] float; qweight [n, k] int8 or, for weight_dtype='int4',
+    [n, k//2] halves-packed nibbles; scale [n] f32 -> [m, n].
     Returns None if the shapes don't fit the kernel (caller falls back)."""
     m, k = x.shape
-    n = qweight.shape[0]
+    n, kw = qweight.shape
+    int4 = weight_dtype == "int4"
+    if (int4 and kw * 2 != k) or (not int4 and kw != k):
+        raise ValueError(
+            f"weight_only_matmul: qweight width {kw} inconsistent with "
+            f"k={k} for weight_dtype={weight_dtype!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if k % 128 or m > 512:
+    if kw % 128 or m > 512:
         return None
-    bn = _pick_block(n, k, m)
+    bn = _pick_block(n, kw, m)
     if bn is None:
         return None
     out_dtype = out_dtype or x.dtype
     return pl.pallas_call(
-        _kernel,
+        _kernel_int4 if int4 else _kernel,
         grid=(n // bn,),
         in_specs=[
             pl.BlockSpec((m, k), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, k), lambda i: (i, 0),
+            pl.BlockSpec((bn, kw), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((bn,), lambda i: (i,),
                          memory_space=pltpu.VMEM),
@@ -73,11 +107,13 @@ def weight_only_matmul(x, qweight, scale, out_dtype=None, interpret=None):
         out_specs=pl.BlockSpec((m, bn), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(x, qweight, scale)
 
 
-def weight_only_matmul_nd(x, qweight, scale, interpret=None):
+def weight_only_matmul_nd(x, qweight, scale, interpret=None,
+                          weight_dtype="int8"):
     """Rank-N wrapper: flattens leading dims of x to m."""
     lead = x.shape[:-1]
     k = x.shape[-1]
@@ -85,7 +121,8 @@ def weight_only_matmul_nd(x, qweight, scale, interpret=None):
     for d in lead:
         m *= d
     out = weight_only_matmul(x.reshape(m, k), qweight, scale,
-                             interpret=interpret)
+                             interpret=interpret,
+                             weight_dtype=weight_dtype)
     if out is None:
         return None
     return out.reshape(*lead, qweight.shape[0])

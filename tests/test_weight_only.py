@@ -103,3 +103,39 @@ def test_pallas_kernel_parity_with_fallback():
     # shapes the kernel refuses fall back to None
     assert weight_only_matmul(jnp.zeros((600, 256)), q._value, s._value,
                               interpret=True) is None
+
+
+def test_int4_packing_halves_container_and_matches():
+    """int4 packs two nibbles per byte ([out, in//2] container — the HBM
+    bytes really halve vs int8) and the Pallas kernel (interpret mode)
+    matches the jnp dequant reference exactly."""
+    import jax.numpy as jnp
+    from paddle_tpu.nn.quant import (weight_quantize, weight_dequantize)
+    from paddle_tpu.ops.pallas.weight_only import weight_only_matmul
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(512, 256).astype("float32") * 0.1
+    q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int4")
+    assert tuple(q.shape) == (256, 256)  # [out, in//2]
+    wd = weight_dequantize(q, s, "weight_only_int4").numpy()
+    assert np.max(np.abs(wd - w)) / np.max(np.abs(w)) < 0.08
+    x = jnp.asarray(rng.randn(8, 512).astype(np.float32))
+    out = weight_only_matmul(x, q._value, s._value, weight_dtype="int4")
+    ref = np.asarray(x) @ wd
+    assert np.max(np.abs(np.asarray(out) - ref)) / np.max(np.abs(ref)) < 1e-4
+    with pytest.raises(ValueError, match="inconsistent"):
+        weight_only_matmul(x, q._value, s._value)  # packed buf as int8
+
+
+def test_int4_weight_only_linear_model_path():
+    from paddle_tpu.nn.quant import WeightOnlyLinear
+
+    paddle.seed(0)
+    lin = paddle.nn.Linear(512, 128)
+    wol = WeightOnlyLinear.from_linear(lin, weight_dtype="int4")
+    assert tuple(wol.quant_weight.shape) == (128, 256)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 512)
+                         .astype("float32"))
+    rel = np.max(np.abs(wol(x).numpy() - lin(x).numpy())) / (
+        np.max(np.abs(lin(x).numpy())) + 1e-9)
+    assert rel < 0.2  # 4-bit quantization noise bound
