@@ -21,6 +21,40 @@ def _drop_float0(g):
     return g
 
 
+# zero cotangents for unused outputs repeat (shape, dtype) every step —
+# multi-output composite ops (lazy segments) would otherwise pay one XLA
+# dispatch per dead output per backward. Zeros are immutable: cache them.
+_ZERO_CACHE: dict = {}
+_SHAPE_CACHE: dict = {}
+
+
+def _zeros_cached(shape, dtype):
+    key = (tuple(shape), str(dtype))
+    z = _ZERO_CACHE.get(key)
+    if z is None:
+        if len(_ZERO_CACHE) >= 256:   # dynamic-shape workloads: bound HBM
+            _ZERO_CACHE.clear()
+        z = jnp.zeros(shape, dtype)
+        _ZERO_CACHE[key] = z
+    return z
+
+
+def _out_shapes_cached(node):
+    from ..core.dispatch import _get_fwd
+
+    sig = tuple((tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else a
+                for a in node.input_arrays)
+    key = (node.impl, node.statics_key, sig)
+    shapes = _SHAPE_CACHE.get(key)
+    if shapes is None:
+        fwd = _get_fwd(node.impl, node.statics_key, node.statics)
+        shapes = jax.eval_shape(fwd, *node.input_arrays)
+        if not isinstance(shapes, (tuple, list)):
+            shapes = [shapes]
+        _SHAPE_CACHE[key] = shapes
+    return shapes
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False):
     from ..core.tensor import Tensor
     from ..core.dispatch import _get_fwd
@@ -95,12 +129,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                 if out_shapes is not None:
                     shapes = out_shapes
                 else:
-                    fwd = _get_fwd(node.impl, node.statics_key, node.statics)
-                    shapes = jax.eval_shape(fwd, *node.input_arrays)
-                    if not isinstance(shapes, (tuple, list)):
-                        shapes = [shapes]
+                    shapes = _out_shapes_cached(node)
                 cts = [
-                    c if c is not None else jnp.zeros(s.shape, s.dtype)
+                    c if c is not None else _zeros_cached(s.shape, s.dtype)
                     for c, s in zip(cts, shapes)
                 ]
             in_grads = node.run_vjp(cts)

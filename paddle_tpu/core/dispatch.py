@@ -28,6 +28,8 @@ import numpy as np
 
 from . import monitor
 
+from .lazy import _state as _lazy_state
+
 __all__ = [
     "apply",
     "no_grad",
@@ -259,6 +261,26 @@ def _apply(name, impl, tensor_args, statics=None, out_wrapper=None):
     Returns a Tensor or tuple of Tensors mirroring impl's output structure.
     """
     from .tensor import Tensor  # circular-safe
+
+    rec = _lazy_state.stack[-1] if _lazy_state.stack else None
+    if rec is not None and out_wrapper is not None:
+        rec = None
+    if rec is not None and _amp_cast_hook is not None:
+        from ..amp import amp_state
+        if amp_state().enabled:
+            rec = None       # per-op autocast needs per-op names: no defer
+    if rec is not None and not rec.flushing:
+        from .. import flags as _flags
+        if _flags.flag("check_nan_inf"):
+            rec = None                     # per-op NaN isolation
+    if rec is not None and not rec.flushing:
+        res = rec.maybe_record(name, impl, tensor_args, statics)
+        if res is not NotImplemented:
+            return res
+        # op declined deferral (shape/value-dependent impl): it is a break
+        # point — materialize the segment, then run this op eagerly
+        rec.flush()
+        monitor.increment("lazy_segment_fallback_ops")
 
     monitor.increment("op_dispatch_total")
     statics = statics or {}

@@ -15,12 +15,13 @@ import jax
 import jax.numpy as jnp
 
 from . import dtype as dtypes
+from . import lazy as _lazy
 from .dispatch import is_grad_enabled
 
 
 class Tensor:
     __slots__ = (
-        "_value",
+        "_v_",
         "stop_gradient",
         "grad",
         "_grad_node",
@@ -36,12 +37,39 @@ class Tensor:
         "__weakref__",
     )
 
+    # _value is a property over the _v_ slot so lazy segments
+    # (core/lazy.py) can defer execution: while a producing segment is
+    # unflushed, _v_ holds a PendingValue; the first real-value demand
+    # flushes the compiled subgraph. Shape/dtype queries stay lazy.
+    @property
+    def _value(self):
+        v = self._v_
+        if type(v) is _lazy.PendingValue:
+            v.recorder.flush()
+            v = self._v_
+        return v
+
+    @_value.setter
+    def _value(self, v):
+        cur = getattr(self, "_v_", None)
+        if type(cur) is _lazy.PendingValue:
+            # flush the recorder that OWNS this pending value (it may not
+            # be the innermost one when segments nest)
+            cur.recorder.flush()
+        elif _lazy._state.stack:
+            rec = _lazy._state.stack[-1]
+            # rebinding a tensor the active segment references must flush
+            # first, else the segment would replay stale values
+            if id(self) in rec._input_ids:
+                rec.flush()
+        self._v_ = v
+
     def __init__(self, value, stop_gradient=True, name=None):
         if isinstance(value, Tensor):
             value = value._value
         if not isinstance(value, jax.Array):
             value = jnp.asarray(value)
-        self._value = value
+        self._v_ = value
         self.stop_gradient = stop_gradient
         self.grad = None
         self._grad_node = None
@@ -54,19 +82,19 @@ class Tensor:
     # -- meta ------------------------------------------------------------
     @property
     def shape(self):
-        return list(self._value.shape)
+        return list(self._v_.shape)
 
     @property
     def dtype(self):
-        return self._value.dtype
+        return self._v_.dtype
 
     @property
     def ndim(self):
-        return self._value.ndim
+        return self._v_.ndim
 
     @property
     def size(self):
-        return int(self._value.size)
+        return int(self._v_.size)
 
     @property
     def place(self):

@@ -498,3 +498,171 @@ class DistributedLookupTable(Layer):
         """slot_ids: [batch, num_slots] int -> [batch, num_slots*dim]."""
         emb = self.embedding(slot_ids)  # [b, slots, dim]
         return emb.reshape([emb.shape[0], -1])
+
+
+# ---------------------------------------------------------------------------
+# CTR accessor + cross-process PS service (round 4)
+# ---------------------------------------------------------------------------
+
+
+class CtrAccessorConfig:
+    """Reference: the ctr_accessor_param proto consumed by
+    paddle/fluid/distributed/ps/table/ctr_accessor.cc:37."""
+
+    def __init__(self, nonclk_coeff=0.1, click_coeff=1.0,
+                 show_click_decay_rate=0.98, delete_threshold=0.8,
+                 delete_after_unseen_days=30, embedx_threshold=10.0):
+        self.nonclk_coeff = float(nonclk_coeff)
+        self.click_coeff = float(click_coeff)
+        self.show_click_decay_rate = float(show_click_decay_rate)
+        self.delete_threshold = float(delete_threshold)
+        self.delete_after_unseen_days = float(delete_after_unseen_days)
+        self.embedx_threshold = float(embedx_threshold)
+
+
+class CtrAccessor:
+    """Per-feature CTR scoring/lifecycle (reference:
+    ps/table/ctr_accessor.h:30, .cc — CtrCommonFeatureValue carries
+    show/click/unseen_days; Shrink() time-decays then deletes by score;
+    NeedExtendMF() gates the wide embedx vector on the same score).
+
+    TPU-native: the accessor is a numpy-side policy object attached to a
+    host table — scoring math matches the reference exactly; storage stays
+    columnar (dict of arrays) instead of packed float rows."""
+
+    def __init__(self, config=None):
+        self.cfg = config or CtrAccessorConfig()
+        self.show = {}          # uid -> float
+        self.click = {}
+        self.unseen_days = {}
+
+    def show_click_score(self, show, click):
+        """Reference ctr_accessor.cc:305: (show-click)*nonclk + click*clk."""
+        c = self.cfg
+        return (show - click) * c.nonclk_coeff + click * c.click_coeff
+
+    def update(self, uids, shows, clicks):
+        """Push-side stat fold (CtrCommonPushValue merge): accumulate
+        show/click and reset unseen_days for the touched rows. Aging is a
+        separate daily pass (age_days) like the reference — doing it per
+        push would both cost O(table) per batch and count batches as
+        days."""
+        for u, s, k in zip(np.asarray(uids).tolist(),
+                           np.asarray(shows).tolist(),
+                           np.asarray(clicks).tolist()):
+            self.show[u] = self.show.get(u, 0.0) + float(s)
+            self.click[u] = self.click.get(u, 0.0) + float(k)
+            self.unseen_days[u] = 0.0
+
+    def age_days(self, days=1.0):
+        """Daily aging pass (reference: unseen_days accrues per day and is
+        consumed by Shrink)."""
+        for u in self.show:
+            self.unseen_days[u] = self.unseen_days.get(u, 0.0) + days
+
+    def score(self, uid):
+        return self.show_click_score(self.show.get(uid, 0.0),
+                                     self.click.get(uid, 0.0))
+
+    def need_extend_mf(self, uid):
+        """Reference ctr_accessor.cc:190 NeedExtendMF: grow the wide
+        embedx vector only once the feature's score crosses the
+        threshold."""
+        return self.score(uid) >= self.cfg.embedx_threshold
+
+    def shrink(self):
+        """Reference ctr_accessor.cc:62 Shrink: decay show/click first,
+        then delete rows whose score fell below delete_threshold or that
+        were unseen too long. Returns the deleted uids."""
+        c = self.cfg
+        dead = []
+        for u in list(self.show):
+            self.show[u] *= c.show_click_decay_rate
+            self.click[u] *= c.show_click_decay_rate
+            if (self.show_click_score(self.show[u], self.click[u])
+                    < c.delete_threshold
+                    or self.unseen_days.get(u, 0.0)
+                    > c.delete_after_unseen_days):
+                dead.append(u)
+                self.show.pop(u, None)
+                self.click.pop(u, None)
+                self.unseen_days.pop(u, None)
+        return dead
+
+
+# -- cross-process push: workers send sparse grads to the owner process ----
+
+_PS_TABLES: dict = {}
+# rpc's SAME-PROCESS fast path runs each call on its own thread; pushes
+# must serialize like the cross-process serve loop does naturally
+_PS_LOCK = threading.Lock()
+
+
+def host_ps_table(name, table, accessor=None):
+    """Owner-process side: register a HostOffloadedEmbedding (or any object
+    with _apply_push(uids, row_ct)) under `name` so remote workers can push
+    to it via dist.rpc (reference: the brpc PsService hosting tables,
+    ps/service/brpc_ps_server.h)."""
+    _PS_TABLES[name] = (table, accessor)
+    return table
+
+
+def _ps_remote_push(name, uids, row_ct, shows=None, clicks=None):
+    """Runs in the OWNER process via rpc: apply a sparse push (and CTR
+    stats when provided). Module-level so rpc can pickle the reference."""
+    with _PS_LOCK:
+        table, accessor = _PS_TABLES[name]
+        table._apply_push(jnp.asarray(np.asarray(uids)),
+                          jnp.asarray(np.asarray(row_ct)))
+        if accessor is not None and shows is not None:
+            accessor.update(uids, clicks=clicks, shows=shows)
+    return True
+
+
+def _ps_remote_pull(name, uids):
+    table, _ = _PS_TABLES[name]
+    rows = np.asarray(table.weight._value)[np.asarray(uids)]
+    return rows
+
+
+class RemoteCommunicator:
+    """Worker-process side: async sparse push to the owner's table over
+    dist.rpc with bounded staleness (reference: the cross-node
+    AsyncCommunicator, ps/service/communicator/communicator.h:427 — send
+    queues bounded by max_merge/independent thread; here jax/numpy grads
+    ride the native-store rpc channel and at most `max_pending` pushes may
+    be in flight before the caller blocks)."""
+
+    def __init__(self, owner, table_name, max_pending=8):
+        self.owner = owner
+        self.table_name = table_name
+        self.max_pending = int(max_pending)
+        self._futs = []
+
+    def push(self, uids, row_ct, shows=None, clicks=None):
+        from . import rpc as _rpc
+        while len(self._futs) >= self.max_pending:
+            self._futs.pop(0).wait(timeout=120)
+        fut = _rpc.rpc_async(
+            self.owner, _ps_remote_push,
+            args=(self.table_name, np.asarray(uids),
+                  np.asarray(row_ct),
+                  None if shows is None else np.asarray(shows),
+                  None if clicks is None else np.asarray(clicks)))
+        self._futs.append(fut)
+        return fut
+
+    def pull(self, uids):
+        from . import rpc as _rpc
+        return _rpc.rpc_sync(self.owner, _ps_remote_pull,
+                             args=(self.table_name, np.asarray(uids)),
+                             timeout=120)
+
+    def flush(self):
+        while self._futs:
+            self._futs.pop(0).wait(timeout=120)
+
+    @property
+    def pending(self):
+        self._futs = [f for f in self._futs if not f.done()]
+        return len(self._futs)

@@ -16,7 +16,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops import schema
-from paddle_tpu.ops.samples import install_samples
+from paddle_tpu.ops.samples import install_samples, Check
 
 _MISSING_SAMPLES = install_samples()
 
@@ -50,7 +50,20 @@ def _to_np(out):
 
 SAMPLED = [s for s in schema.OPS.values() if s.sample is not None]
 GRAD = [s for s in SAMPLED if s.grad is not None]
-BF16 = [s for s in SAMPLED if s.bf16 and s.np_ref is not None]
+BF16 = [s for s in SAMPLED
+        if s.bf16 and s.np_ref is not None
+        and not isinstance(s.np_ref, Check)]
+
+
+def _assert_close(got, want, tol, name, what="output"):
+    want = np.asarray(want)
+    if np.iscomplexobj(want) != np.iscomplexobj(got):
+        got = np.asarray(got).astype(want.dtype)
+    np.testing.assert_allclose(
+        np.asarray(got, "float64") if not np.iscomplexobj(want)
+        else got, want.astype("float64") if not np.iscomplexobj(want)
+        else want, rtol=tol, atol=tol,
+        err_msg=f"op {name} fp32 parity failed ({what})")
 
 
 @pytest.mark.parametrize("spec", SAMPLED, ids=[s.name for s in SAMPLED])
@@ -58,20 +71,34 @@ def test_op_parity(spec):
     args, kwargs = spec.sample()
     t_args = [_to_tensors(a) for a in args]
     out = spec.fn(*t_args, **kwargs)
-    got = _to_np(out)
     if spec.np_ref is None:
         return  # smoke: op ran without raising
-    want = spec.np_ref(*args, **kwargs)
-    if want is None or got is None:
+    if isinstance(spec.np_ref, Check):
+        # reconstruction/property check (sign- or order-ambiguous ops:
+        # qr/svd/eig...) — receives the RAW op output and the numpy args
+        assert spec.np_ref.fn(out, *args, **kwargs), \
+            f"op {spec.name} property check failed"
         return
-    want = np.asarray(want)
-    if np.iscomplexobj(want) != np.iscomplexobj(got):
-        got = got.astype(want.dtype)
-    np.testing.assert_allclose(
-        np.asarray(got, "float64") if not np.iscomplexobj(want)
-        else got, want.astype("float64") if not np.iscomplexobj(want)
-        else want, rtol=spec.tol, atol=spec.tol,
-        err_msg=f"op {spec.name} fp32 parity failed")
+    want = spec.np_ref(*args, **kwargs)
+    if want is None:
+        return
+    if isinstance(want, tuple):
+        # multi-output ops compare EVERY output (VERDICT r4 item 6; the
+        # reference's check_output walks all fetch targets)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        assert len(outs) >= len(want), spec.name
+        for j, w in enumerate(want):
+            if w is None:
+                continue
+            g = _to_np(outs[j])
+            if g is None:
+                continue
+            _assert_close(g, w, spec.tol, spec.name, what=f"output[{j}]")
+        return
+    got = _to_np(out)
+    if got is None:
+        return
+    _assert_close(got, want, spec.tol, spec.name)
 
 
 @pytest.mark.parametrize("spec", BF16, ids=[s.name for s in BF16])
@@ -209,7 +236,10 @@ def test_inplace_autograd_flows():
 
 
 def test_coverage_floor():
-    # round-3 floors: the registry is now an OpTest, not a catalog
+    # round-4 floors (raised from 500/440/300: +24 sampled rows incl. the
+    # in-place activations / TensorArray / nn.utils families, +55 numpy or
+    # property references over the former smoke rows, multi-output ops now
+    # compare every output)
     assert not _MISSING_SAMPLES, _MISSING_SAMPLES
     fn_count = schema.public_op_count()
     assert fn_count >= 650, fn_count
@@ -217,9 +247,9 @@ def test_coverage_floor():
     with_ref = sum(1 for s in schema.OPS.values()
                    if s.sample is not None and s.np_ref is not None)
     grad_checked = len(GRAD)
-    assert sampled >= 500, sampled
-    assert with_ref >= 440, with_ref
-    assert grad_checked >= 300, grad_checked
+    assert sampled >= 575, sampled
+    assert with_ref >= 495, with_ref
+    assert grad_checked >= 305, grad_checked
     assert len(BF16) >= 180, len(BF16)
     # tensor-method artifacts generated from the same rows
     method_count = sum(

@@ -134,3 +134,111 @@ def test_compiled_path_unaffected():
         out = f(paddle.to_tensor(np.float32([-1.0, -2.0])))
         np.testing.assert_allclose(out.numpy(), [-3.0, -6.0])
     assert not any("graph break" in str(w.message) for w in rec)
+
+
+def test_lazy_segments_compile_prefix_of_breaking_function():
+    """VERDICT r4 item 3: after a graph break, the convertible pieces
+    between break points execute as COMPILED subgraphs (lazy segments),
+    counter-verified — not per-op eager."""
+    from paddle_tpu.core import monitor
+
+    paddle.seed(0)
+
+    @paddle.jit.to_static
+    def f(x):
+        # convertible prefix: several ops -> one compiled segment
+        a = x * 2.0 + 1.0
+        b = a @ a
+        c = b.sum()
+        _ = float(c)          # BREAK: host readback
+        # convertible suffix: another compiled segment
+        d = (x + 3.0) * c
+        return d.mean()
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 8)
+                         .astype("float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x)                   # first call: trace, break, eager-fallback
+        before_ops = monitor.get("lazy_segment_ops")
+        before_fl = monitor.get("lazy_segment_flushes")
+        before_disp = monitor.get("op_dispatch_total")
+        out = f(x)             # broken sig: lazy-segment path
+    assert np.isfinite(float(out))
+    seg_ops = monitor.get("lazy_segment_ops") - before_ops
+    flushes = monitor.get("lazy_segment_flushes") - before_fl
+    dispatches = monitor.get("op_dispatch_total") - before_disp
+    # prefix (>=3 ops) and suffix (>=2 ops) deferred into >=2 compiled
+    # segments; the composite dispatches are far fewer than the op count
+    assert seg_ops >= 5, (seg_ops, flushes)
+    assert flushes >= 2, (seg_ops, flushes)
+    assert dispatches < seg_ops, (dispatches, seg_ops)
+    # per-function compiled-vs-eager counters surfaced via utils.monitor
+    from paddle_tpu.utils.monitor import get_all
+    eager_keys = [k for k in get_all() if k.startswith("to_static_eager::")]
+    assert any("f" in k for k in eager_keys)
+
+
+def test_lazy_fallback_gradients_match_eager():
+    import os
+
+    paddle.seed(0)
+    results = {}
+    for mode in ("0", "1"):
+        os.environ["PADDLE_TPU_LAZY_FALLBACK"] = mode
+        try:
+            lin = nn.Linear(6, 3)
+            lin.weight._value = paddle.to_tensor(
+                np.random.RandomState(1).randn(6, 3).astype("float32"))._value
+            lin.bias._value = paddle.to_tensor(
+                np.zeros(3, "float32"))._value
+
+            @paddle.jit.to_static
+            def step(x):
+                h = lin(x)
+                _ = float(h.sum())     # break
+                return (h * h).mean()
+
+            x = paddle.to_tensor(np.random.RandomState(2).randn(4, 6)
+                                 .astype("float32"))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                step(x)                # trigger break
+                loss = step(x)         # fallback path under test
+            loss.backward()
+            results[mode] = (float(loss),
+                             np.asarray(lin.weight.grad.numpy()).copy())
+        finally:
+            os.environ.pop("PADDLE_TPU_LAZY_FALLBACK", None)
+    l0, g0 = results["0"]
+    l1, g1 = results["1"]
+    assert abs(l0 - l1) < 1e-5 * max(1, abs(l0))
+    np.testing.assert_allclose(g0, g1, rtol=1e-5, atol=1e-6)
+
+
+def test_broken_signature_retried_after_n_calls():
+    """A fallback signature gets ONE compile re-attempt after _RETRY_AFTER
+    eager calls (transient guards must not poison the cache forever)."""
+    from paddle_tpu.jit.api import _RETRY_AFTER
+
+    paddle.seed(0)
+    breaking = [True]
+
+    @paddle.jit.to_static
+    def f(x):
+        if breaking[0]:
+            _ = float(x.sum())     # break only while flagged
+        return x * 2.0
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x)                        # breaks -> fallback sig
+        assert len(f._fallback_sigs) == 1
+        breaking[0] = False         # construct becomes convertible
+        for _ in range(_RETRY_AFTER + 1):
+            f(x)
+        # the re-attempt succeeded and cleared the fallback marker
+        assert len(f._fallback_sigs) == 0
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 2.0)
