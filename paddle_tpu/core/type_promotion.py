@@ -1,0 +1,64 @@
+"""Type-promotion parity (reference: paddle/phi/common/type_promotion.h
+`promoteTypes` 12x12 lookup, `NeedTypePromotion`, and the eager hook
+paddle/fluid/eager/type_promotion_utils.h).
+
+JAX's promotion lattice (`jnp.promote_types`) is *identical* to the
+reference's `_promoteTypesLookup` table over all 12 paddle dtypes —
+verified exhaustively by tests/test_type_promotion.py — including the
+corners the reference special-cases:
+
+- ``uint8 x int8 -> int16`` (unsigned/signed same width widens),
+- ``bfloat16 x float16 -> float32`` (the two half floats join at f32),
+- ``bool`` is the promotion identity,
+- any float dominates any int.
+
+The one *runtime* divergence is width policy, not the table: with
+``jax_enable_x64`` off (TPU default), 64-bit results are materialized at
+32-bit width (``int32 x int64 -> int32`` at run time, ``float64``
+arithmetic runs in ``float32``). This is an explicit de-scope: the table
+below answers dtype queries with full-width reference semantics, while
+runtime kernels follow the platform width policy. Enable
+``JAX_ENABLE_X64`` for bit-parity on 64-bit corners.
+
+The reference applies tensor-tensor promotion only when both operands are
+(distinct) floating types (`NeedTypePromotion`, type_promotion.h:107);
+integer pairs must match dtypes. Our dispatch is more permissive (jnp
+promotes integer pairs by the same table instead of raising) — a
+documented superset of the reference contract.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+
+__all__ = ["promote_types", "need_type_promotion", "get_promote_dtype"]
+
+_FLOATS = ("float16", "float32", "float64", "bfloat16")
+
+
+def _canon(d):
+    c = dtypes.convert_dtype(d)
+    return str(c) if c is not None else str(jnp.dtype(d))
+
+
+def promote_types(x_dtype, y_dtype):
+    """Reference: phi::promoteTypes (type_promotion.h:50). Returns the
+    full-width promoted dtype name for any pair of the 12 paddle dtypes."""
+    return str(jnp.promote_types(_canon(x_dtype), _canon(y_dtype)))
+
+
+def need_type_promotion(x_dtype, y_dtype):
+    """Reference: phi::NeedTypePromotion (type_promotion.h:107) — tensor x
+    tensor promotion fires only for two distinct floating dtypes."""
+    x, y = _canon(x_dtype), _canon(y_dtype)
+    return x != y and x in _FLOATS and y in _FLOATS
+
+
+def get_promote_dtype(op_name, x_dtype, y_dtype):
+    """Reference: phi::GetPromoteDtype (type_promotion.h:96) — comparison
+    ops produce bool regardless of operand promotion."""
+    if op_name in ("greater_than", "less_than", "greater_equal",
+                   "less_equal", "equal", "not_equal"):
+        return "bool"
+    return promote_types(x_dtype, y_dtype)

@@ -92,3 +92,9 @@ define_flag("cudnn_deterministic", False, "parity alias: deterministic ops")
 define_flag("embedding_deterministic", 0, "parity")
 define_flag("max_inplace_grad_add", 0, "parity")
 define_flag("conv_workspace_size_limit", 512, "parity")
+define_flag("use_autotune", True,
+            "kernel autotune (XLA's backend autotuner; parity switch read "
+            "by incubate.autotune.get_config)")
+define_flag("layout_autotune", False,
+            "run NCHW convs in the TPU-preferred NHWC layout inside jit "
+            "(reference: eager_layout_auto_tune.h)")

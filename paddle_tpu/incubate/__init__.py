@@ -2,6 +2,7 @@
 APIs; autograd functional here, MoE lives in distributed.moe)."""
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 from . import nn  # noqa: F401
 
 # graph / segment op aliases (reference: python/paddle/incubate/operators —

@@ -1,0 +1,93 @@
+"""Auto-tuning configuration surface (reference:
+python/paddle/incubate/autotune.py `set_config`).
+
+TPU-native mapping of the three tuning domains:
+
+- kernel: the reference exhaustively searches conv algorithms per shape
+  (phi/kernels/autotune). On TPU, XLA's backend autotuner owns kernel
+  selection inside every compiled program; the switch here is recorded in
+  `FLAGS_use_autotune` so `get_config()` reflects the requested state and
+  the tuning_range is kept for parity (XLA tunes at compile time, not over
+  an iteration window, so the range is advisory).
+- layout: the reference transposes eager tensors to the cuDNN-preferred
+  layout (eager_layout_auto_tune.h). Here `FLAGS_layout_autotune` makes the
+  functional conv path run NCHW convs in the MXU-preferred NHWC layout
+  inside jit (nn/functional/conv.py), with boundary transposes fused by XLA.
+- dataloader: the reference tunes num_workers; here
+  `paddle_tpu.io.set_autotune_config` arms the DataLoader to measure
+  single-process batch production at first iteration and promote itself to
+  multiprocess workers when the python pipeline would starve the device.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from ..flags import flag, set_flags
+from .. import io as _io
+
+__all__ = ["set_config", "get_config"]
+
+_kernel_tuning_range = [1, 10]
+
+
+def set_config(config=None):
+    """Reference: incubate/autotune.py:24 `set_config(config=None)` —
+    dict / json-file-path / None (None enables all three domains)."""
+    global _kernel_tuning_range
+    if config is None:
+        set_flags({"use_autotune": True, "layout_autotune": True})
+        _io.set_autotune_config(use_autotune=True)
+        return
+
+    config_dict = {}
+    if isinstance(config, dict):
+        config_dict = config
+    elif isinstance(config, str):
+        try:
+            with open(config) as fh:
+                config_dict = json.load(fh)
+        except Exception as e:
+            warnings.warn(
+                f"Load config error: {e}; "
+                "use default configuration for auto-tuning.")
+
+    if "kernel" in config_dict:
+        kcfg = config_dict["kernel"]
+        if "enable" in kcfg:
+            if isinstance(kcfg["enable"], bool):
+                set_flags({"use_autotune": kcfg["enable"]})
+            else:
+                warnings.warn("kernel.enable should be bool; ignored.")
+        if "tuning_range" in kcfg:
+            if (isinstance(kcfg["tuning_range"], list)
+                    and len(kcfg["tuning_range"]) == 2):
+                _kernel_tuning_range = [int(v) for v in kcfg["tuning_range"]]
+            else:
+                warnings.warn("kernel.tuning_range should be [start, end]; "
+                              "ignored.")
+    if "layout" in config_dict:
+        lcfg = config_dict["layout"]
+        if isinstance(lcfg.get("enable"), bool):
+            set_flags({"layout_autotune": lcfg["enable"]})
+        elif "enable" in lcfg:
+            warnings.warn("layout.enable should be bool; ignored.")
+    if "dataloader" in config_dict:
+        dcfg = config_dict["dataloader"]
+        if isinstance(dcfg.get("enable"), bool):
+            _io.set_autotune_config(use_autotune=dcfg["enable"],
+                                    tuning_steps=int(dcfg.get("tuning_steps",
+                                                              8)))
+        elif "enable" in dcfg:
+            warnings.warn("dataloader.enable should be bool; ignored.")
+
+
+def get_config():
+    """Current tuning state (not in the reference surface; exposed so the
+    advisory kernel switch is observable)."""
+    return {
+        "kernel": {"enable": flag("use_autotune"),
+                   "tuning_range": list(_kernel_tuning_range)},
+        "layout": {"enable": flag("layout_autotune")},
+        "dataloader": dict(_io._autotune_cfg),
+    }

@@ -1,0 +1,12 @@
+"""paddle_tpu.incubate.multiprocessing (reference:
+python/paddle/incubate/multiprocessing/__init__.py) — the stdlib
+multiprocessing namespace plus ForkingPickler reducers that move Tensors
+between processes through shared-memory segments instead of the pickle
+pipe."""
+from .reductions import init_reductions
+
+__all__ = []
+
+from multiprocessing import *  # noqa: F401,F403
+
+init_reductions()

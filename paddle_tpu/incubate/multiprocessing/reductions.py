@@ -1,0 +1,141 @@
+"""Shared-memory tensor transfer between processes (reference:
+python/paddle/incubate/multiprocessing/reductions.py — ForkingPickler
+reducers that pass CPU LoDTensors by file-system shared memory and CUDA
+tensors by IPC handle, with an LRU cache of live segments).
+
+TPU-native: device buffers are PJRT-owned and have no cross-process IPC
+handle, so every tensor ships through host memory — but the payload itself
+crosses the process boundary via a POSIX shared-memory segment
+(`multiprocessing.shared_memory`), not the pickle pipe, matching the
+reference's file_system sharing strategy. The sender keeps each segment
+alive in a bounded LRU (reference `_LRUSharedCache`); the receiver copies
+out and detaches immediately.
+"""
+from __future__ import annotations
+
+import atexit
+import threading
+from collections import OrderedDict
+from multiprocessing.reduction import ForkingPickler
+from multiprocessing.util import register_after_fork
+
+import numpy as np
+
+__all__ = ["init_reductions"]
+
+_CACHE_LIMIT = 128
+
+
+class _LRUSharedCache(OrderedDict):
+    """Sender-side cache keeping shm segments alive until evicted
+    (reference: reductions.py:39 `_LRUSharedCache`, limit 128)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lock = threading.Lock()
+        register_after_fork(self, _LRUSharedCache._after_fork)
+
+    def _after_fork(self):
+        # the child must not unlink the parent's segments
+        self.lock = threading.Lock()
+        OrderedDict.clear(self)
+
+    def put(self, shm):
+        with self.lock:
+            self[shm.name] = shm
+            self.move_to_end(shm.name)
+            while len(self) > _CACHE_LIMIT:
+                _, old = self.popitem(last=False)
+                _destroy(old)
+
+    def clear_all(self):
+        with self.lock:
+            for shm in self.values():
+                _destroy(shm)
+            OrderedDict.clear(self)
+
+
+def _destroy(shm):
+    try:
+        shm.close()
+        shm.unlink()
+    except (FileNotFoundError, OSError):
+        pass
+
+
+_shared_cache = _LRUSharedCache()
+atexit.register(_shared_cache.clear_all)
+
+
+def _rebuild_tensor(cls, shm_name, dtype_str, shape, stop_gradient,
+                    extras=None):
+    """Receiver: attach → copy out → detach (reference:
+    reductions.py:77 `_rebuild_tensor`). Attach in untracked mode where
+    available (3.13+); under a shared multiprocessing resource_tracker the
+    tracked re-registration is a set no-op balanced by the sender's
+    eventual unlink, so no explicit unregister is needed (an unregister
+    here would strip the sender's own registration)."""
+    from multiprocessing import shared_memory
+    try:
+        seg = shared_memory.SharedMemory(name=shm_name, track=False)
+    except TypeError:  # track kwarg is 3.13+
+        seg = shared_memory.SharedMemory(name=shm_name)
+    try:
+        import ml_dtypes  # noqa: F401 — registers bfloat16/float8 names
+        arr = np.ndarray(shape, dtype=np.dtype(dtype_str),
+                         buffer=seg.buf).copy()
+    finally:
+        seg.close()
+    return _finish(cls, arr, stop_gradient, extras)
+
+
+_SHM_THRESHOLD = 64 * 1024  # below this, the pickle pipe is cheaper and
+                            # the segment LRU stays reserved for real payloads
+
+
+def _param_extras(tensor):
+    from ...nn.layer.layers import Parameter
+    if isinstance(tensor, Parameter):
+        return (tensor.trainable, tensor.name)
+    return None
+
+
+def _reduce_tensor(tensor):
+    """Sender: host-stage the buffer into a fresh shm segment (reference:
+    reductions.py:94 `_reduce_tensor`)."""
+    from multiprocessing import shared_memory
+    arr = np.ascontiguousarray(tensor.numpy())
+    extras = _param_extras(tensor)
+    if arr.nbytes <= _SHM_THRESHOLD:
+        # small/zero-size payloads ship inline (zero-size segments are
+        # invalid, and >128 in-flight tiny tensors would evict live
+        # segments from the LRU before the receiver attaches)
+        return (_rebuild_small, (type(tensor), arr, tensor.stop_gradient,
+                                 extras))
+    seg = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    np.ndarray(arr.shape, dtype=arr.dtype, buffer=seg.buf)[...] = arr
+    _shared_cache.put(seg)
+    return (_rebuild_tensor, (type(tensor), seg.name, arr.dtype.name,
+                              arr.shape, tensor.stop_gradient, extras))
+
+
+def _rebuild_small(cls, arr, stop_gradient, extras=None):
+    return _finish(cls, arr, stop_gradient, extras)
+
+
+def _finish(cls, arr, stop_gradient, extras):
+    if extras is not None:
+        trainable, name = extras
+        t = cls(arr, trainable=trainable, name=name)
+    else:
+        t = cls(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def init_reductions():
+    """Register the reducers (reference: reductions.py:182)."""
+    from ...core.tensor import Tensor
+    from ...nn.layer.layers import Parameter
+    ForkingPickler.register(Tensor, _reduce_tensor)
+    ForkingPickler.register(Parameter, _reduce_tensor)

@@ -306,6 +306,21 @@ class _PrefetchIter:
         return item
 
 
+_autotune_cfg = {"use_autotune": False, "tuning_steps": 8}
+
+
+def set_autotune_config(use_autotune, tuning_steps=8):
+    """DataLoader num_workers auto-tuning switch (reference:
+    paddle.io.reader.set_autotune_config, consumed by
+    incubate.autotune.set_config's dataloader section). When enabled, a
+    loader constructed with num_workers=0 times `tuning_steps` batches of
+    single-process iteration at first __iter__ and promotes itself to
+    multiprocess workers if batch production is slower than ~1ms/batch
+    (i.e. the python side could starve the device feed)."""
+    _autotune_cfg["use_autotune"] = bool(use_autotune)
+    _autotune_cfg["tuning_steps"] = int(tuning_steps)
+
+
 class DataLoader:
     """Reference: paddle.io.DataLoader (reader.py:216). num_workers>0 uses a
     background prefetch thread (device transfer is the serialized part on
@@ -351,7 +366,40 @@ class DataLoader:
             for idx_batch in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
+    def _autotune_num_workers(self):
+        """Measure single-process batch production; promote to workers when
+        the map-style pipeline is CPU-bound (num_workers picked from
+        cpu_count, capped at 4 like the reference's tuner search cap)."""
+        import os as _os
+        import time as _time
+        if self._iterable_mode or self.batch_sampler is None:
+            return 0
+        steps = max(2, _autotune_cfg["tuning_steps"])
+        # time only the work the workers could offload: __getitem__ plus a
+        # numpy-level collate. The host->device transfer in the default
+        # collate stays in the parent either way, so including it would
+        # spuriously promote transfer-bound loaders.
+        from .worker import numpy_collate
+        t0 = _time.perf_counter()
+        n = 0
+        for idx_batch in self.batch_sampler:
+            numpy_collate([self.dataset[i] for i in idx_batch])
+            n += 1
+            if n >= steps:
+                break
+        dt = _time.perf_counter() - t0
+        if n == 0:
+            return 0
+        per_batch = dt / n
+        if per_batch > 1e-3:
+            return min(_os.cpu_count() or 1, 4)
+        return 0
+
     def __iter__(self):
+        if (_autotune_cfg["use_autotune"] and not self.num_workers
+                and not getattr(self, "_autotuned", False)):
+            self._autotuned = True
+            self.num_workers = self._autotune_num_workers()
         if self.num_workers and self.num_workers > 0:
             from .worker import MultiprocessIter
             if self.persistent_workers and not self._iterable_mode:

@@ -39,7 +39,18 @@ def _norm_padding(padding, n):
     raise ValueError(f"bad padding {padding}")
 
 
-def _conv_impl(x, w, *, stride, padding, dilation, groups, n_spatial, channel_last):
+def _conv_impl(x, w, *, stride, padding, dilation, groups, n_spatial,
+               channel_last, layout_tuned=False):
+    if layout_tuned and not channel_last:
+        # layout autotune (reference: eager_layout_auto_tune.h): run the conv
+        # in the TPU-preferred channels-last layout; the boundary transposes
+        # fuse into neighbours under jit.
+        perm = (0,) + tuple(range(2, 2 + n_spatial)) + (1,)
+        out = _conv_impl(jnp.transpose(x, perm), w, stride=stride,
+                         padding=padding, dilation=dilation, groups=groups,
+                         n_spatial=n_spatial, channel_last=True)
+        inv = (0, n_spatial + 1) + tuple(range(1, n_spatial + 1))
+        return jnp.transpose(out, inv)
     if channel_last:
         lhs_spec = "N" + "DHW"[3 - n_spatial:] + "C"
     else:
@@ -54,9 +65,11 @@ def _conv_impl(x, w, *, stride, padding, dilation, groups, n_spatial, channel_la
         preferred_element_type=None)
 
 
-def _conv_bias_impl(x, w, b, *, stride, padding, dilation, groups, n_spatial, channel_last):
+def _conv_bias_impl(x, w, b, *, stride, padding, dilation, groups, n_spatial,
+                    channel_last, layout_tuned=False):
     out = _conv_impl(x, w, stride=stride, padding=padding, dilation=dilation,
-                     groups=groups, n_spatial=n_spatial, channel_last=channel_last)
+                     groups=groups, n_spatial=n_spatial,
+                     channel_last=channel_last, layout_tuned=layout_tuned)
     if channel_last:
         return out + b.reshape((1,) * (out.ndim - 1) + (-1,))
     return out + b.reshape((1, -1) + (1,) * n_spatial)
@@ -72,6 +85,9 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n_spa
         "n_spatial": n_spatial,
         "channel_last": channel_last,
     }
+    from ...flags import flag
+    if flag("layout_autotune") and not channel_last and n_spatial == 2:
+        statics["layout_tuned"] = True
     if isinstance(statics["padding"], list):
         statics["padding"] = tuple(tuple(p) for p in statics["padding"])
     if bias is None:

@@ -1,0 +1,191 @@
+"""incubate.autotune + incubate.multiprocessing (reference:
+python/paddle/incubate/autotune.py, incubate/multiprocessing/)."""
+import json
+import multiprocessing as std_mp
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autotune
+
+
+@pytest.fixture(autouse=True)
+def _reset_autotune():
+    yield
+    autotune.set_config({"kernel": {"enable": True},
+                         "layout": {"enable": False},
+                         "dataloader": {"enable": False}})
+
+
+def test_set_config_dict_and_get_config():
+    autotune.set_config({
+        "kernel": {"enable": False, "tuning_range": [2, 5]},
+        "layout": {"enable": True},
+        "dataloader": {"enable": True, "tuning_steps": 4},
+    })
+    cfg = autotune.get_config()
+    assert cfg["kernel"] == {"enable": False, "tuning_range": [2, 5]}
+    assert cfg["layout"]["enable"] is True
+    assert cfg["dataloader"]["use_autotune"] is True
+    assert cfg["dataloader"]["tuning_steps"] == 4
+
+
+def test_set_config_json_file(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"layout": {"enable": True}}))
+    autotune.set_config(str(p))
+    assert autotune.get_config()["layout"]["enable"] is True
+
+
+def test_set_config_none_enables_all():
+    autotune.set_config(None)
+    cfg = autotune.get_config()
+    assert cfg["kernel"]["enable"] and cfg["layout"]["enable"]
+    assert cfg["dataloader"]["use_autotune"]
+
+
+def test_layout_autotune_conv_parity():
+    """NHWC-tuned conv must match the NCHW baseline bit-for-bit in fp32."""
+    x = paddle.randn([2, 3, 8, 8])
+    w = paddle.randn([4, 3, 3, 3])
+    base = paddle.nn.functional.conv2d(x, w, padding=1)
+    autotune.set_config({"layout": {"enable": True}})
+    tuned = paddle.nn.functional.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(base.numpy(), tuned.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+class _SlowDataset(paddle.io.Dataset):
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        import time
+        time.sleep(0.002)
+        return np.float32(i)
+
+
+class _FastDataset(paddle.io.Dataset):
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        return np.float32(i)
+
+
+def test_dataloader_autotune_promotes_slow_pipeline():
+    """A dataset with a slow __getitem__ must be promoted to workers."""
+    autotune.set_config({"dataloader": {"enable": True, "tuning_steps": 2}})
+    dl = paddle.io.DataLoader(_SlowDataset(), batch_size=4, num_workers=0)
+    it = iter(dl)
+    next(it)
+    assert dl.num_workers > 0
+    del it
+
+    # a fast in-memory dataset stays single-process
+    dl2 = paddle.io.DataLoader(_FastDataset(), batch_size=4, num_workers=0)
+    next(iter(dl2))
+    assert dl2.num_workers == 0
+
+
+def _mp_child(q_in, q_out):
+    # receives a Tensor reconstructed from a shared-memory segment
+    t = q_in.get(timeout=30)
+    q_out.put((t.numpy().tolist(), bool(t.stop_gradient)))
+
+
+def test_multiprocessing_shared_tensor_roundtrip():
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401 — registers reducers
+    ctx = std_mp.get_context("spawn")
+    q_in, q_out = ctx.Queue(), ctx.Queue()
+    proc = ctx.Process(target=_mp_child, args=(q_in, q_out))
+    proc.start()
+    try:
+        src = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        src.stop_gradient = False
+        q_in.put(src)
+        vals, sg = q_out.get(timeout=30)
+        assert sg is False
+        np.testing.assert_array_equal(np.array(vals, dtype=np.float32),
+                                      src.numpy())
+    finally:
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.terminate()
+
+
+def test_multiprocessing_reducer_no_pipe_payload():
+    """The pickle stream must carry the shm name, not the data bytes."""
+    import io as _io
+    import pickle
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401
+    from multiprocessing.reduction import ForkingPickler
+
+    big = paddle.to_tensor(np.zeros((1024, 1024), dtype=np.float32))
+    buf = _io.BytesIO()
+    ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(big)
+    assert len(buf.getvalue()) < 64 * 1024  # 4MB tensor, tiny pickle
+
+    rebuilt = pickle.loads(buf.getvalue())
+    np.testing.assert_array_equal(rebuilt.numpy(), big.numpy())
+
+
+def _fp_roundtrip(obj):
+    import io as _io
+    import pickle
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401
+    from multiprocessing.reduction import ForkingPickler
+    buf = _io.BytesIO()
+    ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(obj)
+    return pickle.loads(buf.getvalue())
+
+
+def test_multiprocessing_bfloat16_tensor():
+    """ml_dtypes dtypes must survive the shm reducer (dtype ships by name,
+    not by numpy .str which is opaque void for bf16)."""
+    big = paddle.cast(paddle.to_tensor(
+        np.random.rand(256, 256).astype(np.float32)), "bfloat16")
+    rebuilt = _fp_roundtrip(big)
+    assert str(rebuilt.dtype).endswith("bfloat16")
+    np.testing.assert_array_equal(
+        rebuilt.numpy().astype(np.float32), big.numpy().astype(np.float32))
+
+
+def test_multiprocessing_parameter_keeps_trainable_and_name():
+    from paddle_tpu.nn.layer.layers import Parameter
+    frozen = Parameter(np.ones((300, 300), dtype=np.float32),
+                       trainable=False, name="w_frozen")
+    out = _fp_roundtrip(frozen)
+    assert isinstance(out, Parameter)
+    assert out.trainable is False and out.stop_gradient is True
+    assert out.name == "w_frozen"
+    # small parameter ships inline through the same path
+    small = Parameter(np.ones((4,), dtype=np.float32), trainable=False,
+                      name="b")
+    out2 = _fp_roundtrip(small)
+    assert out2.trainable is False and out2.name == "b"
+
+
+def test_multiprocessing_small_tensor_ships_inline():
+    """Tiny tensors must not consume shm LRU slots (eviction would unlink
+    segments receivers haven't attached yet)."""
+    from paddle_tpu.incubate.multiprocessing import reductions
+    before = len(reductions._shared_cache)
+    for i in range(16):
+        _fp_roundtrip(paddle.to_tensor(np.float32(i)))
+    assert len(reductions._shared_cache) == before
+
+
+def test_multiprocessing_zero_size_tensor():
+    import io as _io
+    import pickle
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401
+    from multiprocessing.reduction import ForkingPickler
+
+    empty = paddle.to_tensor(np.zeros((0, 3), dtype=np.float32))
+    buf = _io.BytesIO()
+    ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(empty)
+    rebuilt = pickle.loads(buf.getvalue())
+    assert rebuilt.shape == [0, 3]

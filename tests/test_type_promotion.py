@@ -1,0 +1,100 @@
+"""Exhaustive parity of core.type_promotion against the reference's
+`_promoteTypesLookup` (paddle/phi/common/type_promotion.h:66-83) plus a
+test documenting the runtime 64-bit width divergence (x64 off)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.type_promotion import (
+    get_promote_dtype, need_type_promotion, promote_types,
+)
+
+# the 12 dtypes in the reference's DataTypeToNum order
+# (type_promotion.h:19-47)
+DTYPES = ["uint8", "int8", "int16", "int32", "int64", "float16", "float32",
+          "float64", "complex64", "complex128", "bool", "bfloat16"]
+
+u1, i1, i2, i4, i8 = "uint8", "int8", "int16", "int32", "int64"
+f2, f4, f8 = "float16", "float32", "float64"
+c4, c8, b1, bf = "complex64", "complex128", "bool", "bfloat16"
+
+# transcription of the reference lookup table (type_promotion.h:66-83):
+# REF_TABLE[i][j] == promoteTypes(DTYPES[i], DTYPES[j])
+REF_TABLE = [
+    #        u1  i1  i2  i4  i8  f2  f4  f8  c4  c8  b1  bf
+    [u1, i2, i2, i4, i8, f2, f4, f8, c4, c8, u1, bf],   # u1
+    [i2, i1, i2, i4, i8, f2, f4, f8, c4, c8, i1, bf],   # i1
+    [i2, i2, i2, i4, i8, f2, f4, f8, c4, c8, i2, bf],   # i2
+    [i4, i4, i4, i4, i8, f2, f4, f8, c4, c8, i4, bf],   # i4
+    [i8, i8, i8, i8, i8, f2, f4, f8, c4, c8, i8, bf],   # i8
+    [f2, f2, f2, f2, f2, f2, f4, f8, c4, c8, f2, f4],   # f2
+    [f4, f4, f4, f4, f4, f4, f4, f8, c4, c8, f4, f4],   # f4
+    [f8, f8, f8, f8, f8, f8, f8, f8, c8, c8, f8, f8],   # f8
+    [c4, c4, c4, c4, c4, c4, c4, c8, c4, c8, c4, c4],   # c4
+    [c8, c8, c8, c8, c8, c8, c8, c8, c8, c8, c8, c8],   # c8
+    [u1, i1, i2, i4, i8, f2, f4, f8, c4, c8, b1, bf],   # b1
+    [bf, bf, bf, bf, bf, f4, f4, f8, c4, c8, bf, bf],   # bf
+]
+
+
+def test_table_matches_reference_everywhere():
+    """All 144 pairs must equal the reference lookup table."""
+    mismatches = []
+    for i, x in enumerate(DTYPES):
+        for j, y in enumerate(DTYPES):
+            got = promote_types(x, y)
+            want = REF_TABLE[i][j]
+            if got != want:
+                mismatches.append((x, y, got, want))
+    assert not mismatches, mismatches
+
+
+def test_need_type_promotion_gate():
+    """Reference NeedTypePromotion: distinct float pairs only
+    (type_promotion.h:107)."""
+    assert need_type_promotion("float16", "float32")
+    assert need_type_promotion("bfloat16", "float16")
+    assert not need_type_promotion("float32", "float32")
+    assert not need_type_promotion("int8", "int16")
+    assert not need_type_promotion("int64", "float32")
+    assert not need_type_promotion("bool", "float16")
+
+
+def test_comparison_ops_return_bool():
+    assert get_promote_dtype("greater_than", "float16", "float32") == "bool"
+    assert get_promote_dtype("equal", "int8", "int32") == "bool"
+    assert get_promote_dtype("add", "float16", "float32") == "float32"
+
+
+def test_runtime_promotion_matches_table_modulo_width():
+    """Runtime jnp arithmetic follows the same table, except 64-bit results
+    materialize at 32-bit width when jax_enable_x64 is off (the documented
+    de-scope in core/type_promotion.py)."""
+    x64 = jax.config.jax_enable_x64
+    narrow = {"int64": "int32", "float64": "float32",
+              "complex128": "complex64"}
+    for i, a in enumerate(DTYPES):
+        for j, b in enumerate(DTYPES):
+            if not x64 and (a in narrow or b in narrow):
+                continue  # inputs themselves would be truncated at creation
+            x = jnp.ones((2,), dtype=a)
+            y = jnp.ones((2,), dtype=b)
+            got = str((x + y).dtype)
+            want = REF_TABLE[i][j]
+            if not x64:
+                want = narrow.get(want, want)
+            assert got == want, (a, b, got, want)
+
+
+def test_runtime_width_divergence_documented():
+    """The divergence itself, pinned: int64 inputs truncate to int32 under
+    x64-off, so i4 x i8 runs as int32 (reference would give int64)."""
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 on: no width divergence")
+    with np.testing.suppress_warnings() as sup:
+        sup.filter(UserWarning)
+        x = jnp.ones((2,), dtype="int32")
+        y = jnp.ones((2,), dtype="int64")  # truncated to int32
+    assert str((x + y).dtype) == "int32"
+    assert promote_types("int32", "int64") == "int64"  # table stays honest
