@@ -40,10 +40,11 @@ def set_config(config=None):
         _io.set_autotune_config(use_autotune=True)
         return
 
+    import os as _os
     config_dict = {}
     if isinstance(config, dict):
         config_dict = config
-    elif isinstance(config, str):
+    elif isinstance(config, (str, _os.PathLike)):
         try:
             with open(config) as fh:
                 config_dict = json.load(fh)
@@ -51,6 +52,10 @@ def set_config(config=None):
             warnings.warn(
                 f"Load config error: {e}; "
                 "use default configuration for auto-tuning.")
+    else:
+        warnings.warn(
+            f"unsupported autotune config type {type(config).__name__}; "
+            "expected dict, str or PathLike — nothing configured.")
 
     if "kernel" in config_dict:
         kcfg = config_dict["kernel"]

@@ -374,6 +374,10 @@ class DataLoader:
         import time as _time
         if self._iterable_mode or self.batch_sampler is None:
             return 0
+        if iter(self.batch_sampler) is self.batch_sampler:
+            # one-shot iterator/generator sampler: probing would consume
+            # the epoch's first batches — leave the loader untuned
+            return 0
         steps = max(2, _autotune_cfg["tuning_steps"])
         # time only the work the workers could offload: __getitem__ plus a
         # numpy-level collate. The host->device transfer in the default

@@ -293,12 +293,15 @@ class GPTForCausalLM(nn.Layer):
                                          cfg.initializer_range),
                                      bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None):
-        hidden = self.transformer(input_ids, position_ids)
+    def _project(self, hidden):
+        """Vocab projection (tied embedding transpose or separate head)."""
         if self.lm_head is None:
             return ops.matmul(hidden, self.transformer.wte.weight,
                               transpose_y=True)
         return self.lm_head(hidden)
+
+    def forward(self, input_ids, position_ids=None):
+        return self._project(self.transformer(input_ids, position_ids))
 
     def init_cache(self, batch_size, max_length, dtype=None):
         """Zeroed per-layer KV caches [B, T, Hkv, D] for cached decode.
@@ -319,19 +322,19 @@ class GPTForCausalLM(nn.Layer):
         plus updated caches (the generation fast path)."""
         hidden, new_caches = self.transformer.forward_step(
             input_ids, caches, pos)
-        if self.lm_head is None:
-            logits = ops.matmul(hidden, self.transformer.wte.weight,
-                                transpose_y=True)
-        else:
-            logits = self.lm_head(hidden)
-        return logits, new_caches
+        return self._project(hidden), new_caches
 
     def loss(self, input_ids, labels=None, position_ids=None):
-        """Causal LM loss. labels defaults to input_ids (shift happens here)."""
+        """Causal LM loss. labels defaults to input_ids (shift happens here).
+
+        The shift slices the *hidden* states before the vocab projection:
+        slicing logits afterwards would force a copy of the full [B,S,V]
+        logits (1.6 GB at the flagship shape) that the projection of the
+        sliced hidden never materializes."""
         if labels is None:
             labels = input_ids
-        logits = self.forward(input_ids, position_ids)
-        shift_logits = logits[:, :-1, :]
+        hidden = self.transformer(input_ids, position_ids)[:, :-1, :]
+        shift_logits = self._project(hidden)
         shift_labels = labels[:, 1:]
         return F.cross_entropy(
             ops.reshape(shift_logits, [-1, self.cfg.vocab_size]),

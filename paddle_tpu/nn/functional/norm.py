@@ -5,6 +5,7 @@ fuses; under data parallelism BatchNorm stats stay per-shard (SyncBatchNorm
 uses psum via the distributed package)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...ops._helpers import apply, wrap, Tensor
@@ -73,16 +74,53 @@ def update_running_stats(running_mean, running_var, mean, var, momentum, n):
                           + unbiased * (1 - momentum))
 
 
-def _layer_norm_impl(x, w, b, *, epsilon, begin_axis):
-    axes = tuple(range(begin_axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) / jnp.sqrt(var + epsilon)
-    if w is not None:
-        out = out * w
-    if b is not None:
-        out = out + b
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_fused(x, w, b, epsilon, begin_axis):
+    out, _ = _ln_fused_fwd(x, w, b, epsilon, begin_axis)
     return out
+
+
+def _ln_fused_fwd(x, w, b, epsilon, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    rstd = jax.lax.rsqrt(var + epsilon)
+    xhat = (xf - mean) * rstd
+    out = (xhat * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(
+        x.dtype)
+    return out, (x, w, b, mean, rstd)
+
+
+def _ln_fused_bwd(epsilon, begin_axis, res, dy):
+    # analytic LN backward (two fused passes instead of AD's replayed
+    # reduction chains): dx = rstd*(dxhat - mean(dxhat) - xhat*mean(dxhat*xhat))
+    x, w, b, mean, rstd = res
+    axes = tuple(range(begin_axis, x.ndim))
+    lead = tuple(range(begin_axis))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean) * rstd
+    dxhat = dyf * w.astype(jnp.float32)
+    m1 = jnp.sum(dxhat, axis=axes, keepdims=True) / n
+    m2 = jnp.sum(dxhat * xhat, axis=axes, keepdims=True) / n
+    dx = (rstd * (dxhat - m1 - xhat * m2)).astype(x.dtype)
+    dw = jnp.sum(dyf * xhat, axis=lead).astype(w.dtype)
+    db = jnp.sum(dyf, axis=lead).astype(b.dtype)
+    return dx, dw, db
+
+
+_ln_fused.defvjp(_ln_fused_fwd, _ln_fused_bwd)
+
+
+def _layer_norm_impl(x, w, b, *, epsilon, begin_axis):
+    return _ln_fused(x, w, b, epsilon, begin_axis)
 
 
 def _layer_norm_nowb_impl(x, *, epsilon, begin_axis):
