@@ -1,0 +1,31 @@
+"""Forward-mode AD routing flag.
+
+The hard-label cross-entropy and affine layer_norm run through
+`jax.custom_vjp` fast paths (hand-written backwards, see
+nn/functional/loss.py and norm.py). custom_vjp functions reject
+forward-mode differentiation by design, so the public
+`paddle.incubate.autograd.jvp`/`forward_grad`/`hessian` entry points wrap
+their traces in `forward_ad()`; ops consult `forward_ad_active()` at
+dispatch time and fall back to the plain-jnp compositions (which
+differentiate in any mode). The flag is threaded into the op's static
+cache key, so forward- and reverse-mode traces get separate compiled
+entries and never alias."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def forward_ad_active():
+    return getattr(_state, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def forward_ad():
+    _state.depth = getattr(_state, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _state.depth -= 1

@@ -277,7 +277,7 @@ def auto_parallelize(model, optimizer=None, loss_fn=None, *, batch_size,
 @dataclasses.dataclass
 class Measurement:
     candidate: Candidate
-    step_time: float            # measured seconds (best of N)
+    step_time: float            # measured seconds (mean of pipelined iters)
     predicted: float            # analytic model's estimate
 
 
@@ -310,21 +310,26 @@ class TunedPlan(Plan):
 
 
 def _time_train_step(step, batch, warmup=1, iters=2):
-    """Best-of-N wall time of step.train_batch. Fences through the loss
-    readback (float(...)) — block_until_ready can return at enqueue time
-    through a PJRT relay, a host readback cannot."""
+    """Mean wall time of step.train_batch over `iters` pipelined steps.
+    Fences through the loss readback (float(...)) — block_until_ready can
+    return at enqueue time through a PJRT relay, a host readback cannot.
+    The fence sits OUTSIDE the timed loop so per-call dispatch latency
+    (~tens of ms through a relay) amortizes instead of being billed to
+    every step — the same methodology as bench.py."""
     import time
 
+    def run():
+        return (step.train_batch(*batch) if isinstance(batch, tuple)
+                else step.train_batch(batch))
+
     for _ in range(warmup):
-        float(step.train_batch(*batch) if isinstance(batch, tuple)
-              else step.train_batch(batch))
-    best = float("inf")
+        float(run())
+    t0 = time.perf_counter()
+    loss = None
     for _ in range(iters):
-        t0 = time.perf_counter()
-        float(step.train_batch(*batch) if isinstance(batch, tuple)
-              else step.train_batch(batch))
-        best = min(best, time.perf_counter() - t0)
-    return best
+        loss = run()
+    float(loss)
+    return (time.perf_counter() - t0) / iters
 
 
 def tune(model, optimizer=None, loss_fn=None, *, batch_size, seq_len,

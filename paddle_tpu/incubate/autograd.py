@@ -50,10 +50,12 @@ def jacobian(func, xs, create_graph=False):
 
 def hessian(func, xs, create_graph=False):
     """d^2 func / d xs^2 for scalar-output func."""
+    from ..core.fwd_ad import forward_ad
     single = not isinstance(xs, (list, tuple))
     arrays = [_unwrap(x) for x in (xs if not single else [xs])]
-    hes = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
-        *arrays)
+    with forward_ad():  # jax.hessian = jacfwd(jacrev): forward-mode outer
+        hes = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+            *arrays)
     if single:
         hes = hes[0][0] if isinstance(hes, tuple) else hes
     return _wrap_out(hes)
@@ -61,6 +63,7 @@ def hessian(func, xs, create_graph=False):
 
 def jvp(func, xs, v=None):
     """Forward-mode: (outputs, J @ v) (reference functional.py jvp)."""
+    from ..core.fwd_ad import forward_ad
     single = not isinstance(xs, (list, tuple))
     arrays = tuple(_unwrap(x) for x in (xs if not single else [xs]))
     if v is None:
@@ -68,7 +71,8 @@ def jvp(func, xs, v=None):
     else:
         vs = v if isinstance(v, (list, tuple)) else [v]
         tangents = tuple(_unwrap(t) for t in vs)
-    out, tan = jax.jvp(_wrap_fn(func), arrays, tangents)
+    with forward_ad():  # custom_vjp ops fall back to composed forms
+        out, tan = jax.jvp(_wrap_fn(func), arrays, tangents)
     return _wrap_out(out), _wrap_out(tan)
 
 

@@ -71,8 +71,8 @@ _ce_hard.defvjp(_ce_hard_fwd, _ce_hard_bwd)
 
 
 def _ce_impl(logits, label, *, soft_label, axis, use_softmax, reduction,
-             ignore_index, has_weight):
-    if not soft_label and use_softmax:
+             ignore_index, has_weight, fwd_ad=False):
+    if not soft_label and use_softmax and not fwd_ad:
         # hard-label softmax CE: hand-written vjp (below) — the AD of the
         # composed log_softmax+take_along_axis would materialize logp AND a
         # scattered d_logp over the full [T, V] logits (23 ms/step of pure
@@ -89,9 +89,12 @@ def _ce_impl(logits, label, *, soft_label, axis, use_softmax, reduction,
             logp = jnp.log(jnp.maximum(logits, 1e-30))
         loss = -jnp.sum(label * logp, axis=axis)
     else:
-        # only reachable with use_softmax=False (the softmax case took the
-        # fused-vjp path above): inputs are already probabilities
-        logp = jnp.log(jnp.maximum(logits, 1e-30))
+        # reachable with use_softmax=False (inputs already probabilities)
+        # or under forward-mode AD (composed ops differentiate in any mode)
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
         lbl = label
         if lbl.ndim == logp.ndim:
             lbl = jnp.squeeze(lbl, axis=axis)
@@ -140,10 +143,12 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                      {"soft_label": soft_label, "axis": int(axis),
                       "use_softmax": bool(use_softmax), "reduction": reduction,
                       "ignore_index": int(ignore_index)})
+    from ...core.fwd_ad import forward_ad_active
     return apply("cross_entropy", _ce_impl, (x, l),
                  {"soft_label": bool(soft_label), "axis": int(axis),
                   "use_softmax": bool(use_softmax), "reduction": reduction,
-                  "ignore_index": int(ignore_index), "has_weight": False})
+                  "ignore_index": int(ignore_index), "has_weight": False,
+                  "fwd_ad": forward_ad_active()})
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

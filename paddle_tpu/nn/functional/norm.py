@@ -119,30 +119,44 @@ def _ln_fused_bwd(epsilon, begin_axis, res, dy):
 _ln_fused.defvjp(_ln_fused_fwd, _ln_fused_bwd)
 
 
-def _layer_norm_impl(x, w, b, *, epsilon, begin_axis):
+def _layer_norm_impl(x, w, b, *, epsilon, begin_axis, fwd_ad=False):
+    if fwd_ad:
+        # composed form differentiates in any mode (custom_vjp rejects jvp)
+        axes = tuple(range(begin_axis, x.ndim))
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        xhat = (xf - mean) / jnp.sqrt(var + epsilon)
+        return (xhat * w.astype(jnp.float32)
+                + b.astype(jnp.float32)).astype(x.dtype)
     return _ln_fused(x, w, b, epsilon, begin_axis)
 
 
 def _layer_norm_nowb_impl(x, *, epsilon, begin_axis):
+    # weight/bias-free spelling kept for the op registry; same f32-stat
+    # normalization as the affine path minus the affine epilogue
     axes = tuple(range(begin_axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    return (x - mean) / jnp.sqrt(var + epsilon)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    return ((xf - mean) / jnp.sqrt(var + epsilon)).astype(x.dtype)
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
+    from ...core.fwd_ad import forward_ad_active
     xx = wrap(x)
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
     begin_axis = xx.ndim - len(normalized_shape)
-    if weight is None and bias is None:
-        return apply("layer_norm_nowb", _layer_norm_nowb_impl, (xx,),
-                     {"epsilon": float(epsilon), "begin_axis": begin_axis})
+    # always run the affine fused path (ones/zeros synthesized when the
+    # caller has no affine params) so every spelling shares the analytic
+    # vjp and f32 statistics
     w = wrap(weight) if weight is not None else Tensor(jnp.ones(tuple(normalized_shape), xx._value.dtype))
     b = wrap(bias) if bias is not None else Tensor(jnp.zeros(tuple(normalized_shape), xx._value.dtype))
     return apply("layer_norm", _layer_norm_impl, (xx, w, b),
-                 {"epsilon": float(epsilon), "begin_axis": begin_axis})
+                 {"epsilon": float(epsilon), "begin_axis": begin_axis,
+                  "fwd_ad": forward_ad_active()})
 
 
 def _rms_norm_impl(x, w, *, epsilon, begin_axis):
