@@ -26,7 +26,7 @@ BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
     "softmax", "log_softmax", "cross_entropy", "cross_entropy_w", "mse_loss",
     "l1_loss", "norm", "sum", "mean", "cumsum", "logsumexp", "layer_norm",
-    "layer_norm_nowb", "batch_norm_train", "batch_norm_infer", "rms_norm",
+    "batch_norm_train", "batch_norm_infer", "rms_norm",
 }
 
 

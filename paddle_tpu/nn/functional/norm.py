@@ -132,16 +132,6 @@ def _layer_norm_impl(x, w, b, *, epsilon, begin_axis, fwd_ad=False):
     return _ln_fused(x, w, b, epsilon, begin_axis)
 
 
-def _layer_norm_nowb_impl(x, *, epsilon, begin_axis):
-    # weight/bias-free spelling kept for the op registry; same f32-stat
-    # normalization as the affine path minus the affine epilogue
-    axes = tuple(range(begin_axis, x.ndim))
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.var(xf, axis=axes, keepdims=True)
-    return ((xf - mean) / jnp.sqrt(var + epsilon)).astype(x.dtype)
-
-
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
     from ...core.fwd_ad import forward_ad_active
