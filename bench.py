@@ -206,6 +206,101 @@ def bench_bert_finetune(on_tpu, dev):
     })
 
 
+def bench_ppyoloe(on_tpu, dev):
+    """BASELINE config 3: PP-YOLOE-s-class anchor-free detector train step
+    (COCO-shape synthetic), images/sec. Train FLOPs/img come from XLA's own
+    cost analysis of the compiled forward (3x fwd for fwd+bwd), so the MFU
+    is accounted against the model actually run, not a paper number."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.vision.models import ppyoloe_s
+
+    batch = int(os.environ.get("BENCH_BATCH", "16" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "2"))
+    size = 640 if on_tpu else 128
+    max_boxes = 16
+    # channels-last is the MXU-native conv layout (same lever as the
+    # resnet config; NCHW<->NHWC loss parity is tested in-tree)
+    fmt = os.environ.get("BENCH_YOLO_FORMAT", "NHWC" if on_tpu else "NCHW")
+
+    def loss_fn(m, img, gb, gl, gm):
+        return m.loss(img, gb, gl, gm)
+
+    def make_engine():
+        paddle.seed(0)
+        model = ppyoloe_s(num_classes=80, max_boxes=max_boxes,
+                          data_format=fmt)
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                        parameters=model.parameters())
+        mesh = dist.build_mesh(dp=-1, devices=jax.devices()[:1])
+        return dist.parallelize(model, opt, loss_fn=loss_fn, mesh=mesh,
+                                compute_dtype="bfloat16" if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    img_shape = (batch, 3, size, size) if fmt == "NCHW" \
+        else (batch, size, size, 3)
+    img = paddle.to_tensor(rng.randn(*img_shape).astype("float32"))
+    # synthetic boxes: xyxy within the image, ~8 valid per sample
+    x0 = rng.uniform(0, size * 0.6, (batch, max_boxes, 2))
+    wh = rng.uniform(size * 0.05, size * 0.35, (batch, max_boxes, 2))
+    gb = paddle.to_tensor(
+        np.concatenate([x0, np.minimum(x0 + wh, size - 1)], -1)
+        .astype("float32"))
+    gl = paddle.to_tensor(rng.randint(0, 80, (batch, max_boxes))
+                          .astype("int64"))
+    gm = paddle.to_tensor(
+        (np.arange(max_boxes)[None] < 8).repeat(batch, 0)
+        .astype("float32"))
+
+    final_loss, dt = _measure_with_retry(make_engine, (img, gb, gl, gm),
+                                         steps, label="ppyoloe bench")
+    ips = batch * steps / dt
+
+    # forward FLOPs of the model actually benched, from XLA cost analysis
+    flops_img = None
+    try:
+        from paddle_tpu.distributed.engine import functionalize
+        paddle.seed(0)
+        from paddle_tpu.vision.models import ppyoloe_s as _mk
+        m2 = _mk(num_classes=80, max_boxes=max_boxes, data_format=fmt)
+        apply_fn, params, buffers = functionalize(
+            m2, method=lambda *b: loss_fn(m2, *b))
+        import jax.numpy as jnp
+        pv = {n: p._value.astype("bfloat16" if on_tpu else "float32")
+              if jnp.issubdtype(p._value.dtype, jnp.floating) else p._value
+              for n, p in params.items()}
+        bv = {n: b._value for n, b in buffers.items()}
+        from paddle_tpu.core.tensor import Tensor as _T
+
+        def fwd(p, b, i, g1, g2, g3):
+            out, _ = apply_fn(p, b, _T(i), _T(g1), _T(g2), _T(g3))
+            return out
+
+        lowered = jax.jit(fwd).lower(
+            pv, bv, img._value.astype("bfloat16" if on_tpu else "float32"),
+            gb._value, gl._value, gm._value)
+        cost = lowered.compile().cost_analysis()
+        if cost and cost.get("flops"):
+            flops_img = 3.0 * float(cost["flops"]) / batch
+    except Exception as e:
+        print(f"ppyoloe: cost analysis unavailable ({e})", file=sys.stderr)
+
+    peak = 197e12 if on_tpu else float("inf")
+    mfu = (ips * flops_img / peak) if flops_img else 0.0
+    return _emit({
+        "metric": f"ppyoloe_s detector train images/sec ({size}px, "
+                  f"bs={batch}, {fmt}, bf16)",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if (on_tpu and flops_img) else 0.0,
+        "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
+                  "train_gflops_per_img": round(flops_img / 1e9, 2)
+                  if flops_img else None,
+                  "platform": dev.platform},
+    })
+
+
 def bench_lora_decode(on_tpu, dev):
     """BASELINE config 5: LoRA-adapted LLM autoregressive decode tokens/sec.
     Decode is HBM-bandwidth-bound: the target is 40% of the
@@ -360,7 +455,8 @@ def main():
         # in-repo artifact); flagship line alone on stdout
         os.environ.pop("BENCH_MODEL", None)   # each config picks defaults
         payloads = [_emit(bench_gpt(on_tpu, dev))]
-        for fn in (bench_resnet50, bench_bert_finetune, bench_lora_decode):
+        for fn in (bench_resnet50, bench_bert_finetune, bench_ppyoloe,
+                   bench_lora_decode):
             os.environ.pop("BENCH_MODEL", None)
             payloads.append(fn(on_tpu, dev))
         for wdtype in ("int8", "int4"):       # weight-only decode variants
@@ -380,6 +476,8 @@ def main():
         return 0 if bench_resnet50(on_tpu, dev) else 1
     if mode.startswith("bert"):
         return 0 if bench_bert_finetune(on_tpu, dev) else 1
+    if "yolo" in mode:
+        return 0 if bench_ppyoloe(on_tpu, dev) else 1
     if "lora" in mode or mode == "decode":
         return 0 if bench_lora_decode(on_tpu, dev) else 1
     print(json.dumps(bench_gpt(on_tpu, dev)))

@@ -2758,9 +2758,13 @@ def _np_nms(boxes, scores=None, iou_threshold=0.3, top_k=None, **k):
 
 def _np_roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, **k):
     """Mirrors vision/ops.py roi_pool's documented bin contract
-    (floor/ceil over a linspace of the scaled roi)."""
+    (floor/ceil over a linspace of the scaled roi), including the
+    roi->image mapping via boxes_num."""
     xs = np.asarray(x, "float64")
     bs = np.asarray(boxes, "float64")
+    bn = np.asarray(boxes_num) if boxes_num is not None \
+        else np.array([bs.shape[0]])
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
     oh = ow = output_size if np.isscalar(output_size) else None
     if oh is None:
         oh, ow = output_size
@@ -2769,6 +2773,7 @@ def _np_roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, **k):
     h, w = xs.shape[2], xs.shape[3]
     out = np.zeros((n_roi, c, oh, ow), "float64")
     for r in range(n_roi):
+        bi = int(batch_idx[r])
         x0, y0, x1, y1 = bs[r] * spatial_scale
         x0, y0 = int(np.floor(x0)), int(np.floor(y0))
         x1, y1 = int(np.ceil(x1)), int(np.ceil(y1))
@@ -2783,7 +2788,7 @@ def _np_roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, **k):
                 xa, xb = int(np.floor(xcs[j])), int(np.ceil(xcs[j + 1]))
                 xa, xb = np.clip([xa, xb], 0, w)
                 if yb > ya and xb > xa:
-                    out[r, :, i, j] = xs[0, :, ya:yb, xa:xb].max((-2, -1))
+                    out[r, :, i, j] = xs[bi, :, ya:yb, xa:xb].max((-2, -1))
     return out
 
 

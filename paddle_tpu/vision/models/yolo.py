@@ -45,11 +45,11 @@ def _dist_to_boxes(d_log, px, py, stride):
                       px + d[..., 2], py + d[..., 3]], -1)
 
 
-def _conv_bn_act(c_in, c_out, k=3, s=1):
+def _conv_bn_act(c_in, c_out, k=3, s=1, data_format="NCHW"):
     return nn.Sequential(
         nn.Conv2D(c_in, c_out, k, stride=s, padding=k // 2,
-                  bias_attr=False),
-        nn.BatchNorm2D(c_out),
+                  bias_attr=False, data_format=data_format),
+        nn.BatchNorm2D(c_out, data_format=data_format),
         nn.Silu(),
     )
 
@@ -57,16 +57,18 @@ def _conv_bn_act(c_in, c_out, k=3, s=1):
 class _CSPBlock(nn.Layer):
     """Cross-stage-partial residual stage (CSPResNet-style)."""
 
-    def __init__(self, c_in, c_out, n=1, stride=2):
+    def __init__(self, c_in, c_out, n=1, stride=2, data_format="NCHW"):
         super().__init__()
-        self.down = _conv_bn_act(c_in, c_out, 3, stride)
+        self._cat_axis = 1 if data_format == "NCHW" else -1
+        self.down = _conv_bn_act(c_in, c_out, 3, stride, data_format)
         mid = c_out // 2
-        self.split1 = _conv_bn_act(c_out, mid, 1)
-        self.split2 = _conv_bn_act(c_out, mid, 1)
+        self.split1 = _conv_bn_act(c_out, mid, 1, 1, data_format)
+        self.split2 = _conv_bn_act(c_out, mid, 1, 1, data_format)
         self.blocks = nn.Sequential(*[
-            nn.Sequential(_conv_bn_act(mid, mid, 3), _conv_bn_act(mid, mid, 3))
+            nn.Sequential(_conv_bn_act(mid, mid, 3, 1, data_format),
+                          _conv_bn_act(mid, mid, 3, 1, data_format))
             for _ in range(n)])
-        self.fuse = _conv_bn_act(2 * mid, c_out, 1)
+        self.fuse = _conv_bn_act(2 * mid, c_out, 1, 1, data_format)
 
     def forward(self, x):
         x = self.down(x)
@@ -76,18 +78,19 @@ class _CSPBlock(nn.Layer):
             b = b + blk(b)
         from ...ops import manipulation as man
 
-        return self.fuse(man.concat([a, b], axis=1))
+        return self.fuse(man.concat([a, b], axis=self._cat_axis))
 
 
 class _Head(nn.Layer):
     """Decoupled per-level head: class logits + (l, t, r, b) distances."""
 
-    def __init__(self, ch, num_classes):
+    def __init__(self, ch, num_classes, data_format="NCHW"):
         super().__init__()
-        self.cls_conv = _conv_bn_act(ch, ch, 3)
-        self.reg_conv = _conv_bn_act(ch, ch, 3)
-        self.cls_pred = nn.Conv2D(ch, num_classes, 1)
-        self.reg_pred = nn.Conv2D(ch, 4, 1)
+        self.cls_conv = _conv_bn_act(ch, ch, 3, 1, data_format)
+        self.reg_conv = _conv_bn_act(ch, ch, 3, 1, data_format)
+        self.cls_pred = nn.Conv2D(ch, num_classes, 1,
+                                  data_format=data_format)
+        self.reg_pred = nn.Conv2D(ch, 4, 1, data_format=data_format)
         # focal-style prior: rare-positive initialization
         self.cls_pred.bias.set_value(
             np.full(num_classes, -math.log((1 - 0.01) / 0.01), np.float32))
@@ -99,23 +102,27 @@ class _Head(nn.Layer):
 class PPYOLOE(nn.Layer):
     """Simplified PP-YOLOE: 3 detection levels (strides 8/16/32)."""
 
-    def __init__(self, num_classes=80, width=0.5, depth=1, max_boxes=16):
+    def __init__(self, num_classes=80, width=0.5, depth=1, max_boxes=16,
+                 data_format="NCHW"):
         super().__init__()
         self.num_classes = num_classes
         self.max_boxes = max_boxes
+        self.data_format = data_format
+        df = data_format
         c = [max(16, int(64 * width)), max(32, int(128 * width)),
              max(64, int(256 * width)), max(64, int(512 * width))]
-        self.stem = _conv_bn_act(3, c[0], 3, 2)       # /2
-        self.stage1 = _CSPBlock(c[0], c[1], depth)    # /4
-        self.stage2 = _CSPBlock(c[1], c[2], depth)    # /8  -> P3
-        self.stage3 = _CSPBlock(c[2], c[3], depth)    # /16 -> P4
-        self.stage4 = _CSPBlock(c[3], c[3], depth)    # /32 -> P5
+        self.stem = _conv_bn_act(3, c[0], 3, 2, df)         # /2
+        self.stage1 = _CSPBlock(c[0], c[1], depth, 2, df)   # /4
+        self.stage2 = _CSPBlock(c[1], c[2], depth, 2, df)   # /8  -> P3
+        self.stage3 = _CSPBlock(c[2], c[3], depth, 2, df)   # /16 -> P4
+        self.stage4 = _CSPBlock(c[3], c[3], depth, 2, df)   # /32 -> P5
         # lateral 1x1s onto a shared neck width
         nw = c[2]
-        self.lat3 = _conv_bn_act(c[2], nw, 1)
-        self.lat4 = _conv_bn_act(c[3], nw, 1)
-        self.lat5 = _conv_bn_act(c[3], nw, 1)
-        self.heads = nn.LayerList([_Head(nw, num_classes) for _ in range(3)])
+        self.lat3 = _conv_bn_act(c[2], nw, 1, 1, df)
+        self.lat4 = _conv_bn_act(c[3], nw, 1, 1, df)
+        self.lat5 = _conv_bn_act(c[3], nw, 1, 1, df)
+        self.heads = nn.LayerList([_Head(nw, num_classes, df)
+                                   for _ in range(3)])
         self.strides = (8, 16, 32)
 
     def backbone(self, x):
@@ -140,9 +147,16 @@ class PPYOLOE(nn.Layer):
         outs = self.forward(images)
         flat_cls, flat_reg, flat_pts, flat_stride = [], [], [], []
         for (cls, reg), s in zip(outs, self.strides):
-            b, c, h, w = cls.shape
-            flat_cls.append(cls.transpose([0, 2, 3, 1]).reshape([b, h * w, c]))
-            flat_reg.append(reg.transpose([0, 2, 3, 1]).reshape([b, h * w, 4]))
+            if self.data_format == "NCHW":
+                b, c, h, w = cls.shape
+                flat_cls.append(
+                    cls.transpose([0, 2, 3, 1]).reshape([b, h * w, c]))
+                flat_reg.append(
+                    reg.transpose([0, 2, 3, 1]).reshape([b, h * w, 4]))
+            else:   # NHWC: channels already last, the flatten is free
+                b, h, w, c = cls.shape
+                flat_cls.append(cls.reshape([b, h * w, c]))
+                flat_reg.append(reg.reshape([b, h * w, 4]))
             px, py = _level_points(h, w, s)
             flat_pts.append(np.stack([px, py], -1))
             flat_stride.append(np.full(h * w, s, np.float32))
@@ -168,9 +182,14 @@ class PPYOLOE(nn.Layer):
         results = []
         boxes_all, scores_all, labels_all = [], [], []
         for (cls, reg), s in zip(outs, self.strides):
-            b, c, h, w = cls.shape
-            logits = cls.transpose([0, 2, 3, 1]).reshape([b, h * w, c])
-            dist = reg.transpose([0, 2, 3, 1]).reshape([b, h * w, 4])
+            if self.data_format == "NCHW":
+                b, c, h, w = cls.shape
+                logits = cls.transpose([0, 2, 3, 1]).reshape([b, h * w, c])
+                dist = reg.transpose([0, 2, 3, 1]).reshape([b, h * w, 4])
+            else:
+                b, h, w, c = cls.shape
+                logits = cls.reshape([b, h * w, c])
+                dist = reg.reshape([b, h * w, 4])
             px, py = _level_points(h, w, s)
             ln = logits.numpy()
             boxes_all.append(np.asarray(_dist_to_boxes(

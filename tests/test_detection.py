@@ -75,3 +75,28 @@ def test_detection_loss_decreases_and_postprocess_localizes():
         if inter / max(union, 1e-9) > 0.3:
             found += 1
     assert found >= 1, dets
+
+
+def test_ppyoloe_layout_parity():
+    """NHWC (MXU-native conv layout) must reproduce the NCHW loss exactly
+    given the same weights — the bench's channels-last option relies on it
+    (bench.py config 3)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import PPYOLOE
+
+    rng = np.random.RandomState(0)
+    img = rng.randn(2, 3, 64, 64).astype("float32")
+    gb = np.array([[[4, 4, 30, 30], [10, 10, 50, 50]]] * 2, "float32")
+    gl = np.array([[1, 2]] * 2, "int64")
+    gm = np.ones((2, 2), "float32")
+
+    paddle.seed(0)
+    m1 = PPYOLOE(num_classes=5, max_boxes=2, data_format="NCHW")
+    l1 = float(m1.loss(paddle.to_tensor(img), paddle.to_tensor(gb),
+                       paddle.to_tensor(gl), paddle.to_tensor(gm)))
+    m2 = PPYOLOE(num_classes=5, max_boxes=2, data_format="NHWC")
+    m2.set_state_dict(m1.state_dict())
+    l2 = float(m2.loss(paddle.to_tensor(img.transpose(0, 2, 3, 1)),
+                       paddle.to_tensor(gb), paddle.to_tensor(gl),
+                       paddle.to_tensor(gm)))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
