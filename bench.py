@@ -328,6 +328,14 @@ def bench_lora_decode(on_tpu, dev):
     if wdtype and wdtype not in ("int8", "int4"):
         raise SystemExit(
             f"BENCH_WEIGHT_DTYPE={wdtype!r} unsupported (int8|int4)")
+    kv_dtype = os.environ.get("BENCH_KV_DTYPE", "")
+    if kv_dtype and kv_dtype != "int8":
+        raise SystemExit(
+            f"BENCH_KV_DTYPE={kv_dtype!r} unsupported (int8)")
+    if kv_dtype:
+        # int8 KV cache: halves the cache bytes (memory capability; the
+        # measured throughput verdict is in docs/decode_perf.md)
+        model.cache_quant = kv_dtype
     from paddle_tpu.nn.quant import quantize_for_inference, WeightOnlyLinear
     if wdtype:
         quantize_for_inference(model, weight_dtype=wdtype)
@@ -361,7 +369,8 @@ def bench_lora_decode(on_tpu, dev):
     return _emit({
         "metric": f"{name}+LoRA decode tokens/sec (bs={batch}, "
                   f"{new_tokens} new tokens, KV cache"
-                  + (f", weight-only {wdtype}" if wdtype else "") + ")",
+                  + (f", weight-only {wdtype}" if wdtype else "")
+                  + (f", {kv_dtype} KV" if kv_dtype else "") + ")",
         "value": round(tps, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(bw_frac / 0.40, 4) if on_tpu else 0.0,
@@ -459,12 +468,17 @@ def main():
                    bench_lora_decode):
             os.environ.pop("BENCH_MODEL", None)
             payloads.append(fn(on_tpu, dev))
-        for wdtype in ("int8", "int4"):       # weight-only decode variants
+        for wdtype, kv in (("int8", ""), ("int4", ""), ("int8", "int8")):
+            # weight-only decode variants + the fully-quantized row; both
+            # env knobs are forced per row so shell-exported values cannot
+            # leak into the matrix
             os.environ["BENCH_WEIGHT_DTYPE"] = wdtype
+            os.environ["BENCH_KV_DTYPE"] = kv
             try:
                 payloads.append(bench_lora_decode(on_tpu, dev))
             finally:
                 os.environ.pop("BENCH_WEIGHT_DTYPE", None)
+                os.environ.pop("BENCH_KV_DTYPE", None)
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_ALL.json"), "w") as f:
             json.dump(payloads, f, indent=1)

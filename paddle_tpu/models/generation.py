@@ -174,7 +174,10 @@ def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
     program is cached on the model per (b, s, cfg) signature; cache buffers
     are donated so each call reuses their HBM."""
     jit_cache = _gen_jit_cache(model)
-    sig = ("cached", b, s, _cfg_key(cfg), _structure_key(model))
+    # cache layout (bf16 pairs vs int8 quads) is part of the compiled
+    # signature — a model toggling cache_quant must not reuse the program
+    sig = ("cached", b, s, _cfg_key(cfg), _structure_key(model),
+           getattr(model, "cache_quant", None))
     key = jax.random.PRNGKey(cfg.seed)
 
     cached = jit_cache.get(sig)
@@ -183,18 +186,19 @@ def _generate_cached(model, ids, cfg: GenerationConfig, b, s, total):
         param_vals = {n: p._value for n, p in params.items()}
         buffer_vals = {n: v._value for n, v in buffers.items()}
         caches = model.init_cache(b, total)
-        cache_vals = [(kc._value, vc._value) for kc, vc in caches]
+        cache_vals = [tuple(t._value for t in entry) for entry in caches]
         return Tensor(jitted(param_vals, buffer_vals, ids, cache_vals, key))
 
     caches = model.init_cache(b, total)
-    cache_vals = [(kc._value, vc._value) for kc, vc in caches]
+    # entries are (k, v) bf16 pairs or (kq, ks, vq, vs) int8 quads
+    cache_vals = [tuple(t._value for t in entry) for entry in caches]
 
     def wrapped(tokens, cache_vals, pos):
-        cts = [(Tensor(k), Tensor(v)) for k, v in cache_vals]
+        cts = [tuple(Tensor(a) for a in entry) for entry in cache_vals]
         logits, new_caches = model.decode_step(
             Tensor(tokens), cts, Tensor(pos))
         return (logits._value,
-                [(nk._value, nv._value) for nk, nv in new_caches])
+                [tuple(t._value for t in nc) for nc in new_caches])
 
     apply_fn, params, buffers = functionalize(model, method=wrapped)
     param_vals = {n: p._value for n, p in params.items()}

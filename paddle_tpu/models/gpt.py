@@ -132,7 +132,17 @@ class GPTAttention(nn.Layer):
         if cache is not None:
             # KV-cached decode (reference: the cached inference path of the
             # LLM families): write this chunk's K/V at `pos`, attend over
-            # the whole static-length cache with a position mask
+            # the whole static-length cache with a position mask.
+            # A 5-tuple cache entry is the int8-quantized layout
+            # (kq, k_scale, vq, v_scale, pos) — see init_cache(quant=).
+            if len(cache) == 5:
+                kq_c, ks_c, vq_c, vs_c, pos = cache
+                out, nkq, nks, nvq, nvs = apply(
+                    "cached_attn_int8", _cached_attn_int8_impl,
+                    [q, k, v, kq_c, ks_c, vq_c, vs_c, pos],
+                    {"num_heads": cfg.num_heads})
+                out = ops.reshape(out, [b, s, q_sz])
+                return self.out_proj(out), (nkq, nks, nvq, nvs)
             k_cache, v_cache, pos = cache
             out, new_k, new_v = apply(
                 "cached_attn", _cached_attn_impl,
@@ -150,6 +160,37 @@ class GPTAttention(nn.Layer):
         return self.dropout(self.out_proj(out))
 
 
+def _cached_attn_core(q, kk, vv, pos, num_heads, k_scale=None,
+                      v_scale=None):
+    """Shared cached-attention core: GQA repeat, causal mask over global
+    positions, softmax, PV. Optional per-(position, head) scales fold into
+    score/prob space (the int8-cache path)."""
+    import jax
+
+    hkv = kk.shape[2]
+    if hkv != num_heads:
+        rep = num_heads // hkv
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+        if k_scale is not None:
+            k_scale = jnp.repeat(k_scale, rep, axis=2)
+            v_scale = jnp.repeat(v_scale, rep, axis=2)
+    s, t = q.shape[1], kk.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32)
+    if k_scale is not None:   # [B,T,H] -> [B,H,1,T]
+        scores = scores * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, :]
+    scores = scores * scale
+    q_idx = pos + jnp.arange(s)[:, None]
+    mask = jnp.arange(t)[None, :] <= q_idx  # causal over global positions
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:   # fold into [B,H,q,T] probs before PV
+        probs = probs * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, :]
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+
+
 def _cached_attn_impl(q, k_new, v_new, k_cache, v_cache, pos, *, num_heads):
     """q [B,s,H,D]; k/v_new [B,s,Hkv,D]; caches [B,T,Hkv,D]; pos scalar
     global offset of this chunk. Returns (out, new_k_cache, new_v_cache)."""
@@ -159,22 +200,44 @@ def _cached_attn_impl(q, k_new, v_new, k_cache, v_cache, pos, *, num_heads):
         k_cache, k_new.astype(k_cache.dtype), pos, axis=1)
     v_cache = jax.lax.dynamic_update_slice_in_dim(
         v_cache, v_new.astype(v_cache.dtype), pos, axis=1)
-    hkv = k_cache.shape[2]
-    kk, vv = k_cache, v_cache
-    if hkv != num_heads:
-        rep = num_heads // hkv
-        kk = jnp.repeat(kk, rep, axis=2)
-        vv = jnp.repeat(vv, rep, axis=2)
-    s, t = q.shape[1], kk.shape[1]
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
-    q_idx = pos + jnp.arange(s)[:, None]
-    mask = jnp.arange(t)[None, :] <= q_idx  # causal over global positions
-    scores = jnp.where(mask[None, None], scores.astype(jnp.float32),
-                       -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    out = _cached_attn_core(q, k_cache, v_cache, pos, num_heads)
     return out, k_cache, v_cache
+
+
+def _quant_kv(x):
+    """Per-(batch, position, head) symmetric int8: scale = amax/127 over
+    the head dim (decode accuracy workhorse; reference analog: the LLM
+    cachekv int8 path of the PaddleNLP inference stack)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale[..., 0]
+
+
+def _cached_attn_int8_impl(q, k_new, v_new, kq_c, ks_c, vq_c, vs_c, pos, *,
+                           num_heads):
+    """int8 KV cache decode: caches store int8 values + f32 per-position
+    scales ([B,T,Hkv,D] int8 + [B,T,Hkv] f32 — half the decode-loop HBM
+    read of a bf16 cache). New K/V are quantized at write; the dequant
+    multiply fuses into the attention matmul's operand read."""
+    import jax
+
+    knq, kns = _quant_kv(k_new)
+    vnq, vns = _quant_kv(v_new)
+    kq_c = jax.lax.dynamic_update_slice_in_dim(kq_c, knq, pos, axis=1)
+    ks_c = jax.lax.dynamic_update_slice_in_dim(
+        ks_c, kns.astype(ks_c.dtype), pos, axis=1)
+    vq_c = jax.lax.dynamic_update_slice_in_dim(vq_c, vnq, pos, axis=1)
+    vs_c = jax.lax.dynamic_update_slice_in_dim(
+        vs_c, vns.astype(vs_c.dtype), pos, axis=1)
+
+    # Scales fold into SCORE space ([B,H,q,T] — tiny at decode q=1) rather
+    # than dequantizing the cache: a broadcast-multiply dequant would
+    # materialize a full bf16 cache copy every step (measured SLOWER than
+    # a bf16 cache, docs/decode_perf.md round-4 addendum).
+    out = _cached_attn_core(q, kq_c.astype(q.dtype), vq_c.astype(q.dtype),
+                            pos, num_heads, k_scale=ks_c, v_scale=vs_c)
+    return out, kq_c, ks_c, vq_c, vs_c
 
 
 class GPTMLP(nn.Layer):
@@ -271,8 +334,9 @@ class GPTModel(nn.Layer):
         if not self.cfg.rope:
             x = x + self.wpe(position_ids)
         new_caches = []
-        for blk, (kc, vc) in zip(self.layers, caches):
-            x, nc = blk(x, position_ids, cache=(kc, vc, pos))
+        for blk, entry in zip(self.layers, caches):
+            # entry: (k, v) bf16 cache or (kq, ks, vq, vs) int8 cache
+            x, nc = blk(x, position_ids, cache=(*entry, pos))
             new_caches.append(nc)
         return self.ln_f(x), new_caches
 
@@ -303,16 +367,34 @@ class GPTForCausalLM(nn.Layer):
     def forward(self, input_ids, position_ids=None):
         return self._project(self.transformer(input_ids, position_ids))
 
-    def init_cache(self, batch_size, max_length, dtype=None):
+    def init_cache(self, batch_size, max_length, dtype=None, quant=None):
         """Zeroed per-layer KV caches [B, T, Hkv, D] for cached decode.
         Cache dtype follows the parameters (bf16 params -> bf16 cache:
-        the KV read is the decode bandwidth bill)."""
+        the KV read is the decode bandwidth bill).
+
+        quant="int8" (or a `cache_quant` attribute set on the model, so
+        `generate()` picks it up without API changes) stores int8 values
+        plus f32 per-position scales — half the per-token cache read
+        (docs/decode_perf.md names the KV read as the biggest
+        weight-independent term in the decode floor)."""
         cfg = self.cfg
+        if quant is None:
+            quant = getattr(self, "cache_quant", None)
         if dtype is None:
             dtype = self.transformer.wte.weight.dtype
         shape = (batch_size, int(max_length), cfg.num_kv_heads, cfg.head_dim)
         from ..core.tensor import Tensor
 
+        if quant == "int8":
+            sshape = shape[:-1]
+            return [(Tensor(jnp.zeros(shape, jnp.int8)),
+                     Tensor(jnp.zeros(sshape, jnp.float32)),
+                     Tensor(jnp.zeros(shape, jnp.int8)),
+                     Tensor(jnp.zeros(sshape, jnp.float32)))
+                    for _ in range(cfg.num_layers)]
+        if quant is not None:
+            raise ValueError(f"unsupported cache quant {quant!r} "
+                             "(supported: 'int8')")
         return [(Tensor(jnp.zeros(shape, dtype)),
                  Tensor(jnp.zeros(shape, dtype)))
                 for _ in range(cfg.num_layers)]
