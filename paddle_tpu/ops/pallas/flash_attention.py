@@ -34,6 +34,18 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
+# long sequences (s*d near the supported() cap) stage >16MB of K/V/dO in
+# VMEM; the chip allows more than Mosaic's 16MB default scoped budget
+# (same fix as ops/pallas/weight_only.py)
+_VMEM_LIMIT = 64 * 1024 * 1024
+
+
+def _compiler_params(interpret):
+    """Shared Mosaic budget for all three kernels (fwd/dq/dkv must never
+    diverge); the interpret backend takes no compiler params."""
+    if interpret:
+        return None
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _ceil_to(x, m):
@@ -145,6 +157,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q, k, v)
     return out, lse
 
@@ -261,6 +274,7 @@ def _bwd(q, k, v, out, lse, do, *, scale, causal, block_q, block_k,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q, k, v, do, lse, delta)[0]
 
     kspec = pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0),
@@ -274,6 +288,7 @@ def _bwd(q, k, v, out, lse, do, *, scale, causal, block_q, block_k,
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
