@@ -149,5 +149,7 @@ if __name__ == "__main__":
         run("layernorm removed", patch=patch_no_layernorm)
     if "sgd" in which:
         run("SGD, no clip (optimizer cost)", optimizer="sgd", clip=False)
+    if "adamw_noclip" in which:
+        run("AdamW, no clip (clip cost isolate)", clip=False)
     if "bs32" in which:
         run("bs=32", batch=32)
