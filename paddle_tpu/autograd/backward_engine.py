@@ -55,12 +55,19 @@ def _out_shapes_cached(node):
     return shapes
 
 
-def run_backward(tensors, grad_tensors=None, retain_graph=False):
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 create_graph=False):
+    """create_graph=True runs every VJP through `dispatch.apply` (taped), so
+    the produced gradients are themselves differentiable — reference:
+    egr::RunBackward's create_graph path (paddle/fluid/eager/backward.cc:428),
+    exercised by test/legacy_test/test_imperative_double_grad.py."""
     from ..core.tensor import Tensor
     from ..core.dispatch import _get_fwd
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
+    if create_graph:
+        retain_graph = True  # taped backward must not free the saved tensors
 
     node_cts = {}  # id(GradNode) -> (node, [cotangent | None] per output slot)
     leaf_seeds = []
@@ -71,6 +78,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             entry = (node, [None] * node.n_outputs)
             node_cts[id(node)] = entry
         lst = entry[1]
+        # Tensor + Tensor in taped mode records the accumulation add itself.
         lst[idx] = ct if lst[idx] is None else lst[idx] + ct
 
     roots = []
@@ -81,6 +89,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                     "backward() on a non-scalar tensor requires an explicit grad tensor"
                 )
             ct = jnp.ones_like(t._value)
+            if create_graph:
+                ct = Tensor(ct)
+        elif create_graph:
+            ct = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
         else:
             ct = g._value if isinstance(g, Tensor) else jnp.asarray(g)
         if t._grad_node is None:
@@ -134,25 +146,40 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                     c if c is not None else _zeros_cached(s.shape, s.dtype)
                     for c, s in zip(cts, shapes)
                 ]
-            in_grads = node.run_vjp(cts)
+            if create_graph:
+                in_grads = node.run_vjp_taped(cts)
+            else:
+                in_grads = node.run_vjp(cts)
 
         for i, meta in enumerate(node.input_metas):
             pnode, pidx, in_tensor, needs = meta
             if not needs:
                 continue
-            g = _drop_float0(in_grads[i]) if in_grads is not None else None
+            if in_grads is None:
+                g = None
+            elif create_graph:
+                g = in_grads[i]
+            else:
+                g = _drop_float0(in_grads[i])
 
             if g is not None and in_tensor is not None and in_tensor._hooks:
                 for h in in_tensor._hooks:
                     if h is None:
                         continue
-                    res = h(Tensor(g))
+                    res = h(g if isinstance(g, Tensor) else Tensor(g))
                     if res is not None:
-                        g = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+                        if create_graph:
+                            g = res if isinstance(res, Tensor) else Tensor(jnp.asarray(res))
+                        else:
+                            g = res._value if isinstance(res, Tensor) else jnp.asarray(res)
 
             if pnode is None:
                 if g is not None and in_tensor is not None:
-                    if in_tensor.grad is None:
+                    if create_graph:
+                        # keep the graph: .grad is the live Tensor chain
+                        in_tensor.grad = g if in_tensor.grad is None \
+                            else in_tensor.grad + g
+                    elif in_tensor.grad is None:
                         in_tensor.grad = Tensor(g)
                     else:
                         in_tensor.grad._value = in_tensor.grad._value + g
@@ -167,7 +194,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             node.release()
 
     for t, ct in leaf_seeds:
-        if t.grad is None:
+        if create_graph:
+            ct_t = ct if isinstance(ct, Tensor) else Tensor(ct)
+            t.grad = ct_t if t.grad is None else t.grad + ct_t
+        elif t.grad is None:
             t.grad = Tensor(ct)
         else:
             t.grad._value = t.grad._value + ct

@@ -7,14 +7,16 @@ Reference: `egr::Backward`/`GeneralGrad` (paddle/fluid/eager/backward.cc:428)
 from __future__ import annotations
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False):
     from .backward_engine import run_backward
 
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
     if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
-    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph,
+                 create_graph=create_graph)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -34,7 +36,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         t.grad = None
         t.stop_gradient = False
     retain = True if retain_graph is None else retain_graph
-    run_backward(list(outputs), grad_outputs, retain_graph=retain)
+    run_backward(list(outputs), grad_outputs, retain_graph=retain,
+                 create_graph=create_graph)
     grads = []
     for t, (old_grad, old_sg) in zip(inputs, saved):
         g = t.grad

@@ -140,6 +140,49 @@ def _get_vjp(impl, statics_key, n_primals, statics):
 
 
 # --------------------------------------------------------------------------
+# create_graph=True path: the VJP itself dispatched as a taped op.
+#
+# Reference analog: egr::RunBackward with create_graph — grad-node execution
+# runs through the normal eager dispatch so new GradNodes are recorded for the
+# cotangent computation (paddle/fluid/eager/backward.cc:428). Here the VJP of
+# op `impl` becomes an op in its own right: a pure function of
+# (primals..., cotangents...) returning one grad per primal. Dispatching it via
+# `apply` makes the produced gradients differentiable (grad-of-grad), and
+# higher orders nest for free — the taped VJP of a taped VJP is just another
+# cached impl.
+# --------------------------------------------------------------------------
+
+_taped_vjp_cache: dict = {}
+
+
+def taped_vjp_impl(impl, n_primals, out_is_seq):
+    key = (impl, n_primals, out_is_seq)
+    fn = _taped_vjp_cache.get(key)
+    if fn is None:
+        def run(*args, **statics):
+            primals, cts = args[:n_primals], args[n_primals:]
+            f = partial(impl, **statics)
+            out, vjp_fn = jax.vjp(f, *primals)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            cts = tuple(
+                jnp.asarray(c, o.dtype)
+                if hasattr(c, "dtype") and c.dtype != o.dtype else c
+                for c, o in zip(cts, outs))
+            grads = vjp_fn(tuple(cts) if out_is_seq else cts[0])
+            # float0 cotangents (integer primals) can't cross a jit boundary
+            # as Tensor payloads; substitute dead float zeros (their metas
+            # carry needs_grad=False so the engine never uses them).
+            return tuple(
+                jnp.zeros(p.shape, jnp.float32)
+                if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0 else g
+                for g, p in zip(grads, primals))
+
+        run.__name__ = f"{getattr(impl, '__name__', 'op')}_taped_vjp"
+        _taped_vjp_cache[key] = fn = run
+    return fn
+
+
+# --------------------------------------------------------------------------
 # Tape
 # --------------------------------------------------------------------------
 
@@ -197,6 +240,29 @@ class GradNode:
             ct = cotangents[0]
         vjp = _get_vjp(self.impl, self.statics_key, len(self.input_arrays), self.statics)
         return vjp(tuple(self.input_arrays), ct)
+
+    def run_vjp_taped(self, cotangents):
+        """create_graph=True: dispatch the VJP through `apply` so the
+        cotangent computation is itself recorded on the tape. `cotangents`
+        entries are Tensors (tracked) or raw arrays (constants); returns a
+        list of Tensors, one per input slot."""
+        unpack = getattr(self, "_unpack_hook", None)
+        if unpack is not None and self.input_arrays is not None:
+            self.input_arrays = [unpack(a) for a in self.input_arrays]
+            self._unpack_hook = None
+        if self.input_arrays is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{self.name}' a second time; "
+                "the saved tensors were already released. Call backward with "
+                "retain_graph=True to backward multiple times.")
+        # Prefer the live input Tensors from the metas — that is what links
+        # the new grad nodes back to the original graph for second order.
+        ins = [meta[2] if meta[2] is not None else a
+               for meta, a in zip(self.input_metas, self.input_arrays)]
+        impl = taped_vjp_impl(self.impl, len(ins), self.out_is_seq)
+        outs = apply(self.name + "_grad", impl, [*ins, *cotangents],
+                     statics=self.statics)
+        return list(outs) if isinstance(outs, (tuple, list)) else [outs]
 
     def release(self):
         self.input_arrays = None

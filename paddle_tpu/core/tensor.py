@@ -170,10 +170,12 @@ class Tensor:
         return t
 
     # -- autograd --------------------------------------------------------
-    def backward(self, grad_tensor=None, retain_graph=False):
+    def backward(self, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
         from ..autograd.backward_engine import run_backward
 
-        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+        run_backward([self], [grad_tensor], retain_graph=retain_graph,
+                     create_graph=create_graph)
 
     def register_hook(self, hook):
         """Register a gradient hook (reference: tensor hooks in

@@ -1,0 +1,137 @@
+"""Eager double-grad (create_graph=True) through the tape.
+
+Reference: egr::RunBackward's create_graph path
+(paddle/fluid/eager/backward.cc:428), exercised by
+test/legacy_test/test_imperative_double_grad.py and the WGAN-GP-style
+gradient-penalty tests (test_imperative_triple_grad.py). Here backward with
+create_graph dispatches every VJP through the tape (GradNode.run_vjp_taped),
+so produced gradients are differentiable to arbitrary order.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_grad_create_graph_second_order():
+    x = pt.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = pt.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([4.0, 9.0]), rtol=1e-6)
+    assert not g.stop_gradient and g._grad_node is not None
+    (g2,) = pt.grad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]), rtol=1e-6)
+
+
+def test_grad_triple_order():
+    x = pt.to_tensor(np.array([1.5], np.float32), stop_gradient=False)
+    y = (x * x * x * x).sum()
+    (g1,) = pt.grad(y, [x], create_graph=True)
+    (g2,) = pt.grad(g1.sum(), [x], create_graph=True)
+    (g3,) = pt.grad(g2.sum(), [x])
+    np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-6)
+
+
+def test_backward_create_graph_populates_differentiable_grad():
+    x = pt.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    z = (x * x).sum()
+    z.backward(create_graph=True)
+    assert x.grad._grad_node is not None, "grad must carry the graph"
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    (h,) = pt.grad(x.grad.sum(), [x])
+    np.testing.assert_allclose(h.numpy(), [2.0])
+
+
+def test_double_grad_matches_hessian():
+    # d2/dx2 of sum(sin(x)^2) vs incubate.autograd.hessian
+    from paddle_tpu.incubate.autograd import hessian
+
+    xv = np.array([0.3, -0.7, 1.1], np.float32)
+    x = pt.to_tensor(xv, stop_gradient=False)
+    y = (pt.sin(x) * pt.sin(x)).sum()
+    (g,) = pt.grad(y, [x], create_graph=True)
+    (g2,) = pt.grad(g.sum(), [x])
+
+    hes = hessian(lambda t: (pt.sin(t) * pt.sin(t)).sum(), pt.to_tensor(xv))
+    hes = np.asarray(hes.numpy() if hasattr(hes, "numpy") else hes)
+    np.testing.assert_allclose(g2.numpy(), hes.reshape(3, 3).sum(0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_wgan_gp_gradient_penalty():
+    """Gradient-penalty training: d(penalty)/dW where the penalty itself
+    contains dD/dx — silently wrong before round 5 (flag was ignored)."""
+    import jax
+
+    pt.seed(0)
+    lin = pt.nn.Linear(4, 1)
+    rng = np.random.RandomState(0)
+    xi = pt.to_tensor(rng.randn(3, 4).astype(np.float32), stop_gradient=False)
+
+    out = lin(xi).sum()
+    (gx,) = pt.grad(out, [xi], create_graph=True)
+    s = (gx * gx).sum()
+    gp = (s - 1.0) * (s - 1.0)
+    (gw,) = pt.grad(gp, [lin.weight])
+
+    b = lin.bias._value
+
+    def penalty(w):
+        def D(x):
+            return (x @ w + b).sum()
+
+        gxv = jax.grad(D)(xi._value)
+        sv = (gxv * gxv).sum()
+        return (sv - 1.0) ** 2
+
+    gw_ref = np.asarray(jax.grad(penalty)(lin.weight._value))
+    np.testing.assert_allclose(gw.numpy(), gw_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_wgan_gp_training_step_changes_loss():
+    """One full GP training step end-to-end: loss finite, weights move."""
+    pt.seed(1)
+    disc = pt.nn.Sequential(
+        pt.nn.Linear(8, 16), pt.nn.LeakyReLU(0.2), pt.nn.Linear(16, 1))
+    opt = pt.optimizer.Adam(learning_rate=1e-3, parameters=disc.parameters())
+    rng = np.random.RandomState(1)
+    real = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    fake = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    eps = pt.to_tensor(rng.rand(4, 1).astype(np.float32))
+
+    for _ in range(2):
+        interp = pt.to_tensor(
+            (eps * real + (1.0 - eps) * fake).numpy(), stop_gradient=False)
+        d_interp = disc(interp).sum()
+        (gi,) = pt.grad(d_interp, [interp], create_graph=True)
+        gnorm = ((gi * gi).sum(axis=1) + 1e-12) ** 0.5
+        gp = (((gnorm - 1.0) * (gnorm - 1.0))).mean()
+        loss = disc(fake).mean() - disc(real).mean() + 10.0 * gp
+        before = {id(p): p.numpy().copy() for p in disc.parameters()}
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss.numpy()))
+    moved = any(not np.allclose(p.numpy(), before[id(p)])
+                for p in disc.parameters())
+    assert moved
+
+
+def test_create_graph_with_accumulated_fanout():
+    # x used twice: taped accumulation (Tensor + Tensor) must stay on-graph
+    x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    a = x * x
+    b = x * 3.0
+    y = (a + b).sum()
+    (g,) = pt.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [7.0])
+    (g2,) = pt.grad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), [2.0])
+
+
+def test_first_order_unchanged_without_create_graph():
+    x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = pt.grad(y, [x])
+    assert g._grad_node is None  # no graph recorded by default
+    np.testing.assert_allclose(g.numpy(), [4.0])
