@@ -56,11 +56,23 @@ def _out_shapes_cached(node):
 
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
-                 create_graph=False):
+                 create_graph=False, accumulate_to=None, capture=None):
     """create_graph=True runs every VJP through `dispatch.apply` (taped), so
     the produced gradients are themselves differentiable — reference:
     egr::RunBackward's create_graph path (paddle/fluid/eager/backward.cc:428),
-    exercised by test/legacy_test/test_imperative_double_grad.py."""
+    exercised by test/legacy_test/test_imperative_double_grad.py.
+
+    accumulate_to: optional set of tensor ids; when given, only those leaves
+    receive .grad writes (paddle.grad's GeneralGrad contract: grads "only for
+    inputs, without touching other tensors' .grad",
+    paddle/fluid/eager/general_grad.h). Without it every reachable leaf
+    accumulates (Tensor.backward semantics).
+
+    capture: optional list of tensors whose total cotangent should be
+    written to .grad even when they are NOT leaves — a non-leaf tensor's
+    accumulated cotangent is complete exactly when its producer node pops
+    from the ready queue (all consumers fired first), so we snapshot it
+    there (GeneralGrad's interior-target case)."""
     from ..core.tensor import Tensor
     from ..core.dispatch import _get_fwd
 
@@ -71,6 +83,15 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
 
     node_cts = {}  # id(GradNode) -> (node, [cotangent | None] per output slot)
     leaf_seeds = []
+    capture_map = {}  # (id(node), out_idx) -> [tensor, ...]
+    if capture:
+        for t in capture:
+            if t._grad_node is not None:
+                lst = capture_map.setdefault(
+                    (id(t._grad_node), t._out_idx), [])
+                # the same tensor listed twice must not accumulate twice
+                if not any(x is t for x in lst):
+                    lst.append(t)
 
     def seed(node, idx, ct):
         entry = node_cts.get(id(node))
@@ -117,6 +138,35 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 indeg[id(pnode)] = indeg.get(id(pnode), 0) + 1
                 stack.append(pnode)
 
+    # GeneralGrad pruning (paddle/fluid/eager/general_grad.h): with an
+    # accumulate_to target set, a node's VJP only needs to run if one of
+    # its input edges leads — directly or through producers — to a target.
+    # Nodes entirely below every target still pop (their accumulated
+    # cotangents feed the capture path and the in-degree bookkeeping) but
+    # skip the VJP computation. Seeds = nodes referencing a target
+    # directly; propagate upward through the consumer relation.
+    needed = None
+    if accumulate_to is not None:
+        needed = set()
+        consumers = {}
+        seeds_n = []
+        for n in nodes.values():
+            direct = False
+            for (pnode, _pi, in_t, _ng) in n.input_metas:
+                if in_t is not None and id(in_t) in accumulate_to:
+                    direct = True
+                if pnode is not None:
+                    consumers.setdefault(id(pnode), []).append(n)
+            if direct:
+                needed.add(id(n))
+                seeds_n.append(n)
+        while seeds_n:
+            p = seeds_n.pop()
+            for c in consumers.get(id(p), ()):
+                if id(c) not in needed:
+                    needed.add(id(c))
+                    seeds_n.append(c)
+
     queue = [n for n in nodes.values() if indeg.get(id(n), 0) == 0]
     processed = set()
 
@@ -134,8 +184,17 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         else:
             cts = entry[1]
 
+        if capture_map and cts is not None:
+            for idx, c in enumerate(cts):
+                targets = capture_map.get((id(node), idx))
+                if targets and c is not None:
+                    for t in targets:
+                        g = c if (create_graph and isinstance(c, Tensor)) \
+                            else Tensor(c._value if isinstance(c, Tensor) else c)
+                        t.grad = g if t.grad is None else t.grad + g
+
         in_grads = None
-        if cts is not None:
+        if cts is not None and (needed is None or id(node) in needed):
             if any(c is None for c in cts):
                 out_shapes = getattr(node, "out_shapes", None)
                 if out_shapes is not None:
@@ -159,6 +218,11 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 g = None
             elif create_graph:
                 g = in_grads[i]
+                # the taped VJP substitutes dead float zeros for float0
+                # (integer-primal) slots — they must not surface as .grad
+                if g is not None and in_tensor is not None and \
+                        not jnp.issubdtype(in_tensor.dtype, jnp.inexact):
+                    g = None
             else:
                 g = _drop_float0(in_grads[i])
 
@@ -174,7 +238,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                             g = res._value if isinstance(res, Tensor) else jnp.asarray(res)
 
             if pnode is None:
-                if g is not None and in_tensor is not None:
+                if g is not None and in_tensor is not None and (
+                        accumulate_to is None or id(in_tensor) in accumulate_to):
                     if create_graph:
                         # keep the graph: .grad is the live Tensor chain
                         in_tensor.grad = g if in_tensor.grad is None \
@@ -194,6 +259,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             node.release()
 
     for t, ct in leaf_seeds:
+        if accumulate_to is not None and id(t) not in accumulate_to:
+            continue
         if create_graph:
             ct_t = ct if isinstance(ct, Tensor) else Tensor(ct)
             t.grad = ct_t if t.grad is None else t.grad + ct_t

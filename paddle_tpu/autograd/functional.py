@@ -37,14 +37,19 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         t.stop_gradient = False
     retain = True if retain_graph is None else retain_graph
     run_backward(list(outputs), grad_outputs, retain_graph=retain,
-                 create_graph=create_graph)
+                 create_graph=create_graph,
+                 accumulate_to={id(t) for t in inputs},
+                 capture=[t for t in inputs if t._grad_node is not None])
+    # read ALL grads before restoring: a tensor listed twice in `inputs`
+    # must yield its gradient for every occurrence
     grads = []
-    for t, (old_grad, old_sg) in zip(inputs, saved):
+    for t in inputs:
         g = t.grad
         if g is None and not allow_unused:
             import jax.numpy as jnp
             g = Tensor(jnp.zeros_like(t._value))
         grads.append(g)
+    for t, (old_grad, old_sg) in zip(inputs, saved):
         t.grad = old_grad
         t.stop_gradient = old_sg
     return grads

@@ -20,8 +20,9 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = tensors
 
-    @property
     def saved_tensor(self):
+        # a method in the reference API (python/paddle/autograd/py_layer.py:
+        # PyLayerContext.saved_tensor()), not a property
         return self._saved
 
     def saved_tensors(self):
@@ -64,6 +65,22 @@ class _PyLayerNode(GradNode):
             else:
                 out.append(jnp.asarray(g))
         return out
+
+    def run_vjp_taped(self, cotangents):
+        """create_graph=True: run the user's backward WITHOUT no_grad and
+        with tracked cotangents, so its eager ops record on the tape — the
+        PyLayer is double-differentiable whenever its backward is composed
+        of taped ops (reference: PyLayer create_graph support via re-entrant
+        recording, fluid/eager/pylayer/py_layer_node.cc)."""
+        cts = [c if isinstance(c, Tensor) else Tensor(c) for c in cotangents]
+        if self.out_is_seq:
+            grads = self._cls.backward(self._ctx, *cts)
+        else:
+            grads = self._cls.backward(self._ctx, cts[0])
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        return [g if (g is None or isinstance(g, Tensor)) else Tensor(jnp.asarray(g))
+                for g in grads]
 
     def release(self):
         pass

@@ -202,6 +202,7 @@ class GradNode:
         "statics_key",
         "input_arrays",
         "input_metas",
+        "input_versions",
         "n_outputs",
         "out_is_seq",
         "_id",
@@ -218,6 +219,13 @@ class GradNode:
         self.input_arrays = input_arrays
         self._unpack_hook = None
         self.input_metas = input_metas  # list of (producer GradNode|None, out_idx, leaf Tensor|None, needs_grad)
+        # Tensor versions at record time — the taped (create_graph) path
+        # recomputes from live tensors and must refuse in-place-mutated ones
+        # (reference analog: the eager tensor inplace_version check,
+        # paddle/fluid/eager/tensor_wrapper.h).
+        self.input_versions = [
+            getattr(m[2], "_version", 0) if m[2] is not None else 0
+            for m in input_metas]
         self.n_outputs = n_outputs
         self.out_is_seq = out_is_seq
         GradNode._counter[0] += 1
@@ -245,20 +253,37 @@ class GradNode:
         """create_graph=True: dispatch the VJP through `apply` so the
         cotangent computation is itself recorded on the tape. `cotangents`
         entries are Tensors (tracked) or raw arrays (constants); returns a
-        list of Tensors, one per input slot."""
-        unpack = getattr(self, "_unpack_hook", None)
-        if unpack is not None and self.input_arrays is not None:
-            self.input_arrays = [unpack(a) for a in self.input_arrays]
-            self._unpack_hook = None
+        list of Tensors, one per input slot.
+
+        Uses the live input Tensors from the metas — that is what links the
+        new grad nodes back to the original graph for second order — guarded
+        by a version check so an in-place mutation between forward and
+        backward raises instead of silently changing the gradient. (Under
+        AMP the live values are the pre-cast fp32 ones, so taped gradients
+        are computed at full precision — an intentional, finer deviation
+        from the snapshot path.) Saved-tensor unpack hooks only fire for
+        slots with no live Tensor, and nothing is unpacked in place, so
+        offloaded residuals stay offloaded."""
         if self.input_arrays is None:
             raise RuntimeError(
                 f"Trying to backward through op '{self.name}' a second time; "
                 "the saved tensors were already released. Call backward with "
                 "retain_graph=True to backward multiple times.")
-        # Prefer the live input Tensors from the metas — that is what links
-        # the new grad nodes back to the original graph for second order.
-        ins = [meta[2] if meta[2] is not None else a
-               for meta, a in zip(self.input_metas, self.input_arrays)]
+        unpack = getattr(self, "_unpack_hook", None)
+        ins = []
+        for meta, a, ver in zip(self.input_metas, self.input_arrays,
+                                self.input_versions):
+            t = meta[2]
+            if t is not None:
+                if getattr(t, "_version", 0) != ver:
+                    raise RuntimeError(
+                        f"Input of op '{self.name}' was modified by an "
+                        "in-place operation after being used in the forward; "
+                        "double-grad (create_graph=True) cannot recompute "
+                        "through it. Clone the tensor before mutating it.")
+                ins.append(t)
+            else:
+                ins.append(unpack(a) if unpack is not None else a)
         impl = taped_vjp_impl(self.impl, len(ins), self.out_is_seq)
         outs = apply(self.name + "_grad", impl, [*ins, *cotangents],
                      statics=self.statics)

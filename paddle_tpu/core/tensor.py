@@ -34,6 +34,10 @@ class Tensor:
         # mesh axes and the owning ProcessMesh
         "_partial_axes",
         "process_mesh",
+        # in-place mutation counter (reference: TensorWrapper inplace_version
+        # check) — read by the taped double-grad path; lazy-segment flushes
+        # write _v_ directly and do NOT bump (same logical value)
+        "_version",
         "__weakref__",
     )
 
@@ -63,6 +67,7 @@ class Tensor:
             if id(self) in rec._input_ids:
                 rec.flush()
         self._v_ = v
+        self._version = getattr(self, "_version", 0) + 1
 
     def __init__(self, value, stop_gradient=True, name=None):
         if isinstance(value, Tensor):
@@ -70,6 +75,7 @@ class Tensor:
         if not isinstance(value, jax.Array):
             value = jnp.asarray(value)
         self._v_ = value
+        self._version = 0
         self.stop_gradient = stop_gradient
         self.grad = None
         self._grad_node = None

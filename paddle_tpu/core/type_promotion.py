@@ -56,8 +56,10 @@ def need_type_promotion(x_dtype, y_dtype):
 
 
 def get_promote_dtype(op_name, x_dtype, y_dtype):
-    """Reference: phi::GetPromoteDtype (type_promotion.h:96) — comparison
-    ops produce bool regardless of operand promotion."""
+    """Reference: phi::GetPromoteDtype (type_promotion.h:96). Intentional
+    superset: the reference special-cases only 'greater_than'; we return
+    bool for all six comparison ops (behaviorally benign — comparison
+    outputs are bool either way)."""
     if op_name in ("greater_than", "less_than", "greater_equal",
                    "less_equal", "equal", "not_equal"):
         return "bool"

@@ -468,6 +468,14 @@ class _SparsePushNode:
         GradNode._counter[0] += 1
         self._id = GradNode._counter[0]
 
+    def run_vjp_taped(self, cotangents):
+        # push_sparse is a side effect (host-table optimizer apply), not a
+        # differentiable op; under create_graph the push still happens and
+        # no second-order graph exists past the table (input_metas is []).
+        from ..core.tensor import Tensor
+        return self.run_vjp(
+            [c._value if isinstance(c, Tensor) else c for c in cotangents])
+
     def run_vjp(self, cotangents):
         ct = cotangents[0]
         dim = self._table.embedding_dim

@@ -423,6 +423,13 @@ class _TracedNode(GradNode):
         GradNode._counter[0] += 1
         self._id = GradNode._counter[0]
 
+    def run_vjp_taped(self, cotangents):
+        raise RuntimeError(
+            "create_graph=True through a to_static traced program is not "
+            "supported: the program's VJP is a compiled artifact, not taped "
+            "ops. Call the layer eagerly (without to_static) to use "
+            "double-grad.")
+
     def run_vjp(self, cotangents):
         # None cotangents → zeros (we know shapes from forward outputs only
         # via entry template; engine fills via out_shapes if set). Build here:

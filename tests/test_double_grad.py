@@ -129,9 +129,108 @@ def test_create_graph_with_accumulated_fanout():
     np.testing.assert_allclose(g2.numpy(), [2.0])
 
 
+def test_grad_does_not_pollute_other_leaves():
+    """paddle.grad must write .grad ONLY for `inputs` (GeneralGrad contract,
+    paddle/fluid/eager/general_grad.h) — caught live: grad(d_i, [interp])
+    was accumulating into the discriminator's parameters, corrupting the
+    subsequent d_loss.backward() in WGAN-GP training."""
+    pt.seed(3)
+    lin = pt.nn.Linear(4, 1)
+    x = pt.to_tensor(np.ones((2, 4), np.float32), stop_gradient=False)
+    (gx,) = pt.grad(lin(x).sum(), [x])
+    assert lin.weight.grad is None and lin.bias.grad is None
+    np.testing.assert_allclose(gx.numpy(), np.tile(lin.weight.numpy().T, (2, 1)),
+                               rtol=1e-5)
+    # and backward() still accumulates into every leaf
+    lin(x).sum().backward()
+    assert lin.weight.grad is not None and x.grad is not None
+
+
 def test_first_order_unchanged_without_create_graph():
     x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
     y = (x * x).sum()
     (g,) = pt.grad(y, [x])
     assert g._grad_node is None  # no graph recorded by default
     np.testing.assert_allclose(g.numpy(), [4.0])
+
+
+def test_grad_wrt_intermediate_tensor():
+    # non-leaf input: dy/da for a = 2x, y = a^2 — was silently zeros
+    x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    a = x * 2.0
+    y = (a * a).sum()
+    (ga,) = pt.grad(y, [a])
+    np.testing.assert_allclose(ga.numpy(), [8.0])
+    # and second order wrt the intermediate
+    (ga2,) = pt.grad(y, [a], create_graph=True)
+    (gaa,) = pt.grad(ga2.sum(), [a])
+    np.testing.assert_allclose(gaa.numpy(), [2.0])
+
+
+def test_grad_duplicate_nonleaf_input_not_doubled():
+    x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    a = x * 2.0
+    y = (a * a).sum()
+    g1, g2 = pt.grad(y, [a, a])
+    np.testing.assert_allclose(g1.numpy(), [8.0])
+    np.testing.assert_allclose(g2.numpy(), [8.0])
+
+
+def test_grad_prunes_below_inputs_but_keeps_needed_paths():
+    # aux branch strictly below the requested input must not affect results
+    x = pt.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    w = pt.to_tensor(np.array([3.0, 4.0], np.float32), stop_gradient=False)
+    a = x * w           # below `b` only through x,w — pruned side
+    b = a * a
+    y = b.sum() + (w * w).sum()   # second branch avoids `a`
+    (ga,) = pt.grad(y, [a])
+    np.testing.assert_allclose(ga.numpy(), 2 * (x.numpy() * w.numpy()))
+    assert w.grad is None and x.grad is None
+
+
+def test_inplace_mutation_raises_under_create_graph():
+    x = pt.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    x.set_value(np.array([5.0], np.float32))
+    with pytest.raises(RuntimeError, match="in-place"):
+        pt.grad(y, [x], create_graph=True)
+
+
+def test_integer_leaf_gets_no_grad_under_create_graph():
+    w = pt.to_tensor(np.eye(4, dtype=np.float32), stop_gradient=False)
+    idx = pt.to_tensor(np.array([1, 2]))
+    idx.stop_gradient = False  # user error; must not surface a float grad
+    y = w[idx].sum()
+    y.backward(create_graph=True)
+    assert idx.grad is None
+    assert w.grad is not None
+
+
+def test_pylayer_double_grad():
+    class Square(pt.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 2.0 * x
+
+    x = pt.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = Square.apply(x).sum()
+    (g,) = pt.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    (g2,) = pt.grad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), [2.0])
+
+
+def test_to_static_create_graph_raises_loudly():
+    import paddle_tpu.nn as nn
+
+    net = pt.jit.to_static(nn.Linear(2, 2))
+    x = pt.to_tensor(np.ones((1, 2), np.float32), stop_gradient=False)
+    y = net(x).sum()
+    with pytest.raises(RuntimeError, match="to_static"):
+        pt.grad(y, [x], create_graph=True)
