@@ -139,6 +139,7 @@ class ShardedTrainStep:
         self._step_fn = None
         self._eval_fn = None
         self._step_count = 0
+        self.last_grad_norm = None
 
     # ------------------------------------------------------------------
     def _cp_guard(self):
@@ -181,6 +182,11 @@ class ShardedTrainStep:
                 loss_of, has_aux=True)(params, buffers, batch, key)
             grads = dict(
                 (n, g.astype(params[n].dtype)) for n, g in grads.items())
+            # pre-clip global grad norm, exposed for parity/diagnostics
+            # (sharding bugs show up in the grad-norm trajectory steps before
+            # they move the loss); XLA CSEs this with the clip's own norm
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                 for g in grads.values()))
             grads = _clip_grads(grads, clip)
             new_params = {}
             new_state = {}
@@ -189,7 +195,7 @@ class ShardedTrainStep:
                                           step_no)
                 new_params[n] = np_
                 new_state[n] = ns
-            return loss, new_params, new_state, new_buf
+            return loss, gnorm, new_params, new_state, new_buf
 
         param_sh = {n: NamedSharding(mesh, s)
                     for n, s in self.param_specs.items()}
@@ -207,7 +213,7 @@ class ShardedTrainStep:
             step,
             in_shardings=(param_sh, state_sh, buf_sh, batch_sh, scalar_sh,
                           scalar_sh, scalar_sh),
-            out_shardings=(scalar_sh, param_sh, state_sh, buf_sh),
+            out_shardings=(scalar_sh, scalar_sh, param_sh, state_sh, buf_sh),
             donate_argnums=(0, 1, 2) if self.donate else (),
         )
 
@@ -234,9 +240,10 @@ class ShardedTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self._step_count, jnp.int32)
         key = rng_mod.next_key()
-        loss, self.param_vals, self.opt_state, self.buffer_vals = \
+        loss, gnorm, self.param_vals, self.opt_state, self.buffer_vals = \
             self._step_fn(self.param_vals, self.opt_state, self.buffer_vals,
                           placed, key, lr, step_no)
+        self.last_grad_norm = gnorm  # device scalar; float() to read
         # keep live Parameter objects pointing at current values so eager
         # reads (state_dict, debugging) stay correct without copies
         for n, p in self._params.items():

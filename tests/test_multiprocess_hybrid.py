@@ -1,0 +1,120 @@
+"""Cross-process hybrid parallelism: TP / PP-1F1B / ZeRO-3 each proven over
+REAL processes, not just the single-process virtual mesh.
+
+Reference strategy: test/legacy_test/test_dist_base.py:962 (spawn workers,
+compare distributed loss trajectory against single-process) and the hybrid
+suites under test/collective/fleet/ (hybrid_parallel_mp_random.py,
+test_parallel_dygraph_pipeline_parallel.py). Here two spawned processes each
+own one CPU device; jax.distributed forms the 2-device global mesh and GSPMD
+emits the cross-process collectives (Gloo on CPU, ICI on TPU). Each worker
+also runs the same-seed model on its LOCAL device alone and asserts the
+sharded loss AND pre-clip grad-norm trajectories match the single-device
+run (trajectory parity, not single-step finiteness).
+"""
+import socket
+
+import numpy as np
+
+import paddle_tpu.distributed as dist
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+_STEPS = 5
+
+
+def _hybrid_worker(coord_port, config):
+    import os
+
+    import numpy as np
+
+    os.environ["PADDLE_TPU_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2 and len(jax.local_devices()) == 1
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, 256, (8, 32)).astype("int32")
+
+    def build_model():
+        if config == "pp_1f1b":
+            from paddle_tpu.models.gpt_pipe import gpt_pipe
+
+            return gpt_pipe("gpt_tiny", num_microbatches=2, num_layers=4,
+                            num_heads=4, hidden_size=64,
+                            pipeline_schedule="1f1b")
+        from paddle_tpu.models import gpt
+
+        return gpt("gpt_tiny", num_layers=2, num_heads=4, hidden_size=64,
+                   dropout=0.0)
+
+    def run(mesh_degrees, devices, stage):
+        mesh = dist.build_mesh(**mesh_degrees, devices=devices)
+        paddle.seed(0)
+        model = build_model()
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-3, parameters=model.parameters(),
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+        kw = {"sharding_stage": stage} if stage else {}
+        eng = dist.parallelize(model, opt, mesh=mesh,
+                               compute_dtype="bfloat16", **kw)
+        ids = paddle.to_tensor(ids_np)
+        losses, gnorms = [], []
+        for _ in range(_STEPS):
+            losses.append(float(eng.train_batch(ids)))
+            gnorms.append(float(eng.last_grad_norm))
+        return losses, gnorms
+
+    degrees, stage = {
+        "tp": ({"mp": 2}, None),
+        "pp_1f1b": ({"pp": 2}, None),
+        "zero3": ({"sharding": 2}, 3),
+    }[config]
+
+    dist_losses, dist_gn = run(degrees, jax.devices(), stage)
+    # single-device reference: each process recomputes independently on its
+    # own local device (no cross-process communication involved)
+    ref_losses, ref_gn = run({"dp": 1}, jax.local_devices()[:1], None)
+
+    assert all(np.isfinite(dist_losses)), dist_losses
+    np.testing.assert_allclose(dist_losses, ref_losses, rtol=1e-2, atol=1e-3,
+                               err_msg=f"{config}: loss trajectory diverged")
+    np.testing.assert_allclose(dist_gn, ref_gn, rtol=2e-2, atol=1e-3,
+                               err_msg=f"{config}: grad-norm trajectory "
+                               "diverged")
+
+    # control plane alongside the data plane
+    store = dist.get_store()
+    rank = jax.process_index()
+    store.set(f"hybrid_done/{config}/{rank}", b"1")
+    store.wait(f"hybrid_done/{config}/{1 - rank}", timeout=60)
+
+
+def _spawn(config):
+    port = _free_port()
+    dist.spawn(_hybrid_worker, args=(port, config), nprocs=2,
+               env={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+
+
+def test_two_process_tensor_parallel():
+    _spawn("tp")
+
+
+def test_two_process_pipeline_1f1b():
+    _spawn("pp_1f1b")
+
+
+def test_two_process_zero3():
+    _spawn("zero3")
