@@ -66,6 +66,9 @@ from .fleet.recompute import (  # noqa: F401
 from .ps import (  # noqa: F401
     ShardedEmbedding, DistributedLookupTable, HostOffloadedEmbedding,
 )
+from .ps_service import (  # noqa: F401
+    PsServer, PsClient, SparseTableShard, serve_shard,
+)
 from .misc_api import (  # noqa: F401,E402
     alltoall, alltoall_single, scatter_object_list, wait, get_backend,
     is_available, destroy_process_group, gloo_init_parallel_env,

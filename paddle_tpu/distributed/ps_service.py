@@ -1,0 +1,389 @@
+"""Multi-server parameter-server service: N server processes each owning a
+table shard, with server-side optimizers, checkpoint/restore and
+kill-a-server recovery.
+
+Reference analogs:
+- brpc PS server/service hosting sharded tables
+  (paddle/fluid/distributed/ps/service/brpc_ps_server.h) — here a
+  length-prefixed-pickle TCP protocol served by a thread-per-connection
+  loop (the data plane is host-side numpy; TPU work stays in XLA, so a
+  Python socket server is the right weight for this IO-bound tier).
+- memory_sparse_table (ps/table/memory_sparse_table.h): lazily-initialized
+  rows keyed by id, server-side sgd/adagrad apply, shrink/save/load.
+- The row→server mapping is the reference's mod sharding
+  (ps/table/table.h shard_num semantics): server_of(id) = id % num_servers.
+
+Control plane: the native coord store (distributed/store.py) publishes
+`ps/<name>/server/<i>` endpoints; a restarted server re-registers (new
+port, bumped epoch) and clients re-resolve on connection failure — the
+recovery story brpc gets from its naming service.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["SparseTableShard", "PsServer", "PsClient", "serve_shard"]
+
+
+# --------------------------------------------------------------------------
+# framed pickle transport
+# --------------------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# --------------------------------------------------------------------------
+# table shard
+# --------------------------------------------------------------------------
+
+class SparseTableShard:
+    """One server's shard of a sparse embedding table.
+
+    Rows are created lazily on first touch with a per-id deterministic
+    initializer (so a re-created shard reproduces untrained rows exactly,
+    and the single-process parity reference can mirror initialization).
+    The optimizer applies SERVER-side (reference: memory_sparse_table's
+    sgd rule objects), so trainers only ship gradients.
+    """
+
+    def __init__(self, embedding_dim, optimizer="adagrad",
+                 learning_rate=0.05, init_std=None, seed=0):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("optimizer must be 'sgd' or 'adagrad'")
+        self.dim = int(embedding_dim)
+        self.optimizer = optimizer
+        self.lr = float(learning_rate)
+        self.std = (float(init_std) if init_std is not None
+                    else 1.0 / max(1.0, np.sqrt(self.dim)))
+        self.seed = int(seed)
+        self.rows: dict = {}
+        self.accum: dict = {}
+        self.lock = threading.Lock()
+        self.applied_pushes = 0
+        # exactly-once pushes: last applied sequence number per client
+        # (a retried PUSH after a dropped response must not re-apply —
+        # the brpc stack gets this from request ids; we persist it with
+        # the shard so restarts keep the guarantee)
+        self.applied_seq: dict = {}
+
+    def _init_row(self, uid):
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + int(uid)) & 0x7FFFFFFF)
+        return rng.normal(0.0, self.std, self.dim).astype(np.float32)
+
+    def pull(self, uids):
+        uids = np.asarray(uids, np.int64).ravel()
+        with self.lock:
+            out = np.empty((len(uids), self.dim), np.float32)
+            for i, u in enumerate(uids):
+                u = int(u)
+                row = self.rows.get(u)
+                if row is None:
+                    row = self.rows[u] = self._init_row(u)
+                out[i] = row
+        return out
+
+    def push(self, uids, grads, lr=None, client=None, seq=None):
+        """Server-side optimizer apply; duplicate ids within one push are
+        merged first (the reference merges by key before table apply).
+        (client, seq) deduplicates retried pushes: a seq at or below the
+        last applied one for that client is acknowledged without applying."""
+        uids = np.asarray(uids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(uids), self.dim)
+        lr = self.lr if lr is None else float(lr)
+        uniq, inv = np.unique(uids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        with self.lock:
+            if client is not None and seq is not None:
+                if seq <= self.applied_seq.get(client, -1):
+                    return  # duplicate of an already-applied push
+                self.applied_seq[client] = seq
+            for i, u in enumerate(uniq):
+                u = int(u)
+                row = self.rows.get(u)
+                if row is None:
+                    row = self.rows[u] = self._init_row(u)
+                g = merged[i]
+                if self.optimizer == "adagrad":
+                    acc = self.accum.get(u, 0.0) + float(g @ g)
+                    self.accum[u] = acc
+                    row -= lr / np.sqrt(acc + 1e-10) * g
+                else:
+                    row -= lr * g
+            self.applied_pushes += 1
+
+    # -- persistence (reference: table save/load in the PS service) --------
+    def save(self, path):
+        with self.lock:
+            state = {"dim": self.dim, "optimizer": self.optimizer,
+                     "lr": self.lr, "std": self.std, "seed": self.seed,
+                     "rows": self.rows, "accum": self.accum,
+                     "applied_pushes": self.applied_pushes,
+                     "applied_seq": self.applied_seq}
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)          # atomic: a killed save can't corrupt
+
+    def load(self, path):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        with self.lock:
+            self.dim = state["dim"]
+            self.optimizer = state["optimizer"]
+            self.lr = state["lr"]
+            self.std = state["std"]
+            self.seed = state["seed"]
+            self.rows = state["rows"]
+            self.accum = state["accum"]
+            self.applied_pushes = state.get("applied_pushes", 0)
+            self.applied_seq = state.get("applied_seq", {})
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class PsServer:
+    """Hosts one shard; serves PULL/PUSH/SAVE/STATS/STOP over TCP and
+    registers its endpoint in the coord store under
+    `ps/<name>/server/<id>` (epoch-tagged in the registry for operator
+    debugging; clients recover from restarts by re-resolving the endpoint
+    on connection failure)."""
+
+    def __init__(self, name, server_id, num_servers, embedding_dim,
+                 store=None, ckpt_dir=None, optimizer="adagrad",
+                 learning_rate=0.05, init_std=None, seed=0, host="127.0.0.1"):
+        self.name = name
+        self.server_id = int(server_id)
+        self.num_servers = int(num_servers)
+        self.store = store
+        self.ckpt_dir = ckpt_dir
+        self.shard = SparseTableShard(embedding_dim, optimizer=optimizer,
+                                      learning_rate=learning_rate,
+                                      init_std=init_std,
+                                      seed=seed * 7919 + self.server_id)
+        if ckpt_dir:
+            p = self._ckpt_path()
+            if os.path.exists(p):
+                self.shard.load(p)     # restart-with-recovery path
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        if store is not None:
+            epoch = store.add(f"ps/{name}/epoch/{server_id}", 1)
+            store.set(f"ps/{name}/server/{server_id}",
+                      f"{host}:{self.port}:{epoch}".encode())
+
+    def _ckpt_path(self):
+        return os.path.join(self.ckpt_dir,
+                            f"{self.name}.shard{self.server_id}.pkl")
+
+    def serve_forever(self):
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        self._sock.close()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = _recv_msg(conn)
+                except (ConnectionError, EOFError):
+                    return
+                op = req["op"]
+                if op == "pull":
+                    _send_msg(conn, {"ok": True,
+                                     "rows": self.shard.pull(req["uids"])})
+                elif op == "push":
+                    self.shard.push(req["uids"], req["grads"],
+                                    lr=req.get("lr"),
+                                    client=req.get("client"),
+                                    seq=req.get("seq"))
+                    _send_msg(conn, {"ok": True})
+                elif op == "save":
+                    if not self.ckpt_dir:
+                        _send_msg(conn, {"ok": False, "err": "no ckpt_dir"})
+                    else:
+                        self.shard.save(self._ckpt_path())
+                        _send_msg(conn, {"ok": True})
+                elif op == "stats":
+                    _send_msg(conn, {
+                        "ok": True, "server_id": self.server_id,
+                        "rows": len(self.shard.rows),
+                        "applied_pushes": self.shard.applied_pushes})
+                elif op == "stop":
+                    _send_msg(conn, {"ok": True})
+                    self._stop.set()
+                    return
+                else:
+                    _send_msg(conn, {"ok": False, "err": f"bad op {op}"})
+        finally:
+            conn.close()
+
+
+def serve_shard(name, server_id, num_servers, embedding_dim, store_port,
+                ckpt_dir, **kw):
+    """Process entry point: build the server, register, serve until STOP.
+    (Module-level so multiprocessing can spawn it by reference.)"""
+    from .store import TCPStore
+
+    store = TCPStore("127.0.0.1", store_port)
+    srv = PsServer(name, server_id, num_servers, embedding_dim, store=store,
+                   ckpt_dir=ckpt_dir, **kw)
+    srv.serve_forever()
+
+
+# --------------------------------------------------------------------------
+# client
+# --------------------------------------------------------------------------
+
+class PsClient:
+    """Trainer-side client: splits requests by the mod row→server mapping,
+    fans out, reassembles. On connection failure it re-resolves the
+    server's endpoint from the coord store and retries with backoff —
+    surviving a server kill+restart (the brpc naming-service recovery);
+    retried pushes carry a (client, seq) id so the server applies each
+    gradient exactly once."""
+
+    def __init__(self, name, num_servers, store, timeout=60.0):
+        import uuid
+
+        self.name = name
+        self.num_servers = int(num_servers)
+        self.store = store
+        self.timeout = float(timeout)
+        self._conns: dict = {}
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
+
+    def server_of(self, uids):
+        return np.asarray(uids, np.int64) % self.num_servers
+
+    # -- connection management --------------------------------------------
+    def _resolve(self, sid):
+        raw = self.store.get(f"ps/{self.name}/server/{sid}").decode()
+        host, port, epoch = raw.rsplit(":", 2)
+        return host, int(port), int(epoch)
+
+    def _connect(self, sid):
+        host, port, _epoch = self._resolve(sid)
+        s = socket.create_connection((host, port), timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns[sid] = s
+        return s
+
+    def _request(self, sid, req):
+        deadline = time.monotonic() + self.timeout
+        delay = 0.05
+        while True:
+            try:
+                s = self._conns.get(sid) or self._connect(sid)
+                _send_msg(s, req)
+                resp = _recv_msg(s)
+                if not resp.get("ok"):
+                    raise RuntimeError(
+                        f"ps server {sid}: {resp.get('err')}")
+                return resp
+            except (ConnectionError, OSError, socket.timeout):
+                # server gone — drop the conn, re-resolve (a restarted
+                # server publishes a fresh endpoint+epoch), retry
+                c = self._conns.pop(sid, None)
+                if c is not None:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"ps server {sid} unreachable for {self.timeout}s")
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    # -- table ops ---------------------------------------------------------
+    def pull(self, uids):
+        uids = np.asarray(uids, np.int64).ravel()
+        owner = self.server_of(uids)
+        parts = {}
+        for sid in np.unique(owner):
+            idx = np.nonzero(owner == sid)[0]
+            resp = self._request(int(sid),
+                                 {"op": "pull", "uids": uids[idx]})
+            parts[int(sid)] = (idx, resp["rows"])
+        dim = next(iter(parts.values()))[1].shape[1] if parts else 0
+        out = np.empty((len(uids), dim), np.float32)
+        for idx, rows in parts.values():
+            out[idx] = rows
+        return out
+
+    def push(self, uids, grads, lr=None):
+        uids = np.asarray(uids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(uids), -1)
+        owner = self.server_of(uids)
+        self._seq += 1
+        for sid in np.unique(owner):
+            idx = np.nonzero(owner == sid)[0]
+            self._request(int(sid), {"op": "push", "uids": uids[idx],
+                                     "grads": grads[idx], "lr": lr,
+                                     "client": self._client_id,
+                                     "seq": self._seq})
+
+    def save(self):
+        """Checkpoint every shard (reference: PSClient::save)."""
+        for sid in range(self.num_servers):
+            self._request(sid, {"op": "save"})
+
+    def stats(self):
+        return [self._request(sid, {"op": "stats"})
+                for sid in range(self.num_servers)]
+
+    def stop_servers(self):
+        for sid in range(self.num_servers):
+            try:
+                self._request(sid, {"op": "stop"})
+            except (TimeoutError, RuntimeError):
+                pass
+
+    def close(self):
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()

@@ -1,0 +1,171 @@
+"""Multi-server parameter-server service: 2 servers + 2 trainers training
+embeddings to parity with a single-process reference, surviving a
+kill-one-server restart.
+
+Reference: brpc_ps_server.h (server fleet), memory_sparse_table.h
+(server-side optimizer rows), test strategy: the PS CTR tests under
+test/distributed_passes. Servers and trainers are real spawned processes;
+the native coord store is the control plane (endpoint registry + barriers).
+"""
+import multiprocessing as mp
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps_service import (
+    PsClient, SparseTableShard, serve_shard)
+from paddle_tpu.distributed.store import TCPStore, create_master_store
+
+DIM = 8
+N_SERVERS = 2
+N_TRAINERS = 2
+UNIVERSE = 64            # uid space
+STEPS_A, STEPS_B = 6, 5  # before / after the server restart
+LR = 0.1
+
+
+def _targets():
+    rng = np.random.RandomState(123)
+    return rng.normal(0.0, 1.0, (UNIVERSE, DIM)).astype(np.float32)
+
+
+def _trainer(rank, store_port, barrier_world):
+    """Pull → grad = rows - target → push; disjoint uid sets per trainer so
+    the update sequence is deterministic and exactly mirrorable."""
+    store = TCPStore("127.0.0.1", store_port)
+    client = PsClient("emb", N_SERVERS, store, timeout=90)
+    targets = _targets()
+    rng = np.random.RandomState(1000 + rank)
+
+    def steps(n, phase):
+        for i in range(n):
+            uids = rng.choice(
+                np.arange(rank, UNIVERSE, N_TRAINERS), size=8, replace=False)
+            rows = client.pull(uids)
+            grads = rows - targets[uids]
+            client.push(uids, grads, lr=LR)
+            store.barrier(f"step/{phase}/{i}", world_size=barrier_world,
+                          timeout=120)
+
+    steps(STEPS_A, "a")
+    # trainer 0 checkpoints all shards, then signals the parent to kill
+    # server 0; everyone waits for the restart before continuing
+    if rank == 0:
+        client.save()
+        store.set("phase/ready_to_kill", b"1")
+    store.wait("phase/restarted", timeout=180)
+    steps(STEPS_B, "b")
+
+    # verify against the single-process mirror
+    expected = _mirror_reference()
+    uids = np.arange(UNIVERSE)
+    rows = client.pull(uids)
+    np.testing.assert_allclose(rows, expected, rtol=1e-5, atol=1e-6)
+    store.add("trainers_ok", 1)
+    client.close()
+
+
+def _mirror_reference():
+    """Replay the exact same update stream on local shards (same per-uid
+    deterministic init, same server-side optimizer, same order — the
+    trainers' uid sets are disjoint and barrier-synced, so the global
+    order is reproducible)."""
+    shards = [SparseTableShard(DIM, optimizer="adagrad", learning_rate=LR,
+                               seed=0 * 7919 + s) for s in range(N_SERVERS)]
+
+    def pull(uids):
+        rows = np.empty((len(uids), DIM), np.float32)
+        for i, u in enumerate(uids):
+            rows[i] = shards[int(u) % N_SERVERS].pull([u])[0]
+        return rows
+
+    def push(uids, grads):
+        for s in range(N_SERVERS):
+            m = (np.asarray(uids) % N_SERVERS) == s
+            if m.any():
+                shards[s].push(np.asarray(uids)[m], grads[m], lr=LR)
+
+    targets = _targets()
+    rngs = [np.random.RandomState(1000 + r) for r in range(N_TRAINERS)]
+    for phase_steps in (STEPS_A, STEPS_B):
+        for _ in range(phase_steps):
+            for r in range(N_TRAINERS):
+                uids = rngs[r].choice(
+                    np.arange(r, UNIVERSE, N_TRAINERS), size=8,
+                    replace=False)
+                rows = pull(uids)
+                push(uids, rows - targets[uids])
+    return pull(np.arange(UNIVERSE))
+
+
+def test_push_retry_dedup():
+    """A retried PUSH (same client+seq — the at-least-once retry path)
+    must apply exactly once (reference: brpc request-id dedup)."""
+    shard = SparseTableShard(4, optimizer="sgd", learning_rate=1.0, seed=0)
+    uids = np.array([1, 2])
+    base = shard.pull(uids).copy()
+    g = np.ones((2, 4), np.float32)
+    shard.push(uids, g, client="c1", seq=1)
+    once = shard.pull(uids).copy()
+    shard.push(uids, g, client="c1", seq=1)   # duplicate: must be a no-op
+    np.testing.assert_array_equal(shard.pull(uids), once)
+    np.testing.assert_allclose(base - once, g, rtol=1e-5)
+    shard.push(uids, g, client="c1", seq=2)   # fresh seq applies
+    np.testing.assert_allclose(once - shard.pull(uids), g, rtol=1e-5)
+    # seq table survives checkpoint round-trip
+    import tempfile, os
+    p = os.path.join(tempfile.mkdtemp(), "s.pkl")
+    shard.save(p)
+    s2 = SparseTableShard(4, optimizer="sgd", learning_rate=1.0, seed=0)
+    s2.load(p)
+    before = s2.pull(uids).copy()
+    s2.push(uids, g, client="c1", seq=2)      # still a duplicate
+    np.testing.assert_array_equal(s2.pull(uids), before)
+
+
+def test_ps_service_two_servers_two_trainers_with_server_restart():
+    store = create_master_store(world_size=N_TRAINERS + N_SERVERS)
+    ctx = mp.get_context("spawn")
+    ckpt_dir = tempfile.mkdtemp(prefix="ps_ckpt_")
+
+    def start_server(sid):
+        p = ctx.Process(
+            target=serve_shard,
+            args=("emb", sid, N_SERVERS, DIM, store.port, ckpt_dir),
+            kwargs={"optimizer": "adagrad", "learning_rate": LR, "seed": 0},
+            daemon=True)
+        p.start()
+        return p
+
+    servers = [start_server(s) for s in range(N_SERVERS)]
+    client = TCPStore("127.0.0.1", store.port)
+    trainers = [ctx.Process(target=_trainer,
+                            args=(r, store.port, N_TRAINERS),
+                            daemon=True)
+                for r in range(N_TRAINERS)]
+    for t in trainers:
+        t.start()
+
+    # kill server 0 once trainer 0 has checkpointed, then restart it — the
+    # restarted process must reload the shard and re-register its endpoint
+    client.wait("phase/ready_to_kill", timeout=300)
+    servers[0].terminate()
+    servers[0].join(timeout=30)
+    servers[0] = start_server(0)
+    client.set("phase/restarted", b"1")
+
+    for t in trainers:
+        t.join(timeout=400)
+        assert t.exitcode == 0, f"trainer failed (exit {t.exitcode})"
+    assert int(client.get("trainers_ok")) == N_TRAINERS
+
+    # shards really were split: each server owns ~half the universe
+    ps = PsClient("emb", N_SERVERS, client)
+    stats = ps.stats()
+    counts = sorted(s["rows"] for s in stats)
+    assert sum(counts) == UNIVERSE and min(counts) > 0, stats
+    ps.stop_servers()
+    for srv in servers:
+        srv.join(timeout=30)
