@@ -1,0 +1,121 @@
+"""GraphSAGE with neighbor sampling over the in-memory CSR graph store.
+
+Reference workflow: PGL-style GraphSAGE fed by the PS graph table's
+neighbor sampling (paddle/fluid/distributed/ps/table/common_graph_table.h,
+python/paddle/geometric/sampling/neighbors.py) — minibatch of target
+nodes → multi-hop uniform neighbor sampling → reindex to compact local
+ids → stacked mean-aggregator convolutions → node classification.
+
+TPU design: topology + sampling stay on host (data-dependent shapes);
+each sampled minibatch crosses to the device as dense features + edge
+index arrays, and the convolution stack is ordinary jit-able segment ops
+(send_u_recv).
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, nn
+
+SMOKE = os.environ.get("EXAMPLES_SMOKE") == "1"
+
+N_COMMUNITIES = 4
+NODES_PER_COMM = 64
+FEAT_DIM = 16
+HIDDEN = 32
+
+
+def make_community_graph(rng):
+    """Synthetic stochastic block model: dense intra-community edges,
+    sparse bridges; node features = noisy community signature."""
+    n = N_COMMUNITIES * NODES_PER_COMM
+    comm = np.repeat(np.arange(N_COMMUNITIES), NODES_PER_COMM)
+    src, dst = [], []
+    for u in range(n):
+        same = np.nonzero(comm == comm[u])[0]
+        nbrs = rng.choice(same[same != u], size=8, replace=False)
+        other = np.nonzero(comm != comm[u])[0]
+        bridge = rng.choice(other, size=1)
+        for v in list(nbrs) + list(bridge):
+            src.append(v)
+            dst.append(u)
+    sig = rng.randn(N_COMMUNITIES, FEAT_DIM).astype("float32")
+    feats = sig[comm] + 0.8 * rng.randn(n, FEAT_DIM).astype("float32")
+    graph = geometric.Graph(np.stack([src, dst]), num_nodes=n)
+    return graph, feats, comm.astype("int64")
+
+
+class SageConv(nn.Layer):
+    """Mean-aggregator GraphSAGE layer: W_s·h_v + W_n·mean(h_u, u→v)."""
+
+    def __init__(self, in_dim, out_dim):
+        super().__init__()
+        self.lin_self = nn.Linear(in_dim, out_dim)
+        self.lin_neigh = nn.Linear(in_dim, out_dim)
+
+    def forward(self, h, src, dst, num_targets):
+        agg = geometric.send_u_recv(h, src, dst, reduce_op="mean",
+                                    out_size=num_targets)
+        return self.lin_self(h[:num_targets]) + self.lin_neigh(agg)
+
+
+class GraphSAGE(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = SageConv(FEAT_DIM, HIDDEN)
+        self.conv2 = SageConv(HIDDEN, N_COMMUNITIES)
+        self.act = nn.ReLU()
+
+    def forward(self, feats, hops):
+        """hops: [(src, dst, num_targets)] outermost-first from
+        Graph.sample_subgraph; consume innermost-first."""
+        h = feats
+        convs = [self.conv1, self.conv2]
+        for conv, (src, dst, nf) in zip(convs, reversed(hops)):
+            h = conv(h, src, dst, nf)
+            if conv is not self.conv2:
+                h = self.act(h)
+        return h
+
+
+def main():
+    rng = np.random.RandomState(0)
+    paddle.seed(0)
+    graph, feats, labels = make_community_graph(rng)
+    model = GraphSAGE()
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    n = graph.num_nodes
+    steps = 12 if SMOKE else 120
+    batch = 32
+    first = last = None
+    for step in range(steps):
+        targets = rng.choice(n, size=batch, replace=False)
+        # 2-hop frontier expansion: 5 then 5 sampled inbound neighbors
+        node_ids, hops = graph.sample_subgraph(targets, [5, 5])
+        h = paddle.to_tensor(feats[np.asarray(node_ids.numpy())])
+        logits = model(h, hops)
+        loss = loss_fn(logits, paddle.to_tensor(labels[targets]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step == 0:
+            first = float(loss.numpy())
+        last = float(loss.numpy())
+
+    # full-graph eval through the same sampled pipeline
+    node_ids, hops = graph.sample_subgraph(np.arange(n), [10, 10])
+    h = paddle.to_tensor(feats[np.asarray(node_ids.numpy())])
+    pred = np.asarray(model(h, hops).numpy()).argmax(-1)
+    acc = float((pred == labels).mean())
+    print(f"loss {first:.3f} -> {last:.3f}; full-graph accuracy {acc:.3f}")
+    assert last < first, "training did not reduce the loss"
+    if not SMOKE:
+        assert acc > 0.9, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
     "reindex_graph", "reindex_heter_graph",
     "sample_neighbors", "weighted_sample_neighbors",
+    "Graph",
 ]
 
 
@@ -269,3 +270,109 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     if return_eids and e is not None:
         res = res + (Tensor(jnp.asarray(np.concatenate(out_eids))),)
     return res
+
+
+# ---------------------------------------------------------------------------
+# in-memory CSR/CSC graph store (host-side)
+# ---------------------------------------------------------------------------
+
+class Graph:
+    """Host-side in-memory graph store in CSC layout, feeding the sampling
+    ops above.
+
+    Reference analog: the PS graph table
+    (paddle/fluid/distributed/ps/table/common_graph_table.h) scoped to
+    single-host — it stores adjacency per node with uniform/weighted
+    neighbor sampling and feature lookup; multi-server sharding is the PS
+    fleet's job, not the store's. TPU design: graph topology and sampling
+    stay on host numpy (data-dependent shapes don't jit); only the sampled
+    minibatch (features + reindexed edges) crosses to the device.
+
+    Construct from an edge_index `[2, E]` (src, dst rows). Internally keeps
+    CSC (per-DST inbound neighbor lists: `colptr`/`row`), matching what
+    `sample_neighbors(row, colptr, nodes)` consumes.
+    """
+
+    def __init__(self, edge_index, num_nodes=None, edge_weight=None,
+                 node_feat=None):
+        e = np.asarray(edge_index if not isinstance(edge_index, Tensor)
+                       else edge_index._value)
+        if e.ndim != 2 or e.shape[0] != 2:
+            raise ValueError(f"edge_index must be [2, E], got {e.shape}")
+        src, dst = e[0].astype(np.int64), e[1].astype(np.int64)
+        n = int(num_nodes) if num_nodes is not None else (
+            int(max(src.max(), dst.max())) + 1 if src.size else 0)
+        self.num_nodes = n
+        self.num_edges = int(src.size)
+        # sort edges by dst -> CSC; keep eids so edge features track
+        order = np.argsort(dst, kind="stable")
+        self._row = src[order]                      # inbound neighbor ids
+        self._eids = order.astype(np.int64)         # original edge ids
+        self._colptr = np.zeros(n + 1, np.int64)
+        np.add.at(self._colptr, dst + 1, 1)
+        np.cumsum(self._colptr, out=self._colptr)
+        self._weight = (None if edge_weight is None else
+                        np.asarray(edge_weight if not isinstance(
+                            edge_weight, Tensor) else edge_weight._value)
+                        [order].astype(np.float32))
+        self.node_feat = node_feat or {}
+
+    # -- store surface (common_graph_table analog) -------------------------
+    @property
+    def row(self):
+        return Tensor(jnp.asarray(self._row))
+
+    @property
+    def colptr(self):
+        return Tensor(jnp.asarray(self._colptr))
+
+    def out_degree(self):
+        deg = np.zeros(self.num_nodes, np.int64)
+        np.add.at(deg, self._row, 1)
+        return Tensor(jnp.asarray(deg))
+
+    def in_degree(self):
+        return Tensor(jnp.asarray(np.diff(self._colptr)))
+
+    def neighbors(self, node):
+        b, e = int(self._colptr[int(node)]), int(self._colptr[int(node) + 1])
+        return Tensor(jnp.asarray(self._row[b:e]))
+
+    def sample_neighbors(self, input_nodes, sample_size=-1,
+                         return_eids=False, weighted=False):
+        """Uniform (or weighted) without-replacement sampling of up to
+        `sample_size` inbound neighbors per input node."""
+        eids = Tensor(jnp.asarray(self._eids)) if return_eids else None
+        if weighted:
+            if self._weight is None:
+                raise ValueError("graph built without edge_weight")
+            return weighted_sample_neighbors(
+                self.row, self.colptr, Tensor(jnp.asarray(self._weight)),
+                input_nodes, sample_size=sample_size, eids=eids,
+                return_eids=return_eids)
+        return sample_neighbors(self.row, self.colptr, input_nodes,
+                                sample_size=sample_size, eids=eids,
+                                return_eids=return_eids)
+
+    def sample_subgraph(self, input_nodes, sample_sizes):
+        """Multi-hop GraphSAGE-style frontier expansion: for each hop,
+        sample neighbors of the current frontier and reindex to compact
+        local ids (reference: the sampling pipeline pgl/GraphSAGE builds
+        from sample_neighbors + reindex_graph).
+
+        Returns (node_ids, [(src, dst, frontier_size) per hop]) where hops
+        are ordered OUTERMOST FIRST, ready to be consumed innermost-first
+        by a stacked conv; node_ids[i] is the global id of local node i and
+        node_ids[:frontier_size] are the hop's target nodes.
+        """
+        nodes = np.asarray(input_nodes if not isinstance(input_nodes, Tensor)
+                           else input_nodes._value).astype(np.int64)
+        hops = []
+        for size in sample_sizes:
+            nb, cnt = self.sample_neighbors(Tensor(jnp.asarray(nodes)),
+                                            sample_size=size)
+            src, dst, out_nodes = reindex_graph(
+                Tensor(jnp.asarray(nodes)), nb, cnt)
+            hops.append((src, dst, len(nodes)))
+            nodes = np.asarray(out_nodes._value)
+        return Tensor(jnp.asarray(nodes)), hops

@@ -16,6 +16,7 @@ _EXAMPLES = [
     "distributed_data_parallel.py",
     "onnx_export_deploy.py",
     "sot_graph_breaks.py",
+    "graphsage_sampling.py",
 ]
 
 

@@ -1,0 +1,163 @@
+"""paddle.geometric: message passing, sampling, and the in-memory CSR
+graph store (reference: test/legacy_test/test_graph_send_recv_op.py,
+test_graph_sample_neighbors.py; store analog common_graph_table.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import geometric as G
+
+
+def _toy():
+    # edges (src -> dst): star into 0 plus a chain
+    src = np.array([1, 2, 3, 0, 1], np.int64)
+    dst = np.array([0, 0, 0, 1, 2], np.int64)
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    return src, dst, x
+
+
+def test_send_u_recv_reductions():
+    src, dst, x = _toy()
+    for op, ref in (
+        ("sum", np.array([[x[1] + x[2] + x[3]], [x[0]], [x[1]], [0 * x[0]]])),
+        ("mean", np.array([[(x[1] + x[2] + x[3]) / 3], [x[0]], [x[1]],
+                           [0 * x[0]]])),
+        ("max", np.array([[np.maximum(np.maximum(x[1], x[2]), x[3])],
+                          [x[0]], [x[1]], [0 * x[0]]])),
+    ):
+        out = G.send_u_recv(pt.to_tensor(x), pt.to_tensor(src),
+                            pt.to_tensor(dst), reduce_op=op)
+        np.testing.assert_allclose(out.numpy(), ref.reshape(4, 2), rtol=1e-6,
+                                   err_msg=op)
+
+
+def test_send_ue_recv_and_send_uv():
+    src, dst, x = _toy()
+    e = np.ones((len(src), 2), np.float32) * 0.5
+    out = G.send_ue_recv(pt.to_tensor(x), pt.to_tensor(e), pt.to_tensor(src),
+                         pt.to_tensor(dst), message_op="mul",
+                         reduce_op="sum")
+    ref = np.zeros_like(x)
+    np.add.at(ref, dst, x[src] * 0.5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    uv = G.send_uv(pt.to_tensor(x), pt.to_tensor(x), pt.to_tensor(src),
+                   pt.to_tensor(dst), message_op="add")
+    np.testing.assert_allclose(uv.numpy(), x[src] + x[dst], rtol=1e-6)
+
+
+def test_send_u_recv_grad():
+    src, dst, x = _toy()
+    t = pt.to_tensor(x, stop_gradient=False)
+    out = G.send_u_recv(t, pt.to_tensor(src), pt.to_tensor(dst),
+                        reduce_op="sum")
+    out.sum().backward()
+    ref = np.zeros_like(x)
+    for s in src:
+        ref[s] += 1.0  # each outgoing edge contributes once
+    np.testing.assert_allclose(t.grad.numpy(), ref, rtol=1e-6)
+
+
+def test_graph_store_topology():
+    src, dst, _ = _toy()
+    g = G.Graph(np.stack([src, dst]), num_nodes=4)
+    assert g.num_nodes == 4 and g.num_edges == 5
+    np.testing.assert_array_equal(g.in_degree().numpy(), [3, 1, 1, 0])
+    np.testing.assert_array_equal(g.out_degree().numpy(), [1, 2, 1, 1])
+    np.testing.assert_array_equal(np.sort(g.neighbors(0).numpy()), [1, 2, 3])
+    np.testing.assert_array_equal(g.neighbors(3).numpy(), [])
+
+
+def test_graph_sample_neighbors_bounds():
+    rng = np.random.RandomState(0)
+    n = 50
+    src = rng.randint(0, n, 400)
+    dst = rng.randint(0, n, 400)
+    g = G.Graph(np.stack([src, dst]), num_nodes=n)
+    nodes = np.arange(0, n, 3)
+    nb, cnt = g.sample_neighbors(pt.to_tensor(nodes), sample_size=4)
+    cnt = cnt.numpy()
+    assert cnt.max() <= 4
+    indeg = g.in_degree().numpy()
+    np.testing.assert_array_equal(cnt, np.minimum(indeg[nodes], 4))
+    # every sampled neighbor really is an inbound neighbor (the random
+    # multigraph has parallel edges, so sampled ids may legitimately
+    # repeat: sampling is without-replacement over EDGES, like the
+    # reference kernel)
+    nb = nb.numpy()
+    off = 0
+    for v, c in zip(nodes, cnt):
+        got = nb[off:off + c]
+        real = set(g.neighbors(v).numpy().tolist())
+        assert set(got.tolist()) <= real
+        off += c
+
+
+def test_graph_sample_neighbors_eids_weighted():
+    src = np.array([1, 2, 3], np.int64)
+    dst = np.array([0, 0, 0], np.int64)
+    w = np.array([1.0, 2.0, 3.0], np.float32)
+    g = G.Graph(np.stack([src, dst]), num_nodes=4, edge_weight=w)
+    nb, cnt, eids = g.sample_neighbors(pt.to_tensor([0]), sample_size=-1,
+                                       return_eids=True)
+    assert cnt.numpy()[0] == 3
+    # eids map back to the original edge order
+    np.testing.assert_array_equal(np.sort(src[eids.numpy()]),
+                                  np.sort(nb.numpy()))
+    nb2, cnt2 = g.sample_neighbors(pt.to_tensor([0]), sample_size=2,
+                                   weighted=True)
+    assert cnt2.numpy()[0] == 2
+
+    with pytest.raises(ValueError, match="edge_weight"):
+        G.Graph(np.stack([src, dst])).sample_neighbors(
+            pt.to_tensor([0]), 1, weighted=True)
+
+
+def test_reindex_graph_roundtrip():
+    x = np.array([10, 20], np.int64)
+    nbrs = np.array([30, 10, 40], np.int64)
+    cnt = np.array([2, 1], np.int32)
+    src, dst, nodes = G.reindex_graph(pt.to_tensor(x), pt.to_tensor(nbrs),
+                                      pt.to_tensor(cnt))
+    nodes = nodes.numpy()
+    np.testing.assert_array_equal(nodes[:2], x)  # targets first, in order
+    np.testing.assert_array_equal(nodes[src.numpy()], nbrs)
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1])
+
+
+def test_sample_subgraph_local_id_invariants():
+    rng = np.random.RandomState(1)
+    n = 40
+    src = rng.randint(0, n, 300)
+    dst = rng.randint(0, n, 300)
+    g = G.Graph(np.stack([src, dst]), num_nodes=n)
+    targets = np.array([0, 5, 9])
+    node_ids, hops = g.sample_subgraph(targets, [3, 3])
+    node_ids = node_ids.numpy()
+    np.testing.assert_array_equal(node_ids[:3], targets)
+    (s0, d0, f0), (s1, d1, f1) = hops
+    assert f0 == 3 and f1 >= 3
+    assert d0.numpy().max() < f0 and s1.numpy().max() < len(node_ids)
+    # every sampled edge at both hops is a real edge in global-id space:
+    # each hop's local ids are a prefix-preserving extension of the previous
+    # hop's node list, so node_ids resolves them all
+    edge_set = set(zip(src.tolist(), dst.tolist()))
+    for s, d, _ in hops:
+        for si, di in zip(s.numpy(), d.numpy()):
+            assert (int(node_ids[si]), int(node_ids[di])) in edge_set
+
+
+def test_graphsage_example_trains():
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(EXAMPLES_SMOKE="1", JAX_PLATFORMS="cpu", PYTHONPATH=root)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "examples",
+                                      "graphsage_sampling.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "accuracy" in proc.stdout
