@@ -463,6 +463,10 @@ def main():
         # #2: every README perf claim must trace to a driver-captured or
         # in-repo artifact); flagship line alone on stdout
         os.environ.pop("BENCH_MODEL", None)   # each config picks defaults
+        # shell-exported quant knobs must not leak into the bf16 rows —
+        # the quantized-variant loop below re-sets them per row
+        os.environ.pop("BENCH_WEIGHT_DTYPE", None)
+        os.environ.pop("BENCH_KV_DTYPE", None)
         payloads = [_emit(bench_gpt(on_tpu, dev))]
         for fn in (bench_resnet50, bench_bert_finetune, bench_ppyoloe,
                    bench_lora_decode):

@@ -71,15 +71,23 @@ def _rebuild_tensor(cls, shm_name, dtype_str, shape, stop_gradient,
                     extras=None):
     """Receiver: attach → copy out → detach (reference:
     reductions.py:77 `_rebuild_tensor`). Attach in untracked mode where
-    available (3.13+); under a shared multiprocessing resource_tracker the
-    tracked re-registration is a set no-op balanced by the sender's
-    eventual unlink, so no explicit unregister is needed (an unregister
-    here would strip the sender's own registration)."""
+    available (3.13+). On older Pythons we unregister the attach-side
+    tracker entry immediately: a receiver with its OWN resource_tracker
+    (spawned independently of the sender) would otherwise unlink the
+    sender's live segments when it exits, breaking a second unpickle of
+    the same bytes. Cleanup stays the sender's job (LRU + atexit); the
+    lost crash-net redundancy is the standard trade (torch does the
+    same in its reductions)."""
     from multiprocessing import shared_memory
     try:
         seg = shared_memory.SharedMemory(name=shm_name, track=False)
     except TypeError:  # track kwarg is 3.13+
         seg = shared_memory.SharedMemory(name=shm_name)
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:  # tracker internals are version-fragile; the
+            pass           # worst case is the pre-fix (tracked) behavior
     try:
         import ml_dtypes  # noqa: F401 — registers bfloat16/float8 names
         arr = np.ndarray(shape, dtype=np.dtype(dtype_str),
