@@ -119,6 +119,7 @@ def install_samples():
     _strings(att)
     _round4_floors(att)
     _round4_floors_b(att)
+    _round5_floors(att)
     _install_extra_grad()
     _install_round4b_grads()
     return _MISSING
@@ -3234,3 +3235,142 @@ def _install_round4b_grads():
         if spec is not None and spec.sample is not None \
                 and spec.grad is None:
             spec.grad = True
+
+
+def _round5_floors(att):
+    """Round-5 coverage push (VERDICT r4 item 7): widen the grad-checked and
+    bf16-swept sets toward "checks are the norm, not the exception"
+    (reference: op_test.py:2963 grad checks / :2016 dtype grid).
+
+    The remaining un-grad-checked rows are non-differentiable by nature —
+    comparisons/logic, integer/index outputs (argmax, searchsorted...),
+    random sampling, property-checked decompositions (qr/svd/eig), and
+    shape/attribute queries — matching the reference, which only
+    check_grad's differentiable ops.
+    """
+    from . import schema
+
+    def flag(name, grad=None, grad_tol=None, bf16=False, bf16_tol=None):
+        spec = schema.OPS.get(name)
+        if spec is None:
+            _MISSING.append(name)
+            return
+        if grad is not None and spec.grad is None:
+            spec.grad = grad
+        if grad_tol is not None:
+            spec.grad_tol = grad_tol
+        if bf16:
+            spec.bf16 = True
+        if bf16_tol is not None:
+            spec.bf16_tol = bf16_tol
+
+    # --- new grad checks (differentiable rows that lacked them) ----------
+    for n in [
+        # complex-output chains (harness projects real+imag)
+        "complex", "polar", "fft.rfft", "fft.rfft2", "fft.rfftn",
+        "fft.ihfft", "fft.ihfft2", "fft.ihfftn", "signal.stft",
+        "polygamma_n",
+        "vision.ops.deform_conv2d",
+        # fused incubate blocks (deterministic samples)
+        "incubate.nn.functional.fused_bias_dropout_residual_layer_norm",
+        "incubate.nn.functional.fused_feedforward",
+        "incubate.nn.functional.fused_ec_moe",
+        "incubate.nn.functional.fused_multi_transformer",
+        # loss tails
+        "nn.functional.hsigmoid_loss", "nn.functional.margin_cross_entropy",
+        "nn.functional.rnnt_loss", "nn.functional.apply_rotary_pos_emb",
+    ]:
+        flag(n, grad=True)
+    # box-coordinate gradients cross discrete bin boundaries (numeric diff
+    # at eps=1e-2 jumps bins) — check the smooth feature-input path only
+    flag("vision.ops.roi_align", grad=[0])
+    # NOT grad-checked, with reasons (the reference skips these too):
+    #   nan_to_num / nan_to_num_raw — the sample's nan/inf elements make
+    #     central differences meaningless at exactly the op's point;
+    #   vision.ops.yolo_loss — argmax-based assignment (piecewise const);
+    #   vision.ops.psroi_pool — pooling path does not tape feature grads;
+    #   audio.functional.power_to_db — host-side numpy math, not taped;
+    #   fused_multi_head_attention — sample runs live dropout (random
+    #     mask differs between the analytic and numeric passes).
+
+    # --- bf16 sweep: exact data-movement ops (any-dtype correct) ---------
+    movement = [
+        "tensor_split", "hsplit", "vsplit", "dsplit", "atleast_1d",
+        "atleast_2d", "atleast_3d", "take", "index_sample", "index_fill",
+        "index_put", "select_scatter", "slice_scatter", "diagonal_scatter",
+        "fill_diagonal_tensor", "fill_diagonal", "masked_scatter",
+        "unflatten", "unfold", "as_strided", "view", "view_as", "rollaxis",
+        "rearrange", "diag", "diagflat", "meshgrid", "ones_like",
+        "zeros_like", "full_like", "broadcast_to", "crop", "diag_embed",
+        "expand_as", "gather_nd", "index_add", "masked_select",
+        "put_along_axis", "repeat_interleave", "rot90", "scatter",
+        "scatter_nd", "scatter_nd_add", "slice", "strided_slice", "unbind",
+        "unstack", "assign", "zero", "fill", "combinations",
+        "fft.fftshift", "fft.ifftshift", "signal.frame",
+        "signal.overlap_add", "nn.functional.channel_shuffle",
+        "nn.functional.pixel_unshuffle", "nn.functional.temporal_shift",
+        "nn.functional.zeropad2d",
+    ]
+    for n in movement:
+        flag(n, bf16=True, bf16_tol=2e-2)  # pure movement: only the input
+        #                                    rounding to bf16 shows up
+
+    # --- bf16 sweep: compute ops at the standard bf16 tolerance ----------
+    compute = [
+        "vander", "ldexp", "polygamma", "multigammaln", "trapezoid",
+        "cumulative_trapezoid", "cdist", "renorm", "baddbmm",
+        "igamma", "igammac", "gammainc", "gammaincc", "cummax", "cummin",
+        "increment", "logcumsumexp", "logit", "logit_raw", "nan_to_num",
+        "nan_to_num_raw", "polygamma_n", "pow_op", "nanmean", "nanmedian",
+        "nansum", "quantile", "nanquantile", "corrcoef", "cov",
+        "bilinear",
+        # svdvals / eigvalsh: jax lowers eigen/svd through LAPACK-style
+        # routines with no bf16 kernels (NotImplementedError) — excluded
+        "nn.functional.adaptive_avg_pool1d",
+        "nn.functional.adaptive_avg_pool2d",
+        "nn.functional.adaptive_avg_pool3d",
+        "nn.functional.adaptive_max_pool1d",
+        "nn.functional.adaptive_max_pool2d",
+        "nn.functional.adaptive_max_pool3d",
+        "nn.functional.avg_pool1d", "nn.functional.avg_pool3d",
+        "nn.functional.max_pool1d", "nn.functional.max_pool3d",
+        "nn.functional.conv1d_transpose", "nn.functional.conv2d_transpose",
+        "nn.functional.conv3d", "nn.functional.conv3d_transpose",
+        "nn.functional.fold", "nn.functional.grid_sample",
+        "nn.functional.affine_grid", "nn.functional.upsample",
+        "nn.functional.local_response_norm", "nn.functional.maxout",
+        "nn.functional.prelu", "nn.functional.elu_",
+        "nn.functional.relu_", "nn.functional.leaky_relu_",
+        "nn.functional.hardtanh_", "nn.functional.softmax_",
+        "nn.functional.thresholded_relu", "nn.functional.thresholded_relu_",
+        "nn.functional.binary_cross_entropy",
+        "nn.functional.binary_cross_entropy_with_logits",
+        "nn.functional.cosine_embedding_loss", "nn.functional.dice_loss",
+        "nn.functional.gaussian_nll_loss",
+        "nn.functional.hinge_embedding_loss", "nn.functional.kl_div",
+        "nn.functional.log_loss", "nn.functional.margin_ranking_loss",
+        "nn.functional.multi_label_soft_margin_loss",
+        "nn.functional.multi_margin_loss", "nn.functional.npair_loss",
+        "nn.functional.pairwise_distance", "nn.functional.pdist",
+        "nn.functional.poisson_nll_loss",
+        "nn.functional.sigmoid_focal_loss",
+        "nn.functional.soft_margin_loss",
+        "nn.functional.square_error_cost",
+        "nn.functional.softmax_with_cross_entropy",
+        "nn.functional.triplet_margin_loss",
+        "nn.functional.triplet_margin_with_distance_loss",
+        "nn.functional.flash_attention",
+        "nn.functional.flash_attn_unpadded",
+        "nn.functional.sparse_attention",
+        "vision.ops.box_iou", "audio.functional.power_to_db",
+        "incubate.graph_send_recv", "incubate.identity_loss",
+        "incubate.segment_max", "incubate.segment_mean",
+        "incubate.segment_min", "incubate.segment_sum",
+        "incubate.softmax_mask_fuse",
+        "incubate.nn.functional.fused_bias_act",
+        "incubate.nn.functional.fused_linear_activation",
+        "geometric.segment_max", "geometric.segment_min",
+        "geometric.send_ue_recv", "geometric.send_uv",
+    ]
+    for n in compute:
+        flag(n, bf16=True)

@@ -128,6 +128,16 @@ def _float_arg_indices(args):
             if isinstance(a, np.ndarray) and a.dtype == np.float32]
 
 
+def _proj_np(o, cot):
+    """Real scalar projection matching _run_loss for numeric differencing;
+    complex outputs project through real+imag (so the gradient exercises
+    the full complex chain — rfft/stft/polar rows)."""
+    o = np.asarray(o)
+    if np.iscomplexobj(o):
+        o = o.real + o.imag
+    return float(np.sum(o.astype("float64") * cot.astype("float64")))
+
+
 def _run_loss(spec, np_args, kwargs, cot, diff_idx):
     """Scalar projection sum(out * cot) through the op (Tensor world)."""
     t_args = []
@@ -138,6 +148,8 @@ def _run_loss(spec, np_args, kwargs, cot, diff_idx):
             t_args.append(_to_tensors(a))
     out = spec.fn(*t_args, **kwargs)
     out = out[0] if isinstance(out, (tuple, list)) else out
+    if np.iscomplexobj(np.asarray(out._value)):
+        out = out.real() + out.imag()
     loss = (out * paddle.to_tensor(cot)).sum()
     return loss, t_args
 
@@ -187,9 +199,7 @@ def test_op_grad(spec):
                 t2 = [_to_tensors(a) for a in np_args]
                 o = spec.fn(*t2, **kwargs)
                 o = o[0] if isinstance(o, (tuple, list)) else o
-                val = float(np.sum(np.asarray(o._value, "float64")
-                                   * cot.astype("float64")))
-                numeric[j] += sgn * val
+                numeric[j] += sgn * _proj_np(o._value, cot)
         numeric /= (2 * eps)
         a_flat = analytic.ravel()[checked]
         n_flat = numeric[checked]
@@ -253,8 +263,14 @@ def test_coverage_floor():
     grad_checked = len(GRAD)
     assert sampled >= 590, sampled
     assert with_ref >= 575, with_ref
-    assert grad_checked >= 355, grad_checked
-    assert len(BF16) >= 180, len(BF16)
+    # round-5 floors (VERDICT r4 item 7): grad 355→375, bf16 180→340.
+    # The ~210 rows still outside the grad sweep are non-differentiable by
+    # nature — comparisons/logic, integer/index outputs (argmax,
+    # searchsorted, ...), random sampling, property-checked decompositions
+    # (qr/svd/eig), shape/attribute queries — matching the reference,
+    # which only check_grad's differentiable ops (op_test.py:2963).
+    assert grad_checked >= 375, grad_checked
+    assert len(BF16) >= 340, len(BF16)
     # tensor-method artifacts generated from the same rows
     method_count = sum(
         1 for s in schema.OPS.values() if s.tensor_method
