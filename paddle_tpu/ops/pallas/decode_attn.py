@@ -1,0 +1,107 @@
+"""Fused single-position decode attention with in-kernel KV dequant.
+
+Reference analog: the fused masked_multihead_attention decode kernel
+(paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu) — one
+kernel per decode step covering QK^T, causal mask, softmax and PV over the
+whole KV cache.
+
+TPU-native motivation (docs/decode_perf.md): with an int8 KV cache the XLA
+path must materialize a bf16 copy of the cache every step (TPU XLA does
+not fuse the int8→bf16 convert into dot operands), so int8 reads MORE
+bytes than bf16. Here the cache is read as int8 into VMEM and dequantized
+in-register, so the HBM bill is genuinely half of bf16's. The workload is
+bandwidth-bound at decode shapes (q_len=1), so everything runs on the VPU
+as 2-D broadcast/reduce ops — the MXU has nothing to chew on at [1,D], and
+per-(batch, head) grid cells keep every block a clean (T, D) tile.
+
+Layout: Mosaic requires the blocked batch/head axes OUT of the last two
+dims, so the kernel consumes caches in [B, Hkv, T, D] ("kernel layout",
+scales [B, Hkv, T, 1]). Scope: q_len == 1.
+
+STATUS — measured record, NOT wired into the model path: at the decode
+bench shapes (bs=8, T=144) the whole attention stack runs an order of
+magnitude below HBM spec (latency-bound), the XLA int8-convert path ties
+bf16, and this kernel measures 1.9–2.3× slower than XLA's lowering
+(docs/decode_perf.md round-5 section). models/gpt.py keeps the XLA
+cached-attention impls; this kernel remains the template for genuinely
+bytes-bound regimes (T in the thousands).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_VMEM_LIMIT = 64 * 1024 * 1024
+
+
+def _default_interpret():
+    if os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1":
+        return True
+    return jax.devices()[0].platform != "tpu"
+
+
+def _kernel(pos_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, o_ref, *,
+            scale):
+    # blocks: q [1,1,1,D]; kq/vq [1,1,T,D] (int8 or float); ks/vs
+    # [1,1,T,1] f32; o [1,1,1,D]. All math f32 on the VPU.
+    q = q_ref[0, 0].astype(jnp.float32)                    # [1, D]
+    kf = kq_ref[0, 0].astype(jnp.float32)                  # [T, D]
+    ks = ks_ref[0, 0]                                      # [T, 1]
+    T = kf.shape[0]
+    scores = jnp.sum(kf * q, axis=1, keepdims=True)        # [T, 1]
+    scores = scores * ks * scale
+    pos = pos_ref[0]
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+    scores = jnp.where(t_idx <= pos, scores, -jnp.inf)
+    m = jnp.max(scores, axis=0, keepdims=True)             # [1, 1]
+    e = jnp.exp(scores - m)
+    p = e / jnp.sum(e, axis=0, keepdims=True)              # [T, 1]
+    vf = vq_ref[0, 0].astype(jnp.float32)                  # [T, D]
+    vs = vs_ref[0, 0]                                      # [T, 1]
+    o = jnp.sum((p * vs) * vf, axis=0, keepdims=True)      # [1, D]
+    o_ref[0, 0, 0] = o[0].astype(o_ref.dtype)
+
+
+def decode_attention(q, kq, ks, vq, vs, pos, interpret=None):
+    """q [B,1,H,D]; kq/vq [B,Hkv,T,D] (int8 or float, kernel layout);
+    ks/vs [B,Hkv,T,1] f32 dequant scales (ones for float caches); pos
+    int32 scalar (global position of the query). Returns [B,1,H,D]."""
+    if interpret is None:
+        interpret = _default_interpret()
+    B, s, H, D = q.shape
+    if s != 1:
+        raise ValueError("decode_attention handles q_len == 1 only")
+    Hkv, T = kq.shape[1], kq.shape[2]
+    if H % Hkv:
+        raise ValueError(
+            f"num_heads {H} must be a multiple of kv heads {Hkv} (an "
+            "uneven ratio would silently clamp block indices past the "
+            "cache's head axis)")
+    rep = H // Hkv
+    scale = 1.0 / (D ** 0.5)
+
+    qh = jnp.transpose(q, (0, 2, 1, 3))                    # [B, H, 1, D]
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    grid = (B, H)
+    q_spec = pl.BlockSpec((1, 1, 1, D), lambda b, h: (b, h, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, 1, T, D), lambda b, h: (b, h // rep, 0, 0),
+                           memory_space=pltpu.VMEM)
+    sc_spec = pl.BlockSpec((1, 1, T, 1), lambda b, h: (b, h // rep, 0, 0),
+                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  q_spec, kv_spec, sc_spec, kv_spec, sc_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )(pos_arr, qh, kq, ks, vq, vs)
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -34,7 +34,7 @@ def _kernel(x_ref, qw_ref, scale_ref, o_ref):
     w = qw_ref[...].astype(jnp.float32)           # [bn, k] int8 -> f32 in VMEM
     out = jax.lax.dot_general(x, w, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32)
-    o_ref[...] = (out * scale_ref[...][None, :]).astype(o_ref.dtype)
+    o_ref[...] = (out * scale_ref[...]).astype(o_ref.dtype)  # scale [1, bn]
 
 
 def _kernel_int4(x_ref, qw_ref, scale_ref, o_ref):
@@ -56,7 +56,7 @@ def _kernel_int4(x_ref, qw_ref, scale_ref, o_ref):
         + jax.lax.dot_general(xh, high, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32) \
         - 8.0 * jnp.sum(xl, axis=1, keepdims=True)
-    o_ref[...] = (out * scale_ref[...][None, :]).astype(o_ref.dtype)
+    o_ref[...] = (out * scale_ref[...]).astype(o_ref.dtype)  # scale [1, bn]
 
 
 def _pick_block(n, k, m):
@@ -93,6 +93,10 @@ def weight_only_matmul(x, qweight, scale, out_dtype=None, interpret=None,
     if bn is None:
         return None
     out_dtype = out_dtype or x.dtype
+    # scale ships as [1, n]: a 1-D f32 operand gets an XLA minor tiling
+    # (T(1024) at n=22016, llama ffn) that can disagree with Mosaic's
+    # block-derived T(bn) and fail layout verification; 2-D operands use
+    # the unambiguous (8, 128) tiling.
     return pl.pallas_call(
         _kernel_int4 if int4 else _kernel,
         grid=(n // bn,),
@@ -101,7 +105,7 @@ def weight_only_matmul(x, qweight, scale, out_dtype=None, interpret=None,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((bn, kw), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn,), lambda i: (i,),
+            pl.BlockSpec((1, bn), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((m, bn), lambda i: (0, i),
@@ -109,7 +113,7 @@ def weight_only_matmul(x, qweight, scale, out_dtype=None, interpret=None,
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(x, qweight, scale)
+    )(x, qweight, scale.reshape(1, n))
 
 
 def weight_only_matmul_nd(x, qweight, scale, interpret=None,

@@ -1,0 +1,84 @@
+"""Fused decode-attention kernel (ops/pallas/decode_attn.py) — numerics vs
+a dense numpy reference, MHA + GQA, int8 and float caches. Runs in
+interpret mode on the CPU mesh; the on-TPU perf verdict lives in
+docs/decode_perf.md (measured: the XLA path wins at decode shapes; the
+kernel stays as the measured record)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.decode_attn import decode_attention
+
+
+def _quant(x):
+    amax = np.abs(x).max(-1, keepdims=True)
+    s = np.maximum(amax, 1e-8) / 127.0
+    q = np.clip(np.round(x / s), -127, 127).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def _ref(q, kf_bhtd, vf_bhtd, pos):
+    H = q.shape[2]
+    Hkv = kf_bhtd.shape[1]
+    kf = np.repeat(np.transpose(kf_bhtd, (0, 2, 1, 3)), H // Hkv, 2)
+    vf = np.repeat(np.transpose(vf_bhtd, (0, 2, 1, 3)), H // Hkv, 2)
+    T, D = kf.shape[1], kf.shape[3]
+    sc = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float32), kf) / np.sqrt(D)
+    sc = np.where((np.arange(T) <= pos)[None, None, None], sc, -np.inf)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, vf)
+
+
+def _case(B, T, H, Hkv, D, pos, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(B, 1, H, D).astype(np.float32)
+    k = rng.randn(B, Hkv, T, D).astype(np.float32)
+    v = rng.randn(B, Hkv, T, D).astype(np.float32)
+    return q, k, v
+
+
+def test_decode_attention_int8_mha():
+    q, k, v = _case(2, 32, 4, 4, 8, pos=20)
+    kq, ks = _quant(k)
+    vq, vs = _quant(v)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(kq), jnp.asarray(ks),
+                           jnp.asarray(vq), jnp.asarray(vs), 20,
+                           interpret=True)
+    ref = _ref(q, kq.astype(np.float32) * ks, vq.astype(np.float32) * vs, 20)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5)
+
+
+def test_decode_attention_int8_gqa():
+    q, k, v = _case(2, 16, 8, 2, 8, pos=9)
+    kq, ks = _quant(k)
+    vq, vs = _quant(v)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(kq), jnp.asarray(ks),
+                           jnp.asarray(vq), jnp.asarray(vs), 9,
+                           interpret=True)
+    ref = _ref(q, kq.astype(np.float32) * ks, vq.astype(np.float32) * vs, 9)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5)
+
+
+def test_decode_attention_float_cache():
+    q, k, v = _case(1, 16, 2, 2, 8, pos=5)
+    ones = np.ones(k.shape[:-1] + (1,), np.float32)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(ones),
+                           jnp.asarray(v), jnp.asarray(ones), 5,
+                           interpret=True)
+    ref = _ref(q, k, v, 5)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5)
+
+
+def test_decode_attention_mask_excludes_future():
+    # positions beyond pos must not contribute: poison them with huge values
+    q, k, v = _case(1, 12, 2, 2, 8, pos=4)
+    k[:, :, 5:] = 100.0
+    v[:, :, 5:] = 100.0
+    ones = np.ones(k.shape[:-1] + (1,), np.float32)
+    out = decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(ones),
+                           jnp.asarray(v), jnp.asarray(ones), 4,
+                           interpret=True)
+    assert np.abs(np.asarray(out)).max() < 50.0
+    ref = _ref(q, k, v, 4)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5)
