@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
 
 
 class Config:
@@ -68,15 +68,27 @@ class _Handle:
 
 
 class Predictor:
-    def __init__(self, config: Config):
-        from ..jit.save_load import load
+    def __init__(self, config: Config, _shared_layer=None):
+        if _shared_layer is None:
+            from ..jit.save_load import load
 
-        self._layer = load(config.model_prefix)
+            self._layer = load(config.model_prefix)
+        else:
+            self._layer = _shared_layer
         n_in = len(self._layer.input_spec)
         self._inputs = {f"input_{i}": _Handle() for i in range(n_in)}
         # output arity is known from the exported module before any run
         n_out = self._layer.num_outputs or 1
         self._outputs = {f"output_{i}": None for i in range(n_out)}
+
+    def clone(self):
+        """Per-thread predictor sharing the loaded executable (reference:
+        AnalysisPredictor::Clone, analysis_predictor.h:233 — clones share
+        weights/program, own their IO scope). The compiled XLA executable
+        is immutable and thread-safe; only the handle state is
+        per-predictor, so a clone is a fresh handle set over the same
+        module — zero copy, zero recompile."""
+        return Predictor(None, _shared_layer=self._layer)
 
     def get_input_names(self):
         return list(self._inputs)
@@ -108,3 +120,61 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class PredictorPool:
+    """Fixed pool of cloned predictors for multi-threaded serving
+    (reference: paddle_infer::services::PredictorPool,
+    fluid/inference/api/paddle_inference_api.h — create once, Retrieve(i)
+    per worker thread). One artifact load + one AOT compile serve every
+    member; handles are per-member, so correctness requires EXCLUSIVE use
+    of a member while a request is in flight. `retrieve(idx)` is the
+    reference-shaped accessor for callers that own the thread↔member
+    mapping (one fixed member per worker thread); `acquire()` is the
+    safe default — an exclusive lease from an internal queue, so
+    dynamically-scheduled workers (ThreadPoolExecutor) can never land two
+    in-flight requests on one member's handles.
+    """
+
+    def __init__(self, config: Config, size: int = 1):
+        import queue
+
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        first = Predictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+        self._free: "queue.Queue[Predictor]" = queue.Queue()
+        for p in self._preds:
+            self._free.put(p)
+
+    def retrieve(self, idx: int) -> Predictor:
+        if not 0 <= idx < len(self._preds):
+            raise IndexError(
+                f"predictor index {idx} out of range [0, {len(self._preds)})")
+        return self._preds[idx]
+
+    # reference spells it Retrieve
+    Retrieve = retrieve
+
+    def acquire(self, timeout=None):
+        """Context manager: lease a member exclusively for one request.
+
+            with pool.acquire() as predictor:
+                ... copy_from_cpu / run ...
+
+        Blocks while every member is in flight; the member returns to the
+        pool on exit."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _lease():
+            p = self._free.get(timeout=timeout)
+            try:
+                yield p
+            finally:
+                self._free.put(p)
+
+        return _lease()
+
+    def __len__(self):
+        return len(self._preds)

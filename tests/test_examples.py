@@ -17,6 +17,7 @@ _EXAMPLES = [
     "onnx_export_deploy.py",
     "sot_graph_breaks.py",
     "graphsage_sampling.py",
+    "serving_predictor_pool.py",
 ]
 
 

@@ -77,3 +77,51 @@ def test_predictor_output_names_before_run(tmp_path):
     paddle.jit.save(model, path, input_spec=[paddle.to_tensor(x)])
     pred = create_predictor(Config(path))
     assert pred.get_output_names() == ["output_0"]
+
+
+def test_predictor_clone_and_pool_concurrent(tmp_path):
+    """Multi-threaded serving (reference: AnalysisPredictor::Clone +
+    services::PredictorPool): clones share the loaded executable, own
+    their handles; concurrent run() calls from a thread pool match the
+    single-threaded reference exactly."""
+    import concurrent.futures
+
+    from paddle_tpu.inference import Config, PredictorPool, create_predictor
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "pool" / "infer")
+    x0 = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+    paddle.jit.save(model, path, input_spec=[paddle.to_tensor(x0)])
+
+    base = create_predictor(Config(path))
+    c = base.clone()
+    assert c._layer is base._layer          # shared executable, no reload
+    assert c._inputs is not base._inputs    # private handles
+
+    pool = PredictorPool(Config(path), size=3)
+    assert len(pool) == 3
+    rng = np.random.RandomState(1)
+    batches = [rng.rand(2, 8).astype(np.float32) for _ in range(24)]
+    want = [model(paddle.to_tensor(b)).numpy() for b in batches]
+
+    def serve(i):
+        # acquire(): exclusive lease — with dynamically-scheduled workers
+        # (more workers than members here), index-based retrieve() could
+        # land two in-flight requests on one member's handles
+        with pool.acquire() as p:
+            h = p.get_input_handle(p.get_input_names()[0])
+            h.copy_from_cpu(batches[i])
+            (out,) = p.run()
+        return i, out
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+        for i, out in ex.map(serve, range(24)):
+            np.testing.assert_allclose(out, want[i], rtol=1e-5,
+                                       err_msg=f"request {i}")
+    # reference-spelled accessor + bounds contract
+    assert pool.Retrieve(0) is pool.retrieve(0)
+    with pytest.raises(IndexError):
+        pool.retrieve(-1)
+    with pytest.raises(IndexError):
+        pool.retrieve(3)
