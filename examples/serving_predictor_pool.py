@@ -44,8 +44,12 @@ def main():
     rng = np.random.RandomState(0)
     model, X, y = train_model(rng)
 
+    with tempfile.TemporaryDirectory(prefix="serve_") as tmp:
+        _serve(model, X, y, os.path.join(tmp, "infer"))
+
+
+def _serve(model, X, y, path):
     # export the deploy artifact (fixed serving batch of 8)
-    path = os.path.join(tempfile.mkdtemp(prefix="serve_"), "infer")
     spec = paddle.to_tensor(np.zeros((8, 16), np.float32))
     paddle.jit.save(model, path, input_spec=[spec])
 

@@ -9,6 +9,8 @@ executable wrapper with the reference's handle-style API.
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
+
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
@@ -156,25 +158,28 @@ class PredictorPool:
     # reference spells it Retrieve
     Retrieve = retrieve
 
+    @_contextlib.contextmanager
     def acquire(self, timeout=None):
         """Context manager: lease a member exclusively for one request.
 
             with pool.acquire() as predictor:
                 ... copy_from_cpu / run ...
 
-        Blocks while every member is in flight; the member returns to the
-        pool on exit."""
-        import contextlib
+        Blocks while every member is in flight (or raises TimeoutError at
+        with-entry if `timeout` seconds pass with none free); the member
+        returns to the pool on exit."""
+        import queue
 
-        @contextlib.contextmanager
-        def _lease():
+        try:
             p = self._free.get(timeout=timeout)
-            try:
-                yield p
-            finally:
-                self._free.put(p)
-
-        return _lease()
+        except queue.Empty:
+            raise TimeoutError(
+                f"no free predictor within {timeout}s "
+                f"(all {len(self._preds)} members in flight)") from None
+        try:
+            yield p
+        finally:
+            self._free.put(p)
 
     def __len__(self):
         return len(self._preds)

@@ -125,3 +125,21 @@ def test_predictor_clone_and_pool_concurrent(tmp_path):
         pool.retrieve(-1)
     with pytest.raises(IndexError):
         pool.retrieve(3)
+
+
+def test_pool_acquire_timeout(tmp_path):
+    from paddle_tpu.inference import Config, PredictorPool
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "t" / "infer")
+    paddle.jit.save(model, path, input_spec=[
+        paddle.to_tensor(np.zeros((1, 8), np.float32))])
+    pool = PredictorPool(Config(path), size=1)
+    with pool.acquire():
+        with pytest.raises(TimeoutError, match="in flight"):
+            with pool.acquire(timeout=0.1):
+                pass
+    # member returned after exit: next lease succeeds
+    with pool.acquire(timeout=1) as p:
+        assert p is pool.retrieve(0)
