@@ -280,7 +280,9 @@ def bench_ppyoloe(on_tpu, dev):
         lowered = jax.jit(fwd).lower(
             pv, bv, img._value.astype("bfloat16" if on_tpu else "float32"),
             gb._value, gl._value, gm._value)
-        cost = lowered.compile().cost_analysis()
+        from paddle_tpu.compat import cost_analysis
+
+        cost = cost_analysis(lowered.compile())
         if cost and cost.get("flops"):
             flops_img = 3.0 * float(cost["flops"]) / batch
     except Exception as e:

@@ -1,0 +1,50 @@
+"""Shared crash-atomic file-write primitives.
+
+One implementation of the write-tmp → flush → fsync → os.replace protocol
+for every durability-sensitive writer (framework_io.save, the distributed
+checkpoint commit protocol, PS table shards), so fixes to the atomicity
+rules land everywhere at once. Standalone on purpose: importing this must
+never pull jax or the distributed package.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+
+
+def fsync_path(p):
+    fd = os.open(p, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(d):
+    try:
+        fsync_path(d)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; renames still order
+
+
+def atomic_write(path, writer, fsync_parent=False):
+    """Write via `writer(fileobj)` into a unique same-directory temp file,
+    fsync, then rename over `path`. A crash leaves either the old file or
+    the new one, never a torn write; the unique suffix keeps concurrent
+    writers (threads or processes) from clobbering each other's staging."""
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if fsync_parent:
+        parent = os.path.dirname(os.path.abspath(path))
+        fsync_dir(parent)

@@ -22,7 +22,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None, name=None):
-    """Reference: paddle.grad (python/paddle/autograd/__init__.py → GeneralGrad)."""
+    """Reference: paddle.grad (python/paddle/autograd/__init__.py → GeneralGrad).
+
+    An input with no gradient path from `outputs` raises RuntimeError
+    (naming the input) unless allow_unused=True, in which case its slot in
+    the result is None — matching the reference semantics."""
     from .backward_engine import run_backward
     from ..core.tensor import Tensor
 
@@ -42,14 +46,21 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                  capture=[t for t in inputs if t._grad_node is not None])
     # read ALL grads before restoring: a tensor listed twice in `inputs`
     # must yield its gradient for every occurrence
-    grads = []
-    for t in inputs:
-        g = t.grad
-        if g is None and not allow_unused:
-            import jax.numpy as jnp
-            g = Tensor(jnp.zeros_like(t._value))
-        grads.append(g)
-    for t, (old_grad, old_sg) in zip(inputs, saved):
-        t.grad = old_grad
-        t.stop_gradient = old_sg
+    try:
+        grads = []
+        for i, t in enumerate(inputs):
+            g = t.grad
+            if g is None and not allow_unused:
+                label = f"the {i}-th input"
+                if getattr(t, "name", None):
+                    label += f" ({t.name!r})"
+                raise RuntimeError(
+                    f"{label} is unreachable from the outputs (no gradient "
+                    "path — detached, stop_gradient, or simply unused). "
+                    "Pass allow_unused=True to get None for it instead.")
+            grads.append(g)
+    finally:
+        for t, (old_grad, old_sg) in zip(inputs, saved):
+            t.grad = old_grad
+            t.stop_gradient = old_sg
     return grads

@@ -1,0 +1,94 @@
+"""Version-compat shims over the jax surface.
+
+jax moved `shard_map` from `jax.experimental.shard_map` to the top-level
+namespace (and renamed `check_rep` to `check_vma`) across the versions this
+framework supports; every internal user imports the shim instead so the
+rest of the codebase can write the modern spelling.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6 surface
+except ImportError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+def tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams across the rename from TPUCompilerParams
+    (same fields: vmem_limit_bytes, dimension_semantics, …)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def ffi():
+    """The FFI namespace (register_ffi_target / pycapsule / ffi_call),
+    which moved from jax.extend.ffi to top-level jax.ffi."""
+    import jax
+
+    try:
+        import jax.ffi  # may be lazily exposed
+
+        return jax.ffi
+    except ImportError:
+        import jax.extend.ffi
+
+        return jax.extend.ffi
+
+
+def cost_analysis(compiled):
+    """`compiled.cost_analysis()` as a dict across jax versions (older
+    versions return a one-element list of per-computation dicts)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
+_MEM_KINDS = None
+
+
+def _memory_kinds():
+    global _MEM_KINDS
+    if _MEM_KINDS is None:
+        import jax
+
+        try:
+            _MEM_KINDS = frozenset(
+                m.kind for m in jax.devices()[0].addressable_memories())
+        except AttributeError:
+            # memories API absent: such builds also lack with_memory_kind,
+            # so report no distinct spaces and let callers degrade
+            _MEM_KINDS = frozenset()
+        except Exception:
+            # transient probe failure (e.g. backend not initialized yet):
+            # degrade for THIS call but don't poison the cache
+            return frozenset()
+    return _MEM_KINDS
+
+
+def supports_memory_kind(kind):
+    """Whether the backend exposes the given memory space ("device",
+    "pinned_host", …). TPU and recent CPU backends expose all three;
+    older jax CPU builds expose only unpinned_host, so host-offload
+    features degrade to default memory residency there."""
+    return kind in _memory_kinds()
+
+
+def has_device_memory_kind():
+    """Whether the backend has a distinct "device" memory space to stream
+    host-offloaded operands into."""
+    return supports_memory_kind("device")
+
+
+def shard_map(f, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)

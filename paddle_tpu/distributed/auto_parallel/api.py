@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ...compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor

@@ -12,7 +12,18 @@ jax.Arrays (replicated copies deduplicated by index); restore builds each
 target device's block straight from the overlapping saved chunks via
 `jax.make_array_from_callback`, so the global tensor is never materialized
 on one host and the saved mesh never needs to match the loading mesh.
+
+Durability layer (docs/checkpointing.md): saves are crash-atomic — staged,
+fsynced, manifest-digested and committed via a `_COMMITTED` sentinel after
+a store barrier (api.py); `CheckpointManager` (manager.py) adds keep-last-K
+rotation, GC of torn leftovers, retry with backoff, async error
+propagation, and `restore_latest()` auto-resume. Kill-at-phase proof:
+tools/ckpt_fault_injector.py.
 """
 from .api import (  # noqa: F401
-    save_state_dict, load_state_dict, LocalTensorMetadata, Metadata,
+    save_state_dict, load_state_dict, load_extra, is_committed,
+    LocalTensorMetadata, Metadata, AsyncCheckpointSave,
+    CheckpointError, CheckpointNotCommittedError, CheckpointCorruptError,
+    COMMITTED_SENTINEL,
 )
+from .manager import CheckpointManager, clean_uncommitted  # noqa: F401

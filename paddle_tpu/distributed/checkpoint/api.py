@@ -1,20 +1,48 @@
 """save_state_dict / load_state_dict implementation.
 
-Layout of a checkpoint directory:
+Layout of a COMMITTED checkpoint directory:
   metadata_<p>.json   one per writing process p: for every tensor, the list
                       of chunks it wrote — global_offset, local_shape,
                       dtype, and the (file, key) that stores the bytes
   data_<p>.npz        that process's chunk payloads
+  manifest_<p>.json   integrity manifest: per-chunk CRC32/sha256 digests and
+                      byte sizes plus file-level size/sha256 for everything
+                      process p wrote
+  extra.json          optional JSON sidecar (process 0 only; e.g. step
+                      counters CheckpointManager splits out of mixed trees)
+  _COMMITTED          commit sentinel, written LAST (rank 0, after a store
+                      barrier on multi-host jobs); its absence means the
+                      checkpoint is torn and must not be loaded
 
 Single-controller runs produce p=0 only; multi-host SPMD runs produce one
-pair per process on a shared filesystem (the reference writes per-rank
+set per process on a shared filesystem (the reference writes per-rank
 files the same way, save_state_dict.py:104).
+
+Commit protocol (crash-atomic):
+  1. every process writes payload + metadata + manifest into a private
+     staging dir `<path>.tmp.<uuid>` and fsyncs each file;
+  2. files are `os.replace`d into the target dir — data first, the
+     manifest LAST, so a manifest's presence implies that process's files
+     are complete;
+  3. processes synchronize (store barrier via distributed/store.py when a
+     job store exists, filesystem polling otherwise);
+  4. rank 0 verifies every process's manifest is present and only then
+     writes the `_COMMITTED` sentinel (tmp + fsync + rename).
+A crash at ANY point leaves either a fully committed directory or one
+without `_COMMITTED`, which `load_state_dict` refuses with
+`CheckpointNotCommittedError`. `tools/ckpt_fault_injector.py` kills a
+saver at each interruption point and proves the invariant.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
 import threading
+import time
+import uuid
+import zlib
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -22,8 +50,27 @@ import jax
 
 from ...core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "LocalTensorMetadata",
-           "Metadata"]
+__all__ = ["save_state_dict", "load_state_dict", "load_extra",
+           "is_committed", "LocalTensorMetadata", "Metadata",
+           "CheckpointError", "CheckpointNotCommittedError",
+           "CheckpointCorruptError", "COMMITTED_SENTINEL"]
+
+COMMITTED_SENTINEL = "_COMMITTED"
+MANIFEST_FORMAT = 1
+
+
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint integrity/commit errors."""
+
+
+class CheckpointNotCommittedError(CheckpointError):
+    """The directory has no `_COMMITTED` sentinel: the save crashed (or is
+    still in flight) and the contents must be treated as torn."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A committed checkpoint failed integrity verification (size or
+    digest mismatch, unreadable payload, missing manifest entry)."""
 
 
 @dataclass
@@ -98,16 +145,153 @@ def _unique_local_chunks(val):
     return out
 
 
-def save_state_dict(state_dict, path, *, async_save=False):
-    """Write every process's owned shards + metadata (reference:
-    save_state_dict.py:104). Blocking by default; async_save=True snapshots
-    all tensor bytes to host synchronously (so a following optimizer step
-    cannot tear the checkpoint) and returns a started threading.Thread that
-    does the file IO — join it before relying on the files (≈ the
-    reference's async checkpoint path)."""
+# --------------------------------------------------------------------------
+# durability helpers + fault injection
+# --------------------------------------------------------------------------
+
+from ..._atomic_io import atomic_write as _atomic_write  # noqa: E402
+from ..._atomic_io import fsync_dir as _fsync_dir  # noqa: E402
+from ..._atomic_io import fsync_path as _fsync_path  # noqa: E402
+
+
+def _maybe_crash(phase, truncate=None):
+    """Fault-injection hook for the kill-at-phase harness
+    (tools/ckpt_fault_injector.py): when PADDLE_TPU_CKPT_KILL_PHASE names
+    this phase, die exactly here with os._exit (no atexit, no unwinding —
+    the closest a test can get to SIGKILL mid-protocol). `truncate` tears
+    the named file to half its bytes first, simulating a crash mid-write."""
+    if os.environ.get("PADDLE_TPU_CKPT_KILL_PHASE") != phase:
+        return
+    if truncate is not None and os.path.exists(truncate):
+        size = os.path.getsize(truncate)
+        with open(truncate, "rb+") as f:
+            f.truncate(size // 2)
+    os._exit(137)
+
+
+def _digest(buf):
+    return {"nbytes": len(buf), "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+            "sha256": hashlib.sha256(buf).hexdigest()}
+
+
+def _write_json(fp, obj):
+    with open(fp, "w") as f:
+        json.dump(obj, f)
+
+
+def _file_digest(path):
+    # size only: chunk-level crc32+sha256 already cover the payload bytes,
+    # and re-reading a multi-GB npz just to hash it again would put a full
+    # extra disk pass on the checkpoint critical path
+    return {"size": os.path.getsize(path)}
+
+
+def _path_tag(path):
+    return hashlib.sha1(os.path.abspath(path).encode()).hexdigest()[:12]
+
+
+def is_committed(path) -> bool:
+    """True if `path` holds a fully committed checkpoint."""
+    return os.path.exists(os.path.join(path, COMMITTED_SENTINEL))
+
+
+# --------------------------------------------------------------------------
+# save
+# --------------------------------------------------------------------------
+
+class AsyncCheckpointSave(threading.Thread):
+    """Handle for an in-flight async save. Unlike a bare daemon thread, IO
+    errors are captured and re-raised from `join()` (and `result()`), and
+    the thread is non-daemon so an interpreter exit cannot tear a
+    checkpoint mid-write."""
+
+    def __init__(self, fn):
+        super().__init__(name="paddle-tpu-ckpt-save", daemon=False)
+        self._fn = fn
+        self.exception: BaseException | None = None
+
+    def run(self):
+        try:
+            self._fn()
+        except BaseException as e:  # noqa: BLE001 — handed to the joiner
+            self.exception = e
+
+    def join(self, timeout=None):
+        super().join(timeout)
+        if not self.is_alive() and self.exception is not None:
+            raise self.exception
+
+    def result(self):
+        self.join()
+
+
+def _commit(path, world, process):
+    """Steps 3-4 of the commit protocol: synchronize writers, then rank 0
+    verifies all manifests exist and drops the sentinel."""
+    tag = _path_tag(path)
+    store = None
+    if world > 1:
+        from ..env import get_store
+
+        store = get_store()
+        if store is not None:
+            store.barrier(f"ckpt/{tag}/written", world_size=world)
+    timeout = float(os.environ.get("PADDLE_TPU_CKPT_COMMIT_TIMEOUT", "120"))
+    if process == 0:
+        deadline = time.time() + timeout
+        while True:
+            missing = [p for p in range(world)
+                       if not os.path.exists(
+                           os.path.join(path, f"manifest_{p}.json"))]
+            if not missing:
+                break
+            # shared-FS visibility lag (or storeless multi-host): poll
+            if time.time() > deadline:
+                raise CheckpointError(
+                    f"cannot commit {path!r}: manifests missing for "
+                    f"processes {missing} after barrier")
+            time.sleep(0.05)
+        _maybe_crash("pre-commit")
+        sentinel = {"format": MANIFEST_FORMAT, "world_size": world,
+                    "unix_time": time.time()}
+        _atomic_write(os.path.join(path, COMMITTED_SENTINEL),
+                      lambda f: f.write(json.dumps(sentinel).encode()))
+        _fsync_dir(path)
+    if world > 1 and store is not None:
+        # every rank returns only once the sentinel exists
+        store.barrier(f"ckpt/{tag}/committed", world_size=world)
+    elif world > 1 and process != 0:
+        deadline = time.time() + timeout
+        while not is_committed(path):
+            if time.time() > deadline:
+                raise CheckpointError(
+                    f"rank {process}: commit of {path!r} did not complete")
+            time.sleep(0.05)
+
+
+def save_state_dict(state_dict, path, *, async_save=False, extra=None,
+                    defer=False):
+    """Crash-atomically write every process's owned shards + metadata +
+    integrity manifest, then commit (reference: save_state_dict.py:104 plus
+    the commit protocol in the module docstring).
+
+    Blocking by default; async_save=True snapshots all tensor bytes to host
+    synchronously (so a following optimizer step cannot tear the
+    checkpoint) and returns a started AsyncCheckpointSave doing the file IO
+    — join it before relying on the files; IO errors re-raise from join()
+    (≈ the reference's async checkpoint path). `extra` is an optional
+    JSON-serializable object written as `extra.json` by process 0.
+
+    defer=True returns the write-and-commit closure instead of running it:
+    the tensor snapshot still happens NOW (synchronously), but the caller
+    owns execution — CheckpointManager uses this to wrap the IO in its
+    retry/async machinery without losing the snapshot guarantee. The
+    closure stages into a fresh uuid dir per invocation, so re-running it
+    after a transient failure is safe (single-process)."""
     items = list(_flat_items(state_dict))
     p = jax.process_index()
-    payload, meta, shapes = {}, {}, {}
+    world = jax.process_count()
+    payload, meta, shapes, chunk_digests = {}, {}, {}, {}
     fname = f"data_{p}.npz"
     for name, v in items:
         val = _as_array(v)
@@ -117,6 +301,7 @@ def save_state_dict(state_dict, path, *, async_save=False):
                 sorted(_unique_local_chunks(val).items())):
             key = f"{name}##%d" % i
             payload[key] = arr
+            chunk_digests[key] = dict(_digest(arr.tobytes()), file=fname)
             chunks.append({
                 "global_offset": list(off), "local_shape": list(shp),
                 "dtype": str(arr.dtype), "file": fname, "key": key,
@@ -124,18 +309,101 @@ def save_state_dict(state_dict, path, *, async_save=False):
         meta[name] = chunks
 
     def _write():
-        os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, fname), **payload)
-        with open(os.path.join(path, f"metadata_{p}.json"), "w") as f:
-            json.dump({"state_dict_metadata": meta,
-                       "global_shapes": shapes}, f)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        staging = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+        os.makedirs(staging)
+        try:
+            staged = []  # files to rename, manifest appended LAST
 
+            def _stage(fname_, writer):
+                fp = os.path.join(staging, fname_)
+                writer(fp)
+                _fsync_path(fp)
+                staged.append(fname_)
+                return fp
+
+            data_path = _stage(fname, lambda fp: np.savez(fp, **payload))
+            _maybe_crash("payload", truncate=data_path)
+            _stage(f"metadata_{p}.json", lambda fp: _write_json(
+                fp, {"state_dict_metadata": meta, "global_shapes": shapes}))
+            files = {fname: _file_digest(data_path)}
+            if extra is not None and p == 0:
+                ep = _stage("extra.json", lambda fp: _write_json(fp, extra))
+                files["extra.json"] = _file_digest(ep)
+            manifest = {"format": MANIFEST_FORMAT, "process": p,
+                        "world_size": world, "files": files,
+                        "chunks": chunk_digests}
+            _stage(f"manifest_{p}.json",
+                   lambda fp: _write_json(fp, manifest))
+
+            os.makedirs(path, exist_ok=True)
+            if world > 1 and any(
+                    f.startswith("manifest_") and f.endswith(".json")
+                    for f in os.listdir(path)):
+                from ..env import get_store
+
+                if get_store() is None:
+                    # without a store, rank 0's commit poll cannot tell a
+                    # previous save's manifests (committed OR torn) from
+                    # this save's — it could commit a mix of old and new
+                    # rank files. The recovery flow is sweep-then-save
+                    # (clean_uncommitted), not overwrite-in-place.
+                    raise CheckpointError(
+                        "storeless multi-host save onto the existing "
+                        f"checkpoint files at {path!r} is unsupported: "
+                        "sweep the directory or provide a coordination "
+                        "store")
+            # overwriting an existing committed checkpoint: the old
+            # sentinel must fall BEFORE any file is replaced, or a crash
+            # mid-overwrite leaves a torn directory that still claims to
+            # be committed
+            try:
+                os.remove(os.path.join(path, COMMITTED_SENTINEL))
+            except FileNotFoundError:
+                pass
+            if p == 0:
+                # stale per-process files of an overwritten save with a
+                # larger world (and a stale extra sidecar this save does
+                # not rewrite) must not survive into the new checkpoint:
+                # they would mix old state into the union read on load.
+                # Indices >= world belong to no live writer, so this
+                # cannot race peers' renames.
+                for f in os.listdir(path):
+                    drop = f == "extra.json" and extra is None
+                    for prefix, suffix in (("manifest_", ".json"),
+                                           ("metadata_", ".json"),
+                                           ("data_", ".npz")):
+                        if f.startswith(prefix) and f.endswith(suffix):
+                            idx = f[len(prefix):-len(suffix)]
+                            drop |= idx.isdigit() and int(idx) >= world
+                    if drop:
+                        try:
+                            os.remove(os.path.join(path, f))
+                        except FileNotFoundError:
+                            pass
+            _fsync_dir(path)
+            for f in staged:
+                if f == f"manifest_{p}.json":
+                    _maybe_crash("pre-manifest")
+                os.replace(os.path.join(staging, f), os.path.join(path, f))
+            _fsync_dir(path)
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
+        _commit(path, world, p)
+
+    if defer:
+        return _write
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
+        t = AsyncCheckpointSave(_write)
         t.start()
         return t
     _write()
 
+
+# --------------------------------------------------------------------------
+# load
+# --------------------------------------------------------------------------
 
 def _read_metadata(path):
     meta = Metadata()
@@ -164,6 +432,64 @@ def _read_metadata(path):
     return meta
 
 
+def _check_committed(path):
+    """Refuse uncommitted dirs; returns the sentinel payload."""
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint directory at {path!r}")
+    if not is_committed(path):
+        raise CheckpointNotCommittedError(
+            f"checkpoint at {path!r} has no {COMMITTED_SENTINEL} sentinel: "
+            "the save never committed (crashed mid-write or still in "
+            "flight) and the directory may be torn — refusing to load. "
+            "Pre-manifest checkpoints must be re-saved with the current "
+            "format.")
+    try:
+        with open(os.path.join(path, COMMITTED_SENTINEL)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _read_manifests(path, expected_world=None):
+    """Manifest union: (file, key) -> digest entry, plus file-level sizes
+    checked immediately."""
+    names = sorted(f for f in os.listdir(path)
+                   if f.startswith("manifest_") and f.endswith(".json"))
+    if not names:
+        raise CheckpointCorruptError(
+            f"committed checkpoint at {path!r} has no integrity manifest")
+    if expected_world is not None and len(names) != expected_world:
+        # stale per-process files from an overwritten checkpoint with a
+        # different world size would otherwise mix into the chunk map
+        raise CheckpointCorruptError(
+            f"checkpoint at {path!r} has {len(names)} manifests but its "
+            f"commit sentinel records world_size={expected_world} "
+            "(overwritten with a different topology?)")
+    chunk_map = {}
+    for n in names:
+        try:
+            with open(os.path.join(path, n)) as fh:
+                m = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"unreadable manifest {n!r} in {path!r}: {e}") from e
+        for fname_, ent in m.get("files", {}).items():
+            fp = os.path.join(path, fname_)
+            if not os.path.exists(fp):
+                raise CheckpointCorruptError(
+                    f"checkpoint file {fname_!r} listed in {n!r} is missing "
+                    f"from {path!r}")
+            actual = os.path.getsize(fp)
+            if actual != ent["size"]:
+                raise CheckpointCorruptError(
+                    f"checkpoint file {fname_!r} in {path!r} is "
+                    f"{actual} bytes, manifest says {ent['size']} "
+                    "(truncated or overwritten)")
+        for key, ent in m.get("chunks", {}).items():
+            chunk_map[(ent["file"], key)] = ent
+    return chunk_map
+
+
 def _overlap(dst_off, dst_shp, src_off, src_shp):
     """Intersection of two boxes; returns (dst_slices, src_slices) or None."""
     dst_sl, src_sl = [], []
@@ -177,24 +503,59 @@ def _overlap(dst_off, dst_shp, src_off, src_shp):
     return tuple(dst_sl), tuple(src_sl)
 
 
-def load_state_dict(state_dict, path, *, strict=True):
+def load_state_dict(state_dict, path, *, strict=True, verify=True):
     """Fill `state_dict`'s tensors in-place from a checkpoint, resharding
     chunks onto each tensor's current sharding (reference:
     load_state_dict.py:365; overlap math :230-322).
+
+    Refuses uncommitted checkpoints (CheckpointNotCommittedError) and, with
+    verify=True (default), checks file sizes against the manifest up front
+    and each chunk's CRC32 as it is read (CheckpointCorruptError on
+    mismatch).
 
     Every target device block is assembled only from the saved chunks that
     intersect it, then handed to jax.make_array_from_callback with the
     target sharding — no host ever holds a full global tensor it doesn't
     already shard."""
+    sentinel = _check_committed(path)
+    chunk_map = _read_manifests(path, sentinel.get("world_size")) \
+        if verify else None
     meta = _read_metadata(path)
     npz_cache = {}
+    verified = set()
 
     def _chunk_bytes(c: LocalTensorMetadata):
         z = npz_cache.get(c.file)
         if z is None:
-            z = np.load(os.path.join(path, c.file))
+            try:
+                z = np.load(os.path.join(path, c.file))
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"unreadable payload file {c.file!r} in {path!r}: {e}"
+                ) from e
             npz_cache[c.file] = z
-        return z[c.key]
+        try:
+            arr = z[c.key]
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"chunk {c.key!r} unreadable from {c.file!r} in {path!r}: "
+                f"{e}") from e
+        if chunk_map is not None and (c.file, c.key) not in verified:
+            ent = chunk_map.get((c.file, c.key))
+            if ent is None:
+                raise CheckpointCorruptError(
+                    f"chunk {c.key!r} of {c.file!r} has no manifest entry "
+                    f"in {path!r}")
+            # crc32+size catch truncation/torn writes at a fraction of
+            # sha256's cost; the manifest's sha256 is for offline audits
+            buf = arr.tobytes()
+            if len(buf) != ent["nbytes"] or \
+                    (zlib.crc32(buf) & 0xFFFFFFFF) != ent["crc32"]:
+                raise CheckpointCorruptError(
+                    f"digest mismatch for chunk {c.key!r} in {path!r} "
+                    "(bit rot or torn write)")
+            verified.add((c.file, c.key))
+        return arr
 
     missing = []
     for name, v in _flat_items(state_dict):
@@ -240,3 +601,17 @@ def load_state_dict(state_dict, path, *, strict=True):
             f"checkpoint at {path!r} is missing tensors: {missing[:8]}"
             + ("..." if len(missing) > 8 else ""))
     return state_dict
+
+
+def load_extra(path):
+    """The `extra.json` sidecar of a committed checkpoint, or None."""
+    _check_committed(path)
+    fp = os.path.join(path, "extra.json")
+    if not os.path.exists(fp):
+        return None
+    try:
+        with open(fp) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable extra.json in {path!r}: {e}") from e

@@ -1,0 +1,273 @@
+"""CheckpointManager: rotation, retry, async handling, auto-resume.
+
+Directory layout under `root`:
+  step_<NNNNNNNN>/            one committed checkpoint per saved step
+  step_<NNNNNNNN>.tmp.<id>/   staging leftovers from crashed saves (GC'd)
+
+On top of the crash-atomic `save_state_dict` commit protocol (api.py) the
+manager adds the operational layer PaddlePaddle's fleet checkpoint stack
+provides around per-rank save_state_dict:
+  - keep-last-K rotation with garbage collection of uncommitted leftovers;
+  - save retry with bounded exponential backoff for transient filesystem
+    errors (NFS hiccups, ENOSPC races with the GC of a peer job);
+  - async saves whose exceptions propagate from `wait()`/`join()` instead
+    of dying silently in a daemon thread;
+  - `restore_latest()` that walks committed checkpoints newest-first and
+    falls back past any that fail integrity verification — a torn or
+    bit-rotted newest checkpoint degrades to the previous good one, never
+    to a crash or silent garbage.
+
+Mixed state trees: Tensor leaves go through the sharded tensor checkpoint;
+JSON-serializable scalar leaves (step counters, LR-scheduler state) are
+split into the `extra.json` sidecar and merged back on restore — so
+`{"model": ..., "opt": optimizer.state_dict()}` round-trips even though
+`_step_count` is a plain int.
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import time
+
+import jax
+
+from ...core.tensor import Tensor
+from .api import (
+    AsyncCheckpointSave, CheckpointError, is_committed, load_extra,
+    load_state_dict, save_state_dict,
+)
+
+__all__ = ["CheckpointManager", "clean_uncommitted"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_SCALAR_TYPES = (bool, int, float, str, bytes)
+
+
+def _split_tree(tree, path=""):
+    """(tensor_tree, scalar_tree): Tensors go to the sharded checkpoint,
+    JSON-serializable leaves to the extra sidecar."""
+    tensors, scalars = {}, {}
+    for k, v in tree.items():
+        name = f"{path}.{k}" if path else str(k)
+        if isinstance(v, dict):
+            t, s = _split_tree(v, name)
+            if t:
+                tensors[k] = t
+            if s:
+                scalars[k] = s
+        elif isinstance(v, Tensor):
+            tensors[k] = v
+        elif v is None or isinstance(v, _SCALAR_TYPES) or (
+                isinstance(v, (list, tuple))
+                and all(isinstance(x, _SCALAR_TYPES) for x in v)):
+            scalars[k] = list(v) if isinstance(v, tuple) else v
+        else:
+            raise TypeError(
+                f"CheckpointManager state leaf {name!r} must be a Tensor "
+                f"or JSON-serializable scalar, got {type(v).__name__}")
+    return tensors, scalars
+
+
+def _merge_scalars(tree, scalars):
+    for k, v in scalars.items():
+        if isinstance(v, dict):
+            sub = tree.get(k)
+            if not isinstance(sub, dict):
+                sub = tree[k] = {}
+            _merge_scalars(sub, v)
+        else:
+            tree[k] = v
+
+
+def _clone_tensor_tree(tree):
+    """Fresh Tensor holders over the same arrays: a load target that can
+    be thrown away if verification fails partway, without having mutated
+    the caller's tensors."""
+    return {k: _clone_tensor_tree(v) if isinstance(v, dict) else Tensor(
+        v._value) for k, v in tree.items()}
+
+
+def _adopt_values(dst, src):
+    for k, v in dst.items():
+        if isinstance(v, dict):
+            _adopt_values(v, src[k])
+        else:
+            v._value = src[k]._value
+
+
+def clean_uncommitted(root):
+    """Remove staging leftovers and torn (uncommitted) checkpoint dirs
+    anywhere under `root` (recursive: the launcher's --ckpt_dir points at
+    a tree in which managers root themselves in subdirs, e.g. hapi's
+    `<save_dir>/ckpt/step_*`). Only safe when no save is in flight for
+    this tree — e.g. from the launcher between elastic relaunches, when
+    all workers are dead. Returns the removed paths relative to root."""
+    removed = []
+    for cur, dirs, _files in os.walk(root):
+        keep = []
+        for e in dirs:
+            p = os.path.join(cur, e)
+            if ".tmp." in e or (_STEP_RE.match(e) and not is_committed(p)):
+                shutil.rmtree(p, ignore_errors=True)
+                removed.append(os.path.relpath(p, root))
+            elif not _STEP_RE.match(e):
+                keep.append(e)  # don't descend into committed checkpoints
+        dirs[:] = keep
+    return removed
+
+
+class CheckpointManager:
+    """Rotating fault-tolerant checkpoint store.
+
+    save(state, step=...) / restore_latest(state) / wait(). One manager
+    instance per training process; on multi-process jobs every process
+    calls save() (the commit protocol coordinates them) and only process 0
+    garbage-collects.
+    """
+
+    def __init__(self, root, keep_last_k=3, async_save=False,
+                 max_retries=3, backoff=0.25, max_backoff=8.0):
+        self.root = str(root)
+        self.keep_last_k = int(keep_last_k) if keep_last_k else 0
+        self.async_save = bool(async_save)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self._pending = None
+        self.last_extra = None  # user extra of the last restore
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- inventory ---------------------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def all_steps(self, committed_only=True):
+        """Ascending step numbers present under root."""
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for e in entries:
+            m = _STEP_RE.match(e)
+            if not m:
+                continue
+            if committed_only and not is_committed(
+                    os.path.join(self.root, e)):
+                continue
+            out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save --------------------------------------------------------------
+    def _with_retry(self, fn):
+        delay = self.backoff
+        for attempt in range(self.max_retries + 1):
+            try:
+                return fn()
+            except OSError:
+                if attempt == self.max_retries:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, self.max_backoff)
+
+    def save(self, state_dict, step, extra=None):
+        """Checkpoint `state_dict` as `step`. Waits for (and re-raises
+        from) any pending async save first. Transient OSErrors retry with
+        bounded exponential backoff — single-process only: a multi-process
+        save re-entering the commit barriers alone would skew the counting
+        epoch and hang the job, so there a failed rank fails the save and
+        the elastic relaunch path owns recovery. Returns the
+        AsyncCheckpointSave handle in async mode, else None."""
+        self.wait()
+        tensors, scalars = _split_tree(state_dict)
+        payload = {"state_scalars": scalars, "user_extra": extra}
+        path = self._step_dir(step)
+        # snapshot NOW (defer=True still captures tensor bytes
+        # synchronously): an optimizer step racing the async IO thread
+        # must not tear the checkpoint across param updates
+        write = save_state_dict(tensors, path, extra=payload, defer=True)
+        retry = jax.process_count() == 1
+
+        def _do():
+            if retry:
+                self._with_retry(write)
+            else:
+                write()
+            self.gc(keep_step=int(step))
+
+        if self.async_save:
+            h = AsyncCheckpointSave(_do)
+            h.start()
+            self._pending = h
+            return h
+        _do()
+        return None
+
+    def wait(self):
+        """Join the pending async save, re-raising its exception if it
+        failed (the daemon-thread silent-death failure mode is the exact
+        thing this manager exists to remove)."""
+        h, self._pending = self._pending, None
+        if h is not None:
+            h.join()
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, state_dict, step, strict=True):
+        """Load checkpoint `step` into `state_dict` (tensors in place,
+        scalar leaves merged back). The load lands in a scratch copy
+        first, so a checkpoint that fails verification partway leaves the
+        caller's tree untouched. strict=False tolerates target tensors
+        absent from the checkpoint (e.g. optimizer accumulators
+        materialized for params that had not stepped at save time).
+        Returns `step`."""
+        path = self._step_dir(step)
+        tensors, _ = _split_tree(state_dict)
+        scratch = _clone_tensor_tree(tensors)
+        load_state_dict(scratch, path, strict=strict)
+        payload = load_extra(path) or {}
+        _adopt_values(tensors, scratch)
+        _merge_scalars(state_dict, payload.get("state_scalars") or {})
+        self.last_extra = payload.get("user_extra")
+        return int(step)
+
+    def restore_latest(self, state_dict, strict=True):
+        """Restore the newest checkpoint that is committed AND passes
+        integrity verification, skipping torn/corrupt ones. Returns the
+        restored step, or None when no loadable checkpoint exists."""
+        for step in reversed(self.all_steps()):
+            try:
+                return self.restore(state_dict, step, strict=strict)
+            except CheckpointError:
+                continue  # torn/corrupt — fall back to the previous one
+        return None
+
+    # -- rotation ----------------------------------------------------------
+    def gc(self, keep_step=None):
+        """Keep the newest `keep_last_k` committed checkpoints; drop
+        staging leftovers and uncommitted dirs (except `keep_step`, which
+        may be a peer process's in-flight save)."""
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return
+        for e in entries:
+            p = os.path.join(self.root, e)
+            if not os.path.isdir(p):
+                continue
+            m = _STEP_RE.match(e)
+            if ".tmp." in e:
+                shutil.rmtree(p, ignore_errors=True)
+            elif m and not is_committed(p) and \
+                    (keep_step is None or int(m.group(1)) != keep_step):
+                shutil.rmtree(p, ignore_errors=True)
+        if self.keep_last_k:
+            steps = self.all_steps()
+            for s in steps[:-self.keep_last_k]:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)

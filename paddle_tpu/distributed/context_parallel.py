@@ -140,8 +140,9 @@ def context_parallel_attention(q, k, v, mesh, *, mode="ring", seq_axis="sep",
     else:
         raise ValueError(f"unknown context-parallel mode {mode!r}")
     spec = _cp_spec(mesh, seq_axis, batch_axes, head_axis)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    from ..compat import shard_map
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 

@@ -26,7 +26,8 @@ class ElasticStatus:
 
 class ElasticManager:
     def __init__(self, store: TCPStore, job_id="default", rank=0,
-                 np_target=1, ttl=10.0, interval=1.0):
+                 np_target=1, ttl=10.0, interval=1.0,
+                 checkpoint_root=None, keep_last_k=3):
         self.store = store
         self.job_id = job_id
         self.rank = int(rank)
@@ -39,6 +40,16 @@ class ElasticManager:
         self._lock = threading.Lock()
         self._status = ElasticStatus.HOLD
         self._thread = None
+        # fault-tolerant resume: membership detects the failure, the
+        # checkpoint manager supplies the state to restart from (the
+        # reference couples its elastic manager to per-rank save_state_dict
+        # the same way)
+        self.checkpoint = None
+        if checkpoint_root:
+            from ..checkpoint.manager import CheckpointManager
+
+            self.checkpoint = CheckpointManager(checkpoint_root,
+                                                keep_last_k=keep_last_k)
 
     # -- membership --------------------------------------------------------
     def register(self):
@@ -102,6 +113,26 @@ class ElasticManager:
                 return True
             time.sleep(self.interval / 2)
         return False
+
+    # -- fault-tolerant resume ---------------------------------------------
+    def resume(self, state_dict):
+        """Restore the newest committed checkpoint into `state_dict`
+        (tensors in place, scalar leaves merged). Returns the restored
+        step, or None when there is nothing to resume from — the restart
+        path after a RESTART transition: relaunched trainers call this
+        before their first step so a detected failure resumes instead of
+        retraining from scratch. Torn checkpoints left by the crash are
+        skipped by the manager's integrity checks."""
+        if self.checkpoint is None:
+            return None
+        return self.checkpoint.restore_latest(state_dict)
+
+    def save(self, state_dict, step, extra=None):
+        """Checkpoint through the manager (atomic commit + rotation)."""
+        if self.checkpoint is None:
+            raise RuntimeError(
+                "ElasticManager has no checkpoint_root configured")
+        return self.checkpoint.save(state_dict, step, extra=extra)
 
     def exit(self):
         self._stop.set()

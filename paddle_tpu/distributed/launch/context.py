@@ -29,6 +29,13 @@ def parse_args(argv=None):
                    help="write per-rank logs under this dir")
     p.add_argument("--elastic", action="store_true",
                    help="relaunch failed workers (elastic mode)")
+    p.add_argument("--ckpt_dir", default=os.environ.get("PADDLE_CKPT_DIR"),
+                   help="fault-tolerant checkpoint root: exported to "
+                        "workers as PADDLE_TPU_CKPT_DIR (consumed by "
+                        "hapi ModelCheckpoint auto-resume / "
+                        "CheckpointManager); on elastic relaunch the "
+                        "controller sweeps torn checkpoints left by the "
+                        "crash before respawning")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="elastic: maximum relaunch attempts")
     p.add_argument("--devices", default=os.environ.get("PADDLE_DEVICES"),

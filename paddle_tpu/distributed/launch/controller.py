@@ -144,6 +144,8 @@ class Controller:
             "PADDLE_RESTART_EPOCH": str(restart_epoch),
             "PADDLE_JOB_ID": args.job_id,
         })
+        if getattr(args, "ckpt_dir", None):
+            env["PADDLE_TPU_CKPT_DIR"] = args.ckpt_dir
         if world > 1:
             # jax.distributed coordinator (data plane) on master host,
             # distinct port from the KV store
@@ -228,6 +230,22 @@ class Controller:
             if not args.elastic or restarts >= args.max_restarts:
                 return rc
             restarts += 1
+            # all workers are dead here (watch() tears down on first
+            # failure), so sweeping torn checkpoints is race-free; the
+            # relaunched workers then auto-resume from the newest
+            # COMMITTED checkpoint (fleet/elastic resume path)
+            if getattr(args, "ckpt_dir", None):
+                from ..checkpoint.manager import clean_uncommitted
+
+                try:
+                    removed = clean_uncommitted(args.ckpt_dir)
+                except OSError as e:
+                    print(f"elastic: checkpoint sweep failed: {e}",
+                          file=sys.stderr)
+                else:
+                    if removed:
+                        print("elastic: swept torn checkpoints "
+                              f"{sorted(removed)}", file=sys.stderr)
             print(f"elastic: relaunching workers "
                   f"(attempt {restarts}/{args.max_restarts})",
                   file=sys.stderr)

@@ -49,7 +49,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     v = in_tensor._value if isinstance(in_tensor, Tensor) \
         else jnp.asarray(in_tensor)
     if group.nranks > 1 and C._axis_sharded(v, group.mesh, group.axis):
-        from jax import shard_map
+        from ..compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
         spec = v.sharding.spec
 

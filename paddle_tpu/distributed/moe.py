@@ -153,7 +153,7 @@ def _moe_ffn_alltoall_impl(x, gate_w, w1, b1, w2, b2, *, top_k, capacity,
     tok = P(all_axes, None)
     ew = P(axis, *([None] * (w1.ndim - 1)))
     eb = P(axis, None)
-    from jax import shard_map
+    from ..compat import shard_map
     y, aux = shard_map(
         body, mesh=mesh,
         in_specs=(tok, P(None, None), ew, eb, P(axis, None, None), eb),
@@ -361,7 +361,7 @@ def global_scatter(x, axis="mp", *, split_axis=0, concat_axis=0):
     mesh axis (XLA collective on ICI). Inside compiled MoE layers this
     collective is inserted automatically by GSPMD; this eager form exists
     for API parity and custom shard_map blocks."""
-    from jax import shard_map
+    from ..compat import shard_map
     from . import functional as dist_f
 
     mesh = topo_mod.get_mesh()

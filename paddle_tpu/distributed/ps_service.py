@@ -34,6 +34,13 @@ __all__ = ["SparseTableShard", "PsServer", "PsClient", "serve_shard"]
 
 # --------------------------------------------------------------------------
 # framed pickle transport
+#
+# SECURITY SCOPE: pickle deserialization executes arbitrary code, so this
+# transport is strictly for loopback / single-tenant trusted cluster
+# networks (the default host everywhere in this module is 127.0.0.1, and
+# the launcher only ever wires workers to their own pod's servers). Never
+# expose a PsServer port to untrusted peers; a hardened deployment would
+# swap this codec for the brpc/protobuf service the reference uses.
 # --------------------------------------------------------------------------
 
 def _send_msg(sock, obj):
@@ -87,8 +94,19 @@ class SparseTableShard:
         # exactly-once pushes: last applied sequence number per client
         # (a retried PUSH after a dropped response must not re-apply —
         # the brpc stack gets this from request ids; we persist it with
-        # the shard so restarts keep the guarantee)
+        # the shard so restarts keep the guarantee).
+        #
+        # CHECKPOINT-FRESHNESS CAVEAT: the dedup table is only as fresh as
+        # the checkpoint it was restored from. A server restored from a
+        # checkpoint older than its crash re-applies any push that was
+        # (a) applied after that checkpoint and (b) retried by a client
+        # after the restart — across a restore the guarantee degrades to
+        # at-least-once for that window. Checkpoint after bursts of
+        # applied pushes (PsClient.save) to keep the window small.
         self.applied_seq: dict = {}
+        # last-activity clock per client, for pruning entries of clients
+        # that have gone away (bounded memory on long-lived servers)
+        self.seq_seen: dict = {}
 
     def _init_row(self, uid):
         rng = np.random.RandomState(
@@ -120,6 +138,7 @@ class SparseTableShard:
         np.add.at(merged, inv, grads)
         with self.lock:
             if client is not None and seq is not None:
+                self.seq_seen[client] = time.time()
                 if seq <= self.applied_seq.get(client, -1):
                     return  # duplicate of an already-applied push
                 self.applied_seq[client] = seq
@@ -137,18 +156,38 @@ class SparseTableShard:
                     row -= lr * g
             self.applied_pushes += 1
 
+    def prune_idle_clients(self, idle_s=3600.0):
+        """Drop applied_seq entries for clients silent longer than
+        `idle_s` (a trainer that exited leaves its entry behind forever
+        otherwise). Safe: a pruned client that somehow retries later is
+        treated as new — its push re-applies, which is the same
+        at-least-once degradation the checkpoint-freshness caveat above
+        already documents. Returns the pruned client ids."""
+        cutoff = time.time() - float(idle_s)
+        with self.lock:
+            idle = [c for c, ts in self.seq_seen.items() if ts < cutoff]
+            for c in idle:
+                self.applied_seq.pop(c, None)
+                self.seq_seen.pop(c, None)
+        return idle
+
     # -- persistence (reference: table save/load in the PS service) --------
-    def save(self, path):
+    def save(self, path, prune_idle_s=3600.0):
+        if prune_idle_s is not None:
+            self.prune_idle_clients(prune_idle_s)
         with self.lock:
             state = {"dim": self.dim, "optimizer": self.optimizer,
                      "lr": self.lr, "std": self.std, "seed": self.seed,
                      "rows": self.rows, "accum": self.accum,
                      "applied_pushes": self.applied_pushes,
-                     "applied_seq": self.applied_seq}
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)          # atomic: a killed save can't corrupt
+                     "applied_seq": self.applied_seq,
+                     "seq_seen": self.seq_seen}
+        from .._atomic_io import atomic_write
+
+        # atomic + fsynced + unique staging: a killed save can't corrupt
+        # and concurrent savers can't clobber each other's temp file
+        atomic_write(path, lambda f: pickle.dump(
+            state, f, protocol=pickle.HIGHEST_PROTOCOL))
 
     def load(self, path):
         with open(path, "rb") as f:
@@ -163,6 +202,12 @@ class SparseTableShard:
             self.accum = state["accum"]
             self.applied_pushes = state.get("applied_pushes", 0)
             self.applied_seq = state.get("applied_seq", {})
+            self.seq_seen = state.get("seq_seen", {})
+            # checkpoints from before the activity clock existed: seed
+            # load time so their clients become prunable once idle
+            now = time.time()
+            for c in self.applied_seq:
+                self.seq_seen.setdefault(c, now)
 
 
 # --------------------------------------------------------------------------
@@ -247,6 +292,7 @@ class PsServer:
                     _send_msg(conn, {
                         "ok": True, "server_id": self.server_id,
                         "rows": len(self.shard.rows),
+                        "dim": self.shard.dim,
                         "applied_pushes": self.shard.applied_pushes})
                 elif op == "stop":
                     _send_msg(conn, {"ok": True})
@@ -292,6 +338,7 @@ class PsClient:
         self._conns: dict = {}
         self._client_id = uuid.uuid4().hex
         self._seq = 0
+        self._dim = None  # table embedding_dim, cached from responses
 
     def server_of(self, uids):
         return np.asarray(uids, np.int64) % self.num_servers
@@ -337,6 +384,14 @@ class PsClient:
                 delay = min(delay * 2, 1.0)
 
     # -- table ops ---------------------------------------------------------
+    def table_dim(self):
+        """The table's embedding_dim, cached client-side (first learned
+        from a pull response, else asked of server 0's stats) so shape
+        contracts hold even for requests that touch no server."""
+        if self._dim is None:
+            self._dim = int(self._request(0, {"op": "stats"})["dim"])
+        return self._dim
+
     def pull(self, uids):
         uids = np.asarray(uids, np.int64).ravel()
         owner = self.server_of(uids)
@@ -346,7 +401,13 @@ class PsClient:
             resp = self._request(int(sid),
                                  {"op": "pull", "uids": uids[idx]})
             parts[int(sid)] = (idx, resp["rows"])
-        dim = next(iter(parts.values()))[1].shape[1] if parts else 0
+        if parts:
+            self._dim = int(next(iter(parts.values()))[1].shape[1])
+            dim = self._dim
+        else:
+            # empty request: keep the (0, embedding_dim) shape contract
+            # instead of inferring (0, 0) from an empty response set
+            dim = self.table_dim()
         out = np.empty((len(uids), dim), np.float32)
         for idx, rows in parts.values():
             out[idx] = rows

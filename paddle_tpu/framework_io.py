@@ -61,11 +61,17 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    """Crash-atomic (shared protocol in _atomic_io): a killed save leaves
+    either the old file or the new one, never a torn pickle — the sharded
+    checkpoint path in distributed/checkpoint gets the same guarantee from
+    its commit protocol."""
+    from ._atomic_io import atomic_write
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    atomic_write(path, lambda f: pickle.dump(_pack(obj), f,
+                                             protocol=protocol))
 
 
 def load(path, return_numpy=False, **configs):

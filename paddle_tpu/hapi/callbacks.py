@@ -105,18 +105,104 @@ class ProgBarLogger(Callback):
 
 class ModelCheckpoint(Callback):
     """Periodic save of model+optimizer state (reference: callbacks.py
-    ModelCheckpoint: save_dir/{epoch} and final)."""
+    ModelCheckpoint: save_dir/{epoch} and final).
 
-    def __init__(self, save_freq=1, save_dir=None):
+    Beyond the reference surface, this callback is the hapi entry into the
+    fault-tolerant checkpoint subsystem (distributed/checkpoint): with
+    `every_n_steps` set it snapshots model+optimizer+step through a
+    `CheckpointManager` (crash-atomic commits, keep-last-K rotation,
+    integrity manifest) under `<save_dir>/ckpt`, and with
+    `auto_resume=True` it restores the newest committed snapshot at
+    `on_train_begin` — the elastic relaunch path (launch/controller.py
+    `--ckpt_dir`) supplies the snapshot root via PADDLE_TPU_CKPT_DIR (the
+    env feeds only the manager; legacy per-epoch saves still require an
+    explicit save_dir) so a restarted worker resumes instead of starting
+    cold. The restored step
+    is exposed as `self.resumed_step` and `model._resume_step` (weights +
+    optimizer are restored; the fit loop replays the epoch's remaining
+    batches)."""
+
+    def __init__(self, save_freq=1, save_dir=None, every_n_steps=None,
+                 keep_last_k=3, auto_resume=False, async_save=False):
         super().__init__()
         self.save_freq = int(save_freq)
         self.save_dir = save_dir
+        # the launcher's --ckpt_dir env fallback feeds ONLY the snapshot
+        # manager; the legacy per-epoch full-model saves stay gated on an
+        # explicitly passed save_dir
+        self._ckpt_root = save_dir or os.environ.get("PADDLE_TPU_CKPT_DIR")
+        self.every_n_steps = every_n_steps
+        self.keep_last_k = int(keep_last_k)
+        self.auto_resume = bool(auto_resume)
+        if (every_n_steps or auto_resume) and not self._ckpt_root:
+            raise ValueError(
+                "ModelCheckpoint(every_n_steps=/auto_resume=) needs a "
+                "checkpoint root: pass save_dir or launch with --ckpt_dir "
+                "(PADDLE_TPU_CKPT_DIR)")
+        self.async_save = bool(async_save)
+        self._manager = None
+        self._global_step = 0
+        self.resumed_step = None
+
+    def _mgr(self):
+        if self._manager is None:
+            from ..distributed.checkpoint import CheckpointManager
+
+            self._manager = CheckpointManager(
+                os.path.join(self._ckpt_root, "ckpt"),
+                keep_last_k=self.keep_last_k, async_save=self.async_save)
+        return self._manager
+
+    def _state(self, ensure_opt=False):
+        """Snapshot tree: model + optimizer (+ the manager splits scalar
+        leaves like `_step_count` into the extra sidecar)."""
+        state = {"model": self.model.network.state_dict()}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None:
+            if ensure_opt:
+                # materialize accumulators so the restore has targets even
+                # before the first optimizer step of this incarnation
+                opt._ensure_state(opt._parameter_list)
+            state["opt"] = opt.state_dict()
+        return state
+
+    def _snapshot(self):
+        self._mgr().save(self._state(), step=self._global_step,
+                         extra={"global_step": self._global_step})
+
+    def on_train_begin(self, logs=None):
+        self._global_step = 0
+        if not (self.auto_resume and self._ckpt_root and self.model):
+            return
+        state = self._state(ensure_opt=True)
+        # strict=False: _ensure_state materializes accumulator targets for
+        # EVERY param, but the snapshot only holds them for params that
+        # had stepped by save time (frozen params have none) — those keep
+        # their fresh zeros
+        step = self._mgr().restore_latest(state, strict=False)
+        if step is None:
+            return
+        self.model.network.set_state_dict(state["model"])
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and "opt" in state:
+            opt.set_state_dict(state["opt"])
+        self.resumed_step = step
+        self.model._resume_step = step
+        self._global_step = step  # keep step numbering monotonic
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self.every_n_steps and self._ckpt_root and self.model and \
+                self._global_step % int(self.every_n_steps) == 0:
+            self._snapshot()
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and self.model and (epoch + 1) % self.save_freq == 0:
             self.model.save(os.path.join(self.save_dir, str(epoch)))
 
     def on_train_end(self, logs=None):
+        if self._manager is not None:
+            self._manager.wait()  # surface async IO errors before exit
         if self.save_dir and self.model:
             self.model.save(os.path.join(self.save_dir, "final"))
 

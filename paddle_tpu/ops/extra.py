@@ -483,8 +483,24 @@ signbit = defop(
     doc="True where the sign bit is set.",
     sample=lambda: ((_s((3, 4)),), {}), np_ref=np.signbit)
 
+@jax.custom_jvp
+def _frexp_impl(x):
+    return jnp.frexp(x)
+
+
+@_frexp_impl.defjvp
+def _frexp_jvp(primals, tangents):
+    # mantissa = x * 2^-e with e locally constant, so dm/dx = 2^-e; the
+    # integer exponent output carries no tangent (jnp.frexp itself has no
+    # differentiation rule and silently yields zero gradients)
+    (x,), (dx,) = primals, tangents
+    m, e = jnp.frexp(x)
+    dm = dx * jnp.exp2(-e).astype(m.dtype)
+    return (m, e), (dm, np.zeros(e.shape, dtype=jax.dtypes.float0))
+
+
 frexp = defop(
-    "frexp", "x", lambda x: jnp.frexp(x), category="unary",
+    "frexp", "x", _frexp_impl, category="unary",
     ref="python/paddle/tensor/math.py frexp",
     doc="Decompose into mantissa and exponent (two outputs).")
 

@@ -165,3 +165,24 @@ def test_setitem_grad():
     loss = y.sum()
     loss.backward()
     np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+
+
+def test_grad_unreachable_input_raises_by_default():
+    """allow_unused=False (default) must raise, naming the unreachable
+    input — zeros here would mask wiring bugs like a stray stop_gradient."""
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    z = pt.to_tensor([4.0], stop_gradient=False)
+    y = x * x
+    with pytest.raises(RuntimeError, match="1-th input"):
+        pt.grad(y, [x, z])
+    # the failed call must not clobber autograd state on the inputs
+    assert z.stop_gradient is False and z.grad is None
+
+
+def test_grad_unreachable_input_none_with_allow_unused():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    z = pt.to_tensor([4.0], stop_gradient=False)
+    y = x * x
+    gx, gz = pt.grad(y, [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert gz is None

@@ -1,0 +1,345 @@
+"""CheckpointManager failure-mode tests: torn/corrupt checkpoint fallback,
+keep-last-K rotation + GC of uncommitted leftovers, async-save exception
+propagation, and save-retry with backoff (reference analog: the fleet
+checkpoint/elastic relaunch story around per-rank save_state_dict)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, CheckpointNotCommittedError, COMMITTED_SENTINEL,
+    clean_uncommitted, load_state_dict,
+)
+from paddle_tpu.distributed.checkpoint import manager as manager_mod
+
+
+def _state(seed, extra_scalar=None):
+    rng = np.random.RandomState(seed)
+    st = {"model": {"w": paddle.to_tensor(rng.randn(8, 4).astype("float32"))},
+          "opt": {"_step_count": int(seed)}}
+    if extra_scalar is not None:
+        st["note"] = extra_scalar
+    return st
+
+
+def _zeros_state():
+    return {"model": {"w": paddle.to_tensor(np.zeros((8, 4), "float32"))},
+            "opt": {"_step_count": -1}}
+
+
+def test_roundtrip_with_scalar_leaves(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(_state(3, extra_scalar="hello"), step=3, extra={"tag": "v1"})
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 3
+    np.testing.assert_array_equal(tgt["model"]["w"].numpy(),
+                                  _state(3)["model"]["w"].numpy())
+    assert tgt["opt"]["_step_count"] == 3  # scalar leaf round-trips
+    assert tgt["note"] == "hello"
+    assert mgr.last_extra == {"tag": "v1"}
+
+
+def test_restore_latest_empty_root_returns_none(tmp_path):
+    assert CheckpointManager(tmp_path).restore_latest(_zeros_state()) is None
+
+
+def test_keep_last_k_rotation(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_k=2)
+    for s in range(5):
+        mgr.save(_state(s), step=s)
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+
+
+def test_missing_committed_sentinel_falls_back(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_k=4)
+    mgr.save(_state(0), step=0)
+    mgr.save(_state(1), step=1)
+    os.remove(mgr._step_dir(1) + "/" + COMMITTED_SENTINEL)
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 0
+    np.testing.assert_array_equal(tgt["model"]["w"].numpy(),
+                                  _state(0)["model"]["w"].numpy())
+    # direct load of the torn dir raises the documented error only
+    with pytest.raises(CheckpointNotCommittedError):
+        load_state_dict({"model": {"w": paddle.zeros([8, 4])}},
+                        mgr._step_dir(1))
+
+
+def test_truncated_payload_falls_back(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_k=4)
+    mgr.save(_state(0), step=0)
+    mgr.save(_state(1), step=1)
+    data = mgr._step_dir(1) + "/data_0.npz"
+    with open(data, "rb+") as f:
+        f.truncate(os.path.getsize(data) // 2)
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 0
+
+
+def test_digest_mismatch_falls_back(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_k=4)
+    mgr.save(_state(0), step=0)
+    mgr.save(_state(1), step=1)
+    # re-save the payload with different bytes but a matching file name;
+    # size+digest can no longer match the manifest
+    np.savez(mgr._step_dir(1) + "/data_0.npz",
+             **{"model.w##0": np.ones((8, 4), "float32")})
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 0
+
+
+def test_gc_removes_uncommitted_and_staging(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep_last_k=3)
+    mgr.save(_state(0), step=0)
+    torn = mgr._step_dir(1)
+    os.makedirs(torn)
+    open(os.path.join(torn, "data_0.npz"), "wb").write(b"torn")
+    staging = mgr._step_dir(2) + ".tmp.deadbeef"
+    os.makedirs(staging)
+    assert sorted(clean_uncommitted(tmp_path)) == [
+        "step_00000001", "step_00000002.tmp.deadbeef"]
+    assert not os.path.exists(torn) and not os.path.exists(staging)
+    assert mgr.all_steps() == [0]
+    # gc() does the same sweep as part of every save
+    os.makedirs(staging)
+    mgr.save(_state(3), step=3)
+    assert not os.path.exists(staging)
+
+
+def test_async_save_propagates_exception_on_wait(tmp_path):
+    blocker = tmp_path / "root" / "step_00000007"
+    os.makedirs(tmp_path / "root")
+    open(blocker, "w").write("a file where the checkpoint dir must go")
+    mgr = CheckpointManager(tmp_path / "root", async_save=True,
+                            max_retries=0)
+    h = mgr.save(_state(0), step=7)
+    assert h is not None
+    with pytest.raises(OSError):
+        mgr.wait()
+    mgr.wait()  # idempotent after the failure surfaced
+
+
+def test_async_save_commits_and_next_save_joins_previous(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    mgr.save(_state(0), step=0)
+    mgr.save(_state(1), step=1)  # implicitly waits for step 0
+    mgr.wait()
+    assert mgr.all_steps() == [0, 1]
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 1
+
+
+def test_save_retries_transient_oserror(tmp_path, monkeypatch):
+    """Retry wraps the deferred write closure (the IO), not the snapshot:
+    the first two write attempts fail, the third lands."""
+    real = manager_mod.save_state_dict
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        write = real(*a, **kw)  # defer=True: snapshot happens here
+
+        def w():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient filesystem hiccup")
+            return write()
+
+        return w
+
+    monkeypatch.setattr(manager_mod, "save_state_dict", flaky)
+    monkeypatch.setattr(manager_mod.time, "sleep", lambda s: None)
+    mgr = CheckpointManager(tmp_path, max_retries=3)
+    mgr.save(_state(0), step=0)
+    assert calls["n"] == 3
+    assert mgr.restore_latest(_zeros_state()) == 0
+
+
+def test_save_retry_exhaustion_raises(tmp_path, monkeypatch):
+    def never_lands(*a, **kw):
+        def w():
+            raise OSError("disk on fire")
+
+        return w
+
+    monkeypatch.setattr(manager_mod, "save_state_dict", never_lands)
+    monkeypatch.setattr(manager_mod.time, "sleep", lambda s: None)
+    mgr = CheckpointManager(tmp_path, max_retries=2)
+    with pytest.raises(OSError):
+        mgr.save(_state(0), step=0)
+
+
+def test_no_retry_in_multiprocess_saves(tmp_path, monkeypatch):
+    """A lone rank re-entering the commit barriers would skew the counting
+    epoch and hang the job, so multi-process saves take one attempt."""
+    calls = {"n": 0}
+
+    def fails_once(*a, **kw):
+        def w():
+            calls["n"] += 1
+            raise OSError("transient")
+
+        return w
+
+    monkeypatch.setattr(manager_mod, "save_state_dict", fails_once)
+    monkeypatch.setattr(manager_mod.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(manager_mod.jax, "process_index", lambda: 0)
+    mgr = CheckpointManager(tmp_path, max_retries=3)
+    with pytest.raises(OSError):
+        mgr.save(_state(0), step=0)
+    assert calls["n"] == 1
+
+
+def test_async_save_snapshots_before_returning(tmp_path):
+    """The manager's async path must capture tensor bytes synchronously:
+    an optimizer step mutating params right after save() returns cannot
+    tear the written checkpoint."""
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    st = _state(0)
+    expected = st["model"]["w"].numpy().copy()
+    mgr.save(st, step=0)
+    # simulate the next optimizer step landing while IO is in flight
+    import jax.numpy as jnp
+
+    st["model"]["w"]._value = jnp.zeros_like(st["model"]["w"]._value)
+    mgr.wait()
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 0
+    np.testing.assert_array_equal(tgt["model"]["w"].numpy(), expected)
+
+
+def test_non_serializable_leaf_raises(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    with pytest.raises(TypeError):
+        mgr.save({"bad": object()}, step=0)
+
+
+def test_model_checkpoint_step_snapshots_and_auto_resume(tmp_path):
+    """hapi wiring: ModelCheckpoint(every_n_steps=) snapshots
+    model+optimizer+step through the manager; auto_resume=True restores
+    the newest committed snapshot on train begin (the elastic relaunch
+    entry point)."""
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+    def make_model():
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(2, 8), nn.Tanh(), nn.Linear(8, 2))
+        m = Model(net)
+        m.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                            parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        return m
+
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(4, 2).astype("float32"),
+                rng.randint(0, 2, (4, 1)).astype("int64"))
+               for _ in range(8)]
+
+    m1 = make_model()
+    cb1 = ModelCheckpoint(save_dir=str(tmp_path), every_n_steps=3)
+    m1.fit(batches, epochs=1, verbose=0, callbacks=[cb1])
+    assert cb1._mgr().all_steps() == [3, 6]
+
+    m2 = make_model()
+    fresh_w = m2.network.state_dict()
+    fresh_w = {k: v.numpy().copy() for k, v in fresh_w.items()}
+    cb2 = ModelCheckpoint(save_dir=str(tmp_path), auto_resume=True)
+    cb2.set_model(m2)
+    cb2.on_train_begin()
+    assert cb2.resumed_step == 6
+    assert m2._resume_step == 6
+    assert m2._optimizer._step_count == 6  # optimizer state came back
+    changed = any(
+        not np.array_equal(v.numpy(), fresh_w[k])
+        for k, v in m2.network.state_dict().items())
+    assert changed, "resume restored the seed init, not trained weights"
+
+
+# -- regressions from review: overwrite, partial mutation, nested sweep ----
+
+def test_overwrite_crash_drops_stale_sentinel(tmp_path, monkeypatch):
+    """Re-saving onto a committed checkpoint must invalidate the OLD
+    sentinel before any file lands, so a crash mid-overwrite reads as
+    uncommitted rather than as a committed mix of old and new files."""
+    from paddle_tpu.distributed.checkpoint import api as api_mod
+    from paddle_tpu.distributed.checkpoint import is_committed, save_state_dict
+
+    path = str(tmp_path / "ck")
+    save_state_dict({"a": paddle.ones([2, 2])}, path)
+    assert is_committed(path)
+
+    def crash_instead_of_commit(*a, **kw):
+        raise RuntimeError("killed before commit")
+
+    monkeypatch.setattr(api_mod, "_commit", crash_instead_of_commit)
+    with pytest.raises(RuntimeError):
+        save_state_dict({"a": paddle.full([2, 2], 7.0)}, path)
+    assert not is_committed(path)  # stale sentinel is gone
+    with pytest.raises(CheckpointNotCommittedError):
+        load_state_dict({"a": paddle.zeros([2, 2])}, path)
+
+
+def test_corrupt_restore_does_not_partially_mutate_target(tmp_path):
+    """A checkpoint whose LATER chunk is corrupt must not leave the
+    earlier tensors of the caller's tree overwritten when restore falls
+    through to None."""
+    mgr = CheckpointManager(tmp_path)
+    rng = np.random.RandomState(0)
+    st = {"a": paddle.to_tensor(rng.randn(4, 4).astype("float32")),
+          "b": paddle.to_tensor(rng.randn(4, 4).astype("float32"))}
+    mgr.save(st, step=0)
+    # rewrite with 'a' intact (its digest still matches) and 'b' altered
+    data = mgr._step_dir(0) + "/data_0.npz"
+    z = dict(np.load(data))
+    z["b##0"] = z["b##0"] + 1.0
+    np.savez(data, **z)
+    tgt = {"a": paddle.to_tensor(np.zeros((4, 4), "float32")),
+           "b": paddle.to_tensor(np.zeros((4, 4), "float32"))}
+    assert mgr.restore_latest(tgt) is None
+    np.testing.assert_array_equal(tgt["a"].numpy(), 0.0)
+    np.testing.assert_array_equal(tgt["b"].numpy(), 0.0)
+
+
+def test_restore_strict_false_tolerates_extra_targets(tmp_path):
+    """Auto-resume template may hold accumulators the snapshot lacks
+    (frozen params): strict=False leaves them at their fresh values."""
+    mgr = CheckpointManager(tmp_path)
+    mgr.save({"model": {"w": paddle.ones([2, 2])}}, step=1)
+    tgt = {"model": {"w": paddle.zeros([2, 2]),
+                     "frozen_moment": paddle.full([2, 2], 5.0)}}
+    with pytest.raises(KeyError):
+        mgr.restore(tgt, 1)  # strict default still surfaces the gap
+    assert mgr.restore_latest(tgt, strict=False) == 1
+    np.testing.assert_array_equal(tgt["model"]["w"].numpy(), 1.0)
+    np.testing.assert_array_equal(tgt["model"]["frozen_moment"].numpy(), 5.0)
+
+
+def test_clean_uncommitted_reaches_nested_manager_roots(tmp_path):
+    """The launcher sweeps --ckpt_dir; hapi managers root themselves at
+    <save_dir>/ckpt below it — the sweep must recurse to them."""
+    nested = tmp_path / "ckpt"
+    mgr = CheckpointManager(nested, keep_last_k=4)
+    mgr.save(_state(0), step=0)
+    os.remove(os.path.join(mgr._step_dir(0), COMMITTED_SENTINEL))
+    staging = str(nested / "step_00000002.tmp.feed")
+    os.makedirs(staging)
+    removed = clean_uncommitted(tmp_path)
+    assert sorted(removed) == ["ckpt/step_00000000",
+                               "ckpt/step_00000002.tmp.feed"]
+    assert not os.path.exists(staging)
+
+
+def test_model_checkpoint_requires_root_for_snapshots(monkeypatch):
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+    monkeypatch.delenv("PADDLE_TPU_CKPT_DIR", raising=False)
+    with pytest.raises(ValueError, match="checkpoint root"):
+        ModelCheckpoint(every_n_steps=10)
+    with pytest.raises(ValueError, match="checkpoint root"):
+        ModelCheckpoint(auto_resume=True)
+    ModelCheckpoint()  # plain legacy use stays fine

@@ -1,0 +1,19 @@
+"""Tier-1 registration of the checkpoint fault-injection harness
+(tools/ckpt_fault_injector.py): kill a saver at every commit-protocol
+interruption point and prove restore_latest() always lands on a bit-exact
+committed checkpoint, with torn directories refused via the documented
+error only. Running it in the suite makes atomicity regressions fail CI."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARNESS = os.path.join(REPO, "tools", "ckpt_fault_injector.py")
+
+
+def test_kill_at_every_phase_never_tears_state():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, HARNESS], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    assert "RESULT: PASS" in r.stdout

@@ -8,7 +8,8 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import ProcessMesh, Shard, Replicate, shard_tensor
 from paddle_tpu.distributed.checkpoint import (
-    save_state_dict, load_state_dict,
+    save_state_dict, load_state_dict, load_extra, is_committed,
+    CheckpointNotCommittedError, CheckpointCorruptError, COMMITTED_SENTINEL,
 )
 
 
@@ -86,3 +87,86 @@ def test_async_save(tmp_path):
     tgt = paddle.to_tensor(np.zeros_like(w))
     load_state_dict({"w": tgt}, str(tmp_path))
     np.testing.assert_allclose(tgt.numpy(), w)
+
+
+# -- commit protocol + integrity manifest ----------------------------------
+
+def test_save_commits_with_manifest_and_sentinel(tmp_path):
+    import json
+    import os
+
+    save_state_dict({"a": paddle.ones([2, 2])}, str(tmp_path),
+                    extra={"step": 9})
+    names = sorted(os.listdir(tmp_path))
+    assert COMMITTED_SENTINEL in names
+    assert "manifest_0.json" in names
+    assert is_committed(str(tmp_path))
+    m = json.load(open(tmp_path / "manifest_0.json"))
+    assert "data_0.npz" in m["files"]
+    (chunk,) = m["chunks"].values()
+    assert {"crc32", "sha256", "nbytes", "file"} <= set(chunk)
+    assert chunk["nbytes"] == 2 * 2 * 4
+    assert load_extra(str(tmp_path)) == {"step": 9}
+
+
+def test_load_refuses_uncommitted(tmp_path):
+    import os
+
+    save_state_dict({"a": paddle.ones([2, 2])}, str(tmp_path))
+    os.remove(tmp_path / COMMITTED_SENTINEL)
+    with pytest.raises(CheckpointNotCommittedError):
+        load_state_dict({"a": paddle.zeros([2, 2])}, str(tmp_path))
+
+
+def test_load_refuses_truncated_payload(tmp_path):
+    import os
+
+    save_state_dict({"a": paddle.ones([4, 4])}, str(tmp_path))
+    data = tmp_path / "data_0.npz"
+    with open(data, "rb+") as f:
+        f.truncate(os.path.getsize(data) // 2)
+    with pytest.raises(CheckpointCorruptError):
+        load_state_dict({"a": paddle.zeros([4, 4])}, str(tmp_path))
+
+
+def test_load_refuses_digest_mismatch(tmp_path):
+    save_state_dict({"a": paddle.ones([4, 4])}, str(tmp_path))
+    # same shape/dtype/keys, different bytes: only the digests can tell
+    np.savez(tmp_path / "data_0.npz",
+             **{"a##0": np.full((4, 4), 7.0, "float32")})
+    with pytest.raises(CheckpointCorruptError):
+        load_state_dict({"a": paddle.zeros([4, 4])}, str(tmp_path))
+
+
+def test_async_save_exception_propagates_on_join(tmp_path):
+    target = tmp_path / "not_a_dir"
+    target.write_text("checkpoint path is occupied by a regular file")
+    th = save_state_dict({"a": paddle.ones([2, 2])},
+                         str(target / "ck"), async_save=True)
+    with pytest.raises(OSError):
+        th.join()
+
+
+def test_overwrite_sweeps_stale_files_and_extra(tmp_path):
+    """Overwriting a checkpoint path must not leak files from the old
+    save into the new one: stale higher-rank shards would mix old state
+    into the union read, and a stale extra.json would masquerade as the
+    new save's sidecar."""
+    import json
+    import os
+
+    save_state_dict({"a": paddle.ones([2, 2])}, str(tmp_path),
+                    extra={"step": 1})
+    # fake leftovers of a previous world_size=2 save
+    np.savez(tmp_path / "data_1.npz", **{"ghost##0": np.ones(2, "float32")})
+    for name in ("metadata_1.json", "manifest_1.json"):
+        json.dump({"state_dict_metadata": {}, "global_shapes": {},
+                   "files": {}, "chunks": {}}, open(tmp_path / name, "w"))
+    save_state_dict({"a": paddle.full([2, 2], 3.0)}, str(tmp_path))
+    names = set(os.listdir(tmp_path))
+    assert not {"data_1.npz", "metadata_1.json", "manifest_1.json"} & names
+    assert "extra.json" not in names  # second save wrote no extra
+    assert load_extra(str(tmp_path)) is None
+    tgt = paddle.zeros([2, 2])
+    load_state_dict({"a": tgt}, str(tmp_path))
+    np.testing.assert_array_equal(tgt.numpy(), 3.0)

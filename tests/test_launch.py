@@ -191,3 +191,25 @@ def test_explicit_rank_reclaim_after_crash():
         host.close()
     finally:
         del os.environ["PADDLE_RDZV_TTL"]
+
+
+def test_launch_elastic_sweeps_torn_checkpoints(tmp_path):
+    """--ckpt_dir exports PADDLE_TPU_CKPT_DIR to workers and the elastic
+    relaunch path sweeps torn (uncommitted) checkpoint dirs left by the
+    crash before respawning, so resumed workers only ever see committed
+    state."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    r = _run_launch("""
+        import os, sys
+        root = os.environ["PADDLE_TPU_CKPT_DIR"]
+        torn = os.path.join(root, "step_00000005")
+        if int(os.environ["PADDLE_RESTART_EPOCH"]) == 0:
+            os.makedirs(torn)
+            open(os.path.join(torn, "data_0.npz"), "wb").write(b"torn")
+            sys.exit(1)   # crash mid-job, torn dir left behind
+        assert not os.path.exists(torn), "torn checkpoint not swept"
+    """, tmp_path, "--elastic", "--max_restarts", "1",
+        "--ckpt_dir", str(ck), procs=1, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "swept torn checkpoints" in r.stderr

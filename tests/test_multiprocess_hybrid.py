@@ -91,7 +91,9 @@ def _hybrid_worker(coord_port, config):
     assert all(np.isfinite(dist_losses)), dist_losses
     np.testing.assert_allclose(dist_losses, ref_losses, rtol=1e-2, atol=1e-3,
                                err_msg=f"{config}: loss trajectory diverged")
-    np.testing.assert_allclose(dist_gn, ref_gn, rtol=2e-2, atol=1e-3,
+    # 3e-2 absorbs the reduction-order spread of gloo CPU collectives
+    # (older jax) on top of bf16; real divergence is O(1)
+    np.testing.assert_allclose(dist_gn, ref_gn, rtol=3e-2, atol=1e-3,
                                err_msg=f"{config}: grad-norm trajectory "
                                "diverged")
 

@@ -222,5 +222,9 @@ def test_group_sharded_offload_trains():
     loss = model(paddle.ones([4, 16])).sum()
     loss.backward()
     opt.step()
-    assert model.weight._value.sharding.memory_kind == "pinned_host"
+    from paddle_tpu.compat import supports_memory_kind
+
+    want = "pinned_host" if supports_memory_kind("pinned_host") \
+        else "unpinned_host"  # backends without a pinned space degrade
+    assert model.weight._value.sharding.memory_kind == want
     assert not np.allclose(w0, model.weight.numpy())

@@ -169,3 +169,60 @@ def test_ps_service_two_servers_two_trainers_with_server_restart():
     ps.stop_servers()
     for srv in servers:
         srv.join(timeout=30)
+
+
+# -- client-side table-dim contract + dedup-table hygiene ------------------
+
+class _StubStore:
+    """Minimal endpoint registry for a single in-process server (the
+    PsClient only ever calls get())."""
+
+    def __init__(self, mapping):
+        self.m = dict(mapping)
+
+    def get(self, key):
+        return self.m[key]
+
+
+def test_empty_pull_keeps_embedding_dim_shape():
+    """pull([]) must return (0, embedding_dim), not (0, 0) inferred from
+    an empty response set — the dim is cached client-side from stats."""
+    import threading
+
+    from paddle_tpu.distributed.ps_service import PsServer
+
+    srv = PsServer("dimtest", 0, 1, DIM)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    store = _StubStore(
+        {"ps/dimtest/server/0": f"127.0.0.1:{srv.port}:1".encode()})
+    c = PsClient("dimtest", 1, store, timeout=20)
+    try:
+        out = c.pull([])
+        assert out.shape == (0, DIM)
+        assert out.dtype == np.float32
+        assert c.pull([3, 5, 3]).shape == (3, DIM)
+        assert c.stats()[0]["dim"] == DIM
+    finally:
+        c.stop_servers()
+        c.close()
+        th.join(timeout=10)
+
+
+def test_applied_seq_pruned_for_idle_clients_and_persisted(tmp_path):
+    sh = SparseTableShard(DIM, optimizer="sgd")
+    sh.push([1], np.ones((1, DIM), np.float32), client="gone", seq=1)
+    sh.push([2], np.ones((1, DIM), np.float32), client="alive", seq=1)
+    assert set(sh.applied_seq) == {"gone", "alive"}
+    # nobody is older than an hour: nothing pruned
+    assert sh.prune_idle_clients(idle_s=3600) == []
+    # backdate one client; only it is pruned
+    sh.seq_seen["gone"] -= 7200
+    assert sh.prune_idle_clients(idle_s=3600) == ["gone"]
+    assert set(sh.applied_seq) == {"alive"}
+    # the activity clock survives checkpoint round-trips
+    p = str(tmp_path / "shard.pkl")
+    sh.save(p, prune_idle_s=None)
+    sh2 = SparseTableShard(DIM, optimizer="sgd")
+    sh2.load(p)
+    assert set(sh2.applied_seq) == {"alive"} and "alive" in sh2.seq_seen

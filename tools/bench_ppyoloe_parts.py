@@ -90,7 +90,9 @@ def main():
     for name, (f, batch) in progs.items():
         jf = jax.jit(f)
         try:
-            flops = jf.lower(pv, *batch).compile().cost_analysis()
+            from paddle_tpu.compat import cost_analysis
+
+            flops = cost_analysis(jf.lower(pv, *batch).compile())
             flops = float(flops.get("flops", 0.0)) if flops else 0.0
         except Exception:
             flops = 0.0

@@ -1,0 +1,167 @@
+"""Fault-injection harness for the checkpoint commit protocol.
+
+For every interruption point of `save_state_dict`'s commit protocol
+(distributed/checkpoint/api.py) — mid-payload write, between payload and
+manifest, and after all files but before the `_COMMITTED` sentinel — a
+child saver process is killed exactly there (os._exit via the
+PADDLE_TPU_CKPT_KILL_PHASE hook, the in-process equivalent of SIGKILL) and
+the parent then proves the atomicity invariant:
+
+  1. `CheckpointManager.restore_latest()` returns the PREVIOUS committed
+     checkpoint, bit-exact — an interrupted save never costs more than the
+     interrupted step;
+  2. directly loading the torn directory raises only the documented
+     `CheckpointNotCommittedError` — never garbage, never a partial load;
+  3. a control run with no fault commits and restores the NEW checkpoint.
+
+Run as a script (exits nonzero on any violation — registered as a tier-1
+test via tests/test_ckpt_fault_injection.py):
+
+    python tools/ckpt_fault_injector.py [--phases payload,pre-manifest,...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PHASES = ("payload", "pre-manifest", "pre-commit")
+KILL_EXIT = 137  # os._exit code used by the _maybe_crash hook
+
+# The child does one committed save (step 0), then a second save (step 1)
+# that the injected fault kills partway through. Deterministic payloads so
+# the parent can check bit-exactness without a side channel.
+_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+root, phase = sys.argv[1], sys.argv[2]
+
+def state(seed):
+    rng = np.random.RandomState(seed)
+    return {{"model": {{"w": paddle.to_tensor(
+                          rng.randn(16, 8).astype(np.float32)),
+                       "b": paddle.to_tensor(
+                          rng.randn(8).astype(np.float32))}},
+            "step": seed}}
+
+mgr = CheckpointManager(root, keep_last_k=4)
+mgr.save(state(0), step=0)
+if phase != "none":
+    os.environ["PADDLE_TPU_CKPT_KILL_PHASE"] = phase
+mgr.save(state(1), step=1)   # fault phases die inside this call
+sys.exit(0)
+"""
+
+
+def _expected_state(seed):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(16, 8).astype(np.float32),
+            "b": rng.randn(8).astype(np.float32)}
+
+
+def spawn_child(phase, workdir):
+    """Start the kill-at-phase child saver (concurrently runnable)."""
+    root = os.path.join(workdir, f"ckpt-{phase}")
+    child = os.path.join(workdir, f"child-{phase}.py")
+    with open(child, "w") as f:
+        f.write(_CHILD.format(repo=REPO))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_CKPT_KILL_PHASE", None)
+    return subprocess.Popen([sys.executable, child, root, phase], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def verify_phase(phase, workdir, proc, verbose=True):
+    """Check the atomicity invariant after the child dies; returns the
+    list of violations."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import (
+        CheckpointManager, CheckpointNotCommittedError, load_state_dict,
+        is_committed,
+    )
+
+    root = os.path.join(workdir, f"ckpt-{phase}")
+    try:
+        _, stderr = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return [f"[{phase}] child hung"]
+    bad = []
+    want_rc = 0 if phase == "none" else KILL_EXIT
+    if proc.returncode != want_rc:
+        return [f"[{phase}] child exited {proc.returncode}, wanted "
+                f"{want_rc}: {stderr[-2000:]}"]
+
+    mgr = CheckpointManager(root, keep_last_k=4)
+    want_step = 1 if phase == "none" else 0
+    tgt = {"model": {"w": paddle.to_tensor(np.zeros((16, 8), np.float32)),
+                     "b": paddle.to_tensor(np.zeros(8, np.float32))},
+           "step": -1}
+    step = mgr.restore_latest(tgt)
+    if step != want_step:
+        bad.append(f"[{phase}] restore_latest -> {step}, wanted {want_step}")
+    else:
+        exp = _expected_state(want_step)
+        for k in ("w", "b"):
+            got = tgt["model"][k].numpy()
+            if not np.array_equal(got, exp[k]):
+                bad.append(f"[{phase}] restored {k!r} is not bit-exact")
+        if tgt["step"] != want_step:
+            bad.append(f"[{phase}] scalar leaf 'step' -> {tgt['step']}, "
+                       f"wanted {want_step}")
+
+    torn = os.path.join(root, "step_00000001")
+    if phase != "none" and os.path.isdir(torn):
+        if is_committed(torn):
+            bad.append(f"[{phase}] torn dir carries a _COMMITTED sentinel")
+        try:
+            load_state_dict(
+                {"model": {"w": paddle.to_tensor(
+                    np.zeros((16, 8), np.float32))}}, torn)
+            bad.append(f"[{phase}] loading the torn dir did not raise")
+        except CheckpointNotCommittedError:
+            pass  # the one documented error
+        except Exception as e:  # noqa: BLE001 — any other error is the bug
+            bad.append(f"[{phase}] torn dir raised {type(e).__name__} "
+                       f"instead of CheckpointNotCommittedError: {e}")
+    if verbose:
+        print(f"  {phase:<12} -> " + ("FAIL" if bad else "ok"))
+    return bad
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phases", default=",".join(PHASES + ("none",)),
+                    help="comma-separated kill phases to run "
+                         "(default: all + the no-fault control)")
+    args = ap.parse_args(argv)
+    violations = []
+    phases = [p.strip() for p in args.phases.split(",")]
+    with tempfile.TemporaryDirectory(prefix="ckpt-fault-") as workdir:
+        print("checkpoint fault injection (kill-at-phase):")
+        procs = [(p, spawn_child(p, workdir)) for p in phases]
+        for phase, proc in procs:
+            violations += verify_phase(phase, workdir, proc)
+    for v in violations:
+        print("VIOLATION:", v, file=sys.stderr)
+    print("RESULT:", "FAIL" if violations else "PASS")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
