@@ -12,12 +12,14 @@ its IO handles so worker threads never race.
 import concurrent.futures
 import os
 import tempfile
+import time
 
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
-from paddle_tpu.inference import Config, PredictorPool
+from paddle_tpu.inference import (Config, DeadlineExceeded, Overloaded,
+                                  PredictorPool, ServingPool)
 
 SMOKE = os.environ.get("EXAMPLES_SMOKE") == "1"
 
@@ -45,7 +47,9 @@ def main():
     model, X, y = train_model(rng)
 
     with tempfile.TemporaryDirectory(prefix="serve_") as tmp:
-        _serve(model, X, y, os.path.join(tmp, "infer"))
+        path = os.path.join(tmp, "infer")
+        _serve(model, X, y, path)
+        _serve_resilient(X, y, path)
 
 
 def _serve(model, X, y, path):
@@ -75,6 +79,59 @@ def _serve(model, X, y, path):
     print(f"served {len(requests)} requests across 4 threads; "
           f"accuracy {acc:.3f}")
     assert acc > 0.8, acc
+
+
+def _serve_resilient(X, y, path):
+    """Production traffic wants more than exclusive leases: deadlines that
+    cover queue wait + execution, and load shedding instead of unbounded
+    queueing. ServingPool (docs/serving.md) adds both, plus member
+    supervision (re-clone on failure, circuit breaker, hang detection)."""
+    # generous default deadline: the first request pays the one-off XLA
+    # compile of the loaded module, which a loaded CI box can stretch
+    pool = ServingPool(Config(path), size=2, max_queue_depth=2,
+                       default_timeout=30.0)
+
+    # normal traffic: infer() leases a healthy member and enforces the
+    # deadline end-to-end, raising typed errors instead of hanging
+    (logits,) = pool.infer([X[:8]])
+    acc = float((logits.argmax(-1) == y[:8]).mean())
+    print(f"resilient pool served a batch; accuracy {acc:.3f}")
+
+    # deadline: a request admitted with no time budget left is refused
+    # BEFORE any compute is wasted
+    try:
+        pool.infer([X[:8]], timeout=-1.0)
+        raise AssertionError("expected DeadlineExceeded")
+    except DeadlineExceeded:
+        print("past-deadline request rejected before compute (typed)")
+
+    # overload shedding: saturate both members with slow requests and
+    # fill the 2-deep admission queue — further traffic is shed with
+    # `Overloaded` instead of queueing unboundedly
+    def slow(pred):
+        time.sleep(0.3)
+        return pred.run([X[:8]])
+
+    in_flight = [pool.submit(slow) for _ in range(2)]   # occupy members
+    time.sleep(0.05)
+    backlog = [pool.submit(slow) for _ in range(2)]     # fill the queue
+    shed = 0
+    for _ in range(4):
+        try:
+            pool.submit(slow)
+        except Overloaded:
+            shed += 1
+    for f in in_flight + backlog:
+        f.result()
+    stats = pool.stats()
+    print(f"overload: {stats['admitted']} admitted, {stats['shed']} shed, "
+          f"{stats['completed']} completed")
+    assert shed == 4 and stats["shed"] >= 4
+
+    # graceful drain: stop admissions, finish in-flight work, release
+    drained = pool.shutdown(drain_timeout=5.0)
+    print(f"drained cleanly: {drained}")
+    assert drained
 
 
 if __name__ == "__main__":

@@ -176,18 +176,23 @@ class SparseTableShard:
         if prune_idle_s is not None:
             self.prune_idle_clients(prune_idle_s)
         with self.lock:
+            # serialize WHILE holding the lock: each connection runs on
+            # its own thread, so a dump over live dicts/arrays outside it
+            # could tear (rows mutated in place mid-pickle, applied_seq
+            # recording a push whose row update is absent) or crash on
+            # dict-resize during iteration
             state = {"dim": self.dim, "optimizer": self.optimizer,
                      "lr": self.lr, "std": self.std, "seed": self.seed,
                      "rows": self.rows, "accum": self.accum,
                      "applied_pushes": self.applied_pushes,
                      "applied_seq": self.applied_seq,
                      "seq_seen": self.seq_seen}
+            blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
         from .._atomic_io import atomic_write
 
         # atomic + fsynced + unique staging: a killed save can't corrupt
         # and concurrent savers can't clobber each other's temp file
-        atomic_write(path, lambda f: pickle.dump(
-            state, f, protocol=pickle.HIGHEST_PROTOCOL))
+        atomic_write(path, lambda f: f.write(blob))
 
     def load(self, path):
         with open(path, "rb") as f:

@@ -29,6 +29,7 @@ _state = {
     "stop": False,
     "req_seq": 0,
     "lock": threading.Lock(),
+    "pending": {},      # future id -> _Future (in-flight rpc_async calls)
 }
 
 
@@ -42,18 +43,67 @@ class WorkerInfo:
 
 
 class _Future:
+    """Pending rpc result.
+
+    Abandonment semantics: a `wait(timeout)` that times out ABANDONS the
+    future — it is deregistered from the pending table immediately (no
+    leak), and if the remote result arrives later it is dropped (the
+    response key is still consumed off the store). An abandoned future
+    never transitions to done: every subsequent `wait()` raises the same
+    TimeoutError, so a timed-out call cannot be silently resurrected;
+    re-issue the rpc instead."""
+
     def __init__(self):
         self._ev = threading.Event()
+        self._lock = threading.Lock()
         self._value = None
         self._err = None
+        self._abandoned = False
+
+    def _register(self):
+        with _state["lock"]:
+            _state["pending"][id(self)] = self
+
+    def _deregister(self):
+        with _state["lock"]:
+            _state["pending"].pop(id(self), None)
 
     def _set(self, value=None, err=None):
-        self._value, self._err = value, err
-        self._ev.set()
+        with self._lock:
+            if self._abandoned or self._ev.is_set():
+                return  # late result of a timed-out/failed call: dropped
+            self._value, self._err = value, err
+            self._ev.set()
+        self._deregister()
+
+    def _abandon(self, reason):
+        with self._lock:
+            if self._ev.is_set() or self._abandoned:
+                return False
+            self._abandoned = True
+            self._err = reason
+            self._ev.set()  # wake every other waiter blocked in wait()
+        self._deregister()
+        return True
 
     def wait(self, timeout=None):
+        if self._abandoned:
+            raise TimeoutError(
+                "rpc future was abandoned by an earlier wait() timeout — "
+                "re-issue the call")
         if not self._ev.wait(timeout):
-            raise TimeoutError("rpc result timed out")
+            if self._abandon(f"abandoned after wait timeout ({timeout}s)"):
+                raise TimeoutError(
+                    f"rpc result timed out after {timeout}s; future "
+                    f"abandoned (a late result will be dropped — re-issue "
+                    f"the call)")
+            # lost the race: the future resolved (or was abandoned by a
+            # concurrent waiter) exactly at the timeout boundary — fall
+            # through so the outcome is reported for what it is
+        if self._abandoned:
+            raise TimeoutError(
+                "rpc future was abandoned by a concurrent wait() timeout — "
+                "re-issue the call")
         if self._err is not None:
             raise RuntimeError(f"rpc raised on the remote worker:\n"
                                f"{self._err}")
@@ -62,7 +112,9 @@ class _Future:
     result = wait
 
     def done(self):
-        return self._ev.is_set()
+        """True once a real result/error landed; abandoned futures never
+        report done (their late result is dropped)."""
+        return self._ev.is_set() and not self._abandoned
 
 
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
@@ -157,6 +209,9 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
     args = args or ()
     fut = _Future()
     if to == _state["name"] or get_world_size() == 1:
+        # register only once the call is definitely in flight — a failed
+        # validation/enqueue below must not leak a pending entry
+        fut._register()  # deregistered on completion or timeout abandon
         def run_local():
             try:
                 fut._set(value=fn(*args, **(kwargs or {})))
@@ -172,6 +227,7 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
         seq_key = f"rpc/{ep}/seq/{dst}"
         seq = store.add(seq_key, 1) - 1
     store.set(f"rpc/{ep}/req/{dst}/{seq}", pickle.dumps((fn, args, kwargs)))
+    fut._register()  # the request is on the wire from here on
 
     def wait_reply():
         try:
@@ -231,6 +287,13 @@ def shutdown(graceful=True):
     t = _state["serve_thread"]
     if t is not None:
         t.join(timeout=2)
+    # fail any still-pending futures: their reply threads die with the
+    # process-wide key space, so waiting on them would hang forever
+    with _state["lock"]:
+        leftover = list(_state["pending"].values())
+        _state["pending"].clear()
+    for f in leftover:
+        f._set(err="rpc shut down before the result arrived")
     _state.update(initialized=False, name=None, serve_thread=None,
                   stop=False, workers={})
     # epoch survives the reset: the next init_rpc starts a new key space

@@ -183,14 +183,19 @@ class Watchdog:
     """Liveness monitor over store heartbeats (reference: CommTaskManager's
     background loop, comm_task_manager.h:142-169, which flags timed-out
     collectives/ranks). Polls /hb/* receipt ages server-side; a member whose
-    heartbeat is older than `ttl` is reported dead via `on_failure`."""
+    heartbeat is older than `ttl` is reported dead via `on_failure`. Death
+    is NOT permanent: an elastic member that rejoins and heartbeats again
+    is revived (cleared from `self.dead`) and reported via `on_recovery`,
+    so a rejoining rank is monitored — and can be re-flagged — like any
+    other member."""
 
     def __init__(self, store: TCPStore, ttl: float = 10.0,
-                 interval: float = 1.0, on_failure=None):
+                 interval: float = 1.0, on_failure=None, on_recovery=None):
         self.store = store
         self.ttl = float(ttl)
         self.interval = float(interval)
         self.on_failure = on_failure
+        self.on_recovery = on_recovery
         self._stop = threading.Event()
         self._thread = None
         self.dead: set[str] = set()
@@ -199,15 +204,23 @@ class Watchdog:
         return [k[len("/hb/"):] for k in self.store.keys("/hb/")]
 
     def check(self) -> list[str]:
-        """One sweep; returns newly-dead member names."""
-        newly = []
+        """One sweep; returns newly-dead member names. Members in
+        `self.dead` whose heartbeat turned fresh again (rejoined elastic
+        workers) are revived first and passed to `on_recovery`."""
+        newly, revived = [], []
         for m in self.members():
-            if m in self.dead:
-                continue
             age = self.store.heartbeat_age(m)
+            fresh = age is not None and age <= self.ttl
+            if m in self.dead:
+                if fresh:  # rejoined: clear dead state, resume monitoring
+                    self.dead.discard(m)
+                    revived.append(m)
+                continue
             if age is not None and age > self.ttl:
                 self.dead.add(m)
                 newly.append(m)
+        if revived and self.on_recovery is not None:
+            self.on_recovery(list(revived))
         if newly and self.on_failure is not None:
             self.on_failure(list(newly))
         return newly

@@ -13,7 +13,13 @@ import contextlib as _contextlib
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool"]
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PredictorPool",
+    # resilient serving runtime (serving.py)
+    "ServingPool", "ServingError", "DeadlineExceeded", "Overloaded",
+    "PoolClosed", "RequestFailed", "CircuitBreaker", "RetryPolicy",
+    "Deadline",
+]
 
 
 class Config:
@@ -61,6 +67,10 @@ class _Handle:
     def copy_to_cpu(self):
         return self._arr
 
+    def reset(self):
+        """Drop the staged array (pool hygiene between leases)."""
+        self._arr = None
+
     def reshape(self, shape):
         pass  # shapes are fixed by the exported program
 
@@ -102,6 +112,13 @@ class Predictor:
         """Either handle-style (copy_from_cpu then run()) or direct
         run([arrays]) -> list of numpy outputs."""
         if inputs is None:
+            unset = [n for n in self.get_input_names()
+                     if self._inputs[n].copy_to_cpu() is None]
+            if unset:
+                raise ValueError(
+                    f"input handle(s) {unset} were never set: call "
+                    f"get_input_handle(name).copy_from_cpu(array) for every "
+                    f"input before run()")
             inputs = [self._inputs[n].copy_to_cpu()
                       for n in self.get_input_names()]
         outs = self._layer(*inputs)
@@ -116,8 +133,19 @@ class Predictor:
 
     def get_output_handle(self, name):
         h = _Handle()
-        h.copy_from_cpu(self._outputs[name])
+        if self._outputs[name] is not None:
+            h.copy_from_cpu(self._outputs[name])
         return h
+
+    def reset_handles(self):
+        """Clear all staged input/output state. Pools call this when a
+        member is released after a failed request (or quarantined), so the
+        next lease can never silently reuse the previous request's
+        inputs."""
+        for h in self._inputs.values():
+            h.reset()
+        for n in self._outputs:
+            self._outputs[n] = None
 
 
 def create_predictor(config: Config) -> Predictor:
@@ -140,6 +168,7 @@ class PredictorPool:
 
     def __init__(self, config: Config, size: int = 1):
         import queue
+        import threading
 
         if size < 1:
             raise ValueError("pool size must be >= 1")
@@ -148,6 +177,10 @@ class PredictorPool:
         self._free: "queue.Queue[Predictor]" = queue.Queue()
         for p in self._preds:
             self._free.put(p)
+        self._lock = threading.Lock()
+        self._leased: set[int] = set()    # id(predictor) of in-flight leases
+        self._leases_granted = 0
+        self._dirty_releases = 0          # released after an exception
 
     def retrieve(self, idx: int) -> Predictor:
         if not 0 <= idx < len(self._preds):
@@ -167,7 +200,10 @@ class PredictorPool:
 
         Blocks while every member is in flight (or raises TimeoutError at
         with-entry if `timeout` seconds pass with none free); the member
-        returns to the pool on exit."""
+        returns to the pool on exit. If the request body raised, the
+        member's IO handles are cleared before it re-enters rotation, so
+        the next lease can never silently reuse the previous request's
+        inputs."""
         import queue
 
         try:
@@ -176,10 +212,34 @@ class PredictorPool:
             raise TimeoutError(
                 f"no free predictor within {timeout}s "
                 f"(all {len(self._preds)} members in flight)") from None
+        with self._lock:
+            self._leased.add(id(p))
+            self._leases_granted += 1
         try:
             yield p
+        except BaseException:
+            p.reset_handles()
+            with self._lock:
+                self._dirty_releases += 1
+            raise
         finally:
+            with self._lock:
+                self._leased.discard(id(p))
             self._free.put(p)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._preds),
+                    "in_flight": len(self._leased),
+                    "leases_granted": self._leases_granted,
+                    "dirty_releases": self._dirty_releases}
 
     def __len__(self):
         return len(self._preds)
+
+
+# the resilient runtime builds on Predictor/clone above — import last
+from .serving import (  # noqa: E402
+    ServingPool, ServingError, DeadlineExceeded, Overloaded, PoolClosed,
+    RequestFailed, CircuitBreaker, RetryPolicy, Deadline,
+)

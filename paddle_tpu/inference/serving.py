@@ -1,0 +1,844 @@
+"""paddle_tpu.inference.serving — resilient serving runtime.
+
+The plain `PredictorPool` (reference: paddle_infer::services::PredictorPool,
+fluid/inference/api/paddle_inference_api.h) stops at "clone once, lease per
+request": no deadlines, no backpressure, no failure handling — one crashed
+or wedged member silently degrades the whole pool. `ServingPool` is the
+production runtime on top of the same clone-sharing substrate:
+
+* **Deadlines** — every request carries a monotonic-clock `Deadline`
+  covering queue wait AND execution. Expired entries are failed with
+  `DeadlineExceeded` *before* compute is wasted (at admission, at dequeue,
+  and by a background sweep), and callers waiting on a result enforce the
+  same deadline themselves, so a request can never hang past it even if
+  the member executing it is wedged.
+
+* **Admission control** — a bounded queue (`max_queue_depth`). Beyond the
+  bound, requests are shed with a typed `Overloaded` error instead of
+  queueing unboundedly; after `shutdown()` admissions raise `PoolClosed`.
+
+* **Member supervision** — each member slot is driven by its own worker
+  thread. A transient execution error quarantines the member: its IO
+  handles are reset and it is replaced by re-cloning from the shared
+  executable (zero recompile — the AOT module is immutable). A per-slot
+  `CircuitBreaker` (trip after K consecutive failures → open; half-open
+  probe after a cooldown; close on success) keeps poisoned slots out of
+  rotation. Transient failures are retried with jittered exponential
+  backoff on another attempt; deterministic request errors (`ValueError` /
+  `TypeError`) fail fast with `RequestFailed` and are NOT retried and NOT
+  charged to the member. A member that hangs past a request's deadline is
+  detected by the supervisor, retired (its thread abandoned), and replaced
+  with a fresh clone, so capacity always converges back to `size`.
+
+* **Graceful drain** — `shutdown(drain_timeout)` stops admissions,
+  finishes in-flight and queued work within the timeout, then fails
+  whatever remains with `PoolClosed` and releases members.
+
+* **Observability** — `stats()` returns a counter snapshot obeying
+      admitted == completed + failed + timed_out + cancelled
+                  + queue_depth + in_flight
+  (shed requests were never admitted), plus per-member health.
+
+Fault injection: the `fault_hook(slot_index, request, predictor)`
+constructor arg is invoked on the member's worker thread immediately
+before execution — a raise is a member fault, a sleep is a member hang,
+and mutating `predictor`'s handles models member corruption. It exists
+for the harness in tools/serving_fault_injector.py (the serving twin of
+the checkpoint kill-at-phase injector) and for tests; leave it None in
+production.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import random
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "ServingError", "DeadlineExceeded", "Overloaded", "PoolClosed",
+    "RequestFailed", "Deadline", "CircuitBreaker", "RetryPolicy",
+    "ServingPool",
+]
+
+
+# ---------------------------------------------------------------------------
+# typed errors
+# ---------------------------------------------------------------------------
+
+class ServingError(RuntimeError):
+    """Base of every error the serving runtime raises for a request."""
+
+
+class DeadlineExceeded(ServingError, TimeoutError):
+    """The request's deadline (queue wait + execution) elapsed."""
+
+
+class Overloaded(ServingError):
+    """Shed at admission: the bounded queue is full."""
+
+
+class PoolClosed(Overloaded):
+    """Shed at admission (or cancelled in flight) because the pool is
+    shutting down."""
+
+
+class RequestFailed(ServingError):
+    """The request's execution raised. `cause` is the original exception,
+    `attempts` how many executions were tried (1 for deterministic
+    fail-fast errors)."""
+
+    def __init__(self, msg, cause=None, attempts=1):
+        super().__init__(msg)
+        self.cause = cause
+        self.attempts = attempts
+
+
+#: deterministic request errors: the request itself is malformed, so a
+#: different member / another attempt cannot help — fail fast, no retry,
+#: and no health penalty for the member that surfaced it.
+DETERMINISTIC_ERRORS = (ValueError, TypeError)
+
+
+# ---------------------------------------------------------------------------
+# deadline
+# ---------------------------------------------------------------------------
+
+class Deadline:
+    """Absolute monotonic-clock deadline. `seconds=None` never expires."""
+
+    def __init__(self, seconds=None, clock=time.monotonic):
+        self._clock = clock
+        self._at = None if seconds is None else clock() + float(seconds)
+
+    def remaining(self):
+        """Seconds left (may be negative); None if unbounded."""
+        return None if self._at is None else self._at - self._clock()
+
+    def expired(self):
+        return self._at is not None and self._clock() >= self._at
+
+    def __repr__(self):
+        r = self.remaining()
+        return f"Deadline(remaining={'inf' if r is None else f'{r:.3f}s'})"
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-member-slot breaker: CLOSED → (K consecutive failures) → OPEN →
+    (cooldown) → HALF_OPEN (one probe) → CLOSED on success / OPEN on
+    failure. Failure counts survive member re-cloning on purpose: the slot
+    is the unit of health, so a fault that re-cloning does not fix
+    eventually takes the slot out of rotation instead of burning a
+    re-clone per request forever."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold=3, reset_timeout=1.0, clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.reset_timeout = float(reset_timeout)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = None
+        self._probing = False
+        self.trips = 0
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._peek_state()
+
+    def _peek_state(self):
+        # lock held; promote OPEN → HALF_OPEN once the cooldown elapsed
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.reset_timeout):
+            self._state = self.HALF_OPEN
+            self._probing = False
+        return self._state
+
+    def allow(self):
+        """True if a request may be executed now. In HALF_OPEN only a
+        single probe is handed out until it resolves (or is returned via
+        `cancel_probe`)."""
+        with self._lock:
+            st = self._peek_state()
+            if st == self.CLOSED:
+                return True
+            if st == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def cancel_probe(self):
+        """Return an unused HALF_OPEN probe token (allow() granted but no
+        request was executed)."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self):
+        with self._lock:
+            self._state = self.CLOSED
+            self._consecutive = 0
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._consecutive += 1
+            st = self._peek_state()
+            if st == self.HALF_OPEN or self._consecutive >= self.threshold:
+                if self._state != self.OPEN:
+                    self.trips += 1
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probing = False
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+class RetryPolicy:
+    """Jittered exponential backoff for transient failures.
+
+    `max_retries` is the number of RE-executions after the first attempt
+    (so a request is executed at most max_retries + 1 times)."""
+
+    def __init__(self, max_retries=2, base_delay=0.02, max_delay=0.5,
+                 multiplier=2.0, jitter=0.5, rng=None):
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt):
+        """Backoff before re-execution number `attempt` (1-based)."""
+        d = min(self.max_delay,
+                self.base_delay * self.multiplier ** max(0, attempt - 1))
+        # full-jitter style: uniform in [d*(1-jitter), d]
+        return d * (1.0 - self.jitter * self._rng.random())
+
+
+# ---------------------------------------------------------------------------
+# request
+# ---------------------------------------------------------------------------
+
+_PENDING, _RUNNING, _DONE, _ABANDONED = range(4)
+
+
+class _Request:
+    """One admitted request: a callable over a leased predictor plus a
+    single-assignment result slot with abandon semantics (the caller may
+    give up at its deadline while a worker still holds the request; exactly
+    one side wins)."""
+
+    __slots__ = ("id", "fn", "deadline", "attempts", "on_timeout", "_lock",
+                 "_ev", "_state", "_value", "_error")
+
+    def __init__(self, rid, fn, deadline, on_timeout=None):
+        self.id = rid
+        self.fn = fn
+        self.deadline = deadline
+        self.attempts = 0
+        self.on_timeout = on_timeout  # pool stats hook (counted once)
+        self._lock = threading.Lock()
+        self._ev = threading.Event()
+        self._state = _PENDING
+        self._value = None
+        self._error = None
+
+    # -- state transitions (each returns whether the caller won) ----------
+    def mark_running(self):
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self._state = _RUNNING
+            return True
+
+    def mark_pending(self):
+        """Back to the queue (retry path)."""
+        with self._lock:
+            if self._state != _RUNNING:
+                return False
+            self._state = _PENDING
+            return True
+
+    def complete(self, value):
+        with self._lock:
+            if self._state in (_DONE, _ABANDONED):
+                return False
+            self._state = _DONE
+            self._value = value
+            self._ev.set()
+            return True
+
+    def fail(self, error):
+        with self._lock:
+            if self._state in (_DONE, _ABANDONED):
+                return False
+            self._state = _DONE
+            self._error = error
+            self._ev.set()
+            return True
+
+    def abandon(self, error):
+        """Caller-side deadline: mark the request dead so a late worker
+        result is discarded."""
+        with self._lock:
+            if self._state in (_DONE, _ABANDONED):
+                return False
+            self._state = _ABANDONED
+            self._error = error
+            self._ev.set()
+            return True
+
+    def done(self):
+        return self._ev.is_set()
+
+    def result(self, timeout=None):
+        """Block until the request resolves, its own deadline passes, or
+        `timeout` elapses — whichever is first. The request's deadline is
+        enforced HERE as well as on the worker side, so result() returns
+        (with `DeadlineExceeded`) even if the executing member is wedged."""
+        limit = self.deadline.remaining()
+        if timeout is not None and (limit is None or timeout < limit):
+            limit = timeout
+        if not self._ev.wait(limit):
+            if self.deadline.expired():
+                err = DeadlineExceeded(
+                    f"request {self.id} exceeded its deadline "
+                    f"(member wedged or pool saturated)")
+                if self.abandon(err) and self.on_timeout is not None:
+                    self.on_timeout(self)
+                raise err
+            raise TimeoutError(
+                f"request {self.id} not resolved within {timeout}s "
+                f"(deadline not yet reached — call result() again)")
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            return self._value
+
+
+# ---------------------------------------------------------------------------
+# member slot
+# ---------------------------------------------------------------------------
+
+class _MemberSlot:
+    """One unit of serving capacity: a predictor clone driven by a
+    dedicated worker thread, plus the slot's health record. The breaker
+    and counters belong to the slot INDEX (they are carried over when the
+    member is re-cloned or the whole slot is replaced after a wedge)."""
+
+    __slots__ = ("index", "predictor", "breaker", "generation", "retired",
+                 "thread", "current", "failures", "reclones", "completed")
+
+    def __init__(self, index, predictor, breaker, generation=0):
+        self.index = index
+        self.predictor = predictor
+        self.breaker = breaker
+        self.generation = generation
+        self.retired = False
+        self.thread = None
+        self.current = None          # in-flight _Request, worker-owned
+        self.failures = 0
+        self.reclones = 0
+        self.completed = 0
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class ServingPool:
+    """Resilient predictor pool: bounded admission, deadlines, supervised
+    members, circuit breaking, retries, graceful drain. See the module
+    docstring for semantics and docs/serving.md for the full contract.
+
+        pool = ServingPool(Config(path), size=4, max_queue_depth=64,
+                           default_timeout=0.5)
+        try:
+            logits, = pool.infer([batch])          # sync convenience
+        except DeadlineExceeded: ...
+        except Overloaded: ...
+        except RequestFailed as e: ... e.cause ...
+        pool.shutdown(drain_timeout=5.0)
+
+    `submit(fn, timeout=...)` is the generic form: `fn(predictor)` runs on
+    the leased member's worker thread and must return materialized results
+    (the member's handles are reset between requests). Pass `predictor=`
+    instead of `config` to build the pool over an existing Predictor.
+    """
+
+    def __init__(self, config=None, size=1, *, predictor=None,
+                 max_queue_depth=64, default_timeout=None,
+                 breaker_threshold=3, breaker_reset_timeout=1.0,
+                 retry=None, hang_grace=0.1, supervise_interval=0.02,
+                 fault_hook=None, clock=time.monotonic):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        if max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        if predictor is None:
+            if config is None:
+                raise ValueError("ServingPool needs a Config or predictor=")
+            from . import Predictor
+            predictor = Predictor(config)
+        self._base = predictor
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_timeout = default_timeout
+        self.hang_grace = float(hang_grace)
+        self._supervise_interval = float(supervise_interval)
+        self._clock = clock
+        self._retry = retry if retry is not None else RetryPolicy()
+        self._fault_hook = fault_hook
+        self._breaker_args = (breaker_threshold, breaker_reset_timeout)
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._retry_timers: dict = {}      # _Request -> threading.Timer
+        self._ids = itertools.count()
+        self._closed = False               # admissions stopped
+        self._stopping = False             # workers must exit
+        self._shutdown_called = False
+        self._drained = False
+
+        # counters (all guarded by self._lock)
+        self._admitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._timed_out = 0
+        self._cancelled = 0
+        self._shed = 0
+        self._retried = 0
+        self._wedged = 0
+        self._late_results = 0
+
+        self._slots = []
+        for i in range(size):
+            member = predictor if i == 0 else predictor.clone()
+            slot = _MemberSlot(i, member,
+                               CircuitBreaker(breaker_threshold,
+                                              breaker_reset_timeout,
+                                              clock=clock))
+            self._slots.append(slot)
+            self._start_worker(slot)
+
+        self._sup_stop = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, name="ServingPool-supervisor",
+            daemon=True)
+        self._supervisor.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, fn, timeout=None) -> _Request:
+        """Admit `fn(predictor) -> result` with a deadline of `timeout`
+        seconds (None → `default_timeout`; both None → no deadline)
+        covering queue wait AND execution. Returns a future-like request:
+        call `.result()` for the value or the typed error. Raises
+        `Overloaded` / `PoolClosed` / `DeadlineExceeded` at admission when
+        shedding."""
+        eff = self.default_timeout if timeout is None else timeout
+        dl = Deadline(eff, clock=self._clock)
+        with self._cv:
+            if self._closed:
+                self._shed += 1
+                raise PoolClosed("pool is shut down — admission refused")
+            if dl.expired():
+                self._shed += 1
+                raise DeadlineExceeded(
+                    "dead on arrival: deadline expired before admission")
+            if len(self._queue) + len(self._retry_timers) \
+                    >= self.max_queue_depth:
+                self._shed += 1
+                raise Overloaded(
+                    f"admission queue full ({self.max_queue_depth} deep) — "
+                    f"request shed; retry with backoff or scale the pool")
+            req = _Request(next(self._ids), fn, dl,
+                           on_timeout=self._on_caller_timeout)
+            self._queue.append(req)
+            self._admitted += 1
+            self._cv.notify()
+        return req
+
+    def infer(self, feeds, timeout=None):
+        """Synchronous convenience: run the exported program over `feeds`
+        (list of arrays) on some healthy member; returns the list of
+        output arrays or raises the typed serving error."""
+        feeds = [np.asarray(f) for f in feeds]
+
+        def _run(pred):
+            return pred.run(feeds)
+
+        return self.submit(_run, timeout=timeout).result()
+
+    def _on_caller_timeout(self, req):
+        with self._lock:
+            self._timed_out += 1
+
+    # -- worker ------------------------------------------------------------
+    def _start_worker(self, slot):
+        t = threading.Thread(
+            target=self._worker_loop, args=(slot,),
+            name=f"ServingPool-worker-{slot.index}-g{slot.generation}",
+            daemon=True)
+        slot.thread = t
+        t.start()
+
+    def _worker_loop(self, slot):
+        br = slot.breaker
+        while True:
+            if slot.retired or self._stopping:
+                return
+            if not br.allow():
+                # out of rotation (breaker open): wait out the cooldown
+                time.sleep(min(0.01, self._supervise_interval))
+                continue
+            req = None
+            with self._cv:
+                if not self._queue:
+                    if self._closed and not self._retry_timers \
+                            and all(s.current is None for s in self._slots):
+                        br.cancel_probe()
+                        return          # drained: no work can appear
+                    self._cv.wait(0.02)
+                while self._queue:
+                    cand = self._queue.popleft()
+                    if cand.done():
+                        continue        # abandoned/failed while queued
+                    if cand.deadline.expired():
+                        if cand.fail(DeadlineExceeded(
+                                f"request {cand.id} expired after queue "
+                                f"wait, before execution")):
+                            self._timed_out += 1
+                        continue
+                    req = cand
+                    break
+            if req is None or not req.mark_running():
+                br.cancel_probe()
+                continue
+            slot.current = req
+            req.attempts += 1
+            try:
+                if self._fault_hook is not None:
+                    self._fault_hook(slot.index, req, slot.predictor)
+                result = req.fn(slot.predictor)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                self._on_execution_error(slot, req, exc)
+            else:
+                self._reset_member(slot)
+                if not slot.retired:
+                    # a retired (wedged) worker's late success must not
+                    # touch the shared breaker: it would erase the wedge
+                    # failures and a repeatedly-hanging member could never
+                    # trip it
+                    br.record_success()
+                with self._lock:
+                    if req.complete(result):
+                        self._completed += 1
+                        slot.completed += 1
+                    else:
+                        self._late_results += 1
+            finally:
+                slot.current = None
+
+    def _reset_member(self, slot):
+        try:
+            slot.predictor.reset_handles()
+        except Exception:
+            pass  # a member too broken to reset is replaced on next fault
+
+    def _on_execution_error(self, slot, req, exc):
+        self._reset_member(slot)
+        if slot.retired:
+            # late failure of a wedged worker: the supervisor already
+            # failed the request and charged the breaker — just account
+            with self._lock:
+                if req.fail(RequestFailed(
+                        f"request {req.id} failed on a retired member: "
+                        f"{type(exc).__name__}: {exc}",
+                        cause=exc, attempts=req.attempts)):
+                    self._failed += 1
+                else:
+                    self._late_results += 1
+            return
+        if isinstance(exc, DETERMINISTIC_ERRORS):
+            # the request is malformed — the member executed fine: fail
+            # fast, never retry, no health penalty for the slot
+            slot.breaker.record_success()
+            err = RequestFailed(
+                f"request {req.id} failed deterministically "
+                f"({type(exc).__name__}) — not retried: {exc}",
+                cause=exc, attempts=req.attempts)
+            err.__cause__ = exc
+            with self._lock:
+                if req.fail(err):
+                    self._failed += 1
+                else:
+                    self._late_results += 1
+            return
+        # transient member fault: quarantine + breaker + maybe retry
+        with self._lock:
+            slot.failures += 1
+        slot.breaker.record_failure()
+        self._quarantine(slot)
+        delay = self._retry.delay(req.attempts)
+        rem = req.deadline.remaining()
+        if req.attempts <= self._retry.max_retries \
+                and (rem is None or rem > delay) and req.mark_pending():
+            with self._lock:
+                self._retried += 1
+            self._schedule_requeue(req, delay)
+            return
+        err = RequestFailed(
+            f"request {req.id} failed after {req.attempts} attempt(s): "
+            f"{type(exc).__name__}: {exc}",
+            cause=exc, attempts=req.attempts)
+        err.__cause__ = exc
+        with self._lock:
+            if req.fail(err):
+                self._failed += 1
+            else:
+                self._late_results += 1
+
+    def _quarantine(self, slot):
+        """Replace the slot's member with a fresh clone of the shared
+        executable (handles already reset). The old member is dropped; the
+        slot's breaker and counters persist."""
+        try:
+            fresh = self._base.clone()
+        except Exception:
+            return  # keep the reset member rather than losing the slot
+        with self._lock:
+            slot.predictor = fresh
+            slot.reclones += 1
+            slot.generation += 1
+
+    def _schedule_requeue(self, req, delay):
+        with self._lock:
+            if self._stopping:
+                if req.fail(PoolClosed(
+                        "pool shut down before the retry could run")):
+                    self._cancelled += 1
+                return
+            t = threading.Timer(delay, self._requeue, args=(req,))
+            t.daemon = True
+            self._retry_timers[req] = t
+            t.start()
+
+    def _requeue(self, req):
+        with self._cv:
+            self._retry_timers.pop(req, None)
+            if req.done():
+                return
+            if self._stopping:
+                if req.fail(PoolClosed(
+                        "pool shut down before the retry could run")):
+                    self._cancelled += 1
+                return
+            if req.deadline.expired():
+                if req.fail(DeadlineExceeded(
+                        f"request {req.id} expired during retry backoff")):
+                    self._timed_out += 1
+                return
+            self._queue.appendleft(req)  # retries resume at the front
+            self._cv.notify()
+
+    # -- supervision -------------------------------------------------------
+    def _supervise_loop(self):
+        while not self._sup_stop.wait(self._supervise_interval):
+            try:
+                self._sweep_expired_queue()
+                self._sweep_wedged()
+            except Exception:
+                pass  # the supervisor must never die
+
+    def _sweep_expired_queue(self):
+        """Fail queued entries whose deadline passed before any worker got
+        to them (keeps fire-and-forget submits from lingering)."""
+        with self._cv:
+            if not self._queue:
+                return
+            live = collections.deque()
+            for req in self._queue:
+                if req.done():
+                    continue
+                if req.deadline.expired():
+                    if req.fail(DeadlineExceeded(
+                            f"request {req.id} expired in queue")):
+                        self._timed_out += 1
+                    continue
+                live.append(req)
+            self._queue = live
+
+    def _sweep_wedged(self):
+        """Detect members stuck past an in-flight request's deadline by
+        more than `hang_grace`: fail the request, retire the worker (its
+        thread is abandoned — it exits when the hang ends), and restore
+        capacity with a fresh clone on a new worker thread."""
+        if self._stopping:
+            return
+        for i, slot in enumerate(list(self._slots)):
+            if slot.retired:
+                # a previous sweep failed to replace this slot (clone
+                # raised): keep retrying so capacity is never lost
+                self._replace_slot(i, slot)
+                continue
+            req = slot.current
+            if req is None:
+                continue
+            rem = req.deadline.remaining()
+            if rem is None or rem > -self.hang_grace:
+                continue
+            slot.retired = True
+            slot.breaker.record_failure()
+            with self._lock:
+                self._wedged += 1
+                if req.fail(DeadlineExceeded(
+                        f"request {req.id} wedged its member past the "
+                        f"deadline; member {i} replaced")):
+                    self._timed_out += 1
+            self._replace_slot(i, slot)
+
+    def _replace_slot(self, i, old):
+        """Install a fresh clone + worker at slot index `i` in place of the
+        retired `old`. A clone failure leaves the retired slot installed;
+        the supervisor retries on every sweep until replacement succeeds."""
+        if self._slots[i] is not old:
+            return  # already replaced
+        try:
+            fresh = self._base.clone()
+        except Exception:
+            return
+        new_slot = _MemberSlot(i, fresh, old.breaker,
+                               generation=old.generation + 1)
+        new_slot.failures = old.failures + 1
+        new_slot.reclones = old.reclones + 1
+        new_slot.completed = old.completed
+        self._slots[i] = new_slot
+        self._start_worker(new_slot)
+
+    # -- drain / shutdown --------------------------------------------------
+    def shutdown(self, drain_timeout=30.0):
+        """Graceful drain: stop admissions immediately, let in-flight and
+        queued requests (and their scheduled retries) finish for up to
+        `drain_timeout` seconds, then fail whatever remains with
+        `PoolClosed` and stop the workers. Returns True if the pool fully
+        drained within the timeout. Idempotent.
+
+        The default is a bounded 30s so `with ServingPool(...)` can never
+        hang the process on a member wedged under a deadline-less request;
+        pass `drain_timeout=None` to explicitly wait indefinitely."""
+        with self._cv:
+            if self._shutdown_called:
+                already = self._drained
+                # fallthrough: a second call just reports the outcome
+                return already
+            self._shutdown_called = True
+            self._closed = True
+            self._cv.notify_all()
+        dl = Deadline(drain_timeout, clock=self._clock)
+        drained = self._wait_idle(dl)
+        with self._cv:
+            for req, timer in list(self._retry_timers.items()):
+                timer.cancel()
+                if not req.done() and req.fail(PoolClosed(
+                        "pool shut down before the retry could run")):
+                    self._cancelled += 1
+            self._retry_timers.clear()
+            while self._queue:
+                req = self._queue.popleft()
+                if not req.done() and req.fail(PoolClosed(
+                        "pool shut down before the request ran")):
+                    self._cancelled += 1
+            self._stopping = True
+            self._cv.notify_all()
+        for slot in self._slots:
+            req = slot.current
+            if req is not None and not req.done():
+                if req.fail(PoolClosed(
+                        "pool shut down before the request completed")):
+                    with self._lock:
+                        self._cancelled += 1
+        self._sup_stop.set()
+        self._supervisor.join(timeout=1.0)
+        for slot in self._slots:
+            if slot.thread is not None:
+                slot.thread.join(timeout=0.5)
+        self._drained = drained
+        return drained
+
+    def _wait_idle(self, dl):
+        while True:
+            with self._cv:
+                idle = (not self._queue and not self._retry_timers
+                        and all(s.current is None for s in self._slots))
+            if idle:
+                return True
+            if dl.expired():
+                return False
+            time.sleep(min(0.005, self._supervise_interval))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- observability -----------------------------------------------------
+    def stats(self):
+        """Counter snapshot. Conservation law (quiesced pool):
+        admitted == completed + failed + timed_out + cancelled; at any
+        instant the right side also includes queue_depth + in_flight (and
+        a transiently-handed-off request or two)."""
+        with self._lock:
+            members = []
+            for slot in self._slots:
+                alive = (not slot.retired and slot.thread is not None
+                         and slot.thread.is_alive())
+                members.append({
+                    "index": slot.index,
+                    "generation": slot.generation,
+                    "alive": alive,
+                    "breaker": slot.breaker.state,
+                    "failures": slot.failures,
+                    "reclones": slot.reclones,
+                    "completed": slot.completed,
+                    "in_flight": slot.current is not None,
+                })
+            healthy = sum(1 for m in members
+                          if m["alive"] and m["breaker"] == "closed")
+            return {
+                "size": len(self._slots),
+                "healthy": healthy,
+                "closed": self._closed,
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "timed_out": self._timed_out,
+                "cancelled": self._cancelled,
+                "shed": self._shed,
+                "retried": self._retried,
+                "wedged": self._wedged,
+                "late_results": self._late_results,
+                "reclones": sum(m["reclones"] for m in members),
+                "breaker_trips": sum(s.breaker.trips for s in self._slots),
+                "queue_depth": len(self._queue) + len(self._retry_timers),
+                "in_flight": sum(1 for m in members if m["in_flight"]),
+                "members": members,
+            }
+
+    def __len__(self):
+        return len(self._slots)

@@ -127,6 +127,54 @@ def test_predictor_clone_and_pool_concurrent(tmp_path):
         pool.retrieve(3)
 
 
+def test_run_with_unset_handle_raises_naming_it(tmp_path):
+    """Handle-style run() with an input handle nobody set must not feed
+    None into the program — it names the unset handle instead."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "unset" / "infer")
+    paddle.jit.save(model, path, input_spec=[
+        paddle.to_tensor(np.ones((1, 8), np.float32))])
+    pred = create_predictor(Config(path))
+    with pytest.raises(ValueError, match="input_0.*never set"):
+        pred.run()
+    # after setting it, the same predictor works
+    pred.get_input_handle("input_0").copy_from_cpu(
+        np.ones((1, 8), np.float32))
+    assert pred.run()[0].shape == (1, 4)
+
+
+def test_pool_release_after_exception_clears_handles(tmp_path):
+    """A member released after the request body raised must come back with
+    clean IO handles: the next lease cannot silently reuse the previous
+    request's inputs."""
+    from paddle_tpu.inference import Config, PredictorPool
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "dirty" / "infer")
+    paddle.jit.save(model, path, input_spec=[
+        paddle.to_tensor(np.ones((2, 8), np.float32))])
+    pool = PredictorPool(Config(path), size=1)
+
+    stale = np.full((2, 8), 123.0, np.float32)
+    with pytest.raises(RuntimeError, match="request exploded"):
+        with pool.acquire() as p:
+            p.get_input_handle("input_0").copy_from_cpu(stale)
+            raise RuntimeError("request exploded")
+
+    with pool.acquire(timeout=1) as p:
+        # the stale input is gone: handle-style run() refuses to reuse it
+        assert p.get_input_handle("input_0").copy_to_cpu() is None
+        with pytest.raises(ValueError, match="never set"):
+            p.run()
+    s = pool.stats()
+    assert s["dirty_releases"] == 1 and s["in_flight"] == 0
+    assert s["leases_granted"] == 2
+
+
 def test_pool_acquire_timeout(tmp_path):
     from paddle_tpu.inference import Config, PredictorPool
 

@@ -38,6 +38,77 @@ def test_loopback_error_propagates():
         rpc.shutdown()
 
 
+def _slow():
+    import time
+
+    time.sleep(0.4)
+    return 7
+
+
+def test_future_timeout_deregisters_and_abandons():
+    """A wait(timeout) that times out must not leak the pending future:
+    it is deregistered immediately and the late result is dropped (the
+    future stays abandoned — documented semantics)."""
+    import time
+
+    rpc.init_rpc("worker0")
+    try:
+        fut = rpc.rpc_async("worker0", _slow)
+        assert len(rpc._state["pending"]) == 1
+        with pytest.raises(TimeoutError, match="abandoned"):
+            fut.wait(timeout=0.05)
+        assert len(rpc._state["pending"]) == 0  # deregistered, no leak
+        time.sleep(0.6)          # the call finishes on the worker...
+        assert not fut.done()    # ...but the abandoned future drops it
+        with pytest.raises(TimeoutError, match="abandoned"):
+            fut.wait(timeout=0.05)  # every later wait keeps raising
+        # completed futures deregister themselves too
+        ok = rpc.rpc_async("worker0", _add, args=(1, 2))
+        assert ok.wait(timeout=10) == 3
+        assert len(rpc._state["pending"]) == 0
+    finally:
+        rpc.shutdown()
+
+
+def test_future_abandon_wakes_concurrent_waiters():
+    """Abandoning a future on timeout must wake a second waiter blocked in
+    wait() — reported as the timeout it is, never a remote error, never a
+    hang."""
+    import threading
+    import time
+
+    rpc.init_rpc("worker0")
+    try:
+        fut = rpc.rpc_async("worker0", _slow)
+        caught = {}
+
+        def waiter():
+            try:
+                fut.wait()  # unbounded: only the abandon can wake it
+            except Exception as e:  # noqa: BLE001 — asserted below
+                caught["e"] = e
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with pytest.raises(TimeoutError):
+            fut.wait(timeout=0.05)
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert isinstance(caught["e"], TimeoutError)
+    finally:
+        rpc.shutdown()
+
+
+def test_shutdown_fails_pending_futures():
+    rpc.init_rpc("worker0")
+    fut = rpc.rpc_async("worker0", _slow)
+    rpc.shutdown()
+    assert len(rpc._state["pending"]) == 0
+    with pytest.raises(RuntimeError, match="shut down"):
+        fut.wait(timeout=1)
+
+
 def _rpc_worker():
     import paddle_tpu.distributed as dist
     from paddle_tpu.distributed import rpc as R

@@ -1,0 +1,410 @@
+"""Resilient serving runtime (paddle_tpu/inference/serving.py): deadline,
+admission-control/shedding, circuit-breaker state machine, retry
+classification, graceful drain, and a multi-threaded stress run under
+injected faults. Uses a fake exported layer so no XLA compile is paid —
+the real-model end-to-end path is covered by test_serving_fault_injection
+and test_inference_export."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (
+    CircuitBreaker, Deadline, DeadlineExceeded, Overloaded, PoolClosed,
+    Predictor, RequestFailed, RetryPolicy, ServingPool,
+)
+
+
+class _Out:
+    def __init__(self, a):
+        self._a = a
+
+    def numpy(self):
+        return self._a
+
+
+class _FakeLayer:
+    """Minimal TranslatedLayer stand-in: doubles its input."""
+
+    input_spec = [{"shape": [2], "dtype": "float32"}]
+    num_outputs = 1
+
+    def __call__(self, x):
+        return _Out(np.asarray(x) * 2.0)
+
+
+def _pool(**kw):
+    kw.setdefault("max_queue_depth", 16)
+    kw.setdefault("default_timeout", 5.0)
+    return ServingPool(predictor=Predictor(None, _shared_layer=_FakeLayer()),
+                       **kw)
+
+
+# ---------------------------------------------------------------------------
+# deadline
+# ---------------------------------------------------------------------------
+
+def test_deadline_basics():
+    d = Deadline(0.05)
+    assert not d.expired() and d.remaining() > 0
+    time.sleep(0.08)
+    assert d.expired() and d.remaining() < 0
+    assert not Deadline(None).expired()
+    assert Deadline(None).remaining() is None
+
+
+def test_infer_roundtrip_and_shutdown():
+    with _pool(size=2) as pool:
+        out, = pool.infer([np.ones(2, np.float32)])
+        np.testing.assert_allclose(out, np.full(2, 2.0))
+        assert len(pool) == 2
+    # context exit shut the pool down: admissions now refused, typed
+    with pytest.raises(PoolClosed):
+        pool.submit(lambda p: None)
+
+
+def test_dead_on_arrival_deadline_is_shed():
+    pool = _pool(size=1)
+    try:
+        with pytest.raises(DeadlineExceeded, match="dead on arrival"):
+            pool.submit(lambda p: None, timeout=-1.0)
+        assert pool.stats()["shed"] == 1
+        assert pool.stats()["admitted"] == 0
+    finally:
+        pool.shutdown(1)
+
+
+def test_deadline_covers_queue_wait():
+    """A request that spends its whole deadline queued behind a slow one
+    fails with DeadlineExceeded without ever executing."""
+    gate = threading.Event()
+    pool = _pool(size=1)
+    try:
+        blocker = pool.submit(lambda p: (gate.wait(5), "done")[1])
+        time.sleep(0.05)  # the single worker is now occupied
+        ran = []
+        queued = pool.submit(lambda p: ran.append(1), timeout=0.15)
+        with pytest.raises(DeadlineExceeded):
+            queued.result()
+        gate.set()
+        assert blocker.result() == "done"
+        assert ran == []  # compute was never wasted on the expired request
+        assert pool.stats()["timed_out"] == 1
+    finally:
+        pool.shutdown(1)
+
+
+def test_wedged_member_detected_and_replaced():
+    pool = _pool(size=1, hang_grace=0.05, supervise_interval=0.01)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            pool.submit(lambda p: time.sleep(0.6), timeout=0.15).result()
+        # the caller was released at its deadline, not after the hang
+        assert time.monotonic() - t0 < 0.45
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            s = pool.stats()
+            if s["healthy"] == 1 and s["wedged"] == 1:
+                break
+            time.sleep(0.02)
+        s = pool.stats()
+        assert s["wedged"] == 1 and s["healthy"] == 1, s
+        # replacement member serves correctly
+        out, = pool.infer([np.ones(2, np.float32)], timeout=2.0)
+        np.testing.assert_allclose(out, np.full(2, 2.0))
+    finally:
+        pool.shutdown(1)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_overload_shedding_and_recovery():
+    gate = threading.Event()
+    pool = _pool(size=1, max_queue_depth=2)
+    try:
+        blocker = pool.submit(lambda p: (gate.wait(5), "ok")[1])
+        time.sleep(0.05)
+        accepted = [pool.submit(lambda p: "fast") for _ in range(2)]
+        shed = 0
+        for _ in range(5):
+            with pytest.raises(Overloaded, match="queue full"):
+                pool.submit(lambda p: "never")
+            shed += 1
+        gate.set()
+        assert blocker.result() == "ok"
+        assert [f.result() for f in accepted] == ["fast", "fast"]
+        s = pool.stats()
+        assert s["shed"] == shed == 5
+        assert s["admitted"] == 3 and s["completed"] == 3
+    finally:
+        pool.shutdown(1)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (fake clock — no sleeps)
+# ---------------------------------------------------------------------------
+
+def test_breaker_transitions():
+    now = [0.0]
+    br = CircuitBreaker(threshold=3, reset_timeout=10.0, clock=lambda: now[0])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # below threshold
+    br.record_failure()
+    assert br.state == "open" and br.trips == 1
+    assert not br.allow()
+    now[0] = 9.9
+    assert not br.allow()        # cooldown not elapsed
+    now[0] = 10.0
+    assert br.state == "half_open"
+    assert br.allow()            # the single probe
+    assert not br.allow()        # no second probe while one is out
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_probe_failure_reopens_and_cancel_probe():
+    now = [0.0]
+    br = CircuitBreaker(threshold=1, reset_timeout=5.0, clock=lambda: now[0])
+    br.record_failure()
+    assert br.state == "open"
+    now[0] = 5.0
+    assert br.allow()            # half-open probe
+    br.record_failure()          # probe failed -> straight back to open
+    assert br.state == "open" and br.trips == 2
+    now[0] = 10.0
+    assert br.allow()
+    br.cancel_probe()            # probe returned unused
+    assert br.allow()            # so another taker can have it
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_consecutive_failures_reset_on_success():
+    br = CircuitBreaker(threshold=3, reset_timeout=1.0)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # never 3 consecutive
+
+
+# ---------------------------------------------------------------------------
+# failure classification / retry
+# ---------------------------------------------------------------------------
+
+def test_deterministic_error_fails_fast_no_retry():
+    calls = []
+    pool = _pool(size=1)
+    try:
+        def bad(p):
+            calls.append(1)
+            raise ValueError("malformed request")
+
+        with pytest.raises(RequestFailed) as ei:
+            pool.submit(bad, timeout=2).result()
+        assert isinstance(ei.value.cause, ValueError)
+        assert ei.value.attempts == 1 and len(calls) == 1
+        s = pool.stats()
+        assert s["retried"] == 0 and s["reclones"] == 0 and s["failed"] == 1
+        assert s["members"][0]["breaker"] == "closed"  # no health penalty
+    finally:
+        pool.shutdown(1)
+
+
+def test_transient_error_retried_on_fresh_clone():
+    seen = []
+    pool = _pool(size=1,
+                 retry=RetryPolicy(max_retries=2, base_delay=0.005,
+                                   max_delay=0.02))
+    try:
+        def flaky(p):
+            seen.append(id(p))
+            if len(seen) < 3:
+                raise RuntimeError("transient")
+            return "recovered"
+
+        assert pool.submit(flaky, timeout=5).result() == "recovered"
+        assert len(seen) == 3
+        assert len(set(seen)) == 3  # every attempt ran on a fresh clone
+        s = pool.stats()
+        assert s["retried"] == 2 and s["reclones"] == 2
+        assert s["completed"] == 1 and s["failed"] == 0
+    finally:
+        pool.shutdown(1)
+
+
+def test_retry_budget_exhaustion_is_typed():
+    pool = _pool(size=1,
+                 retry=RetryPolicy(max_retries=1, base_delay=0.005,
+                                   max_delay=0.01))
+    try:
+        def always(p):
+            raise RuntimeError("permanent transient-looking fault")
+
+        with pytest.raises(RequestFailed) as ei:
+            pool.submit(always, timeout=5).result()
+        assert ei.value.attempts == 2  # 1 try + 1 retry
+        assert isinstance(ei.value.cause, RuntimeError)
+    finally:
+        pool.shutdown(1)
+
+
+def test_poisoned_slot_trips_breaker_then_heals():
+    poisoned = {"on": True}
+
+    def hook(slot, req, pred):
+        if poisoned["on"] and slot == 0:
+            raise RuntimeError("poisoned")
+
+    pool = _pool(size=2, breaker_threshold=3, breaker_reset_timeout=0.2,
+                 fault_hook=hook,
+                 retry=RetryPolicy(max_retries=2, base_delay=0.005,
+                                   max_delay=0.02))
+    try:
+        for _ in range(16):
+            out, = pool.infer([np.ones(2, np.float32)], timeout=3.0)
+            np.testing.assert_allclose(out, np.full(2, 2.0))
+        s = pool.stats()
+        assert s["breaker_trips"] >= 1, s
+        assert s["healthy"] == 1  # slot 0 out of rotation, slot 1 serving
+        poisoned["on"] = False
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            pool.infer([np.ones(2, np.float32)], timeout=2.0)
+            if pool.stats()["healthy"] == 2:
+                break
+            time.sleep(0.02)
+        assert pool.stats()["healthy"] == 2  # probe closed the breaker
+    finally:
+        pool.shutdown(1)
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+def test_graceful_drain_finishes_inflight_and_queued():
+    gate = threading.Event()
+    pool = _pool(size=1)
+    inflight = pool.submit(lambda p: (gate.wait(5), "inflight")[1])
+    queued = pool.submit(lambda p: "queued")
+    time.sleep(0.05)
+
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(pool.shutdown(drain_timeout=5)))
+    t.start()
+    time.sleep(0.1)
+    with pytest.raises(PoolClosed):   # admissions stopped immediately
+        pool.submit(lambda p: None)
+    gate.set()
+    t.join(timeout=5)
+    assert done == [True]             # fully drained
+    assert inflight.result() == "inflight"
+    assert queued.result() == "queued"
+    s = pool.stats()
+    assert s["cancelled"] == 0 and s["completed"] == 2
+
+
+def test_drain_timeout_cancels_leftovers_typed():
+    gate = threading.Event()
+    pool = _pool(size=1)
+    stuck = pool.submit(lambda p: (gate.wait(10), "late")[1])
+    waiting = pool.submit(lambda p: "queued")
+    time.sleep(0.05)
+    assert pool.shutdown(drain_timeout=0.1) is False
+    with pytest.raises(PoolClosed):
+        waiting.result(timeout=1)
+    with pytest.raises(PoolClosed):
+        stuck.result(timeout=1)
+    gate.set()
+    s = pool.stats()
+    assert s["cancelled"] == 2
+    assert s["admitted"] == s["completed"] + s["failed"] + s["timed_out"] \
+        + s["cancelled"]
+
+
+def test_shutdown_idempotent():
+    pool = _pool(size=1)
+    assert pool.shutdown(1) is True
+    assert pool.shutdown(1) is True
+
+
+# ---------------------------------------------------------------------------
+# multi-threaded stress under injected faults
+# ---------------------------------------------------------------------------
+
+def test_stress_no_double_lease_no_lost_member_stats_consistent():
+    """ThreadPoolExecutor hammers the pool while a fault hook injects
+    crashes and a hang: no two requests may ever execute concurrently on
+    one predictor object, no member may be lost, and the stats
+    conservation law must hold at quiesce."""
+    import concurrent.futures
+
+    lock = threading.Lock()
+    running = {}
+    max_conc = [0]
+    hung = [False]
+
+    def hook(slot, req, pred):
+        if slot == 0 and req.id % 9 == 4 and req.attempts == 1:
+            raise RuntimeError("injected crash")
+        if slot == 1 and not hung[0] and req.id > 20:
+            hung[0] = True
+            time.sleep(0.6)   # one wedge: supervisor must replace slot 1
+
+    pool = _pool(size=3, max_queue_depth=128, default_timeout=2.0,
+                 hang_grace=0.05, supervise_interval=0.01, fault_hook=hook,
+                 retry=RetryPolicy(max_retries=2, base_delay=0.005,
+                                   max_delay=0.02))
+
+    def request(i):
+        def fn(pred):
+            with lock:
+                n = running.get(id(pred), 0) + 1
+                running[id(pred)] = n
+                max_conc[0] = max(max_conc[0], n)
+            try:
+                time.sleep(0.001)
+                out = pred.run([np.full(2, float(i), np.float32)])
+            finally:
+                with lock:
+                    running[id(pred)] -= 1
+            return out
+        try:
+            out, = pool.submit(fn, timeout=2.0).result()
+            np.testing.assert_allclose(out, np.full(2, 2.0 * i))
+            return "ok"
+        except (DeadlineExceeded, Overloaded, RequestFailed) as e:
+            return type(e).__name__
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+            results = list(ex.map(request, range(120)))
+        assert max_conc[0] == 1, "double-lease: concurrent use of a member"
+        ok = results.count("ok")
+        assert ok >= 100, results  # faults affected only a small fraction
+        # quiesce, then the books must balance and capacity must be whole
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            s = pool.stats()
+            if s["queue_depth"] == 0 and s["in_flight"] == 0 \
+                    and s["healthy"] == 3:
+                break
+            time.sleep(0.02)
+        s = pool.stats()
+        assert s["healthy"] == 3, s          # no lost member
+        assert s["queue_depth"] == 0 and s["in_flight"] == 0, s
+        assert s["admitted"] == 120
+        assert s["admitted"] == s["completed"] + s["failed"] \
+            + s["timed_out"] + s["cancelled"], s
+        assert s["completed"] == ok
+    finally:
+        assert pool.shutdown(drain_timeout=2) is True

@@ -1,0 +1,21 @@
+"""Tier-1 registration of the serving fault-injection harness
+(tools/serving_fault_injector.py): inject crash / hang / poison / corrupt
+faults into live ServingPool members and prove the pool always converges
+back to full healthy capacity with no stuck leases, and that every admitted
+request either completes bit-correct or fails with a documented typed error
+— never hangs. Running it in the suite makes resilience regressions fail
+CI, mirroring tests/test_ckpt_fault_injection.py for checkpoints."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARNESS = os.path.join(REPO, "tools", "serving_fault_injector.py")
+
+
+def test_every_fault_phase_converges_to_healthy():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, HARNESS], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    assert "RESULT: PASS" in r.stdout

@@ -107,6 +107,48 @@ def test_heartbeat_and_watchdog(store):
     assert failures == ["rank1"]
 
 
+def test_watchdog_revives_rejoined_member(store):
+    """Death is not permanent: an elastic member that rejoins and
+    heartbeats again is cleared from `dead`, reported via on_recovery, and
+    monitored (re-flaggable) like any other member."""
+    failures, recoveries = [], []
+    dog = Watchdog(store, ttl=0.25, interval=0.05,
+                   on_failure=lambda d: failures.extend(d),
+                   on_recovery=lambda r: recoveries.extend(r))
+
+    worker = TCPStore(port=store.port)
+    worker.start_heartbeat("rank7", interval=0.05)
+    time.sleep(0.2)
+    assert dog.check() == []
+    worker.stop_heartbeat()
+    worker.close()
+
+    deadline = time.time() + 5
+    while "rank7" not in dog.dead and time.time() < deadline:
+        dog.check()
+        time.sleep(0.05)
+    assert failures == ["rank7"] and "rank7" in dog.dead
+
+    # the member rejoins (fresh connection, fresh heartbeat)
+    rejoined = TCPStore(port=store.port)
+    rejoined.start_heartbeat("rank7", interval=0.05)
+    deadline = time.time() + 5
+    while "rank7" in dog.dead and time.time() < deadline:
+        dog.check()
+        time.sleep(0.05)
+    assert "rank7" not in dog.dead
+    assert recoveries == ["rank7"]
+
+    # and it can die (and be reported) again — monitoring resumed
+    rejoined.stop_heartbeat()
+    rejoined.close()
+    deadline = time.time() + 5
+    while failures.count("rank7") < 2 and time.time() < deadline:
+        dog.check()
+        time.sleep(0.05)
+    assert failures == ["rank7", "rank7"]
+
+
 def _rank_main(port, rank, world, q):
     s = TCPStore(port=port, world_size=world, timeout=20)
     s.set(f"/rdzv/{rank}", str(rank))

@@ -1,0 +1,293 @@
+"""Fault-injection harness for the resilient serving runtime.
+
+The serving twin of tools/ckpt_fault_injector.py: where that harness kills
+a checkpoint saver at every commit-protocol phase and proves atomicity,
+this one injects member faults into a live `ServingPool`
+(paddle_tpu/inference/serving.py) over a REAL exported model and proves
+the resilience invariant for every fault phase:
+
+  1. the pool converges back to FULL healthy capacity (every slot alive,
+     every breaker closed, queue empty, nothing in flight — no stuck
+     leases) once the fault stops;
+  2. every admitted request either completes with bit-correct outputs or
+     fails with one of the documented typed errors (`DeadlineExceeded` /
+     `Overloaded` / `RequestFailed`) — never an untyped error, never a
+     hang;
+  3. the stats conservation law holds:
+     admitted == completed + failed + timed_out + cancelled.
+
+Phases (injected via the pool's `fault_hook`, which runs on the member's
+worker thread right before execution — the in-process equivalent of the
+member crashing/wedging under a request):
+
+  crash    one member raises mid-run on a fraction of requests (transient
+           fault → quarantine + re-clone + jittered retry);
+  hang     one member sleeps past the request deadline (wedge → supervisor
+           retires the worker and restores capacity with a fresh clone);
+  poison   one slot fails EVERY request until its circuit breaker trips
+           (K consecutive failures → open), then the fault is lifted and
+           the half-open probe must close the breaker again;
+  corrupt  the fault scribbles garbage into the member's input handles
+           before raising — quarantine must reset/replace the handles so
+           no later request can silently consume them;
+  none     fault-free control.
+
+Run as a script (exits nonzero on any violation — registered as a tier-1
+test via tests/test_serving_fault_injection.py):
+
+    python tools/serving_fault_injector.py [--phases crash,hang,...]
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PHASES = ("crash", "hang", "poison", "corrupt", "none")
+
+POOL_SIZE = 3
+N_REQUESTS = 48
+DEADLINE = 2.0          # per-request deadline (generous: execution is ~ms)
+HANG_SLEEP = 0.9        # how long the wedged member sleeps
+HANG_DEADLINE = 0.25    # deadline for requests in the hang phase
+CONVERGE_TIMEOUT = 10.0
+
+
+def _export_model(path):
+    """Export a deterministic linear program whose outputs the harness can
+    check bit-for-bit against the eager model."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    model.eval()
+    x = np.zeros((2, 8), np.float32)
+    paddle.jit.save(model, path, input_spec=[paddle.to_tensor(x)])
+    return model
+
+
+class _Injector:
+    """Per-phase fault hook plus bookkeeping: counts injections and tracks
+    per-member execution re-entrancy (a double-leased member would run two
+    requests concurrently on one predictor object)."""
+
+    def __init__(self, phase):
+        self.phase = phase
+        self.active = False     # armed after warmup
+        self.lock = threading.Lock()
+        self.injected = 0
+        self.in_member = {}     # id(predictor) -> concurrent executions
+        self.max_concurrency = 0
+
+    def enter_member(self, pred):
+        with self.lock:
+            n = self.in_member.get(id(pred), 0) + 1
+            self.in_member[id(pred)] = n
+            self.max_concurrency = max(self.max_concurrency, n)
+
+    def exit_member(self, pred):
+        with self.lock:
+            self.in_member[id(pred)] = self.in_member.get(id(pred), 1) - 1
+
+    def hook(self, slot, req, pred):
+        if not self.active or slot != 0:
+            return
+        if self.phase == "crash":
+            # fail the first execution of every 4th request: exercises
+            # quarantine + retry without starving the phase of successes
+            if req.id % 4 == 0 and req.attempts == 1:
+                with self.lock:
+                    self.injected += 1
+                raise RuntimeError(f"injected crash (req {req.id})")
+        elif self.phase == "hang":
+            if req.id % 6 == 0 and req.attempts == 1:
+                with self.lock:
+                    self.injected += 1
+                time.sleep(HANG_SLEEP)
+        elif self.phase in ("poison", "corrupt"):
+            with self.lock:
+                self.injected += 1
+            if self.phase == "corrupt":
+                import numpy as np
+
+                for name in pred.get_input_names():
+                    pred.get_input_handle(name).copy_from_cpu(
+                        np.full((2, 8), 777.0, np.float32))
+            raise RuntimeError(f"injected {self.phase} fault")
+
+
+def run_phase(phase, model, path, verbose=True):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (
+        Config, DeadlineExceeded, Overloaded, RequestFailed, ServingError,
+        ServingPool)
+    from paddle_tpu.inference.serving import RetryPolicy
+
+    inj = _Injector(phase)
+    deadline = HANG_DEADLINE if phase == "hang" else DEADLINE
+    pool = ServingPool(
+        Config(path), size=POOL_SIZE, max_queue_depth=N_REQUESTS + 8,
+        default_timeout=deadline,
+        breaker_threshold=3, breaker_reset_timeout=0.25,
+        retry=RetryPolicy(max_retries=2, base_delay=0.01, max_delay=0.05),
+        hang_grace=0.05, supervise_interval=0.01, fault_hook=inj.hook)
+
+    rng = np.random.RandomState(7)
+    batches = [rng.rand(2, 8).astype(np.float32) for _ in range(N_REQUESTS)]
+    want = [model(paddle.to_tensor(b)).numpy() for b in batches]
+
+    bad = []
+    outcomes = {"ok": 0, "deadline": 0, "overloaded": 0, "failed": 0}
+
+    # warm up (XLA compiles the shared module on the first run), THEN arm
+    pool.infer([batches[0]], timeout=60.0)
+    inj.active = True
+
+    def one_request(i):
+        def fn(pred):
+            inj.enter_member(pred)
+            try:
+                # handle-style on purpose: stale-handle corruption would
+                # be visible here if quarantine failed to reset state
+                h = pred.get_input_handle(pred.get_input_names()[0])
+                h.copy_from_cpu(batches[i])
+                return pred.run()
+            finally:
+                inj.exit_member(pred)
+        try:
+            out, = pool.submit(fn, timeout=deadline).result()
+        except DeadlineExceeded:
+            return i, "deadline", None
+        except Overloaded:
+            return i, "overloaded", None
+        except RequestFailed:
+            return i, "failed", None
+        except ServingError as e:  # any other typed error is still a bug
+            return i, f"unexpected-typed:{type(e).__name__}: {e}", None
+        except BaseException as e:  # noqa: BLE001 — untyped = violation
+            return i, f"untyped:{type(e).__name__}: {e}", None
+        return i, "ok", out
+
+    t0 = time.monotonic()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        futs = [ex.submit(one_request, i) for i in range(N_REQUESTS)]
+        try:
+            for f in concurrent.futures.as_completed(futs, timeout=90):
+                i, kind, out = f.result()
+                if kind == "ok":
+                    outcomes["ok"] += 1
+                    if not np.allclose(out, want[i], rtol=1e-5, atol=1e-6):
+                        bad.append(f"[{phase}] request {i} completed with "
+                                   f"WRONG output (stale/corrupt handles?)")
+                elif kind in outcomes:
+                    outcomes[kind] += 1
+                else:
+                    bad.append(f"[{phase}] request {i} -> {kind}")
+        except concurrent.futures.TimeoutError:
+            bad.append(f"[{phase}] requests HUNG: "
+                       f"{sum(not f.done() for f in futs)} unresolved "
+                       f"after 90s — a request escaped its deadline")
+            for f in futs:
+                f.cancel()
+    wall = time.monotonic() - t0
+
+    if inj.max_concurrency > 1:
+        bad.append(f"[{phase}] double-lease: {inj.max_concurrency} requests "
+                   f"executed concurrently on one member")
+    if phase != "none" and inj.injected == 0:
+        bad.append(f"[{phase}] harness error: no fault was injected")
+    if phase == "none" and outcomes["ok"] != N_REQUESTS:
+        bad.append(f"[{phase}] control run lost requests: {outcomes}")
+    if phase in ("crash", "corrupt") and outcomes["ok"] < N_REQUESTS * 3 // 4:
+        bad.append(f"[{phase}] too few successes despite retries: {outcomes}")
+    if phase == "poison" and pool.stats()["breaker_trips"] < 1:
+        bad.append(f"[{phase}] poisoned slot never tripped its breaker")
+
+    # fault lifted: the pool must converge back to full healthy capacity
+    inj.active = False
+    deadline_at = time.monotonic() + CONVERGE_TIMEOUT
+    stats = pool.stats()
+    while time.monotonic() < deadline_at:
+        stats = pool.stats()
+        if (stats["healthy"] == POOL_SIZE and stats["queue_depth"] == 0
+                and stats["in_flight"] == 0):
+            break
+        try:  # traffic drives half-open probes after the poison phase
+            pool.infer([batches[0]], timeout=1.0)
+        except ServingError:
+            pass
+        time.sleep(0.05)
+    else:
+        bad.append(f"[{phase}] pool did NOT converge to full healthy "
+                   f"capacity within {CONVERGE_TIMEOUT}s: healthy="
+                   f"{stats['healthy']}/{POOL_SIZE}, "
+                   f"queue={stats['queue_depth']}, "
+                   f"in_flight={stats['in_flight']}, "
+                   f"members={stats['members']}")
+
+    # post-fault correctness: every request must serve bit-correct results
+    for i in (0, 1, 2):
+        try:
+            out, = pool.infer([batches[i]], timeout=5.0)
+            if not np.allclose(out, want[i], rtol=1e-5, atol=1e-6):
+                bad.append(f"[{phase}] post-fault output wrong for "
+                           f"request {i}")
+        except ServingError as e:
+            bad.append(f"[{phase}] post-fault request failed: {e}")
+
+    drained = pool.shutdown(drain_timeout=5.0)
+    if not drained:
+        bad.append(f"[{phase}] shutdown failed to drain (stuck lease)")
+    final = pool.stats()
+    lhs = final["admitted"]
+    rhs = (final["completed"] + final["failed"] + final["timed_out"]
+           + final["cancelled"])
+    if lhs != rhs:
+        bad.append(f"[{phase}] stats conservation violated: admitted={lhs} "
+                   f"!= completed+failed+timed_out+cancelled={rhs} ({final})")
+    if final["in_flight"] != 0 or final["queue_depth"] != 0:
+        bad.append(f"[{phase}] leaked lease/queue entry after shutdown: "
+                   f"{final}")
+    if verbose:
+        tag = "FAIL" if bad else "ok"
+        print(f"  {phase:<8} -> {tag}  ({outcomes}, injected="
+              f"{inj.injected}, reclones={final['reclones']}, "
+              f"wedged={final['wedged']}, trips={final['breaker_trips']}, "
+              f"{wall:.1f}s)")
+    return bad
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phases", default=",".join(PHASES),
+                    help="comma-separated fault phases to run "
+                         "(default: all + the no-fault control)")
+    args = ap.parse_args(argv)
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    violations = []
+    with tempfile.TemporaryDirectory(prefix="serving-fault-") as workdir:
+        path = os.path.join(workdir, "infer")
+        model = _export_model(path)
+        print("serving fault injection (hook-at-execution):")
+        for phase in phases:
+            violations += run_phase(phase, model, path)
+    for v in violations:
+        print("VIOLATION:", v, file=sys.stderr)
+    print("RESULT:", "FAIL" if violations else "PASS")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
