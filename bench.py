@@ -82,6 +82,35 @@ def _measure_with_retry(make_engine, batch, steps, attempts=6,
     return _retry_transient(attempt, attempts=attempts, label=label)
 
 
+def _export_profile(make_engine, batch, steps=3):
+    """BENCH_PROFILE=1: capture host spans (engine dispatch / device_put /
+    write-back plus eager op dispatches) over a few post-compile steps and
+    export a chrome trace (path: BENCH_PROFILE_PATH, default
+    bench_host_trace.json)."""
+    prof = None
+    try:
+        from paddle_tpu.profiler import Profiler, ProfilerTarget
+
+        eng = make_engine()
+        float(eng.train_batch(*batch))  # compile outside the capture
+        prof = Profiler(targets={ProfilerTarget.CPU})
+        prof.start()
+        try:
+            for _ in range(steps):
+                eng.train_batch(*batch)
+                prof.step()
+        finally:
+            # a failed capture must not leave the tracer/profile hook live
+            # — later benchmarks would silently pay tracing overhead
+            prof.stop()
+        path = os.environ.get("BENCH_PROFILE_PATH", "bench_host_trace.json")
+        prof.export_chrome_tracing(path)
+        prof.summary()
+        print(f"bench: host chrome trace -> {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — profiling must never fail a bench
+        print(f"bench: BENCH_PROFILE failed ({e})", file=sys.stderr)
+
+
 def _emit(payload):
     # under BENCH_ALL the per-config lines go to stderr; the driver
     # contract (ONE json line on stdout) is satisfied by main() printing
@@ -446,7 +475,32 @@ def bench_gpt(on_tpu, dev):
     # ("INTERNAL ... response body closed"); these are transient transport
     # faults, not program errors — retry with backoff, rebuilding the engine
     # each attempt (donated buffers are poisoned by a failed step).
-    final_loss, dt = _measure_with_retry(make_engine, (ids,), steps)
+    #
+    # BENCH_MULTISTEP=k (default 5) drives the pipelined hot path: k
+    # optimizer steps per dispatch through Engine.train_batches' fused
+    # lax.scan variant — no host work between micro-steps
+    # (docs/performance.md). BENCH_MULTISTEP=1 restores one dispatch/step.
+    ms = int(os.environ.get("BENCH_MULTISTEP", "5"))
+    k = max(i for i in range(1, max(1, min(ms, steps)) + 1)
+            if steps % i == 0)
+    if k > 1:
+        def attempt():
+            eng = make_engine()
+            lv = eng.train_batches([(ids,)] * k)  # warmup/compile fused k-step
+            float(lv.numpy()[-1])                 # readback fence
+            t0 = time.perf_counter()
+            for _ in range(steps // k):
+                lv = eng.train_batches([(ids,)] * k)
+            final_loss = float(lv.numpy()[-1])
+            dt = time.perf_counter() - t0
+            return final_loss, dt
+
+        final_loss, dt = _retry_transient(attempt)
+    else:
+        final_loss, dt = _measure_with_retry(make_engine, (ids,), steps)
+
+    if os.environ.get("BENCH_PROFILE") == "1":
+        _export_profile(make_engine, (ids,))
 
     tokens = batch * seq_len * steps
     tps = tokens / dt
@@ -464,7 +518,8 @@ def bench_gpt(on_tpu, dev):
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
         "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
-                  "steps": steps, "platform": dev.platform},
+                  "steps": steps, "steps_per_dispatch": k,
+                  "platform": dev.platform},
     }
 
 

@@ -34,7 +34,40 @@ import jax.numpy as jnp
 
 from . import monitor
 
-__all__ = ["lazy_segments", "lazy_recorder", "PendingValue"]
+__all__ = ["lazy_segments", "lazy_recorder", "PendingValue", "EngineRef"]
+
+
+class EngineRef:
+    """Lazy binding of a Tensor to externally-managed device state.
+
+    The distributed engine donates its parameter buffers every step, so a
+    live Parameter's current value is whatever the engine's state dict
+    holds *now*. Instead of rewriting every Parameter's `_value` after
+    each step (a Python loop of property-setter work on the hot path),
+    the engine installs one EngineRef per Parameter at construction:
+    `_value` reads resolve through `fetch()` against the live engine
+    state, and shape/dtype queries stay host-only. Writes through the
+    `_value` setter simply replace the ref; the engine detects that
+    (identity check) and adopts the external value on its next step.
+    """
+
+    __slots__ = ("fetch", "shape", "dtype")
+
+    def __init__(self, fetch, shape, dtype):
+        self.fetch = fetch
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
 
 
 class PendingValue:

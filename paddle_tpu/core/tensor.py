@@ -48,9 +48,15 @@ class Tensor:
     @property
     def _value(self):
         v = self._v_
-        if type(v) is _lazy.PendingValue:
+        tv = type(v)
+        if tv is _lazy.PendingValue:
             v.recorder.flush()
             v = self._v_
+        elif tv is _lazy.EngineRef:
+            # engine-managed parameter: resolve against the live engine
+            # state on every read (never cached — the engine donates and
+            # replaces these buffers each step)
+            v = v.fetch()
         return v
 
     @_value.setter

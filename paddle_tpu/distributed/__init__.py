@@ -29,6 +29,7 @@ from . import rpc  # noqa: F401
 from . import stream  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401
 from .engine import ShardedTrainStep, parallelize  # noqa: F401
+from .prefetch import DevicePrefetcher, prefetch_to_device  # noqa: F401
 from .sharding_spec import (  # noqa: F401
     shard_params, shard_constraint, spec_for_param, DEFAULT_TP_RULES,
 )

@@ -7,6 +7,7 @@ Reference analogs, collapsed into one component:
 - HybridParallelOptimizer grad-clip-across-groups
   (hybrid_parallel_optimizer.py:254)
 - static-graph Engine._parallel (auto_parallel/static/engine.py:764)
+- multi-step `Executor.run` amortization (the pipelined hot path below)
 
 TPU-native design: ONE jitted program per training step. Parameters,
 optimizer slots and the batch carry NamedShardings over the hybrid mesh
@@ -15,16 +16,29 @@ reference implements imperatively: grad all-reduce over dp (reducer),
 all-gather of ZeRO-sharded params before use + reduce-scatter of grads
 (stages 1-3), mp all-reduces inside TP blocks. Buffers are donated so
 parameter memory updates in place in HBM.
+
+Pipelined hot path (PR 3): the per-step host work is driven to ~zero —
+batch placement uses cached per-ndim NamedShardings, the learning rate
+and step counter live on device (the step counter and RNG key are donated
+carry state incremented/split in-graph), and live Parameter objects
+resolve lazily against engine state (core.lazy.EngineRef) instead of
+being reassigned every step. `train_batches` runs N optimizer steps per
+dispatch via `lax.scan` (with a fused variant for a static repeated
+batch), so nothing host-side executes between micro-steps.
 """
 from __future__ import annotations
+
+from contextlib import nullcontext
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import lazy as _lazy
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from ..optimizer.lr import LRScheduler
 from ..ops import random as rng_mod
 from .functional import functionalize
 from .sharding_spec import (
@@ -35,6 +49,44 @@ from . import topology as topo_mod
 
 def _is_float(x):
     return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def default_batch_spec(mesh):
+    """The engine's default batch layout: dim0 over the fused data axes
+    (dp+sharding — the reference fuses them for grad sync, topology.py:228),
+    dim1 over sep when in use. Shared with prefetch_to_device so standalone
+    placement matches the engine's exactly; tolerates meshes missing axes."""
+    axes = mesh.shape
+    entries = []
+    data = tuple(a for a in ("dp", "sharding") if a in axes)
+    if data:
+        entries.append(data)
+    if axes.get("sep", 1) > 1:
+        entries.append("sep")
+    return P(*entries)
+
+
+def batch_spec_for_ndim(spec, ndim):
+    """Trim/pad a batch PartitionSpec to an array's rank."""
+    entries = list(spec)[:ndim]
+    entries += [None] * (ndim - len(entries))
+    return P(*entries)
+
+
+_prof_mod = None
+
+
+def _span(name):
+    """RecordEvent span when a host profiler is actively recording, else a
+    no-op — keeps the native tracer (and its first-use build) entirely off
+    the un-profiled hot path."""
+    global _prof_mod
+    if _prof_mod is None:
+        from .. import profiler as _p
+        _prof_mod = _p
+    if _prof_mod.host_recording():
+        return _prof_mod.RecordEvent(name)
+    return nullcontext()
 
 
 def _clip_grads(grads, clip):
@@ -93,7 +145,7 @@ class ShardedTrainStep:
         self._apply, self._params, self._buffers = functionalize(
             model, method=lambda *b: loss_fn(model, *b))
 
-        # ---- shardings -------------------------------------------------
+        # ---- shardings (built ONCE; the hot path only does dict reads) --
         mesh = self.mesh
         self.param_specs = dict(
             (n, spec_for_param(n, p, self.rules,
@@ -103,25 +155,26 @@ class ShardedTrainStep:
             (n, opt_state_spec(self.param_specs[n], p.shape, mesh,
                                sharding_stage=sharding_stage))
             for n, p in self._params.items())
-        # batch: dim0 over the fused data axes (dp+sharding, the reference
-        # fuses them for grad sync, topology.py:228); dim1 (sequence) over
-        # sep when in use.
         if batch_spec is None:
-            entries = [("dp", "sharding")]
-            if mesh.shape["sep"] > 1:
-                entries.append("sep")
-            batch_spec = P(*entries)
+            batch_spec = default_batch_spec(mesh)
         self.batch_spec = batch_spec
+        self._param_sh = {n: NamedSharding(mesh, s)
+                          for n, s in self.param_specs.items()}
+        self._state_sh = {n: NamedSharding(mesh, s)
+                          for n, s in self.state_specs.items()}
+        self._scalar_sh = NamedSharding(mesh, P())
+        self._batch_sh_cache = {}   # ndim -> NamedSharding
 
         # ---- place values ---------------------------------------------
         self.param_vals = {}
         for n, p in self._params.items():
-            sh = NamedSharding(mesh, self.param_specs[n])
-            p._value = jax.device_put(p._value, sh)
+            p._value = jax.device_put(p._value, self._param_sh[n])
             self.param_vals[n] = p._value
         self.buffer_vals = {}
+        self._buf_sh = {}
         for n, b in self._buffers.items():
             sh = NamedSharding(mesh, P(*([None] * b.ndim)))
+            self._buf_sh[n] = sh
             b._value = jax.device_put(b._value, sh)
             self.buffer_vals[n] = b._value
 
@@ -131,15 +184,45 @@ class ShardedTrainStep:
         if self.optimizer is not None:
             for n, p in self._params.items():
                 names = self.optimizer._state_names
-                sh = NamedSharding(mesh, self.state_specs[n])
+                sh = self._state_sh[n]
                 self.opt_state[n] = {
                     s: jax.device_put(jnp.zeros(p.shape, p.dtype), sh)
                     for s in names}
 
+        # ---- lazy parameter write-back ---------------------------------
+        # Live Parameters resolve against engine state on read (EngineRef)
+        # instead of being reassigned every step. External writes replace
+        # the ref; _adopt_external_writes() picks them up (identity check,
+        # no per-step property work).
+        self._param_refs = []
+        for n, p in self._params.items():
+            v = self.param_vals[n]
+            ref = _lazy.EngineRef(
+                (lambda eng=self, k=n: eng.param_vals[k]), v.shape, v.dtype)
+            p._value = ref
+            self._param_refs.append((n, p, ref))
+
         self._step_fn = None
-        self._eval_fn = None
+        self._eval_fns = {}
+        self._multi_fns = {}
         self._step_count = 0
         self.last_grad_norm = None
+        self.last_grad_norms = None
+        # device-resident per-step scalars: lr re-put only when the host
+        # value changes; step counter and RNG key are donated carry state
+        self._lr_host = None
+        self._lr_dev = None
+        self._step_dev = None
+        self._key_dev = None
+        self._key_epoch = None
+        # most-recent (n, lr) -> device (n,) array for constant lr; a
+        # single entry so host-driven lr decay can't grow it unboundedly
+        self._lrs_key = None
+        self._lrs_dev = None
+        # dispatch-count hook: host dispatches of compiled step programs and
+        # explicit host->device transfers, for perf smoke tests that must
+        # not depend on wall-clock
+        self.stats = {"dispatches": 0, "device_puts": 0, "steps": 0}
 
     # ------------------------------------------------------------------
     def _cp_guard(self):
@@ -151,13 +234,79 @@ class ShardedTrainStep:
         from .context_parallel import context_parallel_guard
         return context_parallel_guard(self.mesh, mode=self.context_parallel)
 
-    def _build_step(self, batch_avals):
-        mesh = self.mesh
+    # ---- cached placement helpers (shared by train/eval/prefetch) -----
+    def _batch_sharding(self, ndim):
+        sh = self._batch_sh_cache.get(ndim)
+        if sh is None:
+            sh = NamedSharding(self.mesh, self._batch_spec_for(ndim))
+            self._batch_sh_cache[ndim] = sh
+        return sh
+
+    def _place_batch(self, batch):
+        """Tensors/arrays -> sharded device arrays via the per-ndim cached
+        NamedShardings. Values already carrying the target sharding (e.g.
+        from prefetch_to_device) are passed through untouched."""
+        placed = []
+        nputs = 0
+        for b in batch:
+            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            sh = self._batch_sharding(v.ndim)
+            if getattr(v, "sharding", None) != sh:
+                v = jax.device_put(v, sh)
+                nputs += 1
+            placed.append(v)
+        self.stats["device_puts"] += nputs
+        return tuple(placed)
+
+    def _lr_scalar(self):
+        lr = self.optimizer.get_lr()
+        if self._lr_dev is None or lr != self._lr_host:
+            self._lr_host = lr
+            self._lr_dev = jax.device_put(jnp.asarray(lr, jnp.float32),
+                                          self._scalar_sh)
+            self.stats["device_puts"] += 1
+        return self._lr_dev
+
+    def _step_scalar(self):
+        if self._step_dev is None:
+            self._step_dev = jax.device_put(
+                jnp.asarray(self._step_count + 1, jnp.int32), self._scalar_sh)
+            self.stats["device_puts"] += 1
+        return self._step_dev
+
+    def _key_scalar(self):
+        # the RNG key is donated carry state split in-graph; a mid-run
+        # paddle.seed()/set_state() bumps the seed epoch and must refresh
+        # the cached key or the reseed would be silently ignored
+        epoch = rng_mod.seed_epoch()
+        if self._key_dev is None or self._key_epoch != epoch:
+            self._key_epoch = epoch
+            self._key_dev = jax.device_put(rng_mod.next_key(),
+                                           self._scalar_sh)
+            self.stats["device_puts"] += 1
+        return self._key_dev
+
+    def _adopt_external_writes(self):
+        """A write to an engine-managed Parameter (load_state_dict, manual
+        surgery) replaces its EngineRef; fold the new value into engine
+        state and re-install the ref. Identity checks only on the common
+        path — no property-setter work per step."""
+        for n, p, ref in self._param_refs:
+            if p._v_ is not ref:
+                self.param_vals[n] = jax.device_put(p._value,
+                                                    self._param_sh[n])
+                self.stats["device_puts"] += 1
+                p._v_ = ref
+
+    # ---- step program --------------------------------------------------
+    def _make_step(self):
+        """The pure single-step function shared by the one-step jit and the
+        lax.scan multi-step variants: carries (params, opt_state, buffers,
+        key, step_no) with the RNG split and step increment in-graph."""
         apply_fn = self._apply
         opt = self.optimizer
         clip = getattr(opt, "_grad_clip", None)
         compute_dtype = self.compute_dtype
-
         cp_guard = self._cp_guard
 
         def loss_of(params, buffers, batch, key):
@@ -177,9 +326,10 @@ class ShardedTrainStep:
                 rng_mod.pop_trace_key()
             return loss, new_buf
 
-        def step(params, opt_state, buffers, batch, key, lr, step_no):
+        def step(params, opt_state, buffers, batch, lr, key, step_no):
+            key, sub = jax.random.split(key)
             (loss, new_buf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params, buffers, batch, key)
+                loss_of, has_aux=True)(params, buffers, batch, sub)
             grads = dict(
                 (n, g.astype(params[n].dtype)) for n, g in grads.items())
             # pre-clip global grad norm, exposed for parity/diagnostics
@@ -195,97 +345,282 @@ class ShardedTrainStep:
                                           step_no)
                 new_params[n] = np_
                 new_state[n] = ns
-            return loss, gnorm, new_params, new_state, new_buf
+            return (loss, gnorm, new_params, new_state, new_buf, key,
+                    step_no + 1)
 
-        param_sh = {n: NamedSharding(mesh, s)
-                    for n, s in self.param_specs.items()}
-        state_sh = {n: {s: NamedSharding(mesh, self.state_specs[n])
-                        for s in self.opt_state[n]}
-                    for n in self.opt_state}
-        buf_sh = {n: NamedSharding(mesh, P(*([None] * v.ndim)))
-                  for n, v in self.buffer_vals.items()}
-        batch_sh = tuple(
-            NamedSharding(mesh, self._batch_spec_for(a.ndim))
-            for a in batch_avals)
-        scalar_sh = NamedSharding(mesh, P())
+        return step
+
+    def _opt_state_sh(self):
+        return {n: {s: self._state_sh[n] for s in self.opt_state[n]}
+                for n in self.opt_state}
+
+    def _build_step(self, batch_avals):
+        step = self._make_step()
+        param_sh = self._param_sh
+        state_sh = self._opt_state_sh()
+        buf_sh = self._buf_sh
+        batch_sh = tuple(self._batch_sharding(a.ndim) for a in batch_avals)
+        scalar_sh = self._scalar_sh
 
         return jax.jit(
             step,
             in_shardings=(param_sh, state_sh, buf_sh, batch_sh, scalar_sh,
                           scalar_sh, scalar_sh),
-            out_shardings=(scalar_sh, scalar_sh, param_sh, state_sh, buf_sh),
-            donate_argnums=(0, 1, 2) if self.donate else (),
+            out_shardings=(scalar_sh, scalar_sh, param_sh, state_sh, buf_sh,
+                           scalar_sh, scalar_sh),
+            # donate the whole carried state: params, slots, buffers, RNG
+            # key and step counter update in place in HBM (lr is reused
+            # across steps and stays un-donated)
+            donate_argnums=(0, 1, 2, 5, 6) if self.donate else (),
+        )
+
+    def _build_multi(self, batch_avals, static):
+        # scan length comes from the (n,) lrs xs; the _multi_fns cache key
+        # carries n so each micro-step count compiles its own program
+        step = self._make_step()
+        param_sh = self._param_sh
+        state_sh = self._opt_state_sh()
+        buf_sh = self._buf_sh
+        scalar_sh = self._scalar_sh
+
+        def body(carry, x):
+            params, opt_state, buffers, key, step_no = carry
+            batch, lr = x
+            loss, gnorm, params, opt_state, buffers, key, step_no = step(
+                params, opt_state, buffers, batch, lr, key, step_no)
+            return (params, opt_state, buffers, key, step_no), (loss, gnorm)
+
+        if static:
+            # fused variant for a static batch: the batch rides along as a
+            # scan-invariant operand — no stacking, no duplicated HBM
+            def multi(params, opt_state, buffers, batch, lrs, key, step0):
+                carry = (params, opt_state, buffers, key, step0)
+                carry, (losses, gnorms) = jax.lax.scan(
+                    lambda c, lr: body(c, (batch, lr)), carry, lrs)
+                params, opt_state, buffers, key, step_no = carry
+                return (losses, gnorms, params, opt_state, buffers, key,
+                        step_no)
+
+            batch_sh = tuple(self._batch_sharding(a.ndim)
+                             for a in batch_avals)
+        else:
+            # per-step batches stacked on a leading scan axis
+            def multi(params, opt_state, buffers, batches, lrs, key, step0):
+                carry = (params, opt_state, buffers, key, step0)
+                carry, (losses, gnorms) = jax.lax.scan(
+                    lambda c, x: body(c, x), carry, (batches, lrs))
+                params, opt_state, buffers, key, step_no = carry
+                return (losses, gnorms, params, opt_state, buffers, key,
+                        step_no)
+
+            batch_sh = tuple(
+                NamedSharding(self.mesh, P(
+                    None, *self._batch_spec_for(a.ndim - 1)))
+                for a in batch_avals)
+
+        return jax.jit(
+            multi,
+            in_shardings=(param_sh, state_sh, buf_sh, batch_sh, scalar_sh,
+                          scalar_sh, scalar_sh),
+            out_shardings=(scalar_sh, scalar_sh, param_sh, state_sh, buf_sh,
+                           scalar_sh, scalar_sh),
+            donate_argnums=(0, 1, 2, 5, 6) if self.donate else (),
         )
 
     def _batch_spec_for(self, ndim):
-        spec = list(self.batch_spec)[:ndim]
-        spec += [None] * (ndim - len(spec))
-        return P(*spec)
+        return batch_spec_for_ndim(self.batch_spec, ndim)
 
+    # ---- public step APIs ----------------------------------------------
     def train_batch(self, *batch):
         """Run one optimizer step; returns the (device) loss Tensor."""
         if self.optimizer is None:
             raise RuntimeError(
                 "this engine was built without an optimizer; use eval_batch")
-        batch_vals = tuple(
-            b._value if isinstance(b, Tensor) else jnp.asarray(b)
-            for b in batch)
-        placed = tuple(
-            jax.device_put(v, NamedSharding(self.mesh,
-                                            self._batch_spec_for(v.ndim)))
-            for v in batch_vals)
+        self._adopt_external_writes()
+        with _span("engine::device_put"):
+            placed = self._place_batch(batch)
         if self._step_fn is None:
             self._step_fn = self._build_step(placed)
+        lr = self._lr_scalar()
+        key = self._key_scalar()
+        step_no = self._step_scalar()
         self._step_count += 1
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        step_no = jnp.asarray(self._step_count, jnp.int32)
-        key = rng_mod.next_key()
-        loss, gnorm, self.param_vals, self.opt_state, self.buffer_vals = \
-            self._step_fn(self.param_vals, self.opt_state, self.buffer_vals,
-                          placed, key, lr, step_no)
+        with _span("engine::dispatch"):
+            (loss, gnorm, self.param_vals, self.opt_state, self.buffer_vals,
+             self._key_dev, self._step_dev) = self._step_fn(
+                self.param_vals, self.opt_state, self.buffer_vals, placed,
+                lr, key, step_no)
+        self.stats["dispatches"] += 1
+        self.stats["steps"] += 1
         self.last_grad_norm = gnorm  # device scalar; float() to read
-        # keep live Parameter objects pointing at current values so eager
-        # reads (state_dict, debugging) stay correct without copies
-        for n, p in self._params.items():
-            p._value = self.param_vals[n]
-        for n, b in self._buffers.items():
-            b._value = self.buffer_vals[n]
-        # LR schedulers follow the eager convention: the USER calls
-        # scheduler.step(); get_lr() is re-read (host-side) every batch.
+        self.last_grad_norms = None  # per-step vector: train_batches only
+        with _span("engine::write_back"):
+            self._write_back_buffers()
+        # Parameters resolve lazily via their EngineRef — no per-param
+        # write-back loop. LR schedulers follow the eager convention: the
+        # USER calls scheduler.step(); get_lr() is re-read every batch (the
+        # device scalar is refreshed only when the host value changes).
         return Tensor(loss)
 
+    def train_batches(self, batches, n=None):
+        """Run up to `n` optimizer micro-steps in ONE XLA dispatch.
+
+        `batches` is an iterable of batch-arg tuples (or single args). All
+        micro-steps run inside a `lax.scan`: the step counter, RNG key and
+        learning-rate schedule advance on-device, so no host code executes
+        between micro-steps. When every element is the *same* batch object
+        (e.g. ``[batch] * n``) the fused static variant is used — the batch
+        is passed once as a scan-invariant operand instead of stacked.
+
+        If the optimizer's learning rate is an LRScheduler the engine
+        advances it once per consumed micro-batch (do NOT also call
+        ``scheduler.step()`` for these steps). Returns a device Tensor of
+        shape ``(n,)`` with the per-micro-step losses.
+        """
+        if self.optimizer is None:
+            raise RuntimeError(
+                "this engine was built without an optimizer; use eval_batch")
+        batches = list(batches)
+        if n is not None:
+            batches = batches[:n]
+        if not batches:
+            return Tensor(jnp.zeros((0,), jnp.float32))
+        n = len(batches)
+        static = all(b is batches[0] for b in batches[1:])
+        norm = [tuple(b) if isinstance(b, (list, tuple)) else (b,)
+                for b in batches]
+
+        self._adopt_external_writes()
+        with _span("engine::device_put"):
+            if static:
+                placed = self._place_batch(norm[0])
+            else:
+                vals = [tuple(b._value if isinstance(b, Tensor)
+                              else jnp.asarray(b) for b in bt)
+                        for bt in norm]
+                arity = len(vals[0])
+                ragged = any(len(bt) != arity for bt in vals) or any(
+                    len(set((tuple(bt[j].shape), str(bt[j].dtype))
+                            for bt in vals)) > 1
+                    for j in range(arity))
+                if ragged:
+                    # ragged batches can't stack onto a scan axis — fall
+                    # back to sequential single-step dispatches, keeping
+                    # the train_batches contract: the engine (not the
+                    # user) advances an LRScheduler per consumed batch
+                    sched = self.optimizer._learning_rate
+                    losses, gnorms = [], []
+                    for bt in norm:
+                        losses.append(self.train_batch(*bt)._value)
+                        gnorms.append(self.last_grad_norm)
+                        if isinstance(sched, LRScheduler):
+                            sched.step()
+                    self.last_grad_norms = jnp.stack(gnorms)
+                    return Tensor(jnp.stack(losses))
+                placed = []
+                nputs = 0
+                for j in range(len(vals[0])):
+                    stacked = jnp.stack([bt[j] for bt in vals])
+                    sh = NamedSharding(
+                        self.mesh,
+                        P(None, *self._batch_spec_for(stacked.ndim - 1)))
+                    placed.append(jax.device_put(stacked, sh))
+                    nputs += 1
+                placed = tuple(placed)
+                self.stats["device_puts"] += nputs
+
+        sig = (n, static, tuple((tuple(a.shape), str(a.dtype))
+                                for a in placed))
+        fn = self._multi_fns.get(sig)
+        if fn is None:
+            fn = self._build_multi(placed, static)
+            self._multi_fns[sig] = fn
+
+        lrs = self._lr_schedule_array(n)
+        key = self._key_scalar()
+        step0 = self._step_scalar()
+        with _span("engine::dispatch"):
+            (losses, gnorms, self.param_vals, self.opt_state,
+             self.buffer_vals, self._key_dev, self._step_dev) = fn(
+                self.param_vals, self.opt_state, self.buffer_vals, placed,
+                lrs, key, step0)
+        self.stats["dispatches"] += 1
+        self.stats["steps"] += n
+        self._step_count += n
+        self.last_grad_norms = gnorms  # (n,) device vector, one per step
+        self.last_grad_norm = gnorms[-1]
+        with _span("engine::write_back"):
+            self._write_back_buffers()
+        return Tensor(losses)
+
+    def _lr_schedule_array(self, n):
+        """(n,) device lr values for the next n micro-steps. Plain-float
+        learning rates are cached per (n, value); an LRScheduler is
+        evaluated AND advanced host-side once per micro-step (the schedule
+        values then ride into the compiled scan as xs)."""
+        sched = self.optimizer._learning_rate
+        if not isinstance(sched, LRScheduler):
+            lr = float(sched)
+            if self._lrs_key != (n, lr):
+                self._lrs_key = (n, lr)
+                self._lrs_dev = jax.device_put(
+                    jnp.full((n,), lr, jnp.float32), self._scalar_sh)
+                self.stats["device_puts"] += 1
+            return self._lrs_dev
+        vals = np.empty((n,), np.float32)
+        for i in range(n):
+            vals[i] = float(sched())
+            sched.step()
+        arr = jax.device_put(jnp.asarray(vals), self._scalar_sh)
+        self.stats["device_puts"] += 1
+        return arr
+
+    def _write_back_buffers(self):
+        for n, b in self._buffers.items():
+            b._value = self.buffer_vals[n]
+
     def eval_batch(self, *batch):
-        """Jitted loss evaluation (no grads, no update)."""
-        batch_vals = tuple(
-            b._value if isinstance(b, Tensor) else jnp.asarray(b)
-            for b in batch)
-        placed = tuple(
-            jax.device_put(v, NamedSharding(self.mesh,
-                                            self._batch_spec_for(v.ndim)))
-            for v in batch_vals)
-        if self._eval_fn is None:
-            apply_fn = self._apply
-            compute_dtype = self.compute_dtype
-
-            cp_guard = self._cp_guard
-
-            def ev(params, buffers, batch, key):
-                if compute_dtype is not None:
-                    params = {n: (v.astype(compute_dtype) if _is_float(v)
-                                  else v) for n, v in params.items()}
-                rng_mod.push_trace_key(key)
-                try:
-                    with cp_guard():
-                        loss, _ = apply_fn(params, buffers,
-                                           *[Tensor(b) for b in batch])
-                finally:
-                    rng_mod.pop_trace_key()
-                return loss
-
-            self._eval_fn = jax.jit(ev)
+        """Jitted loss evaluation (no grads, no update). Shares the cached
+        batch-placement helper and shardings with the train path."""
+        self._adopt_external_writes()
+        with _span("engine::device_put"):
+            placed = self._place_batch(batch)
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in placed)
+        fn = self._eval_fns.get(sig)
+        if fn is None:
+            fn = self._build_eval(placed)
+            self._eval_fns[sig] = fn
         key = rng_mod.next_key()
-        return Tensor(self._eval_fn(self.param_vals, self.buffer_vals,
-                                    placed, key))
+        with _span("engine::dispatch"):
+            loss = fn(self.param_vals, self.buffer_vals, placed, key)
+        self.stats["dispatches"] += 1
+        return Tensor(loss)
+
+    def _build_eval(self, batch_avals):
+        apply_fn = self._apply
+        compute_dtype = self.compute_dtype
+        cp_guard = self._cp_guard
+
+        def ev(params, buffers, batch, key):
+            if compute_dtype is not None:
+                params = {n: (v.astype(compute_dtype) if _is_float(v)
+                              else v) for n, v in params.items()}
+            rng_mod.push_trace_key(key)
+            try:
+                with cp_guard():
+                    loss, _ = apply_fn(params, buffers,
+                                       *[Tensor(b) for b in batch])
+            finally:
+                rng_mod.pop_trace_key()
+            return loss
+
+        batch_sh = tuple(self._batch_sharding(a.ndim) for a in batch_avals)
+        return jax.jit(
+            ev,
+            in_shardings=(self._param_sh, self._buf_sh, batch_sh,
+                          self._scalar_sh),
+            out_shardings=self._scalar_sh,
+        )
 
     def sync_optimizer_state(self):
         """Write engine opt slots back into the eager Optimizer (for

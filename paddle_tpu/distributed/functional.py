@@ -13,6 +13,7 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 
+from ..core import lazy as _lazy
 from ..core.tensor import Tensor
 from ..core.dispatch import no_grad
 
@@ -80,9 +81,20 @@ def functionalize(layer, method=None):
     if isinstance(method, str):
         fn = getattr(layer, method)
 
+    def _raw_value(t):
+        # preserve an engine-installed lazy binding (EngineRef) verbatim —
+        # reading ._value would resolve it to a snapshot and the restore
+        # below would then pin the Parameter to a stale (soon-donated)
+        # buffer; pending lazy segments still flush as before
+        v = t._v_
+        if type(v) is _lazy.EngineRef:
+            return v
+        return t._value
+
     def apply_fn(param_vals, buffer_vals, *args, **kwargs):
         holders = list(params.items()) + list(buffers.items())
-        saved = [(h, h._value, h._grad_node, h._out_idx) for _, h in holders]
+        saved = [(h, _raw_value(h), h._grad_node, h._out_idx)
+                 for _, h in holders]
         try:
             for name, p in params.items():
                 p._value = param_vals[name]

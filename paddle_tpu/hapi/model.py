@@ -153,7 +153,13 @@ class Model:
     # -- loops -------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
-            verbose=2, shuffle=True, num_workers=0, callbacks=None):
+            verbose=2, shuffle=True, num_workers=0, callbacks=None,
+            prefetch=0):
+        """`prefetch=N` (N>=1) overlaps host->device transfer with compute:
+        each epoch's loader is wrapped in
+        `paddle_tpu.distributed.prefetch_to_device`, a bounded background
+        thread that ships batches to the device N deep ahead of the train
+        step (docs/performance.md)."""
         assert train_data is not None, "train_data is required"
         self._save_dir = save_dir
         loader = self._loader(train_data, batch_size, shuffle, num_workers)
@@ -181,15 +187,24 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(loader):
-                cblist.call("on_train_batch_begin", step, {})
-                ins, lbs = self._split_batch(batch)
-                res = self.train_batch(ins, lbs or None)
-                losses = res[0] if isinstance(res, tuple) else res
-                logs = self._metric_logs({"loss": losses[0]})
-                cblist.call("on_train_batch_end", step, logs)
-                if self.stop_training:
-                    break
+            batch_iter = loader
+            if prefetch:
+                from ..distributed.prefetch import prefetch_to_device
+
+                batch_iter = prefetch_to_device(iter(loader), size=prefetch)
+            try:
+                for step, batch in enumerate(batch_iter):
+                    cblist.call("on_train_batch_begin", step, {})
+                    ins, lbs = self._split_batch(batch)
+                    res = self.train_batch(ins, lbs or None)
+                    losses = res[0] if isinstance(res, tuple) else res
+                    logs = self._metric_logs({"loss": losses[0]})
+                    cblist.call("on_train_batch_end", step, logs)
+                    if self.stop_training:
+                        break
+            finally:
+                if prefetch:
+                    batch_iter.close()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self._run_eval(eval_loader, cblist)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})

@@ -274,36 +274,111 @@ def default_collate_fn(batch):
     return batch
 
 
+class PrefetchThread:
+    """Bounded background producer shared by the io prefetch iterator and
+    `distributed.prefetch_to_device`: one daemon thread pulls from `gen`,
+    applies `transform` (e.g. a sharded device_put), and queues results
+    FIFO `depth` deep. Producer errors surface to the consumer at the
+    position they occurred; both exhaustion and `close()` join the thread
+    (stop-aware puts — a worker blocked on a full queue wakes and exits)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, gen, transform=None, depth=2,
+                 name="paddle-tpu-prefetch"):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self._gen = gen
+        self._transform = transform
+        self._q = queue.Queue(maxsize=depth)
+        self._err = None
+        self._done = False
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True, name=name)
+        self._t.start()
+
+    def _run(self):
+        try:
+            for item in self._gen:
+                if self._stop.is_set():
+                    return
+                if self._transform is not None:
+                    item = self._transform(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — handed to the consumer
+            self._err = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def get(self):
+        """Next produced item; raises StopIteration at the end of the
+        stream (or the producer's exception, at its position)."""
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._done = True
+            self._t.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Abandon the stream early: wake + join the worker (no leaked
+        thread when a consumer breaks out of the loop). Idempotent;
+        in-flight prefetched items are dropped."""
+        self._done = True
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._t.is_alive():
+            self._t.join(timeout=10)
+
+    def is_alive(self):
+        return self._t.is_alive()
+
+
 class _PrefetchIter:
     def __init__(self, gen, depth=2):
-        self._q = queue.Queue(maxsize=depth)
-        self._sentinel = object()
-        self._err = None
-
-        def worker():
-            try:
-                for item in gen:
-                    self._q.put(item)
-            except BaseException as e:  # propagate to consumer
-                self._err = e
-            finally:
-                self._q.put(self._sentinel)
-
-        self._t = threading.Thread(target=worker, daemon=True)
-        self._t.start()
+        self._impl = PrefetchThread(gen, depth=depth,
+                                    name="paddle-tpu-loader-prefetch")
+        self._t = self._impl._t
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
-        if item is self._sentinel:
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
+        item = self._impl.get()
         from ..core import monitor
         monitor.increment("dataloader_batches_total")
         return item
+
+    def close(self):
+        self._impl.close()
+
+
+def prefetch_to_device(iterator, mesh=None, size=2, spec=None, engine=None):
+    """Sharded host->device prefetch (see
+    paddle_tpu.distributed.prefetch_to_device — re-exported here because it
+    plays the role of the reference DataLoader's pin-memory double-buffer)."""
+    from ..distributed.prefetch import prefetch_to_device as _impl
+    return _impl(iterator, mesh=mesh, size=size, spec=spec, engine=engine)
 
 
 _autotune_cfg = {"use_autotune": False, "tuning_steps": 8}

@@ -35,9 +35,20 @@ def _root_key():
     return _gen.key
 
 
+# bumped on every explicit (re)seed/state-restore; consumers that cache
+# derived keys (the distributed engine's on-device RNG carry) compare it to
+# notice a mid-run paddle.seed() and refresh their cached key
+_seed_epoch = [0]
+
+
+def seed_epoch():
+    return _seed_epoch[0]
+
+
 def seed(s: int):
     """Reference: paddle.seed."""
     _gen.key = jax.random.PRNGKey(int(s))
+    _seed_epoch[0] += 1
 
 
 def get_state():
@@ -49,6 +60,7 @@ def set_state(key):
     """Restore a snapshot taken by get_state."""
     import jax.numpy as _jnp
     _gen.key = _jnp.asarray(key)
+    _seed_epoch[0] += 1
     return _gen
 
 
@@ -58,6 +70,7 @@ def get_rng_state():
 
 def set_rng_state(state):
     _gen.key = state
+    _seed_epoch[0] += 1
 
 
 class _TraceKeys(threading.local):

@@ -28,7 +28,19 @@ from collections import defaultdict
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "benchmark",
+    "host_recording",
 ]
+
+# module flag flipped by Profiler's record window; hot paths (the
+# distributed engine's dispatch/device_put/write-back spans) consult it so
+# un-profiled runs never touch the native tracer
+_cpu_recording = False
+
+
+def host_recording():
+    """True while a Profiler with the CPU target is inside its RECORD
+    window (host spans are being captured)."""
+    return _cpu_recording
 
 from ..native import build_and_load
 
@@ -223,6 +235,8 @@ class Profiler:
             from ..core import dispatch
 
             dispatch.set_profile_hook(_op_span_hook)
+            global _cpu_recording
+            _cpu_recording = True
         if ProfilerTarget.TPU in self.targets and not self._device_tracing:
             import jax
 
@@ -236,6 +250,8 @@ class Profiler:
 
     def _end_record(self):
         if ProfilerTarget.CPU in self.targets:
+            global _cpu_recording
+            _cpu_recording = False
             _lib().pht_disable()
             from ..core import dispatch
 
@@ -301,7 +317,7 @@ class Profiler:
             ts = self._step_times
             lines.append(
                 f"steps: {len(ts)}  avg {sum(ts) / len(ts) * 1e3:.2f} ms"
-                f"  ips {len(ts) / sum(ts):.2f}")
+                f"  steps/sec {len(ts) / sum(ts):.2f}")
         out = "\n".join(lines)
         print(out)
         return out

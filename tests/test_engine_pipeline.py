@@ -1,0 +1,412 @@
+"""Pipelined training hot path (PR 3): multi-step compiled loop
+(`Engine.train_batches`), device prefetch, lazy parameter write-back, and
+the dispatch-count perf smoke (counts, not wall-clock — timing is flaky in
+CI; host-dispatch counts are deterministic).
+
+Reference analogs: multi-step `Executor.run` amortization and the
+pin-memory/double-buffer DataLoader readers, rebuilt on jax.jit donation +
+lax.scan.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import prefetch_to_device
+from paddle_tpu.models import gpt
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 8)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    def loss(self, x, y):
+        return ((self.forward(x) - y) ** 2).mean()
+
+
+def _mlp_engine(seed=0, lr=0.1, opt_cls=None, **kw):
+    paddle.seed(seed)
+    model = _MLP()
+    opt_cls = opt_cls or paddle.optimizer.SGD
+    opt = opt_cls(learning_rate=lr, parameters=model.parameters())
+    eng = dist.parallelize(model, opt, mesh=dist.build_mesh(dp=8), **kw)
+    return model, eng
+
+
+def _xy(seed=0, bs=8):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randn(bs, 8).astype("float32")),
+            paddle.to_tensor(rng.randn(bs, 8).astype("float32")))
+
+
+def _gpt_engine(seed=0, lr=0.1):
+    paddle.seed(seed)
+    model = gpt("gpt_tiny")
+    opt = paddle.optimizer.SGD(learning_rate=lr,
+                               parameters=model.parameters())
+    return model, dist.parallelize(model, opt, mesh=dist.build_mesh(dp=8))
+
+
+# ---------------------------------------------------------------------------
+# train_batches parity (acceptance: same loss trajectory as n x train_batch)
+# ---------------------------------------------------------------------------
+
+def test_train_batches_static_parity_gpt_tiny():
+    """Fused static-batch scan == 3 sequential train_batch calls."""
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 256, (8, 16)).astype("int32"))
+    _, e_seq = _gpt_engine()
+    seq, gnorms = [], []
+    for _ in range(3):
+        seq.append(float(e_seq.train_batch(ids)))
+        gnorms.append(float(e_seq.last_grad_norm))
+    _, e_multi = _gpt_engine()
+    multi = e_multi.train_batches([(ids,)] * 3)
+    np.testing.assert_allclose(seq, multi.numpy(), rtol=1e-4, atol=1e-6)
+    # grad-norm trajectory parity (sharding/step bugs surface here first)
+    np.testing.assert_allclose(
+        gnorms, np.asarray(e_multi.last_grad_norms), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(e_multi.last_grad_norm), gnorms[-1],
+                               rtol=1e-4, atol=1e-6)
+    assert e_multi.stats["dispatches"] == 1
+    assert e_multi.stats["steps"] == 3 == e_multi._step_count
+
+
+def test_train_batches_dynamic_parity():
+    """Stacked per-step batches (scan xs) == sequential steps, distinct
+    batches."""
+    batches = [_xy(seed=s) for s in range(3)]
+    _, e_seq = _mlp_engine()
+    seq = [float(e_seq.train_batch(*b)) for b in batches]
+    _, e_multi = _mlp_engine()
+    multi = e_multi.train_batches(batches)
+    np.testing.assert_allclose(seq, multi.numpy(), rtol=1e-5, atol=1e-7)
+    assert e_multi.stats["dispatches"] == 1
+
+
+def test_train_batches_adamw_step_counter_on_device():
+    """Bias-correction uses the in-graph step counter: AdamW multi-step
+    must match sequential (step numbers 1,2,3 inside ONE dispatch)."""
+    b = _xy()
+    _, e_seq = _mlp_engine(opt_cls=paddle.optimizer.AdamW, lr=1e-2)
+    seq = [float(e_seq.train_batch(*b)) for _ in range(3)]
+    _, e_multi = _mlp_engine(opt_cls=paddle.optimizer.AdamW, lr=1e-2)
+    multi = e_multi.train_batches([b] * 3)
+    np.testing.assert_allclose(seq, multi.numpy(), rtol=1e-5, atol=1e-7)
+
+
+def test_train_batches_lr_schedule_moves_on_device():
+    """An LRScheduler's values ride into the fused dispatch as scan xs and
+    the engine advances the host schedule once per consumed micro-batch."""
+    b = _xy()
+
+    def mk(seed=0):
+        paddle.seed(seed)
+        model = _MLP()
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=model.parameters())
+        return sched, dist.parallelize(model, opt,
+                                       mesh=dist.build_mesh(dp=8))
+
+    s_seq, e_seq = mk()
+    seq = []
+    for _ in range(3):
+        seq.append(float(e_seq.train_batch(*b)))
+        s_seq.step()
+    s_multi, e_multi = mk()
+    multi = e_multi.train_batches([b] * 3)
+    np.testing.assert_allclose(seq, multi.numpy(), rtol=1e-5, atol=1e-7)
+    assert s_multi.last_epoch == s_seq.last_epoch  # advanced n times
+
+
+def test_train_batches_ragged_falls_back():
+    """Shape-mismatched batches can't stack on a scan axis: sequential
+    fallback still produces the right losses AND keeps the train_batches
+    contract of advancing an LRScheduler once per consumed batch."""
+    b8 = _xy(seed=0, bs=8)
+    b16 = _xy(seed=1, bs=16)
+
+    def mk(seed=0):
+        paddle.seed(seed)
+        model = _MLP()
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=model.parameters())
+        return sched, dist.parallelize(model, opt,
+                                       mesh=dist.build_mesh(dp=8))
+
+    s_seq, e_seq = mk()
+    seq = []
+    for b in (b8, b16):
+        seq.append(float(e_seq.train_batch(*b)))
+        s_seq.step()
+    s, e = mk()
+    out = e.train_batches([b8, b16])
+    np.testing.assert_allclose(seq, out.numpy(), rtol=1e-5, atol=1e-7)
+    assert e.stats["dispatches"] == 2  # one per ragged batch
+    assert s.last_epoch == s_seq.last_epoch  # schedule advanced per batch
+    assert len(np.asarray(e.last_grad_norms)) == 2
+
+
+# ---------------------------------------------------------------------------
+# dispatch-count smoke (acceptance: 20 steps via train_batches/prefetch use
+# fewer dispatches + fewer device_puts than 20x train_batch)
+# ---------------------------------------------------------------------------
+
+def test_20_step_pipeline_fewer_dispatches_and_device_puts():
+    rng = np.random.RandomState(0)
+    raw = [(rng.randn(8, 8).astype("float32"),
+            rng.randn(8, 8).astype("float32")) for _ in range(20)]
+
+    _, e_loop = _mlp_engine()
+    for x, y in raw:
+        e_loop.train_batch(paddle.to_tensor(x), paddle.to_tensor(y))
+    assert e_loop.stats["steps"] == 20
+    assert e_loop.stats["dispatches"] == 20
+
+    _, e_pipe = _mlp_engine()
+    with prefetch_to_device(iter(raw), engine=e_pipe, size=2) as pf:
+        batches = list(pf)
+    e_pipe.train_batches(batches, 20)
+    assert e_pipe.stats["steps"] == 20
+    # the whole 20-step run is ONE compiled dispatch...
+    assert e_pipe.stats["dispatches"] < e_loop.stats["dispatches"]
+    assert e_pipe.stats["dispatches"] == 1
+    # ...and batch transfer work dropped from per-step to per-dispatch
+    assert e_pipe.stats["device_puts"] < e_loop.stats["device_puts"]
+
+
+def test_train_batch_scalar_transfers_are_cached():
+    """lr/step/key device scalars move host->device once, not per step."""
+    b = _xy()
+    _, e = _mlp_engine()
+    e.train_batch(*b)
+    first = e.stats["device_puts"]
+    e.train_batch(*b)
+    e.train_batch(*b)
+    # only the 2 batch args are re-placed per step; no new scalar puts
+    assert e.stats["device_puts"] - first == 4
+
+
+# ---------------------------------------------------------------------------
+# prefetch_to_device
+# ---------------------------------------------------------------------------
+
+def test_prefetch_ordering_and_stopiteration():
+    rng = np.random.RandomState(0)
+    items = [rng.randn(4, 3).astype("float32") for _ in range(8)]
+    pf = prefetch_to_device(iter(items), size=3)
+    got = [t.numpy() for t in pf]
+    assert len(got) == 8
+    for want, g in zip(items, got):
+        np.testing.assert_array_equal(want, g)
+    assert not pf._t.is_alive()  # exhaustion joins the worker
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_prefetch_close_no_leaked_thread():
+    def infinite():
+        i = 0
+        while True:
+            yield np.full((4,), i, np.float32)
+            i += 1
+
+    before = threading.active_count()
+    pf = prefetch_to_device(infinite(), size=2)
+    next(pf)
+    pf.close()
+    pf.close()  # idempotent
+    assert not pf._t.is_alive()
+    assert threading.active_count() <= before + 1
+
+
+def test_prefetch_propagates_source_error():
+    def bad():
+        yield np.ones((2,), np.float32)
+        raise ValueError("boom")
+
+    pf = prefetch_to_device(bad())
+    next(pf)
+    with pytest.raises(ValueError, match="boom"):
+        next(pf)
+    assert not pf._t.is_alive()
+
+
+def test_prefetch_with_engine_shares_placement():
+    """engine= placement yields values train_batch passes through with no
+    further device_put."""
+    rng = np.random.RandomState(0)
+    _, e = _mlp_engine()
+    raw = [(rng.randn(8, 8).astype("float32"),
+            rng.randn(8, 8).astype("float32")) for _ in range(3)]
+    with prefetch_to_device(iter(raw), engine=e) as pf:
+        placed = list(pf)
+    base = e.stats["device_puts"]
+    for x, y in placed:
+        e.train_batch(x, y)
+    # scalar lr/key/step transfers only — batch args were pre-placed
+    assert e.stats["device_puts"] - base <= 3
+
+
+# ---------------------------------------------------------------------------
+# lazy parameter write-back
+# ---------------------------------------------------------------------------
+
+def test_lazy_writeback_state_dict_matches_eager():
+    """state_dict() after k engine steps == k eager steps (acceptance)."""
+    b = _xy()
+
+    paddle.seed(0)
+    eager = _MLP()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=eager.parameters())
+    for _ in range(3):
+        loss = eager.loss(*b)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    model, eng = _mlp_engine()
+    eng.train_batches([b] * 3)
+    want = eager.state_dict()
+    got = model.state_dict()
+    assert set(want) == set(got)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(want[k].numpy()), np.asarray(got[k].numpy()),
+            rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_lazy_param_reads_track_engine_state():
+    from paddle_tpu.core.lazy import EngineRef
+
+    model, eng = _mlp_engine()
+    b = _xy()
+    eng.train_batch(*b)
+    p = model.fc1.weight
+    assert type(p._v_) is EngineRef  # ref survives trace + step
+    name = "fc1.weight"
+    assert p._value is eng.param_vals[name]  # reads resolve, zero copy
+    before = p.numpy().copy()
+    eng.train_batch(*b)
+    after = p.numpy()
+    assert not np.allclose(before, after)  # tracks the live (donated) state
+
+
+def test_reseed_refreshes_engine_key():
+    """paddle.seed() mid-training must refresh the donated on-device RNG
+    carry (old per-step next_key() behavior responded to reseeds)."""
+    _, e = _mlp_engine()
+    b = _xy()
+    e.train_batch(*b)
+    k1 = e._key_dev
+    e.train_batch(*b)
+    assert e._key_dev is not k1  # carry advanced in-graph
+    paddle.seed(123)
+    e.train_batch(*b)  # reseed picked up: a fresh host key was pulled
+    paddle.seed(123)
+    k_a = np.asarray(e._key_scalar())
+    _, e2 = _mlp_engine()
+    paddle.seed(123)
+    k_b = np.asarray(e2._key_scalar())
+    np.testing.assert_array_equal(k_a, k_b)  # deterministic under seed
+
+
+def test_external_param_write_adopted():
+    import jax.numpy as jnp
+
+    model, eng = _mlp_engine(lr=0.0)  # lr 0: update is a no-op
+    b = _xy()
+    eng.train_batch(*b)
+    model.fc1.weight._value = jnp.zeros((8, 16), jnp.float32)
+    eng.train_batch(*b)  # must adopt the external write into engine state
+    np.testing.assert_allclose(model.fc1.weight.numpy(), 0.0)
+    np.testing.assert_allclose(
+        np.asarray(eng.param_vals["fc1.weight"]), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# eval path shares the cached placement helper + shardings
+# ---------------------------------------------------------------------------
+
+def test_eval_batch_shares_cached_shardings():
+    model, eng = _mlp_engine()
+    b = _xy()
+    eng.train_batch(*b)
+    cached = dict(eng._batch_sh_cache)
+    l1 = float(eng.eval_batch(*b))
+    l2 = float(eng.eval_batch(*b))
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert eng._batch_sh_cache == cached  # train's cache reused, not rebuilt
+    assert len(eng._eval_fns) == 1       # one compiled eval per signature
+    assert eng.stats["dispatches"] == 3
+
+
+# ---------------------------------------------------------------------------
+# hapi wiring
+# ---------------------------------------------------------------------------
+
+def test_hapi_fit_with_prefetch():
+    from paddle_tpu.hapi import Model
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8, 8).astype("float32"),
+             rng.randn(8, 8).astype("float32")) for _ in range(4)]
+
+    class _Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = Model(_Net())
+    m.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=m.parameters()),
+        loss=lambda out, y: ((out - y) ** 2).mean())
+    hist = m.fit(data, epochs=2, verbose=0, prefetch=2)
+    assert len(hist["loss"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# profiler spans on the engine hot path
+# ---------------------------------------------------------------------------
+
+def test_engine_spans_recorded_under_profiler():
+    try:
+        from paddle_tpu.native import build_and_load
+        build_and_load("host_tracer")
+    except Exception as e:  # pragma: no cover - no toolchain in env
+        pytest.skip(f"native host_tracer unavailable: {e}")
+    from paddle_tpu.profiler import Profiler, ProfilerTarget, host_recording
+
+    model, eng = _mlp_engine()
+    b = _xy()
+    eng.train_batch(*b)  # compile outside the capture
+    assert not host_recording()
+    prof = Profiler(targets={ProfilerTarget.CPU})
+    prof.start()
+    assert host_recording()
+    eng.train_batch(*b)
+    prof.step()
+    prof.stop()
+    assert not host_recording()
+    names = {name for _, name, _, _ in prof.events()}
+    assert "engine::dispatch" in names
+    assert "engine::device_put" in names
+    out = prof.summary()
+    assert "steps/sec" in out
