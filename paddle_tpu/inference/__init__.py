@@ -19,6 +19,8 @@ __all__ = [
     "ServingPool", "ServingError", "DeadlineExceeded", "Overloaded",
     "PoolClosed", "RequestFailed", "CircuitBreaker", "RetryPolicy",
     "Deadline",
+    # dynamic request batching (batching.py)
+    "BatchConfig", "DynamicBatcher",
 ]
 
 
@@ -56,10 +58,13 @@ class Config:
 
 
 class _Handle:
-    """Input/output tensor handle (reference: ZeroCopyTensor)."""
+    """Input/output tensor handle (reference: ZeroCopyTensor). Input
+    handles carry the exported input_spec entry so shape errors surface
+    at the handle, not later inside the compiled module."""
 
-    def __init__(self):
+    def __init__(self, spec=None):
         self._arr = None
+        self._spec = spec  # {"shape": [...], "dtype": ...} for inputs
 
     def copy_from_cpu(self, arr):
         self._arr = np.asarray(arr)
@@ -72,7 +77,18 @@ class _Handle:
         self._arr = None
 
     def reshape(self, shape):
-        pass  # shapes are fixed by the exported program
+        """Shapes are fixed by the exported program: a matching reshape
+        is a no-op (reference-API compatibility), a mismatched one is an
+        error HERE — not a deferred failure inside the module."""
+        if self._spec is None:
+            return  # output handle: nothing to validate against
+        want = [int(s) for s in self._spec["shape"]]
+        got = [int(s) for s in shape]
+        if got != want:
+            raise ValueError(
+                f"reshape({got}) conflicts with the exported program's "
+                f"fixed input shape {want} — re-export with the desired "
+                f"input_spec (jit.save) instead of reshaping the handle")
 
     @property
     def shape(self):
@@ -87,11 +103,14 @@ class Predictor:
             self._layer = load(config.model_prefix)
         else:
             self._layer = _shared_layer
-        n_in = len(self._layer.input_spec)
-        self._inputs = {f"input_{i}": _Handle() for i in range(n_in)}
-        # output arity is known from the exported module before any run
+        spec = self._layer.input_spec
+        self._inputs = {f"input_{i}": _Handle(spec=spec[i])
+                        for i in range(len(spec))}
+        # output arity is known from the exported module before any run;
+        # output handles are STABLE objects (paddle semantics): callers
+        # may fetch them once and re-read after every run()
         n_out = self._layer.num_outputs or 1
-        self._outputs = {f"output_{i}": None for i in range(n_out)}
+        self._outputs = {f"output_{i}": _Handle() for i in range(n_out)}
 
     def clone(self):
         """Per-thread predictor sharing the loaded executable (reference:
@@ -124,18 +143,18 @@ class Predictor:
         outs = self._layer(*inputs)
         outs = outs if isinstance(outs, tuple) else (outs,)
         res = [np.asarray(o.numpy()) for o in outs]
-        for i, h in enumerate(res):
-            self._outputs[f"output_{i}"] = h
+        for i, arr in enumerate(res):
+            self._outputs[f"output_{i}"].copy_from_cpu(arr)
         return res
 
     def get_output_names(self):
         return list(self._outputs)
 
     def get_output_handle(self, name):
-        h = _Handle()
-        if self._outputs[name] is not None:
-            h.copy_from_cpu(self._outputs[name])
-        return h
+        """The per-name output handle — a stable object (reference
+        semantics): repeated calls return the SAME handle, whose contents
+        update on every run() and clear on reset_handles()."""
+        return self._outputs[name]
 
     def reset_handles(self):
         """Clear all staged input/output state. Pools call this when a
@@ -144,8 +163,8 @@ class Predictor:
         inputs."""
         for h in self._inputs.values():
             h.reset()
-        for n in self._outputs:
-            self._outputs[n] = None
+        for h in self._outputs.values():
+            h.reset()
 
 
 def create_predictor(config: Config) -> Predictor:
@@ -239,6 +258,7 @@ class PredictorPool:
 
 
 # the resilient runtime builds on Predictor/clone above — import last
+from .batching import BatchConfig, DynamicBatcher  # noqa: E402
 from .serving import (  # noqa: E402
     ServingPool, ServingError, DeadlineExceeded, Overloaded, PoolClosed,
     RequestFailed, CircuitBreaker, RetryPolicy, Deadline,

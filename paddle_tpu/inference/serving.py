@@ -30,6 +30,17 @@ production runtime on top of the same clone-sharing substrate:
   detected by the supervisor, retired (its thread abandoned), and replaced
   with a fresh clone, so capacity always converges back to `size`.
 
+* **Dynamic batching** (`batching=BatchConfig(...)`) — concurrent
+  `infer()` requests are coalesced by the workers into padded batches
+  along configured size buckets and served by ONE bucketed AOT dispatch
+  (batching.py + jit/aot.py), deadline-aware: a batch flushes when its
+  bucket fills, `max_wait_ms` elapses, or the earliest request deadline
+  nears. Per-request outputs are sliced back bit-identical to unbatched
+  execution. A failed multi-request batch retries as split singles, so
+  one poison request can't fail its batchmates; `warmup()` precompiles
+  every bucket (persistent across processes via the on-disk compile
+  cache).
+
 * **Graceful drain** — `shutdown(drain_timeout)` stops admissions,
   finishes in-flight and queued work within the timeout, then fails
   whatever remains with `PoolClosed` and releases members.
@@ -240,17 +251,27 @@ class _Request:
     """One admitted request: a callable over a leased predictor plus a
     single-assignment result slot with abandon semantics (the caller may
     give up at its deadline while a worker still holds the request; exactly
-    one side wins)."""
+    one side wins).
 
-    __slots__ = ("id", "fn", "deadline", "attempts", "on_timeout", "_lock",
-                 "_ev", "_state", "_value", "_error")
+    When dynamic batching is on, `feeds` carries the validated input
+    arrays (set by `infer`) so workers can coalesce compatible requests
+    into one dispatch; `fn` remains the batch=1 fallback. `no_batch` is
+    set when a failed batch is split — the request then re-runs alone so
+    failure classification is per-request."""
 
-    def __init__(self, rid, fn, deadline, on_timeout=None):
+    __slots__ = ("id", "fn", "deadline", "attempts", "on_timeout", "feeds",
+                 "no_batch", "enqueued_at", "_lock", "_ev", "_state",
+                 "_value", "_error")
+
+    def __init__(self, rid, fn, deadline, on_timeout=None, feeds=None):
         self.id = rid
         self.fn = fn
         self.deadline = deadline
         self.attempts = 0
         self.on_timeout = on_timeout  # pool stats hook (counted once)
+        self.feeds = feeds            # batchable payload (None: fn-only)
+        self.no_batch = False         # split fallback: must run alone
+        self.enqueued_at = None       # admission clock stamp (queue-wait)
         self._lock = threading.Lock()
         self._ev = threading.Event()
         self._state = _PENDING
@@ -330,6 +351,22 @@ class _Request:
             return self._value
 
 
+class _BatchTicket:
+    """A formed batch in flight on one member: the unit the supervisor
+    sees as `slot.current`. Hang detection is governed by the
+    earliest-expiring request deadline in the batch; a wedge fails every
+    request in it (their compute is abandoned with the retired worker)."""
+
+    __slots__ = ("requests", "deadline")
+
+    def __init__(self, requests):
+        self.requests = requests
+        bounded = [r.deadline for r in requests
+                   if r.deadline.remaining() is not None]
+        self.deadline = (min(bounded, key=lambda d: d.remaining())
+                         if bounded else requests[0].deadline)
+
+
 # ---------------------------------------------------------------------------
 # member slot
 # ---------------------------------------------------------------------------
@@ -384,7 +421,7 @@ class ServingPool:
                  max_queue_depth=64, default_timeout=None,
                  breaker_threshold=3, breaker_reset_timeout=1.0,
                  retry=None, hang_grace=0.1, supervise_interval=0.02,
-                 fault_hook=None, clock=time.monotonic):
+                 fault_hook=None, batching=None, clock=time.monotonic):
         if size < 1:
             raise ValueError("pool size must be >= 1")
         if max_queue_depth < 1:
@@ -395,6 +432,16 @@ class ServingPool:
             from . import Predictor
             predictor = Predictor(config)
         self._base = predictor
+        self._batcher = None
+        if batching is not None and batching is not False:
+            from .batching import BatchConfig, DynamicBatcher
+
+            if isinstance(batching, DynamicBatcher):
+                self._batcher = batching
+            else:
+                cfg = BatchConfig() if batching is True else batching
+                self._batcher = DynamicBatcher(predictor._layer, cfg,
+                                               clock=clock)
         self.max_queue_depth = int(max_queue_depth)
         self.default_timeout = default_timeout
         self.hang_grace = float(hang_grace)
@@ -449,6 +496,9 @@ class ServingPool:
         call `.result()` for the value or the typed error. Raises
         `Overloaded` / `PoolClosed` / `DeadlineExceeded` at admission when
         shedding."""
+        return self._admit(fn, timeout)
+
+    def _admit(self, fn, timeout, feeds=None):
         eff = self.default_timeout if timeout is None else timeout
         dl = Deadline(eff, clock=self._clock)
         with self._cv:
@@ -466,7 +516,8 @@ class ServingPool:
                     f"admission queue full ({self.max_queue_depth} deep) — "
                     f"request shed; retry with backoff or scale the pool")
             req = _Request(next(self._ids), fn, dl,
-                           on_timeout=self._on_caller_timeout)
+                           on_timeout=self._on_caller_timeout, feeds=feeds)
+            req.enqueued_at = self._clock()
             self._queue.append(req)
             self._admitted += 1
             self._cv.notify()
@@ -475,13 +526,34 @@ class ServingPool:
     def infer(self, feeds, timeout=None):
         """Synchronous convenience: run the exported program over `feeds`
         (list of arrays) on some healthy member; returns the list of
-        output arrays or raises the typed serving error."""
-        feeds = [np.asarray(f) for f in feeds]
+        output arrays or raises the typed serving error. With batching
+        enabled, concurrent `infer` calls are coalesced into bucketed
+        batch dispatches (feeds are validated against the exported
+        input_spec at admission — a shape mismatch raises ValueError
+        here, synchronously)."""
+        if self._batcher is not None:
+            feeds = self._batcher.validate(feeds)
+        else:
+            feeds = [np.asarray(f) for f in feeds]
 
         def _run(pred):
             return pred.run(feeds)
 
-        return self.submit(_run, timeout=timeout).result()
+        return self._admit(_run, timeout,
+                           feeds=feeds if self._batcher is not None
+                           else None).result()
+
+    def warmup(self, buckets=None):
+        """Precompile (or load from the persistent compile cache) the AOT
+        executable for every batch bucket, so the pool takes traffic with
+        zero compile stalls. The executables live on the shared exported
+        layer: every clone and every future re-clone (quarantine
+        replacement) uses them for free. Requires batching."""
+        if self._batcher is None:
+            raise RuntimeError(
+                "warmup() needs batching: construct the pool with "
+                "batching=BatchConfig(...)")
+        return self._batcher.warmup(buckets)
 
     def _on_caller_timeout(self, req):
         with self._lock:
@@ -506,6 +578,7 @@ class ServingPool:
                 time.sleep(min(0.01, self._supervise_interval))
                 continue
             req = None
+            batch = None
             with self._cv:
                 if not self._queue:
                     if self._closed and not self._retry_timers \
@@ -525,7 +598,16 @@ class ServingPool:
                         continue
                     req = cand
                     break
-            if req is None or not req.mark_running():
+                if req is not None and self._batcher is not None \
+                        and req.feeds is not None and not req.no_batch:
+                    batch = self._gather_batchmates(req)
+            if req is None:
+                br.cancel_probe()
+                continue
+            if batch is not None:
+                self._run_batch(slot, batch)
+                continue
+            if not req.mark_running():
                 br.cancel_probe()
                 continue
             slot.current = req
@@ -552,6 +634,155 @@ class ServingPool:
                         self._late_results += 1
             finally:
                 slot.current = None
+
+    # -- batched dispatch --------------------------------------------------
+    def _gather_batchmates(self, first):
+        """_cv held. Deadline-aware batch formation (Clipper-style bounded
+        queueing delay): collect batchable queued requests up to the
+        largest bucket, waiting at most `max_wait_ms` for latecomers and
+        flushing early when the bucket fills, the earliest request
+        deadline in the forming batch gets within `deadline_margin_ms`,
+        or the pool is draining. Collected requests are removed from the
+        queue (non-batchable entries keep their order)."""
+        bt = self._batcher
+        cfg = bt.config
+        batch = [first]
+        target = bt.max_bucket
+        start = self._clock()
+        wait_s = cfg.max_wait_ms / 1e3
+        margin_s = cfg.deadline_margin_ms / 1e3
+        while True:
+            if len(batch) < target and self._queue:
+                rest = collections.deque()
+                for c in self._queue:
+                    if c.done():
+                        continue
+                    if (len(batch) < target and c.feeds is not None
+                            and not c.no_batch):
+                        if c.deadline.expired():
+                            if c.fail(DeadlineExceeded(
+                                    f"request {c.id} expired after queue "
+                                    f"wait, before execution")):
+                                self._timed_out += 1
+                            continue
+                        batch.append(c)
+                    else:
+                        rest.append(c)
+                self._queue = rest
+            if len(batch) >= target:
+                bt.note_flush("full")
+                return batch
+            if self._closed or self._stopping:
+                bt.note_flush("drain")
+                return batch
+            budget = wait_s - (self._clock() - start)
+            rem = None
+            for r in batch:
+                rr = r.deadline.remaining()
+                if rr is not None and (rem is None or rr < rem):
+                    rem = rr
+            if rem is not None and rem - margin_s < budget:
+                if rem - margin_s <= 0:
+                    bt.note_flush("deadline")
+                    return batch
+                budget = rem - margin_s
+            if budget <= 0:
+                bt.note_flush("wait")
+                return batch
+            # short slices: submit() notify() may wake a different idle
+            # worker, so the gatherer re-checks the queue periodically
+            self._cv.wait(min(budget, 0.0025))
+
+    def _run_batch(self, slot, batch):
+        """Execute a formed batch on this member: one bucketed AOT
+        dispatch serves the whole group. Per-request outputs are sliced
+        back bit-identical to unbatched execution (batching.py)."""
+        br = slot.breaker
+        live = [r for r in batch if r.mark_running()]
+        if not live:
+            br.cancel_probe()
+            return
+        slot.current = _BatchTicket(live)
+        for r in live:
+            r.attempts += 1
+        try:
+            if self._fault_hook is not None:
+                for r in live:
+                    self._fault_hook(slot.index, r, slot.predictor)
+            results = self._batcher.execute(live)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            self._on_batch_error(slot, live, exc)
+        else:
+            self._reset_member(slot)
+            if not slot.retired:
+                br.record_success()
+            with self._lock:
+                for r, res in zip(live, results):
+                    if r.complete(res):
+                        self._completed += 1
+                        slot.completed += 1
+                    else:
+                        self._late_results += 1
+        finally:
+            slot.current = None
+
+    def _on_batch_error(self, slot, batch, exc):
+        """A batch dispatch raised. The fault cannot be attributed to one
+        request, so a multi-request batch is retried as SPLIT singles
+        (`no_batch`): innocent batchmates re-run and complete, while a
+        poison request re-fails alone and surfaces its own typed error —
+        one bad request can never fail its batchmates."""
+        if len(batch) == 1:
+            self._on_execution_error(slot, batch[0], exc)
+            return
+        self._reset_member(slot)
+        if slot.retired:
+            # late failure of a wedged worker: the supervisor already
+            # failed the batch and charged the breaker — just account
+            with self._lock:
+                for r in batch:
+                    if r.fail(RequestFailed(
+                            f"request {r.id} failed on a retired member: "
+                            f"{type(exc).__name__}: {exc}",
+                            cause=exc, attempts=r.attempts)):
+                        self._failed += 1
+                    else:
+                        self._late_results += 1
+            return
+        if isinstance(exc, DETERMINISTIC_ERRORS):
+            # some batchmate is malformed — the member executed fine: no
+            # health penalty; the split re-run pins the blame
+            slot.breaker.record_success()
+        else:
+            # transient member fault: quarantine + breaker, like singles
+            with self._lock:
+                slot.failures += 1
+            slot.breaker.record_failure()
+            self._quarantine(slot)
+        self._batcher.note_split(len(batch))
+        with self._cv:
+            requeued = []
+            for r in batch:
+                if r.done():
+                    continue
+                if self._stopping:
+                    if r.fail(PoolClosed(
+                            "pool shut down before the split retry ran")):
+                        self._cancelled += 1
+                    continue
+                if r.deadline.expired():
+                    if r.fail(DeadlineExceeded(
+                            f"request {r.id} expired before its split "
+                            f"retry could run")):
+                        self._timed_out += 1
+                    continue
+                if r.mark_pending():
+                    r.no_batch = True
+                    requeued.append(r)
+            for r in reversed(requeued):
+                self._queue.appendleft(r)  # splits resume at the front
+            if requeued:
+                self._cv.notify_all()
 
     def _reset_member(self, slot):
         try:
@@ -695,20 +926,24 @@ class ServingPool:
                 # raised): keep retrying so capacity is never lost
                 self._replace_slot(i, slot)
                 continue
-            req = slot.current
-            if req is None:
+            cur = slot.current
+            if cur is None:
                 continue
-            rem = req.deadline.remaining()
+            rem = cur.deadline.remaining()
             if rem is None or rem > -self.hang_grace:
                 continue
             slot.retired = True
             slot.breaker.record_failure()
+            # a wedged batch fails whole: every request's compute is
+            # abandoned with the retired worker (late results discarded)
+            reqs = cur.requests if isinstance(cur, _BatchTicket) else [cur]
             with self._lock:
                 self._wedged += 1
-                if req.fail(DeadlineExceeded(
-                        f"request {req.id} wedged its member past the "
-                        f"deadline; member {i} replaced")):
-                    self._timed_out += 1
+                for req in reqs:
+                    if req.fail(DeadlineExceeded(
+                            f"request {req.id} wedged its member past the "
+                            f"deadline; member {i} replaced")):
+                        self._timed_out += 1
             self._replace_slot(i, slot)
 
     def _replace_slot(self, i, old):
@@ -765,9 +1000,11 @@ class ServingPool:
             self._stopping = True
             self._cv.notify_all()
         for slot in self._slots:
-            req = slot.current
-            if req is not None and not req.done():
-                if req.fail(PoolClosed(
+            cur = slot.current
+            reqs = (cur.requests if isinstance(cur, _BatchTicket)
+                    else [cur] if cur is not None else [])
+            for req in reqs:
+                if not req.done() and req.fail(PoolClosed(
                         "pool shut down before the request completed")):
                     with self._lock:
                         self._cancelled += 1
@@ -808,6 +1045,10 @@ class ServingPool:
             for slot in self._slots:
                 alive = (not slot.retired and slot.thread is not None
                          and slot.thread.is_alive())
+                cur = slot.current
+                in_flight = (len(cur.requests)
+                             if isinstance(cur, _BatchTicket)
+                             else 1 if cur is not None else 0)
                 members.append({
                     "index": slot.index,
                     "generation": slot.generation,
@@ -816,7 +1057,7 @@ class ServingPool:
                     "failures": slot.failures,
                     "reclones": slot.reclones,
                     "completed": slot.completed,
-                    "in_flight": slot.current is not None,
+                    "in_flight": in_flight,
                 })
             healthy = sum(1 for m in members
                           if m["alive"] and m["breaker"] == "closed")
@@ -836,8 +1077,10 @@ class ServingPool:
                 "reclones": sum(m["reclones"] for m in members),
                 "breaker_trips": sum(s.breaker.trips for s in self._slots),
                 "queue_depth": len(self._queue) + len(self._retry_timers),
-                "in_flight": sum(1 for m in members if m["in_flight"]),
+                "in_flight": sum(m["in_flight"] for m in members),
                 "members": members,
+                "batch": (self._batcher.stats()
+                          if self._batcher is not None else None),
             }
 
     def __len__(self):

@@ -2,3 +2,4 @@
 from .api import (to_static, not_to_static, ignore_module, StaticFunction,
                   enable_to_static, set_code_level, set_verbosity)
 from .save_load import save, load, TranslatedLayer
+from .aot import CompileCache, compile_batched

@@ -1,0 +1,249 @@
+"""jit.aot — shape-bucketed AOT executables + persistent compile cache.
+
+Serving pays XLA compilation twice today: once per process for the
+exported module's batch=1 path, and again for every *new* batch shape a
+batching layer wants to run. Both costs are removable:
+
+* **Bucketed AOT lowering** (`compile_batched`) builds, for one exported
+  module and one bucket size B, a single XLA executable mapping
+  `(params, stacked_inputs[B, ...]) -> stacked_outputs[B, ...]`. The body
+  is `lax.map` over the module's `call` — the exported program is traced
+  ONCE regardless of B (no graph duplication at large buckets), weights
+  stay runtime arguments (never baked in as constants, so the serialized
+  executable holds no model weights), and each example runs exactly the
+  program the standalone module would run, so per-example outputs are
+  bit-identical to unbatched execution. One dispatch then serves B
+  requests — the serving analog of the training engine's multi-step scan.
+
+* **Persistent compile cache** (`CompileCache`): compiled executables are
+  serialized (`jax.experimental.serialize_executable`) to an on-disk
+  cache keyed by model fingerprint x bucket shape x jax/jaxlib version x
+  backend, so a fresh process (or a re-cloned pool member on another
+  host with the same platform) loads the executable instead of
+  recompiling. Writes are crash-atomic (shared `_atomic_io` protocol)
+  and the directory is size-bounded (keep-last-K by LRU mtime).
+
+Cache location: `$PADDLE_TPU_COMPILE_CACHE` if set, else
+`~/.cache/paddle_tpu/compile`. Capacity: `$PADDLE_TPU_COMPILE_CACHE_KEEP`
+entries (default 64). A corrupt or version-skewed entry is never fatal —
+deserialization failure falls back to a fresh compile and overwrites it.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+
+__all__ = ["CompileCache", "compile_batched", "default_cache", "cache_dir"]
+
+_ENV_DIR = "PADDLE_TPU_COMPILE_CACHE"
+_ENV_KEEP = "PADDLE_TPU_COMPILE_CACHE_KEEP"
+_SUFFIX = ".aotexec"
+
+
+def cache_dir():
+    """Resolve the persistent cache directory (env override first, so
+    tests and hermetic CI never pollute $HOME)."""
+    d = os.environ.get(_ENV_DIR)
+    if d:
+        return d
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "compile")
+
+
+class CompileCache:
+    """Size-bounded on-disk blob cache for serialized XLA executables.
+
+    Filesystem layout is one file per key (`<sha256>.aotexec`); writes go
+    through the crash-atomic write-tmp/fsync/rename protocol so a killed
+    process can never leave a torn entry, and concurrent writers (two
+    pools warming the same bucket) simply last-write-win the same bytes.
+    Reads bump the entry's mtime, making the keep-last-K prune an LRU.
+    """
+
+    def __init__(self, root=None, keep=None):
+        self.root = root or cache_dir()
+        if keep is None:
+            keep = int(os.environ.get(_ENV_KEEP, "64"))
+        if keep < 1:
+            raise ValueError("compile cache must keep at least 1 entry")
+        self.keep = keep
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evictions = 0
+
+    # -- keys -------------------------------------------------------------
+    @staticmethod
+    def key(*parts):
+        """Stable cache key over the identity parts (model fingerprint,
+        bucket shapes, software versions, backend)."""
+        h = hashlib.sha256()
+        for p in parts:
+            h.update(str(p).encode())
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    def _path(self, key):
+        return os.path.join(self.root, key + _SUFFIX)
+
+    # -- IO ---------------------------------------------------------------
+    def get(self, key):
+        """Blob bytes for `key`, or None. A hit refreshes the entry's
+        LRU position."""
+        p = self._path(key)
+        try:
+            with open(p, "rb") as f:
+                blob = f.read()
+        except OSError:
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            os.utime(p, None)
+        except OSError:
+            pass
+        with self._lock:
+            self.hits += 1
+        return blob
+
+    def put(self, key, blob):
+        from .._atomic_io import atomic_write
+
+        os.makedirs(self.root, exist_ok=True)
+        atomic_write(self._path(key), lambda f: f.write(blob))
+        with self._lock:
+            self.puts += 1
+        self._prune()
+
+    def _prune(self):
+        """Drop the oldest entries beyond `keep` (LRU by mtime)."""
+        try:
+            names = [n for n in os.listdir(self.root)
+                     if n.endswith(_SUFFIX)]
+        except OSError:
+            return
+        if len(names) <= self.keep:
+            return
+        aged = []
+        for n in names:
+            try:
+                aged.append((os.path.getmtime(os.path.join(self.root, n)), n))
+            except OSError:
+                continue
+        aged.sort()
+        for _, n in aged[: max(0, len(aged) - self.keep)]:
+            try:
+                os.remove(os.path.join(self.root, n))
+                with self._lock:
+                    self.evictions += 1
+            except OSError:
+                pass  # concurrent prune; the bound still holds eventually
+
+    def entries(self):
+        try:
+            return sorted(n[: -len(_SUFFIX)] for n in os.listdir(self.root)
+                          if n.endswith(_SUFFIX))
+        except OSError:
+            return []
+
+    def stats(self):
+        with self._lock:
+            return {"root": self.root, "keep": self.keep,
+                    "entries": len(self.entries()), "hits": self.hits,
+                    "misses": self.misses, "puts": self.puts,
+                    "evictions": self.evictions}
+
+
+_default_cache = None
+_default_lock = threading.Lock()
+
+
+def default_cache():
+    """Process-wide CompileCache over the resolved cache dir. Rebuilt if
+    the env override changed (tests repoint it per tmpdir)."""
+    global _default_cache
+    with _default_lock:
+        if _default_cache is None or _default_cache.root != cache_dir():
+            _default_cache = CompileCache()
+        return _default_cache
+
+
+# ---------------------------------------------------------------------------
+# batched AOT lowering
+# ---------------------------------------------------------------------------
+
+def _versions():
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return (jax.__version__, getattr(jaxlib, "__version__", "?"),
+            dev.platform, str(dev.device_kind))
+
+
+def executable_key(fingerprint, bucket, input_spec, holder_shapes):
+    """Cache key for one bucket executable: model identity x batch shape x
+    software/backend identity (a jax upgrade or platform change must never
+    resurrect a stale executable)."""
+    return CompileCache.key(
+        "batched-v1", fingerprint, bucket,
+        [(list(s["shape"]), str(s["dtype"])) for s in input_spec],
+        holder_shapes, *_versions())
+
+
+def compile_batched(exported, holder_avals, input_spec, bucket, *,
+                    fingerprint=None, cache=None):
+    """AOT-compile (or cache-load) the bucket-B executable for a
+    deserialized `jax.export` module.
+
+    Returns `(fn, source)` where `fn(holder_vals, *stacked_inputs)` runs
+    the module over `bucket` stacked examples in one dispatch and returns
+    a tuple of stacked outputs, and `source` is "compiled" (cold: built
+    here, persisted if a fingerprint was given) or "disk" (warm: loaded
+    from the persistent cache, zero XLA compilation).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable as _se
+
+    if bucket < 1:
+        raise ValueError(f"bucket size must be >= 1, got {bucket}")
+    holder_shapes = [(list(a.shape), str(a.dtype)) for a in holder_avals]
+    key = None
+    if fingerprint is not None:
+        cache = cache or default_cache()
+        key = executable_key(fingerprint, bucket, input_spec, holder_shapes)
+        blob = cache.get(key)
+        if blob is not None:
+            try:
+                payload, in_tree, out_tree = pickle.loads(blob)
+                loaded = _se.deserialize_and_load(payload, in_tree, out_tree)
+                return (lambda holders, *stacked:
+                        loaded(list(holders), *stacked)), "disk"
+            except Exception:
+                pass  # stale/corrupt entry: recompile and overwrite below
+
+    def batched(holder_vals, *stacked):
+        def body(xs):
+            out = exported.call(holder_vals, *xs)
+            return out if isinstance(out, tuple) else (out,)
+        # lax.map traces the exported program once (single copy of the
+        # graph at any bucket size) and runs it per example inside ONE
+        # XLA program — identical per-example numerics, one dispatch.
+        return jax.lax.map(body, tuple(stacked))
+
+    stacked_avals = [
+        jax.ShapeDtypeStruct((bucket, *s["shape"]), jnp.dtype(s["dtype"]))
+        for s in input_spec]
+    compiled = jax.jit(batched).lower(
+        list(holder_avals), *stacked_avals).compile()
+    if key is not None:
+        try:
+            cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))
+        except Exception:
+            pass  # an unserializable backend still serves from memory
+    return (lambda holders, *stacked:
+            compiled(list(holders), *stacked)), "compiled"

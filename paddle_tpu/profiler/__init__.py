@@ -28,7 +28,7 @@ from collections import defaultdict
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "benchmark",
-    "host_recording",
+    "host_recording", "profiled_span",
 ]
 
 # module flag flipped by Profiler's record window; hot paths (the
@@ -41,6 +41,20 @@ def host_recording():
     """True while a Profiler with the CPU target is inside its RECORD
     window (host spans are being captured)."""
     return _cpu_recording
+
+
+def profiled_span(name):
+    """RecordEvent span when a host profiler is actively recording, else
+    a zero-cost no-op context. The shared gate for hot-path
+    instrumentation (the distributed engine's dispatch spans, the serving
+    batcher's form/pad/dispatch/scatter spans): outside a record window
+    the native tracer is never touched, so unprofiled runs pay nothing
+    — not even the tracer's first-use build."""
+    if _cpu_recording:
+        return RecordEvent(name)
+    from contextlib import nullcontext
+
+    return nullcontext()
 
 from ..native import build_and_load
 

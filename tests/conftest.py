@@ -19,3 +19,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' (ROADMAP.md): the mark fences
+    # heavyweight coverage (subprocess smokes etc.) out of the CI budget
+    config.addinivalue_line(
+        "markers", "slow: heavyweight test excluded from the tier-1 run")

@@ -175,6 +175,58 @@ def test_pool_release_after_exception_clears_handles(tmp_path):
     assert s["leases_granted"] == 2
 
 
+def test_handle_reshape_validates_against_input_spec(tmp_path):
+    """`reshape()` is no longer a silent no-op: a matching shape is
+    accepted (reference-API compatibility), a mismatch raises HERE rather
+    than failing later inside the compiled module."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "rs" / "infer")
+    paddle.jit.save(model, path, input_spec=[
+        paddle.to_tensor(np.zeros((2, 8), np.float32))])
+    pred = create_predictor(Config(path))
+    h = pred.get_input_handle("input_0")
+    h.reshape([2, 8])           # exact match: fine
+    h.reshape((2, 8))           # any sequence spelling
+    with pytest.raises(ValueError, match=r"\[4, 8\].*fixed input shape"):
+        h.reshape([4, 8])
+    with pytest.raises(ValueError, match="fixed input shape"):
+        h.reshape([16])
+    # output handles have no spec to validate against: reshape stays inert
+    pred.get_output_handle("output_0").reshape([99])
+
+
+def test_output_handle_is_stable_and_cleared_on_reset(tmp_path):
+    """Paddle semantics: `get_output_handle` returns the SAME handle
+    object every call — fetch once, re-read after every run();
+    `reset_handles()` clears its contents."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    model = _model()
+    model.eval()
+    path = str(tmp_path / "oh" / "infer")
+    paddle.jit.save(model, path, input_spec=[
+        paddle.to_tensor(np.zeros((2, 8), np.float32))])
+    pred = create_predictor(Config(path))
+    oh = pred.get_output_handle("output_0")
+    assert oh is pred.get_output_handle("output_0")   # stable identity
+    assert oh.copy_to_cpu() is None                   # nothing staged yet
+
+    x1 = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+    out1, = pred.run([x1])
+    np.testing.assert_array_equal(oh.copy_to_cpu(), out1)
+    x2 = np.random.RandomState(1).rand(2, 8).astype(np.float32)
+    out2, = pred.run([x2])
+    # the SAME handle object tracks the latest run
+    np.testing.assert_array_equal(oh.copy_to_cpu(), out2)
+
+    pred.reset_handles()
+    assert oh.copy_to_cpu() is None
+    assert oh is pred.get_output_handle("output_0")
+
+
 def test_pool_acquire_timeout(tmp_path):
     from paddle_tpu.inference import Config, PredictorPool
 

@@ -3,8 +3,11 @@
 faults into live ServingPool members and prove the pool always converges
 back to full healthy capacity with no stuck leases, and that every admitted
 request either completes bit-correct or fails with a documented typed error
-— never hangs. Running it in the suite makes resilience regressions fail
-CI, mirroring tests/test_ckpt_fault_injection.py for checkpoints."""
+— never hangs. The batch-crash / batch-hang / batch-poison phases run the
+same invariants with dynamic batching enabled: a failed batch retries as
+split singles, and a poison request is the ONLY typed failure in its batch.
+Running it in the suite makes resilience regressions fail CI, mirroring
+tests/test_ckpt_fault_injection.py for checkpoints."""
 import os
 import subprocess
 import sys

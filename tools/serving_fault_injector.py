@@ -32,10 +32,25 @@ member crashing/wedging under a request):
            no later request can silently consume them;
   none     fault-free control.
 
+Batched phases (`batch-*`) run the same invariants with DYNAMIC BATCHING
+on (ServingPool(batching=BatchConfig(...)) — bucketed AOT dispatch,
+split-on-failure; see docs/serving.md):
+
+  batch-crash   a transient fault fails a whole formed batch: it must be
+                retried as split singles and every request must still
+                complete bit-correct (no innocent batchmate lost);
+  batch-hang    a wedged batch is failed whole by the supervisor (typed
+                DeadlineExceeded for every batchmate) and capacity is
+                restored with a fresh clone;
+  batch-poison  ONE request deterministically raises inside its batch:
+                after the split, the poison request must be the ONLY
+                typed failure in its batch — every batchmate completes
+                bit-correct.
+
 Run as a script (exits nonzero on any violation — registered as a tier-1
 test via tests/test_serving_fault_injection.py):
 
-    python tools/serving_fault_injector.py [--phases crash,hang,...]
+    python tools/serving_fault_injector.py [--phases crash,batch-crash,...]
 """
 from __future__ import annotations
 
@@ -52,7 +67,8 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-PHASES = ("crash", "hang", "poison", "corrupt", "none")
+PHASES = ("crash", "hang", "poison", "corrupt", "none",
+          "batch-crash", "batch-hang", "batch-poison")
 
 POOL_SIZE = 3
 N_REQUESTS = 48
@@ -87,6 +103,7 @@ class _Injector:
         self.active = False     # armed after warmup
         self.lock = threading.Lock()
         self.injected = 0
+        self.poison_id = None   # batch-poison: the one doomed request id
         self.in_member = {}     # id(predictor) -> concurrent executions
         self.max_concurrency = 0
 
@@ -101,7 +118,34 @@ class _Injector:
             self.in_member[id(pred)] = self.in_member.get(id(pred), 1) - 1
 
     def hook(self, slot, req, pred):
-        if not self.active or slot != 0:
+        if not self.active:
+            return
+        if self.phase.startswith("batch-"):
+            # batched phases target REQUESTS (the hook runs once per
+            # request in the formed batch, before the bucketed dispatch)
+            kind = self.phase.split("-", 1)[1]
+            if kind == "crash":
+                # first execution of every 4th request fails its whole
+                # batch: exercises split-retry (innocents must recover)
+                if req.id % 4 == 0 and req.attempts == 1:
+                    with self.lock:
+                        self.injected += 1
+                    raise RuntimeError(f"injected batch crash (req {req.id})")
+            elif kind == "hang":
+                if req.id % 10 == 3 and req.attempts == 1:
+                    with self.lock:
+                        self.injected += 1
+                    time.sleep(HANG_SLEEP)
+            elif kind == "poison":
+                # ONE deterministically-malformed request: raises in the
+                # batch (forcing a split) and again alone (surfacing a
+                # typed RequestFailed for it and nobody else)
+                if req.id == self.poison_id:
+                    with self.lock:
+                        self.injected += 1
+                    raise ValueError(f"injected poison request {req.id}")
+            return
+        if slot != 0:
             return
         if self.phase == "crash":
             # fail the first execution of every 4th request: exercises
@@ -135,14 +179,19 @@ def run_phase(phase, model, path, verbose=True):
         ServingPool)
     from paddle_tpu.inference.serving import RetryPolicy
 
+    from paddle_tpu.inference import BatchConfig
+
+    batched = phase.startswith("batch-")
     inj = _Injector(phase)
-    deadline = HANG_DEADLINE if phase == "hang" else DEADLINE
+    deadline = HANG_DEADLINE if phase.endswith("hang") else DEADLINE
     pool = ServingPool(
         Config(path), size=POOL_SIZE, max_queue_depth=N_REQUESTS + 8,
         default_timeout=deadline,
         breaker_threshold=3, breaker_reset_timeout=0.25,
         retry=RetryPolicy(max_retries=2, base_delay=0.01, max_delay=0.05),
-        hang_grace=0.05, supervise_interval=0.01, fault_hook=inj.hook)
+        hang_grace=0.05, supervise_interval=0.01, fault_hook=inj.hook,
+        batching=BatchConfig(buckets=(1, 2, 4), max_wait_ms=5.0)
+        if batched else None)
 
     rng = np.random.RandomState(7)
     batches = [rng.rand(2, 8).astype(np.float32) for _ in range(N_REQUESTS)]
@@ -151,8 +200,13 @@ def run_phase(phase, model, path, verbose=True):
     bad = []
     outcomes = {"ok": 0, "deadline": 0, "overloaded": 0, "failed": 0}
 
-    # warm up (XLA compiles the shared module on the first run), THEN arm
+    # warm up (XLA compiles the shared module — and with batching on,
+    # every bucket executable via the persistent cache), THEN arm
+    if batched:
+        pool.warmup()
     pool.infer([batches[0]], timeout=60.0)
+    # traffic request ids start after the warmup infer; doom a mid-run one
+    inj.poison_id = 1 + N_REQUESTS // 2
     inj.active = True
 
     def one_request(i):
@@ -167,7 +221,11 @@ def run_phase(phase, model, path, verbose=True):
             finally:
                 inj.exit_member(pred)
         try:
-            out, = pool.submit(fn, timeout=deadline).result()
+            if batched:
+                # feeds-style: the coalescible path batching operates on
+                out, = pool.infer([batches[i]], timeout=deadline)
+            else:
+                out, = pool.submit(fn, timeout=deadline).result()
         except DeadlineExceeded:
             return i, "deadline", None
         except Overloaded:
@@ -214,6 +272,24 @@ def run_phase(phase, model, path, verbose=True):
         bad.append(f"[{phase}] too few successes despite retries: {outcomes}")
     if phase == "poison" and pool.stats()["breaker_trips"] < 1:
         bad.append(f"[{phase}] poisoned slot never tripped its breaker")
+    if batched:
+        bs = pool.stats()["batch"]
+        multi = sum(v for k, v in bs["executed_by_bucket"].items() if k > 1)
+        if multi == 0:
+            bad.append(f"[{phase}] batching never formed a multi-request "
+                       f"batch: {bs['executed_by_bucket']}")
+        acc = sum(k * v for k, v in bs["executed_by_bucket"].items())
+        if acc != bs["requests"] + bs["padded_examples"]:
+            bad.append(f"[{phase}] batch accounting violated: "
+                       f"sum(bucket*dispatches)={acc} != requests+padding="
+                       f"{bs['requests']}+{bs['padded_examples']}")
+    if phase == "batch-crash" and outcomes["ok"] != N_REQUESTS:
+        bad.append(f"[{phase}] split retry lost innocent batchmates: "
+                   f"{outcomes}")
+    if phase == "batch-poison":
+        if outcomes["failed"] != 1 or outcomes["ok"] != N_REQUESTS - 1:
+            bad.append(f"[{phase}] the poison request must be the ONLY "
+                       f"failure in its batch: {outcomes}")
 
     # fault lifted: the pool must converge back to full healthy capacity
     inj.active = False
@@ -278,6 +354,11 @@ def main(argv=None):
     phases = [p.strip() for p in args.phases.split(",") if p.strip()]
     violations = []
     with tempfile.TemporaryDirectory(prefix="serving-fault-") as workdir:
+        # batched phases share one compile cache: the first warmup builds
+        # the bucket executables, later phases disk-hit (and $HOME stays
+        # clean when the harness runs in CI)
+        os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE",
+                              os.path.join(workdir, "compile-cache"))
         path = os.path.join(workdir, "infer")
         model = _export_model(path)
         print("serving fault injection (hook-at-execution):")
