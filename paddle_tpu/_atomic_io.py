@@ -4,12 +4,14 @@ One implementation of the write-tmp → flush → fsync → os.replace protocol
 for every durability-sensitive writer (framework_io.save, the distributed
 checkpoint commit protocol, PS table shards), so fixes to the atomicity
 rules land everywhere at once. Standalone on purpose: importing this must
-never pull jax or the distributed package.
+never pull jax or the distributed package (analysis.locks is stdlib-only).
 """
 from __future__ import annotations
 
 import os
 import uuid
+
+from .analysis import locks as _locks
 
 
 def fsync_path(p):
@@ -34,11 +36,14 @@ def atomic_write(path, writer, fsync_parent=False):
     writers (threads or processes) from clobbering each other's staging."""
     tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
     try:
-        with open(tmp, "wb") as f:
-            writer(f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # fsync + rename is a blocking point: holding any framework lock
+        # across it convoys every peer of that lock on disk latency
+        with _locks.blocking_region("io.atomic_write"):
+            with open(tmp, "wb") as f:
+                writer(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # also blocking (network-FS metadata op)
     finally:
         if os.path.exists(tmp):
             try:

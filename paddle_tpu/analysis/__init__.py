@@ -1,0 +1,34 @@
+"""paddle_tpu.analysis — correctness tooling for a threaded, traced
+framework.
+
+Two passes, two failure families:
+
+* `tracelint` — a pure-AST **trace-safety linter** for JAX-under-trace
+  hazards (wall clocks, host RNG, concretization, closed-over mutation,
+  swallowed exceptions, recompile traps). CLI: ``tools/tpu_lint.py``.
+  Ratchet: ``.tpu_lint_baseline.json`` at the repo root freezes existing
+  findings; new ones fail CI.
+* `lockcheck` + `locks` — an opt-in (``PADDLE_TPU_LOCKCHECK=1``)
+  **lock-order / race checker**: named lock constructors
+  (``locks.new_lock("serving.pool")``), per-thread held-sets, a global
+  acquisition-order graph with cycle detection, and
+  blocked-while-holding probes at the framework's dispatch/IO points.
+
+See docs/static_analysis.md for the rule catalogue and workflows.
+"""
+from . import lockcheck, locks  # noqa: F401
+
+__all__ = ["lockcheck", "locks", "tracelint"]
+
+
+def __getattr__(name):
+    # tracelint (the full AST linter) loads lazily: every runtime import
+    # of analysis.locks — including _atomic_io's, which promises a lean
+    # import — must not pay for a module only tools/tpu_lint.py and the
+    # lint tests need
+    if name == "tracelint":
+        # importlib, NOT `from . import ...`: the from-import form probes
+        # this very __getattr__ mid-load and recurses
+        import importlib
+        return importlib.import_module(".tracelint", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,105 @@
+"""paddle_tpu.analysis.locks — named lock constructors + blocking markers.
+
+The framework's thread-synchronization points are created through these
+constructors instead of bare ``threading.Lock()`` so that
+
+* every lock has a stable human-readable name (``"serving.pool"``,
+  ``"aot.compile_cache"`` ...) — lockcheck reports and acquisition-order
+  graphs speak in those names instead of ``<locked _thread.lock object
+  at 0x...>``;
+* when the checker is off (the default), they return the PLAIN
+  ``threading`` primitive — zero overhead, byte-identical behavior;
+* when ``PADDLE_TPU_LOCKCHECK=1`` (or ``lockcheck.enable()`` ran before
+  construction), they return the instrumented wrappers from
+  `paddle_tpu.analysis.lockcheck`.
+
+Blocking points (XLA dispatch, compile-cache file IO, atomic writes,
+unbounded queue waits) are annotated in the framework with::
+
+    with locks.blocking_region("serving.execute"):
+        result = executable(...)
+
+which is a no-op singleton when the checker is off and a
+held-locks-at-blocking-call probe when it is on.
+
+Names are free-form dotted strings; instances may share a name (each
+request's result lock is ``"serving.request"``) — ordering analysis is
+per NAME, which also catches two same-class instances nesting.
+"""
+from __future__ import annotations
+
+import threading
+
+from . import lockcheck
+
+__all__ = ["new_lock", "new_rlock", "new_condition", "blocking_region",
+           "is_checked"]
+
+
+def new_lock(name):
+    """A mutex named `name`: plain threading.Lock when the checker is
+    off, an InstrumentedLock when it is on."""
+    if lockcheck.enabled():
+        return lockcheck.InstrumentedLock(name)
+    return threading.Lock()
+
+
+def new_rlock(name):
+    if lockcheck.enabled():
+        return lockcheck.InstrumentedRLock(name)
+    return threading.RLock()
+
+
+def new_condition(name, lock=None):
+    """A condition variable. `lock` may be a lock previously returned by
+    `new_lock` (shared lock/cv idiom); when omitted a fresh lock named
+    `name` is created."""
+    if lock is None:
+        lock = new_lock(name)
+    if isinstance(lock, lockcheck.InstrumentedLock):
+        return lockcheck.InstrumentedCondition(lock)
+    if isinstance(lock, lockcheck.InstrumentedRLock):
+        raise TypeError("condition over a checked RLock is unsupported; "
+                        "use new_lock() for the condition's mutex")
+    return threading.Condition(lock)
+
+
+def is_checked(lock):
+    return isinstance(lock, (lockcheck.InstrumentedLock,
+                             lockcheck.InstrumentedRLock))
+
+
+class _NullRegion:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullRegion()
+
+
+class _CheckedRegion:
+    __slots__ = ("label",)
+
+    def __init__(self, label):
+        self.label = label
+
+    def __enter__(self):
+        lockcheck.registry().note_blocking(self.label)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def blocking_region(label):
+    """Mark a blocking call site (dispatch / file IO / queue wait).
+    Entering it while holding any checked lock records a
+    held-across-blocking violation. Free when the checker is off."""
+    if lockcheck.enabled():
+        return _CheckedRegion(label)
+    return _NULL

@@ -1,0 +1,955 @@
+"""paddle_tpu.analysis.tracelint — pure-AST trace-safety linter.
+
+JAX-under-trace hazards are silent: a `time.time()` inside a jitted
+function is evaluated ONCE at trace time and baked into the graph as a
+constant; `np.random.*` likewise freezes a single sample; `bool()/int()/
+float()/.item()` on a tracer raises `ConcretizationTypeError` (or, under
+`jax.ensure_compile_time_eval`, silently concretizes); mutating a
+closed-over list/dict from inside a traced function runs at TRACE time,
+not per step, so the mutation happens once and then never again; a bare
+`print` prints tracers at trace time instead of values per step
+(`jax.debug.print` is the runtime form); unhashable static args and
+f-strings over traced values force retraces on every call. None of these
+fail loudly in the common path — they corrupt results or silently
+recompile. This linter finds them statically.
+
+Rule catalogue (docs/static_analysis.md has one bad/good example each):
+
+  TL001  wall-clock call under trace (`time.time/monotonic/perf_counter`,
+         `datetime.now`) — value frozen at trace time
+  TL002  host RNG under trace (`np.random.*`, stdlib `random.*`) — sample
+         frozen at trace time; use `jax.random` with a threaded key
+  TL003  concretizing a likely-traced value (`.item()`, `.tolist()`,
+         `bool()/int()/float()` on an expression derived from traced
+         arguments) — trace-time error or silent constant-folding
+  TL004  `np.*` applied to a likely-traced value — silently falls back to
+         host numpy at trace time (constant-folds) or raises; use `jnp.*`
+  TL005  mutation of closed-over container state under trace (append/
+         update/subscript-store on a free variable) — runs once at trace
+         time, not per execution
+  TL006  `print` under trace — prints tracers at trace time; use
+         `jax.debug.print`
+  TL007  swallowed exception: bare `except:` or `except Exception:` /
+         `except BaseException:` that neither binds the exception nor
+         re-raises — hides real faults (anywhere, not just under trace)
+  TL008  unhashable static argument: a list/dict/set literal passed in a
+         position declared static via `static_argnums`/`static_argnames`
+         — `TypeError: unhashable` at call time
+  TL009  f-string interpolating a likely-traced value under trace —
+         concretization/retrace hazard (the string is built at trace
+         time from the tracer's repr)
+  TL010  `time.time()` anywhere (host code included): wall clocks step
+         under NTP, so deadline/interval arithmetic built on them can
+         jump backwards or fire early/late — use `time.monotonic()`;
+         suppress where wall-clock time IS the point (manifest
+         timestamps, user-facing dates)
+
+Suppressions: append ``# tpu-lint: disable=TL001`` (comma-separate for
+several, or ``disable=all``) to the offending line (for ``except``
+clauses: the ``except`` line). Suppressed findings never appear and never
+enter the baseline.
+
+Baseline ratchet: existing findings are frozen in
+``.tpu_lint_baseline.json`` keyed by ``path::rule::scope`` with a count
+(line numbers deliberately excluded so unrelated edits don't churn the
+file). A key whose current count exceeds its baselined count fails; a key
+at or under it passes. Regenerate with ``tools/tpu_lint.py
+--write-baseline`` (sorted keys — diffs stay reviewable).
+
+Everything here is stdlib-`ast` only: no imports of the linted code, no
+JAX, safe to run anywhere (including CI boxes with no accelerator).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+
+__all__ = [
+    "RULES", "Finding", "lint_source", "lint_file", "lint_paths",
+    "iter_python_files", "load_baseline", "write_baseline",
+    "new_findings",
+]
+
+RULES = {
+    "TL000": "file does not parse (never baseline this: fix the syntax)",
+    "TL001": "wall-clock call under trace (value frozen at trace time)",
+    "TL002": "host RNG under trace (use jax.random with a threaded key)",
+    "TL003": "concretizing a likely-traced value",
+    "TL004": "np.* applied to a likely-traced value (use jnp.*)",
+    "TL005": "mutation of closed-over state under trace (runs once, at "
+             "trace time)",
+    "TL006": "print under trace (use jax.debug.print)",
+    "TL007": "swallowed exception (bare/overbroad except that neither "
+             "binds nor re-raises)",
+    "TL008": "unhashable literal passed as a static argument",
+    "TL009": "f-string over a likely-traced value under trace "
+             "(concretization/retrace hazard)",
+    "TL010": "wall-clock time.time() for deadline/interval math (NTP "
+             "step-fragile; use time.monotonic())",
+}
+
+# Decorators / higher-order callers that put the wrapped function under a
+# JAX trace. Matched on the trailing dotted components, so `jax.jit`,
+# `jit`, `partial(jax.jit, ...)` and `functools.partial(jit, ...)` all
+# hit.
+_TRACING_NAMES = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "custom_jvp", "custom_vjp", "defjvp",
+    "linearize", "jvp", "vjp", "make_jaxpr", "eval_shape", "xla_computation",
+    "to_static",
+}
+# Higher-order lax/control-flow callers whose FUNCTION ARGUMENTS are
+# traced (the call itself may appear in untraced code).
+_TRACING_CALLERS = _TRACING_NAMES | {
+    "scan", "while_loop", "cond", "fori_loop", "switch", "map",
+    "associative_scan", "custom_root",
+}
+# Which positional args of a tracing caller are the traced callables
+# (everything not listed here takes its function at position 0):
+#   while_loop(cond_fun, body_fun, init)   cond(pred, true_fn, false_fn, *)
+#   fori_loop(lo, hi, body_fun, init)      switch(index, branches, *)
+_CALLABLE_POSITIONS = {
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "switch": (1,),
+}
+
+_WALL_CLOCK = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("time", "monotonic_ns"), ("time", "perf_counter_ns"),
+    ("time", "time_ns"), ("datetime", "now"), ("datetime", "utcnow"),
+}
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear", "appendleft",
+    "extendleft", "popleft",
+}
+_NP_SAFE = {
+    # attribute *calls* on np that are trace-safe / shape-only
+    "ndim", "shape", "dtype", "result_type", "promote_types", "issubdtype",
+    "iinfo", "finfo", "can_cast", "broadcast_shapes",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable(?:=|\s*=\s*)([A-Za-z0-9_,\s]+|all)")
+
+
+class Finding:
+    """One lint hit. `key` is the baseline identity: path, rule and
+    enclosing scope — no line numbers, so edits elsewhere in the file
+    don't invalidate the ratchet."""
+
+    __slots__ = ("rule", "path", "line", "col", "scope", "message")
+
+    def __init__(self, rule, path, line, col, scope, message=""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.scope = scope
+        self.message = message or RULES[rule]
+
+    @property
+    def key(self):
+        return f"{self.path}::{self.rule}::{self.scope}"
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "scope": self.scope,
+                "message": self.message}
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.scope}] {self.message}")
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+
+def _suppressions(source):
+    """line (1-based) -> set of suppressed rule ids (or {'all'}).
+
+    Only real COMMENT tokens count — a string literal containing the
+    marker text must not silence findings on its line. Callers parse the
+    source first, so tokenization is expected to succeed; if it still
+    fails we fall back to honoring no suppressions (fail CLOSED: a
+    finding too many beats one silently masked)."""
+    out = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for lineno, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        # rule tokens run until the first word that is not rule-shaped:
+        # `disable=TL007 deliberate swallow` suppresses TL007 (the plain
+        # -word reason must not void the suppression it annotates)
+        tokens = [t for t in re.split(r"[\s,]+", m.group(1).strip()) if t]
+        if tokens and tokens[0].lower() == "all":
+            out[lineno] = {"all"}
+            continue
+        rules = set()
+        for tok in tokens:
+            if re.fullmatch(r"[A-Za-z]{2}\d+", tok):
+                rules.add(tok.upper())
+            else:
+                break
+        if rules:
+            out[lineno] = rules
+    return out
+
+
+def _wallclock_aliases(tree):
+    """local name -> dotted wall-clock callable for `from time import
+    time [as t]`-style bindings, which call sites reach as a BARE name
+    the two-component _WALL_CLOCK match can never see."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if ("time", a.name) in _WALL_CLOCK:
+                    out[a.asname or a.name] = f"time.{a.name}"
+    return out
+
+
+def _rng_aliases(tree):
+    """local name -> dotted host-RNG callable for `from random import
+    random [as r]` / `from numpy.random import rand`-style bindings,
+    which call sites reach as a BARE name the `random.`/`np.random.`
+    prefix match can never see."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "random", "numpy.random"):
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _jax_aliases(tree):
+    """Names this module binds to JAX submodules, e.g. `from jax import
+    random` / `import jax.random as jrandom` / `import jax.numpy as np`.
+    Rules that pattern-match on `random.*` / `np.*` must NOT fire on
+    names that actually resolve to jax — that code is already correct."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.") and a.asname:
+                    out[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and (node.module == "jax"
+                     or node.module.startswith("jax.")):
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _module_aliases(tree):
+    """asname -> real dotted module for `import time as t` /
+    `import numpy as n` / `import numpy.random as nr` bindings (plus
+    `from datetime import datetime as dt`). Hazard matching is on the
+    real module path, so aliased call sites resolve through this first."""
+    real = ("time", "datetime", "random", "numpy", "numpy.random")
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname and a.asname != a.name and a.name in real:
+                    out[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module == "datetime":
+            for a in node.names:
+                if a.name == "datetime" and a.asname:
+                    out[a.asname] = "datetime"
+    return out
+
+
+def _resolve_module_alias(callee, aliases):
+    """'t.time' -> 'time.time' when `import time as t` is in scope."""
+    if not callee or not aliases or "." not in callee:
+        return callee
+    head, rest = callee.split(".", 1)
+    real = aliases.get(head)
+    return f"{real}.{rest}" if real else callee
+
+
+def _suppressed(suppress, rule, *lines):
+    for ln in lines:
+        if ln is None:
+            continue
+        rules = suppress.get(ln)
+        if rules and ("all" in rules or rule in rules):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+# --------------------------------------------------------------------------
+
+def _dotted(node):
+    """'jax.lax.scan' for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last(dotted):
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_tracing_callee(dotted):
+    """True if a dotted callee name is a trace-inducing higher-order
+    caller (jax.jit / lax.scan / jit / partial(jax.jit, ...) handled by
+    the caller)."""
+    if not dotted:
+        return False
+    last = _last(dotted)
+    if last not in _TRACING_CALLERS:
+        return False
+    # plain `map`/`cond`/... only count when qualified (lax.map), to
+    # avoid flagging builtins; the jit/vmap-style names count bare too.
+    if last in ("map", "cond", "switch", "while_loop", "scan",
+                "fori_loop") and "." not in dotted:
+        return False
+    return True
+
+
+def _tracing_decorator(dec):
+    """Does this decorator node put the function under trace?"""
+    if isinstance(dec, ast.Call):
+        callee = _dotted(dec.func)
+        if _last(callee) == "partial":
+            return any(_is_tracing_callee(_dotted(a)) for a in dec.args)
+        return _is_tracing_callee(callee)
+    return _is_tracing_callee(_dotted(dec))
+
+
+# --------------------------------------------------------------------------
+# phase A: find traced regions (functions + lambdas) in a module
+# --------------------------------------------------------------------------
+
+class _FuncInfo:
+    __slots__ = ("node", "qualname", "called", "traced", "nested_in")
+
+    def __init__(self, node, qualname, nested_in=None):
+        self.node = node
+        self.qualname = qualname
+        self.called = set()     # simple names this function calls
+        self.traced = False
+        self.nested_in = nested_in  # enclosing _FuncInfo or None
+
+
+class _Collector(ast.NodeVisitor):
+    """Builds the per-module function table, the (name-resolved, same
+    module) call graph, and the traced-root set."""
+
+    def __init__(self):
+        self.funcs = []             # all _FuncInfo
+        self.by_name = {}           # simple name -> [_FuncInfo]
+        self.traced_lambdas = []    # Lambda nodes passed to tracing callers
+        self._scope = []            # stack of _FuncInfo
+        self._class_stack = []
+        self._deferred_marks = []   # simple names to resolve post-walk
+
+    # -- defs -------------------------------------------------------------
+    def _handle_def(self, node):
+        parts = [f.node.name for f in self._scope]
+        qual = ".".join(self._class_stack + parts + [node.name])
+        info = _FuncInfo(node, qual,
+                         nested_in=self._scope[-1] if self._scope else None)
+        if any(_tracing_decorator(d) for d in node.decorator_list):
+            info.traced = True
+        self.funcs.append(info)
+        self.by_name.setdefault(node.name, []).append(info)
+        self._scope.append(info)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._handle_def(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node.name)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._class_stack.pop()
+
+    # -- calls ------------------------------------------------------------
+    def _mark_callable_arg(self, arg):
+        # resolution happens after the walk: the target def may appear
+        # later in the module than the call that traces it
+        if isinstance(arg, ast.Lambda):
+            # pair the lambda with its enclosing qualname NOW (the scope
+            # stack is live): scope must stay line-number-free or the
+            # baseline key churns whenever code above the lambda moves
+            encl = ".".join(self._class_stack
+                            + [f.node.name for f in self._scope])
+            self.traced_lambdas.append((arg, encl or "<module>"))
+        elif isinstance(arg, ast.Name):
+            self._deferred_marks.append(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            self._deferred_marks.append(arg.attr)
+
+    def resolve_marks(self):
+        for name in self._deferred_marks:
+            for info in self.by_name.get(name, ()):
+                info.traced = True
+
+    def visit_Call(self, node):
+        callee = _dotted(node.func)
+        if self._scope and isinstance(node.func, ast.Name):
+            self._scope[-1].called.add(node.func.id)
+        if _is_tracing_callee(callee):
+            # only CALLABLE positions: data args (scan carry/xs, cond
+            # operands) must not taint a same-named module function
+            for i in _CALLABLE_POSITIONS.get(_last(callee), (0,)):
+                if i < len(node.args):
+                    a = node.args[i]
+                    if isinstance(a, (ast.List, ast.Tuple)):
+                        for el in a.elts:    # switch branch lists
+                            self._mark_callable_arg(el)
+                    else:
+                        self._mark_callable_arg(a)
+            for kw in node.keywords:
+                if kw.arg in ("f", "fun", "func", "body_fun", "cond_fun"):
+                    self._mark_callable_arg(kw.value)
+        self.generic_visit(node)
+
+
+def _propagate(collector):
+    """Mark traced: roots + nested defs inside traced fns + same-module
+    callees of traced fns (transitively)."""
+    changed = True
+    while changed:
+        changed = False
+        for info in collector.funcs:
+            if info.traced:
+                continue
+            if info.nested_in is not None and info.nested_in.traced:
+                info.traced = changed = True
+                continue
+        frontier = [f for f in collector.funcs if f.traced]
+        seen = set(id(f) for f in frontier)
+        while frontier:
+            f = frontier.pop()
+            for name in f.called:
+                for callee in collector.by_name.get(name, ()):
+                    if id(callee) not in seen:
+                        callee.traced = True
+                        seen.add(id(callee))
+                        frontier.append(callee)
+                        changed = True
+    return [f for f in collector.funcs if f.traced]
+
+
+# --------------------------------------------------------------------------
+# phase B: rule walkers
+# --------------------------------------------------------------------------
+
+class _TraceRules(ast.NodeVisitor):
+    """Walks ONE traced function/lambda body. Nested defs/lambdas are
+    skipped — they are traced regions of their own and get their own
+    walk."""
+
+    def __init__(self, path, scope, params, suppress, findings,
+                 jax_aliases=None, wall_lines=None, wall_aliases=None,
+                 mod_aliases=None, rng_aliases=None):
+        self.path = path
+        self.scope = scope
+        self.tainted = set(params)
+        self.local = set(params)
+        self.suppress = suppress
+        self.findings = findings
+        self.jax_aliases = jax_aliases or {}
+        self.wall_aliases = wall_aliases or {}
+        self.mod_aliases = mod_aliases or {}
+        self.rng_aliases = rng_aliases or {}
+        # lines with a wall-clock call under trace, SUPPRESSED OR NOT:
+        # the TL010 sweep skips them so one acknowledged call never
+        # needs a second stacked `disable=TL010`
+        self.wall_lines = wall_lines if wall_lines is not None else set()
+        self._root = None
+
+    def _is_jax(self, callee):
+        return bool(callee) and \
+            self.jax_aliases.get(callee.split(".", 1)[0], "").startswith(
+                "jax")
+
+    # -- helpers ----------------------------------------------------------
+    def _emit(self, rule, node, message=""):
+        line = getattr(node, "lineno", None)
+        if _suppressed(self.suppress, rule, line,
+                       getattr(node, "end_lineno", None)):
+            return
+        self.findings.append(Finding(
+            rule, self.path, line or 0, getattr(node, "col_offset", 0),
+            self.scope, message or RULES[rule]))
+
+    def _is_tainted(self, node):
+        return bool(_names_in(node) & self.tainted)
+
+    # -- scope fencing -----------------------------------------------------
+    def run(self, root_body):
+        for stmt in root_body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        self.local.add(node.name)   # nested def binds its name locally
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass  # walked separately if itself passed to a tracing caller
+
+    def visit_ClassDef(self, node):
+        self.local.add(node.name)
+
+    # -- taint bookkeeping -------------------------------------------------
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        tainted = self._is_tainted(node.value)
+        for tgt in node.targets:
+            self._check_store(tgt)
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    self.local.add(n.id)
+                    if tainted:
+                        self.tainted.add(n.id)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        self._check_store(node.target)
+        if isinstance(node.target, ast.Name):
+            self.local.add(node.target.id)
+            if self._is_tainted(node.value):
+                self.tainted.add(node.target.id)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            if isinstance(node.target, ast.Name):
+                self.local.add(node.target.id)
+                if self._is_tainted(node.value):
+                    self.tainted.add(node.target.id)
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        tainted = self._is_tainted(node.iter)
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                self.local.add(n.id)
+                if tainted:
+                    self.tainted.add(n.id)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_With(self, node):
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                for n in ast.walk(item.optional_vars):
+                    if isinstance(n, ast.Name):
+                        self.local.add(n.id)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def visit_comprehension_targets(self, node):
+        for gen in node.generators:
+            for n in ast.walk(gen.target):
+                if isinstance(n, ast.Name):
+                    self.local.add(n.id)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_comprehension_targets
+    visit_SetComp = visit_comprehension_targets
+    visit_DictComp = visit_comprehension_targets
+    visit_GeneratorExp = visit_comprehension_targets
+
+    # -- TL005: closed-over mutation --------------------------------------
+    def _check_store(self, tgt):
+        if isinstance(tgt, ast.Subscript):
+            root = tgt.value
+            while isinstance(root, ast.Subscript):
+                root = root.value
+            name = _dotted(root)
+            if name is None:
+                return
+            head = name.split(".", 1)[0]
+            # self/cls are parameters, not closed-over state — same
+            # exemption as the mutator-call branch below
+            if head not in self.local and head not in ("self", "cls"):
+                self._emit("TL005", tgt,
+                           f"subscript-store into closed-over `{name}` "
+                           "runs at trace time, not per step")
+
+    # -- calls: TL001/2/3/4/6 + TL005 mutator methods ----------------------
+    def visit_Call(self, node):
+        callee = _resolve_module_alias(_dotted(node.func),
+                                       self.mod_aliases)
+        last = _last(callee)
+
+        wall = self.wall_aliases.get(callee, callee) if callee else None
+        if wall and tuple(wall.split(".")[-2:]) in _WALL_CLOCK:
+            if node.lineno:
+                self.wall_lines.add(node.lineno)
+            self._emit("TL001", node,
+                       f"`{callee}()` is evaluated once, at trace time")
+        elif callee and (callee.startswith(("np.random.", "numpy.random.",
+                                            "random."))
+                         or (callee in self.rng_aliases
+                             and callee not in self.local)) \
+                and not self._is_jax(callee):
+            real = self.rng_aliases.get(callee, callee)
+            self._emit("TL002", node,
+                       f"`{real}()` freezes one host sample into the "
+                       "graph; use jax.random")
+        elif callee and callee.split(".", 1)[0] in ("np", "numpy") \
+                and "." in callee and last not in _NP_SAFE \
+                and not callee.split(".", 1)[1].startswith("random") \
+                and not self._is_jax(callee):
+            if any(self._is_tainted(a) for a in node.args):
+                self._emit("TL004", node,
+                           f"`{callee}` on a traced value constant-folds "
+                           "at trace time or raises; use jnp")
+        elif last in ("bool", "int", "float") and callee == last \
+                and len(node.args) == 1 and self._is_tainted(node.args[0]):
+            self._emit("TL003", node,
+                       f"`{last}()` on a traced value concretizes at "
+                       "trace time")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist") \
+                and self._is_tainted(node.func.value):
+            last = node.func.attr
+            self._emit("TL003", node,
+                       f"`.{last}()` on a traced value concretizes at "
+                       "trace time")
+        elif callee == "print":
+            self._emit("TL006", node)
+        elif last in _MUTATORS and isinstance(node.func, ast.Attribute):
+            name = _dotted(node.func.value)
+            if name is not None:
+                head = name.split(".", 1)[0]
+                if head not in self.local and head not in ("self", "cls"):
+                    self._emit("TL005", node,
+                               f"`{name}.{last}(...)` mutates closed-over "
+                               "state at trace time")
+        self.generic_visit(node)
+
+    # -- TL009: f-strings over traced values -------------------------------
+    def visit_JoinedStr(self, node):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue) and self._is_tainted(
+                    v.value):
+                self._emit("TL009", node,
+                           "f-string interpolates a traced value "
+                           "(concretization/retrace hazard)")
+                break
+        self.generic_visit(node)
+
+
+def _swallow_findings(path, tree, suppress, findings):
+    """TL007 over the whole module (traced or not)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        overbroad = node.type is None or _last(_dotted(node.type)) in (
+            "Exception", "BaseException")
+        if isinstance(node.type, ast.Tuple):
+            overbroad = any(_last(_dotted(e)) in ("Exception",
+                                                  "BaseException")
+                            for e in node.type.elts)
+        if not overbroad or node.name is not None:
+            continue
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            continue
+        if _suppressed(suppress, "TL007", node.lineno):
+            continue
+        findings.append(Finding(
+            "TL007", path, node.lineno, node.col_offset, "<module>",
+            "bare/overbroad except neither binds nor re-raises the "
+            "exception — name the expected type, bind `as e`, or add a "
+            "suppression saying what is deliberately swallowed"))
+
+
+def _wallclock_findings(path, tree, suppress, findings, wall_aliases=None,
+                        mod_aliases=None):
+    """TL010 over the whole module. Call sites already flagged TL001
+    (under trace) are filtered by the caller — one diagnosis per bug."""
+    wall_aliases = wall_aliases or {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _resolve_module_alias(_dotted(node.func),
+                                       mod_aliases or {})
+        if wall_aliases.get(callee, callee) in ("time.time",
+                                                "time.time_ns"):
+            if not _suppressed(suppress, "TL010", node.lineno):
+                findings.append(Finding(
+                    "TL010", path, node.lineno, node.col_offset,
+                    "<module>"))
+
+
+def _static_spec(keywords):
+    """(positions, names) declared static in a jit/partial keyword list."""
+    positions, names = set(), set()
+    for kw in keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elts:
+            if not isinstance(e, ast.Constant):
+                continue
+            if kw.arg == "static_argnums" and isinstance(e.value, int):
+                positions.add(e.value)
+            elif kw.arg == "static_argnames" and isinstance(e.value, str):
+                names.add(e.value)
+    return positions, names
+
+
+def _static_arg_findings(path, tree, suppress, findings):
+    """TL008: list/dict/set literals at positions declared static.
+
+    Two declaration shapes are resolved to the name call sites use:
+      g = jax.jit(f, static_argnums=(1,))     ->  calls of `g`
+      @partial(jax.jit, static_argnums=(1,))  ->  calls of the def'd name
+      def f(...)
+    """
+    wrapped = {}   # callable name -> (positions, names, is_method)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if _last(_dotted(call.func)) in ("jit", "pjit"):
+                pos, names = _static_spec(call.keywords)
+                if pos or names:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            wrapped[tgt.id] = (pos, names, False)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                dlast = _last(_dotted(dec.func))
+                if dlast in ("jit", "pjit") or (
+                        dlast == "partial" and any(
+                            _last(_dotted(a)) in ("jit", "pjit")
+                            for a in dec.args)):
+                    pos, names = _static_spec(dec.keywords)
+                    if pos or names:
+                        args = node.args.posonlyargs + node.args.args
+                        is_method = bool(args) and \
+                            args[0].arg in ("self", "cls")
+                        wrapped[node.name] = (pos, names, is_method)
+    if not wrapped:
+        return
+    unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                  ast.SetComp)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        spec = wrapped.get(_last(fname)) if fname else None
+        if spec is None:
+            continue
+        positions, names, is_method = spec
+        # a method is called bound (`m.f(...)`) and its static_argnums
+        # count `self`; a plain function is called by name. Requiring the
+        # shapes to agree both fixes the position bookkeeping and stops
+        # unrelated attribute calls that merely SHARE the last name
+        # component from matching a wrapped plain function.
+        if is_method != isinstance(node.func, ast.Attribute):
+            continue
+        offset = 1 if is_method else 0
+        bad = [a for i, a in enumerate(node.args)
+               if i + offset in positions] + \
+              [kw.value for kw in node.keywords if kw.arg in names]
+        for b in bad:
+            if isinstance(b, unhashable) and not _suppressed(
+                    suppress, "TL008", node.lineno):
+                findings.append(Finding(
+                    "TL008", path, b.lineno, b.col_offset, "<module>",
+                    f"unhashable literal passed to `{fname}` in a static "
+                    "position — TypeError at call time"))
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+def lint_source(source, path="<string>"):
+    """Lint one source string. Returns a sorted list of Findings."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        # dedicated rule id: reporting this under a real rule would let a
+        # baselined finding for the same file::rule::scope key silently
+        # absorb the parse error (and the ratchet would also "pass" every
+        # finding the broken file can no longer produce)
+        return [Finding("TL000", path, e.lineno or 0, 0, "<module>",
+                        f"file does not parse: {e.msg}")]
+    suppress = _suppressions(source)
+    jax_aliases = _jax_aliases(tree)
+    wall_aliases = _wallclock_aliases(tree)
+    mod_aliases = _module_aliases(tree)
+    rng_aliases = _rng_aliases(tree)
+    findings = []
+
+    collector = _Collector()
+    collector.visit(tree)
+    collector.resolve_marks()
+    traced = _propagate(collector)
+
+    wall_under_trace = set()
+    for info in traced:
+        node = info.node
+        params = [a.arg for a in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs)
+                  if a.arg not in ("self", "cls")]
+        if node.args.vararg:
+            params.append(node.args.vararg.arg)
+        if node.args.kwarg:
+            params.append(node.args.kwarg.arg)
+        walker = _TraceRules(path, info.qualname, params, suppress,
+                             findings, jax_aliases, wall_under_trace,
+                             wall_aliases, mod_aliases, rng_aliases)
+        walker.run(node.body)
+    for lam, encl in collector.traced_lambdas:
+        params = [a.arg for a in lam.args.args]
+        walker = _TraceRules(path, f"<lambda in {encl}>", params,
+                             suppress, findings, jax_aliases,
+                             wall_under_trace, wall_aliases, mod_aliases,
+                             rng_aliases)
+        walker.visit(lam.body)
+
+    _swallow_findings(path, tree, suppress, findings)
+    # TL001 territory (suppressed or not) is excluded from the TL010
+    # sweep: the under-trace diagnosis is the more specific one, and a
+    # `disable=TL001` must silence that line outright
+    tl001_lines = {f.line for f in findings if f.rule == "TL001"} \
+        | wall_under_trace
+    wall = []
+    _wallclock_findings(path, tree, suppress, wall, wall_aliases,
+                        mod_aliases)
+    findings.extend(f for f in wall if f.line not in tl001_lines)
+    _static_arg_findings(path, tree, suppress, findings)
+    return sorted(findings, key=Finding.sort_key)
+
+
+def lint_file(path, rel=None):
+    try:
+        # tokenize.open honors PEP 263 coding cookies (valid non-UTF-8
+        # source must not crash the ratchet run)
+        with tokenize.open(path) as f:
+            source = f.read()
+    except (UnicodeDecodeError, SyntaxError, ValueError) as e:
+        return [Finding("TL000", rel or path, 0, 0, "<module>",
+                        f"file cannot be decoded: {e}")]
+    return lint_source(source, rel or path)
+
+
+def iter_python_files(root):
+    """Sorted walk of .py files under `root` (deterministic output)."""
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths, relative_to=None):
+    """Lint files/trees. Paths in findings are made relative to
+    `relative_to` (posix separators) so baselines are machine-portable.
+    Files reachable from several roots are linted ONCE — double-counting
+    would push per-key counts past their own baseline."""
+    findings, seen = [], set()
+    for root in paths:
+        for path in iter_python_files(root):
+            real = os.path.realpath(path)
+            if real in seen:
+                continue
+            seen.add(real)
+            rel = path
+            if relative_to:
+                candidate = os.path.relpath(path, relative_to)
+                # a target OUTSIDE relative_to would get a '../..'-style
+                # key that depends on where the two trees sit relative
+                # to each other — keep the absolute path instead
+                if not candidate.startswith(os.pardir + os.sep):
+                    rel = candidate
+            rel = rel.replace(os.sep, "/")
+            findings.extend(lint_file(path, rel))
+    return sorted(findings, key=Finding.sort_key)
+
+
+# --------------------------------------------------------------------------
+# baseline ratchet
+# --------------------------------------------------------------------------
+
+def counts_by_key(findings):
+    out = {}
+    for f in findings:
+        out[f.key] = out.get(f.key, 0) + 1
+    return out
+
+
+def load_baseline(path):
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "counts" not in data:
+        raise ValueError(f"{path}: not a tpu-lint baseline "
+                         "(missing 'counts')")
+    return data["counts"]
+
+
+def write_baseline(path, findings):
+    """Deterministic (sorted-keys, newline-terminated) baseline dump.
+    TL000 (parse/decode failure) is never written: baselining it would
+    make CI pass on a file that does not parse — and a broken file
+    produces ONLY TL000, hiding every real finding it would have."""
+    data = {"version": 1, "tool": "tpu_lint",
+            "counts": counts_by_key(
+                f for f in findings if f.rule != "TL000")}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def new_findings(findings, baseline_counts):
+    """Findings at keys whose count exceeds the baselined count. All
+    findings at an over-budget key are reported (the linter cannot know
+    which individual one is 'new' without line-number churn). TL000 is
+    ALWAYS new — a hand-edited baseline entry must not absorb it."""
+    current = counts_by_key(findings)
+    over = {k for k, n in current.items()
+            if n > baseline_counts.get(k, 0)}
+    return [f for f in findings if f.key in over or f.rule == "TL000"]

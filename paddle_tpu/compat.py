@@ -65,7 +65,7 @@ def _memory_kinds():
             # memories API absent: such builds also lack with_memory_kind,
             # so report no distinct spaces and let callers degrade
             _MEM_KINDS = frozenset()
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — probe, see below
             # transient probe failure (e.g. backend not initialized yet):
             # degrade for THIS call but don't poison the cache
             return frozenset()

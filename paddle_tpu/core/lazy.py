@@ -168,10 +168,10 @@ class SegmentRecorder:
                 out_aval = jax.eval_shape(
                     lambda *a: impl(*a, **statics), *aval_args)
                 _EVAL_CACHE[ck] = out_aval
-        except Exception:
-            # shape-/value-dependent impl, unhashable statics, or a
-            # non-hashable scalar arg: this op is a break point — the
-            # caller flushes and runs it eagerly
+        except Exception:  # tpu-lint: disable=TL007 — deliberate probe:
+            # ANY trace failure (shape-/value-dependent impl, unhashable
+            # statics, non-hashable scalar arg) just means this op is a
+            # break point — the caller flushes and runs it eagerly
             return NotImplemented
 
         out_is_seq = isinstance(out_aval, (tuple, list))

@@ -133,8 +133,8 @@ def _unique_local_chunks(val):
             prev = owner.get(key)
             if prev is None or dev.id < prev.id:
                 owner[key] = dev
-    except Exception:
-        owner = None  # unusual shardings: fall back to per-process dedup
+    except Exception:  # tpu-lint: disable=TL007 — any owner-map failure
+        owner = None  # (unusual shardings) falls back to per-process dedup
     out = {}
     for sh in val.addressable_shards:
         key = _norm_index(sh.index, val.shape)
@@ -238,7 +238,7 @@ def _commit(path, world, process):
             store.barrier(f"ckpt/{tag}/written", world_size=world)
     timeout = float(os.environ.get("PADDLE_TPU_CKPT_COMMIT_TIMEOUT", "120"))
     if process == 0:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             missing = [p for p in range(world)
                        if not os.path.exists(
@@ -246,14 +246,18 @@ def _commit(path, world, process):
             if not missing:
                 break
             # shared-FS visibility lag (or storeless multi-host): poll
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise CheckpointError(
                     f"cannot commit {path!r}: manifests missing for "
                     f"processes {missing} after barrier")
             time.sleep(0.05)
         _maybe_crash("pre-commit")
         sentinel = {"format": MANIFEST_FORMAT, "world_size": world,
-                    "unix_time": time.time()}
+                    # the manifest field is DELIBERATELY wall-clock: it
+                    # names when the snapshot was committed for operators
+                    # and cross-host tooling (monotonic is meaningless
+                    # outside this process)
+                    "unix_time": time.time()}  # tpu-lint: disable=TL010
         _atomic_write(os.path.join(path, COMMITTED_SENTINEL),
                       lambda f: f.write(json.dumps(sentinel).encode()))
         _fsync_dir(path)
@@ -261,9 +265,9 @@ def _commit(path, world, process):
         # every rank returns only once the sentinel exists
         store.barrier(f"ckpt/{tag}/committed", world_size=world)
     elif world > 1 and process != 0:
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while not is_committed(path):
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise CheckpointError(
                     f"rank {process}: commit of {path!r} did not complete")
             time.sleep(0.05)

@@ -72,8 +72,8 @@ def init_parallel_env():
                 # explicitly for cross-process computations
                 jax.config.update(
                     "jax_cpu_collectives_implementation", "gloo")
-            except Exception:
-                pass
+            except Exception:  # tpu-lint: disable=TL007 — option absent on
+                pass           # this jax version: collectives just default
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(os.environ["PADDLE_TPU_NUM_PROCESSES"]),

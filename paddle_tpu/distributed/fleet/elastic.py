@@ -107,8 +107,8 @@ class ElasticManager:
 
     def wait_for_world(self, timeout=60.0):
         """Block until np_target members are alive (job convergence)."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if len(self.alive_members()) == self.np_target:
                 return True
             time.sleep(self.interval / 2)

@@ -99,8 +99,8 @@ class Controller:
                 # then only the LATEST claimant (per the atomic counter)
                 # may take over — so concurrent rejoiners can't both win.
                 ttl = float(os.environ.get("PADDLE_RDZV_TTL", "5"))
-                deadline = time.time() + ttl
-                while time.time() < deadline:
+                deadline = time.monotonic() + ttl
+                while time.monotonic() < deadline:
                     age = self._store.heartbeat_age(f"ctl/{job}/{args.rank}")
                     if age is not None and age < ttl:
                         raise SystemExit(
@@ -191,9 +191,9 @@ class Controller:
                     pr.popen.send_signal(sig)
                 except ProcessLookupError:
                     pass
-        deadline = time.time() + grace
+        deadline = time.monotonic() + grace
         for pr in self.procs:
-            left = max(0.1, deadline - time.time())
+            left = max(0.1, deadline - time.monotonic())
             try:
                 pr.popen.wait(timeout=left)
             except subprocess.TimeoutExpired:

@@ -100,7 +100,7 @@ class DevicePrefetcher:
     def __del__(self):  # best-effort; explicit close() is the contract
         try:
             self.close()
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — interpreter teardown
             pass
 
 

@@ -291,7 +291,8 @@ class HostOffloadedEmbedding(Layer):
                                   jnp.float32(0.0))
                 jax.block_until_ready(t)
                 self._push_probe = True
-            except Exception:
+            except Exception:  # tpu-lint: disable=TL007 — capability
+                # probe: ANY failure means "no device push path here"
                 self._push_probe = False
         return self._push_probe
 

@@ -29,6 +29,8 @@ import time
 
 import numpy as np
 
+from ..analysis import locks as _locks
+
 __all__ = ["SparseTableShard", "PsServer", "PsClient", "serve_shard"]
 
 
@@ -89,7 +91,7 @@ class SparseTableShard:
         self.seed = int(seed)
         self.rows: dict = {}
         self.accum: dict = {}
-        self.lock = threading.Lock()
+        self.lock = _locks.new_lock("ps.shard")
         self.applied_pushes = 0
         # exactly-once pushes: last applied sequence number per client
         # (a retried PUSH after a dropped response must not re-apply —
@@ -138,7 +140,7 @@ class SparseTableShard:
         np.add.at(merged, inv, grads)
         with self.lock:
             if client is not None and seq is not None:
-                self.seq_seen[client] = time.time()
+                self.seq_seen[client] = time.monotonic()
                 if seq <= self.applied_seq.get(client, -1):
                     return  # duplicate of an already-applied push
                 self.applied_seq[client] = seq
@@ -163,7 +165,7 @@ class SparseTableShard:
         treated as new — its push re-applies, which is the same
         at-least-once degradation the checkpoint-freshness caveat above
         already documents. Returns the pruned client ids."""
-        cutoff = time.time() - float(idle_s)
+        cutoff = time.monotonic() - float(idle_s)
         with self.lock:
             idle = [c for c, ts in self.seq_seen.items() if ts < cutoff]
             for c in idle:
@@ -181,12 +183,14 @@ class SparseTableShard:
             # could tear (rows mutated in place mid-pickle, applied_seq
             # recording a push whose row update is absent) or crash on
             # dict-resize during iteration
+            # seq_seen is deliberately NOT saved: its values are this
+            # process's time.monotonic() stamps, meaningless anywhere
+            # else — load() rebuilds it from applied_seq keys
             state = {"dim": self.dim, "optimizer": self.optimizer,
                      "lr": self.lr, "std": self.std, "seed": self.seed,
                      "rows": self.rows, "accum": self.accum,
                      "applied_pushes": self.applied_pushes,
-                     "applied_seq": self.applied_seq,
-                     "seq_seen": self.seq_seen}
+                     "applied_seq": self.applied_seq}
             blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
         from .._atomic_io import atomic_write
 
@@ -207,12 +211,13 @@ class SparseTableShard:
             self.accum = state["accum"]
             self.applied_pushes = state.get("applied_pushes", 0)
             self.applied_seq = state.get("applied_seq", {})
-            self.seq_seen = state.get("seq_seen", {})
-            # checkpoints from before the activity clock existed: seed
-            # load time so their clients become prunable once idle
-            now = time.time()
-            for c in self.applied_seq:
-                self.seq_seen.setdefault(c, now)
+            # re-stamp EVERY client at load time: persisted stamps come
+            # from another process's monotonic clock (a different, and
+            # pre-fix a wall, clock domain) so comparing them against
+            # this process's idle cutoff would be garbage — a loaded
+            # client earns pruning only by being idle from now on
+            now = time.monotonic()
+            self.seq_seen = {c: now for c in self.applied_seq}
 
 
 # --------------------------------------------------------------------------

@@ -181,8 +181,8 @@ def _serve_loop():
         key = f"rpc/{ep}/req/{rank}/{served}"
         try:
             raw = store.get_nowait(key)
-        except Exception:
-            # transient store fault: the serve loop must outlive it
+        except Exception:  # tpu-lint: disable=TL007 — logged below; the
+            # serve loop must outlive transient store/socket faults
             print(f"rpc serve loop (rank {rank}) store fault:\n"
                   f"{traceback.format_exc()}", file=sys.stderr)
             time.sleep(0.05)
@@ -194,7 +194,8 @@ def _serve_loop():
             fn, args, kwargs = pickle.loads(raw)
             result = fn(*args, **(kwargs or {}))
             payload = pickle.dumps(("ok", result))
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — user-fn error: the
+            # full traceback is serialized back to the caller, not eaten
             payload = pickle.dumps(("err", traceback.format_exc()))
         store.set(f"rpc/{ep}/res/{rank}/{served}", payload)
         store.delete_key(key)
@@ -215,8 +216,8 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
         def run_local():
             try:
                 fut._set(value=fn(*args, **(kwargs or {})))
-            except Exception:
-                fut._set(err=traceback.format_exc())
+            except Exception:  # tpu-lint: disable=TL007 — forwarded to
+                fut._set(err=traceback.format_exc())  # the caller's Future
         threading.Thread(target=run_local, daemon=True).start()
         return fut
 
@@ -243,8 +244,8 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
                 fut._set(value=payload)
             else:
                 fut._set(err=payload)
-        except Exception:
-            fut._set(err=traceback.format_exc())
+        except Exception:  # tpu-lint: disable=TL007 — forwarded to the
+            fut._set(err=traceback.format_exc())  # caller's Future
 
     threading.Thread(target=wait_reply, daemon=True).start()
     return fut

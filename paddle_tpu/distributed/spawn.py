@@ -33,8 +33,8 @@ def _worker(func, args, rank, nprocs, master, error_queue, env_extra):
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    except Exception:
-        pass
+    except Exception:  # tpu-lint: disable=TL007 — best-effort pin: a jax
+        pass           # without the option must not kill the child proc
     try:
         func(*args)
     except KeyboardInterrupt:

@@ -175,7 +175,7 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — interpreter teardown
             pass
 
 

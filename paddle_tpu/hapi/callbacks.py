@@ -80,7 +80,7 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = self.params.get("steps")
-        self._t0 = time.time()
+        self._t0 = time.monotonic()
 
     def _fmt(self, logs):
         return " - ".join(
@@ -94,7 +94,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose >= 1:
-            dt = time.time() - self._t0
+            dt = time.monotonic() - self._t0
             print(f"Epoch {epoch + 1}/{self.epochs} [{dt:.1f}s] - "
                   f"{self._fmt(logs)}")
 

@@ -86,8 +86,8 @@ def _rebuild_tensor(cls, shm_name, dtype_str, shape, stop_gradient,
         try:
             from multiprocessing import resource_tracker
             resource_tracker.unregister(seg._name, "shared_memory")
-        except Exception:  # tracker internals are version-fragile; the
-            pass           # worst case is the pre-fix (tracked) behavior
+        except Exception:  # tpu-lint: disable=TL007 — tracker internals
+            pass  # are version-fragile; worst case is tracked (pre-fix)
     try:
         import ml_dtypes  # noqa: F401 — registers bfloat16/float8 names
         arr = np.ndarray(shape, dtype=np.dtype(dtype_str),

@@ -187,7 +187,7 @@ class PredictorPool:
 
     def __init__(self, config: Config, size: int = 1):
         import queue
-        import threading
+        from ..analysis import locks as _locks
 
         if size < 1:
             raise ValueError("pool size must be >= 1")
@@ -196,7 +196,7 @@ class PredictorPool:
         self._free: "queue.Queue[Predictor]" = queue.Queue()
         for p in self._preds:
             self._free.put(p)
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("serving.predictor_pool")
         self._leased: set[int] = set()    # id(predictor) of in-flight leases
         self._leases_granted = 0
         self._dirty_releases = 0          # released after an exception

@@ -37,10 +37,11 @@ when a Profiler is recording (`profiler.profiled_span`).
 """
 from __future__ import annotations
 
-import threading
 import time
 
 import numpy as np
+
+from ..analysis import locks as _locks
 
 __all__ = ["BatchConfig", "DynamicBatcher"]
 
@@ -109,7 +110,7 @@ class DynamicBatcher:
         self.layer = layer
         self.config = config or BatchConfig()
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("serving.batcher")
         # counters (guarded by _lock)
         self._formed = 0
         self._requests = 0

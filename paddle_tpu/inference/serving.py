@@ -68,6 +68,8 @@ import time
 
 import numpy as np
 
+from ..analysis import locks as _locks
+
 __all__ = [
     "ServingError", "DeadlineExceeded", "Overloaded", "PoolClosed",
     "RequestFailed", "Deadline", "CircuitBreaker", "RetryPolicy",
@@ -156,7 +158,7 @@ class CircuitBreaker:
         self.threshold = int(threshold)
         self.reset_timeout = float(reset_timeout)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("serving.breaker")
         self._state = self.CLOSED
         self._consecutive = 0
         self._opened_at = None
@@ -272,7 +274,7 @@ class _Request:
         self.feeds = feeds            # batchable payload (None: fn-only)
         self.no_batch = False         # split fallback: must run alone
         self.enqueued_at = None       # admission clock stamp (queue-wait)
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("serving.request")
         self._ev = threading.Event()
         self._state = _PENDING
         self._value = None
@@ -451,8 +453,8 @@ class ServingPool:
         self._fault_hook = fault_hook
         self._breaker_args = (breaker_threshold, breaker_reset_timeout)
 
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = _locks.new_lock("serving.pool")
+        self._cv = _locks.new_condition("serving.pool", lock=self._lock)
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._retry_timers: dict = {}      # _Request -> threading.Timer
         self._ids = itertools.count()
@@ -615,7 +617,8 @@ class ServingPool:
             try:
                 if self._fault_hook is not None:
                     self._fault_hook(slot.index, req, slot.predictor)
-                result = req.fn(slot.predictor)
+                with _locks.blocking_region("serving.execute"):
+                    result = req.fn(slot.predictor)
             except Exception as exc:  # noqa: BLE001 — classified below
                 self._on_execution_error(slot, req, exc)
             else:
@@ -709,7 +712,8 @@ class ServingPool:
             if self._fault_hook is not None:
                 for r in live:
                     self._fault_hook(slot.index, r, slot.predictor)
-            results = self._batcher.execute(live)
+            with _locks.blocking_region("serving.batch_dispatch"):
+                results = self._batcher.execute(live)
         except Exception as exc:  # noqa: BLE001 — classified below
             self._on_batch_error(slot, live, exc)
         else:
@@ -787,8 +791,8 @@ class ServingPool:
     def _reset_member(self, slot):
         try:
             slot.predictor.reset_handles()
-        except Exception:
-            pass  # a member too broken to reset is replaced on next fault
+        except Exception:  # tpu-lint: disable=TL007 — a member too broken
+            pass           # to reset is replaced on the next fault
 
     def _on_execution_error(self, slot, req, exc):
         self._reset_member(slot)
@@ -849,8 +853,8 @@ class ServingPool:
         slot's breaker and counters persist."""
         try:
             fresh = self._base.clone()
-        except Exception:
-            return  # keep the reset member rather than losing the slot
+        except Exception:  # tpu-lint: disable=TL007 — keep the reset
+            return         # member rather than losing the slot
         with self._lock:
             slot.predictor = fresh
             slot.reclones += 1
@@ -892,8 +896,8 @@ class ServingPool:
             try:
                 self._sweep_expired_queue()
                 self._sweep_wedged()
-            except Exception:
-                pass  # the supervisor must never die
+            except Exception:  # tpu-lint: disable=TL007 — the supervisor
+                pass           # must never die; sweeps retry next tick
 
     def _sweep_expired_queue(self):
         """Fail queued entries whose deadline passed before any worker got
@@ -954,8 +958,8 @@ class ServingPool:
             return  # already replaced
         try:
             fresh = self._base.clone()
-        except Exception:
-            return
+        except Exception:  # tpu-lint: disable=TL007 — clone failed: leave
+            return  # the retired slot; the supervisor retries every sweep
         new_slot = _MemberSlot(i, fresh, old.breaker,
                                generation=old.generation + 1)
         new_slot.failures = old.failures + 1

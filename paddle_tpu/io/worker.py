@@ -152,8 +152,8 @@ def _discard(obj):
     if isinstance(obj, _ShmArray):
         try:
             obj.materialize()
-        except Exception:
-            pass
+        except Exception:  # tpu-lint: disable=TL007 — discard path: a
+            pass           # torn/unlinked segment has nothing to free
         return
     if isinstance(obj, (list, tuple)):
         for x in obj:
@@ -192,16 +192,17 @@ def _worker_loop(dataset, iterable_mode, batch_size, drop_last, collate_fn,
                     samples = [dataset[i] for i in indices]
                 batch = collate_fn(samples)
                 result_queue.put((batch_idx, _pack(batch, shm_threshold)))
-            except Exception:
+            except Exception:  # tpu-lint: disable=TL007 — forwarded: the
+                # full traceback rides to the parent as a _RemoteError
                 result_queue.put(
                     (batch_idx, _RemoteError(worker_id, traceback.format_exc())))
     except KeyboardInterrupt:
         pass
-    except Exception:
+    except Exception:  # tpu-lint: disable=TL007 — forwarded when possible
         try:
             result_queue.put((-1, _RemoteError(worker_id, traceback.format_exc())))
-        except Exception:
-            pass
+        except Exception:  # tpu-lint: disable=TL007 — queue already torn
+            pass           # down; the parent reaps the dead worker anyway
     finally:
         result_queue.cancel_join_thread()
         result_queue.close()
@@ -414,8 +415,8 @@ class MultiprocessIter:
         for iq in self._index_queues:
             try:
                 iq.put(None)
-            except Exception:
-                pass
+            except Exception:  # tpu-lint: disable=TL007 — shutdown path:
+                pass           # a closed index queue needs no sentinel
         for w in self._workers:
             w.join(timeout=2)
             if w.is_alive():
@@ -425,13 +426,13 @@ class MultiprocessIter:
             while True:
                 _, d = self._result_queue.get_nowait()
                 _discard(d)
-        except Exception:
-            pass
+        except Exception:  # tpu-lint: disable=TL007 — Empty ends the
+            pass           # drain; EOF/OSError mean the queue is gone
 
     def __del__(self):
         try:
             self._shutdown_workers()
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — interpreter teardown
             pass
 
 

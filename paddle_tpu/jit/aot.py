@@ -33,7 +33,8 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
-import threading
+
+from ..analysis import locks as _locks
 
 __all__ = ["CompileCache", "compile_batched", "default_cache", "cache_dir"]
 
@@ -69,7 +70,7 @@ class CompileCache:
         if keep < 1:
             raise ValueError("compile cache must keep at least 1 entry")
         self.keep = keep
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("aot.compile_cache")
         self.hits = 0
         self.misses = 0
         self.puts = 0
@@ -95,7 +96,8 @@ class CompileCache:
         LRU position."""
         p = self._path(key)
         try:
-            with open(p, "rb") as f:
+            with _locks.blocking_region("aot.cache_read"), \
+                    open(p, "rb") as f:
                 blob = f.read()
         except OSError:
             with self._lock:
@@ -113,6 +115,7 @@ class CompileCache:
         from .._atomic_io import atomic_write
 
         os.makedirs(self.root, exist_ok=True)
+        # atomic_write enters blocking_region("io.atomic_write") itself
         atomic_write(self._path(key), lambda f: f.write(blob))
         with self._lock:
             self.puts += 1
@@ -158,7 +161,7 @@ class CompileCache:
 
 
 _default_cache = None
-_default_lock = threading.Lock()
+_default_lock = _locks.new_lock("aot.default_cache")
 
 
 def default_cache():
@@ -223,8 +226,8 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
                 loaded = _se.deserialize_and_load(payload, in_tree, out_tree)
                 return (lambda holders, *stacked:
                         loaded(list(holders), *stacked)), "disk"
-            except Exception:
-                pass  # stale/corrupt entry: recompile and overwrite below
+            except Exception:  # tpu-lint: disable=TL007 — stale/corrupt
+                pass  # cache entry: recompile and overwrite below
 
     def batched(holder_vals, *stacked):
         def body(xs):
@@ -243,7 +246,7 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))
-        except Exception:
-            pass  # an unserializable backend still serves from memory
+        except Exception:  # tpu-lint: disable=TL007 — an unserializable
+            pass           # backend still serves from memory
     return (lambda holders, *stacked:
             compiled(list(holders), *stacked)), "compiled"

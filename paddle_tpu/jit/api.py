@@ -89,7 +89,8 @@ class StaticFunction:
         self._source_function = function
         try:
             function = convert_function(function)
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — unconvertible python
+            # control flow: fall back to tracing the original function
             function = self._source_function
         self._function = function
         self._layer = layer

@@ -13,6 +13,8 @@ import os
 import pickle
 import threading
 
+from ..analysis import locks as _locks
+
 import numpy as np
 import jax
 
@@ -115,7 +117,7 @@ class TranslatedLayer:
         # shape-bucketed AOT executables (jit.aot): keyed by batch bucket,
         # shared by every Predictor clone over this layer — a re-cloned
         # (quarantined) serving member never re-pays compilation
-        self._aot_lock = threading.Lock()
+        self._aot_lock = _locks.new_lock("aot.layer")
         self._aot_execs: dict = {}
         self._aot_building: dict = {}   # bucket -> Event (build in flight)
         self._aot_counts = {"compiles": 0, "disk_hits": 0, "mem_hits": 0}
@@ -206,9 +208,10 @@ class TranslatedLayer:
         from .aot import compile_batched
 
         try:
-            raw, source = compile_batched(
-                self._exported, self._holder_avals(), self.input_spec,
-                bucket, fingerprint=self.fingerprint, cache=cache)
+            with _locks.blocking_region("aot.compile"):
+                raw, source = compile_batched(
+                    self._exported, self._holder_avals(), self.input_spec,
+                    bucket, fingerprint=self.fingerprint, cache=cache)
 
             def fn(*stacked_inputs, _raw=raw):
                 holders = [self._params[n]._value

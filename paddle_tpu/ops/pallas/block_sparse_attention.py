@@ -24,8 +24,10 @@ from jax.experimental import pallas as pl
 try:  # pltpu is import-safe on CPU; guards match flash_attention.py
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PLTPU = True
-except Exception:  # pragma: no cover
-    pltpu = None
+except Exception:  # tpu-lint: disable=TL007 — capability probe:
+    # version-skewed jax raises AttributeError/RuntimeError here, not
+    # just ImportError; any failure degrades to the interpret path
+    pltpu = None  # pragma: no cover
     _HAS_PLTPU = False
 
 _NEG_INF = -1e30

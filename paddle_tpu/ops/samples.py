@@ -22,8 +22,10 @@ import numpy as np
 try:
     import scipy.linalg as spl
     import scipy.special as sps
-except Exception:  # pragma: no cover - scipy ships with jax
-    spl = sps = None
+except Exception:  # tpu-lint: disable=TL007 — capability probe: a scipy
+    # binary-incompatible with the installed numpy raises ValueError, not
+    # ImportError; any failure degrades to the numpy reference paths
+    spl = sps = None  # pragma: no cover - scipy ships with jax
 
 _INSTALLED = False
 _MISSING: list = []
@@ -2489,7 +2491,8 @@ def _round4_floors(att):
 def _scipy_cumtrapz(y, x, dx, axis):
     try:
         from scipy.integrate import cumulative_trapezoid
-    except Exception:
+    except Exception:  # tpu-lint: disable=TL007 — capability probe: broken
+        # scipy installs raise more than ImportError; caller handles None
         return None
     return cumulative_trapezoid(y, x=x, dx=dx, axis=axis)
 
@@ -2583,7 +2586,10 @@ def _window_ref(window, win_length, fftbins=True, **k):
         from scipy.signal import get_window as gw
         name = window if not isinstance(window, tuple) else window
         return np.asarray(gw(name, win_length, fftbins=fftbins), "float32")
-    except Exception:
+    except Exception:  # tpu-lint: disable=TL007 — reference probe: no
+        # scipy, unknown window name (ValueError) or malformed tuple
+        # spec (TypeError) all mean the same thing — no reference
+        # available, the sample check degrades to skipping it
         return None
 
 
@@ -3190,8 +3196,8 @@ def _maybe_pop():
     from . import random as rnd
     try:
         rnd.pop_trace_key()
-    except Exception:
-        pass
+    except Exception:  # tpu-lint: disable=TL007 — nothing pushed: the
+        pass           # trace-key stack is simply already empty
     return False
 
 

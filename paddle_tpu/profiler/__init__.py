@@ -259,7 +259,8 @@ class Profiler:
             try:
                 jax.profiler.start_trace(self._device_trace_dir)
                 self._device_tracing = True
-            except Exception:
+            except Exception:  # tpu-lint: disable=TL007 — backend can't
+                # trace (already tracing / unsupported): profile host-only
                 self._device_tracing = False
 
     def _end_record(self):

@@ -246,7 +246,8 @@ class Flowers(Dataset):
         try:
             from PIL import Image
             have_pil = True
-        except Exception:
+        except Exception:  # tpu-lint: disable=TL007 — capability probe: PIL
+            # with broken native deps raises OSError, not just ImportError
             have_pil = False
         with tarfile.open(data_file) as f:
             for m in f.getmembers():

@@ -15,10 +15,29 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # bf16-friendly matmul precision).
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
+import pytest  # noqa: E402
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture
+def checker():
+    """Enable the global lock-order checker for the test, leave it clean
+    after — restoring (not clobbering) a session-wide
+    PADDLE_TPU_LOCKCHECK=1. Shared by test_lockcheck.py (FSM units) and
+    test_batching.py (pool lock discipline)."""
+    from paddle_tpu.analysis import lockcheck
+
+    was_enabled = lockcheck.enabled()
+    lockcheck.enable()
+    lockcheck.reset()
+    yield lockcheck
+    lockcheck.reset()
+    if not was_enabled:
+        lockcheck.disable()
 
 
 def pytest_configure(config):

@@ -452,3 +452,28 @@ def test_batch_config_validation():
         BatchConfig(max_wait_ms=-1)
     cfg = BatchConfig(buckets=(8, 2, 4, 2))
     assert cfg.buckets == (2, 4, 8)   # sorted, deduped
+
+
+# ---------------------------------------------------------------------------
+# lock discipline under the race checker (paddle_tpu.analysis.lockcheck)
+# ---------------------------------------------------------------------------
+
+def test_batched_pool_lock_discipline_clean(exported, checker):
+    """The batching hot path (gather under the pool cv -> one bucketed
+    dispatch -> scatter) run with the lock-order checker ENABLED (the
+    shared `checker` fixture from conftest): no acquisition-order cycles
+    and no lock held across the serving.batch_dispatch / aot.* blocking
+    regions. Constructing the pool after enable() is what instruments
+    its named locks."""
+    pool = _pool(exported, size=1)
+    try:
+        futs = _gated_wave(pool, exported, range(8))
+        for i, f in enumerate(futs):
+            out, = f.result()
+            assert (out == exported["want"][i]).all()
+    finally:
+        pool.shutdown(5)
+    rep = checker.assert_clean()
+    observed = set(rep["locks"])
+    assert {"serving.pool", "serving.batcher",
+            "serving.request"} <= observed

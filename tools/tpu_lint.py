@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""tpu_lint — CLI for the paddle_tpu trace-safety linter.
+
+Usage:
+
+    python tools/tpu_lint.py --package paddle_tpu            # ratcheted run
+    python tools/tpu_lint.py --paths some/file.py other/dir  # ad-hoc paths
+    python tools/tpu_lint.py --package paddle_tpu --format json
+    python tools/tpu_lint.py --package paddle_tpu --write-baseline
+
+Exit codes (stable contract, asserted by tests/test_tracelint.py):
+
+    0   clean — no findings beyond the baseline
+    1   new findings beyond the baseline
+    2   usage error (unknown package/path, unreadable baseline, bad args)
+
+The baseline (default: <repo>/.tpu_lint_baseline.json) freezes existing
+findings by ``path::rule::scope`` count. ``--no-baseline`` reports
+everything. ``--write-baseline`` regenerates it deterministically
+(sorted keys) from the current findings and exits 0.
+
+Pure AST: this never imports the linted code, so it runs identically on
+accelerator-less CI boxes.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)     # --package resolution (find_spec only —
+    #                              nothing from the repo is ever executed)
+
+# Load the linter STRAIGHT from its file: importing it as
+# `paddle_tpu.analysis.tracelint` would execute paddle_tpu/__init__.py —
+# i.e. import jax and the very code being linted, which is both slow
+# (seconds of startup per CI invocation) and against the tool's contract
+# (pure AST, runs identically on accelerator-less boxes).
+_TL = os.path.join(REPO, "paddle_tpu", "analysis", "tracelint.py")
+_spec = importlib.util.spec_from_file_location("_tpu_lint_tracelint", _TL)
+tracelint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tracelint)
+
+DEFAULT_BASELINE = os.path.join(REPO, ".tpu_lint_baseline.json")
+
+USAGE_ERROR, NEW_FINDINGS, CLEAN = 2, 1, 0
+
+
+def _resolve_package(name):
+    """Filesystem root of an importable package WITHOUT importing it.
+    Only the TOP-LEVEL name goes through find_spec (a dotted name would
+    make find_spec import — i.e. execute — the parent package, breaking
+    the nothing-is-executed contract); submodule parts are resolved as
+    plain paths under the top-level root."""
+    if os.sep in name or name.endswith(".py"):
+        return None
+    top, _, rest = name.partition(".")
+    try:
+        spec = importlib.util.find_spec(top)
+    except (ImportError, ValueError):
+        return None
+    if spec is None:
+        return None
+    if spec.submodule_search_locations:
+        root = list(spec.submodule_search_locations)[0]
+    else:
+        root = spec.origin
+    if not rest:
+        return root
+    if not root or not os.path.isdir(root):
+        return None                      # a module has no submodules
+    sub = os.path.join(root, *rest.split("."))
+    if os.path.isdir(sub):
+        return sub
+    if os.path.isfile(sub + ".py"):
+        return sub + ".py"
+    return None
+
+
+def _render_text(all_findings, fresh, baseline_used, out):
+    for f in fresh:
+        print(f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.scope}] "
+              f"{f.message}", file=out)
+    kept = len(all_findings) - len(fresh)
+    tail = f" ({kept} baselined finding(s) suppressed)" \
+        if baseline_used and kept else ""
+    print(f"tpu_lint: {len(fresh)} new finding(s), "
+          f"{len(all_findings)} total{tail}", file=out)
+
+
+def _render_json(all_findings, fresh, baseline_used, out):
+    payload = {
+        "tool": "tpu_lint",
+        "new": [f.to_dict() for f in fresh],
+        "new_count": len(fresh),
+        "total_count": len(all_findings),
+        "baseline_used": bool(baseline_used),
+        "rules": tracelint.RULES,
+    }
+    json.dump(payload, out, indent=2, sort_keys=True)
+    out.write("\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpu_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--package", default=None,
+                    help="importable package to lint (e.g. paddle_tpu)")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="explicit files/directories to lint")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "(sorted keys) and exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on bad args already; normalize anything else
+        raise SystemExit(USAGE_ERROR if e.code else 0)
+
+    roots = []
+    if args.package:
+        root = _resolve_package(args.package)
+        if root is None or not os.path.exists(root):
+            print(f"tpu_lint: cannot resolve package {args.package!r}",
+                  file=sys.stderr)
+            return USAGE_ERROR
+        roots.append(root)
+    for p in args.paths or ():
+        if not os.path.exists(p):
+            print(f"tpu_lint: no such path: {p}", file=sys.stderr)
+            return USAGE_ERROR
+        roots.append(p)
+    if not roots:
+        print("tpu_lint: nothing to lint (use --package and/or --paths)",
+              file=sys.stderr)
+        return USAGE_ERROR
+
+    findings = tracelint.lint_paths(roots, relative_to=REPO)
+
+    if args.write_baseline:
+        written = [f for f in findings if f.rule != "TL000"]
+        tracelint.write_baseline(args.baseline, findings)
+        print(f"tpu_lint: wrote {len(written)} finding(s) across "
+              f"{len(tracelint.counts_by_key(written))} key(s) to "
+              f"{args.baseline}", file=sys.stderr)
+        for f in findings:
+            if f.rule == "TL000":
+                print(f"tpu_lint: NOT baselined (fix the file): "
+                      f"{f.path}:{f.line}: TL000 {f.message}",
+                      file=sys.stderr)
+        return CLEAN
+
+    baseline_counts, baseline_used = {}, False
+    if not args.no_baseline:
+        if os.path.exists(args.baseline):
+            try:
+                baseline_counts = tracelint.load_baseline(args.baseline)
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                print(f"tpu_lint: unreadable baseline {args.baseline}: "
+                      f"{e}", file=sys.stderr)
+                return USAGE_ERROR
+            baseline_used = True
+        elif args.baseline != DEFAULT_BASELINE:
+            # an explicitly-passed baseline that doesn't exist is a
+            # usage error; the default one merely not existing yet means
+            # "no ratchet" (first run)
+            print(f"tpu_lint: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return USAGE_ERROR
+
+    fresh = tracelint.new_findings(findings, baseline_counts)
+    render = _render_json if args.format == "json" else _render_text
+    render(findings, fresh, baseline_used, sys.stdout)
+    return NEW_FINDINGS if fresh else CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())
