@@ -544,6 +544,148 @@ def bench_serving(on_tpu, dev):
         })
 
 
+def bench_decode(on_tpu, dev):
+    """BENCH_DECODE=1: continuous-batching LLM decode — tokens/sec and
+    p50/p99 time-to-first-token of the iteration-level `DecodeEngine`
+    (inference/decode, docs/llm_serving.md) vs REQUEST-level batching on
+    mixed-length generations.
+
+    The baseline emulates what `DynamicBatcher` semantics give a
+    generation workload: a formed batch decodes until its LONGEST member
+    finishes (a batched program cannot stop per-row, so finished
+    sequences keep occupying their slots doing padded work) and the next
+    batch waits for the whole gang to drain. Both modes run the SAME
+    paged, bucketed AOT step executables, so the measured delta is the
+    scheduling policy alone — iteration-level join/leave vs
+    head-of-line blocking. Only useful (per-request) tokens count toward
+    tokens/sec; per-request outputs are checked identical across modes
+    (greedy decode is deterministic). `vs_baseline` is the
+    continuous/request-level tokens/sec ratio; the acceptance gate is
+    >= 1.5x at concurrency >= 8. The CPU smoke runs a tiny varied-output
+    GPT (rope + GQA + swiglu); real-model TPU numbers land in the next
+    BENCH_r06.json."""
+    import concurrent.futures
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import DecodeEngine
+    from paddle_tpu.models import gpt
+
+    conc = int(os.environ.get("BENCH_DECODE_CONCURRENCY", "8"))
+    lens = [int(x) for x in os.environ.get(
+        "BENCH_DECODE_LENS", "3,4,6,8,10,12,16,20").split(",")]
+    gangs = int(os.environ.get("BENCH_DECODE_GANGS", "6"))
+    n_req = gangs * conc
+    prompt_len = 6
+    max_len = prompt_len + max(lens) + prompt_len  # headroom for prefill pad
+
+    with tempfile.TemporaryDirectory(prefix="bench-decode-") as workdir:
+        os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE",
+                              os.path.join(workdir, "compile-cache"))
+        paddle.seed(7)
+        name = os.environ.get("BENCH_MODEL", "gpt_base" if on_tpu else "")
+        if name:
+            model = gpt(name, max_position_embeddings=max(max_len, 64))
+        else:
+            model = gpt("gpt_tiny", vocab_size=97, hidden_size=48,
+                        num_heads=4, num_kv_heads=2, num_layers=2,
+                        rope=True, swiglu=True, rms_norm=True,
+                        max_position_embeddings=64,
+                        tie_word_embeddings=False)
+        model.eval()
+        vocab = model.cfg.vocab_size
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, vocab, (prompt_len,)).astype(np.int32)
+                   for _ in range(conc)]
+        want = [lens[i % len(lens)] for i in range(n_req)]
+
+        def make_engine():
+            return DecodeEngine(
+                model, max_length=max_len, block_size=8,
+                decode_buckets=tuple(sorted({1, 2, 4, conc})),
+                prefill_buckets=(8,), default_timeout=600.0,
+                num_blocks=1 + 2 * conc * -(-max_len // 8))
+
+        def percentiles(ts):
+            return {"p50_ms": round(float(np.percentile(ts, 50)) * 1e3, 1),
+                    "p99_ms": round(float(np.percentile(ts, 99)) * 1e3, 1)}
+
+        results = {}
+        for mode in ("request_level", "continuous"):
+            eng = make_engine()
+            try:
+                eng.warmup()          # compiles excluded from the measure
+                ttft = [0.0] * n_req
+                outs = [None] * n_req
+                t0 = time.perf_counter()
+
+                def one(i, max_new):
+                    s = eng.submit(prompts[i % conc], max_new)
+                    toks = []
+                    for tok in s:
+                        if not toks:
+                            ttft[i] = time.perf_counter() - t0
+                        toks.append(tok)
+                    outs[i] = toks
+
+                if mode == "continuous":
+                    # open admission: sequences join the running batch the
+                    # moment a client thread frees up
+                    with concurrent.futures.ThreadPoolExecutor(conc) as ex:
+                        list(ex.map(one, range(n_req), want))
+                else:
+                    # request granularity: every gang member decodes to the
+                    # gang max (the batched program can't stop per-row) and
+                    # the next gang waits for a full drain
+                    for g in range(0, n_req, conc):
+                        gang = list(range(g, g + conc))
+                        gmax = max(want[i] for i in gang)
+                        with concurrent.futures.ThreadPoolExecutor(
+                                conc) as ex:
+                            list(ex.map(one, gang, [gmax] * conc))
+                dt = time.perf_counter() - t0
+                useful = sum(want)
+                results[mode] = {
+                    "tokens_per_sec": round(useful / dt, 1),
+                    "ttft": percentiles(ttft),
+                    "occupancy": round(eng.stats()["occupancy"], 3),
+                    "steps": eng.stats()["steps"],
+                }
+                # useful tokens only: truncate gang overruns before compare
+                results[mode]["outs"] = [o[:want[i]]
+                                         for i, o in enumerate(outs)]
+            finally:
+                eng.shutdown(drain_timeout=30.0)
+
+        mismatches = sum(
+            1 for a, b in zip(results["continuous"].pop("outs"),
+                              results["request_level"].pop("outs"))
+            if a != b)
+        speedup = (results["continuous"]["tokens_per_sec"]
+                   / results["request_level"]["tokens_per_sec"])
+        payload = _emit({
+            "metric": f"continuous-batching decode tokens/sec "
+                      f"(concurrency={conc}, mixed max_new "
+                      f"{min(lens)}..{max(lens)}, "
+                      f"{name or 'tiny gpt'})",
+            "value": results["continuous"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": round(speedup, 3),
+            "extra": {"modes": results, "requests": n_req,
+                      "mismatches": mismatches,
+                      "platform": dev.platform},
+        })
+        if mismatches:
+            print(f"bench_decode: {mismatches} request(s) diverged between "
+                  f"modes", file=sys.stderr)
+            return None
+        if conc >= 8 and speedup < 1.5:
+            print(f"bench_decode: speedup {speedup:.2f}x below the 1.5x "
+                  f"gate at concurrency {conc}", file=sys.stderr)
+            return None
+        return payload
+
+
 def bench_gpt(on_tpu, dev):
     """Flagship (BASELINE north star): GPT/ERNIE-base-class pretrain step."""
     import jax
@@ -644,6 +786,11 @@ def main():
         # serving-throughput mode: its own one-line JSON (requests/sec,
         # batched-vs-unbatched) instead of the flagship train metric
         return 0 if bench_serving(on_tpu, dev) else 1
+
+    if os.environ.get("BENCH_DECODE") == "1":
+        # continuous-batching decode mode: tokens/sec + TTFT, iteration-
+        # level engine vs request-level batching (gate >= 1.5x at c >= 8)
+        return 0 if bench_decode(on_tpu, dev) else 1
 
     if "--model" in sys.argv:
         i = sys.argv.index("--model")

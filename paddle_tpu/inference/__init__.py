@@ -21,6 +21,8 @@ __all__ = [
     "Deadline",
     # dynamic request batching (batching.py)
     "BatchConfig", "DynamicBatcher",
+    # continuous-batching LLM decode engine (decode/)
+    "DecodeEngine", "SequenceStream", "BlockKVCache", "OutOfBlocks",
 ]
 
 
@@ -262,4 +264,7 @@ from .batching import BatchConfig, DynamicBatcher  # noqa: E402
 from .serving import (  # noqa: E402
     ServingPool, ServingError, DeadlineExceeded, Overloaded, PoolClosed,
     RequestFailed, CircuitBreaker, RetryPolicy, Deadline,
+)
+from .decode import (  # noqa: E402
+    BlockKVCache, DecodeEngine, OutOfBlocks, SequenceStream,
 )

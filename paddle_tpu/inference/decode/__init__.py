@@ -1,0 +1,14 @@
+"""paddle_tpu.inference.decode — continuous-batching LLM decode engine.
+
+Composes the paged KV-cache allocator (`block_pool.BlockKVCache`), the
+iteration-level scheduler (`engine.DecodeEngine`) and streaming output
+through the resilient serving runtime. See docs/llm_serving.md for the
+architecture and contract; `ops/pallas/decode_attn.paged_decode_attention`
+is the TPU-native read-through-the-block-table attention kernel.
+"""
+from __future__ import annotations
+
+from .block_pool import BlockKVCache, OutOfBlocks
+from .engine import DecodeEngine, SequenceStream
+
+__all__ = ["BlockKVCache", "OutOfBlocks", "DecodeEngine", "SequenceStream"]

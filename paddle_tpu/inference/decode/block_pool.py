@@ -1,0 +1,198 @@
+"""paddle_tpu.inference.decode.block_pool — paged KV-cache allocator.
+
+The dense KV cache (`GPTForCausalLM.init_cache`) allocates one
+``[B, max_len, Hkv, D]`` buffer per layer per *batch slot*: every sequence
+pays for its worst-case length up front, and a serving batch of mixed
+lengths wastes most of that memory. The paged layout (vLLM/PagedAttention,
+SOSP '23) instead keeps ONE device-resident pool of fixed-size blocks per
+layer —
+
+    k_pool: [num_blocks, block_size, Hkv, D]      (bf16 cache)
+    kq/ks/vq/vs pools for the int8 layout           (int8 values +
+                                                    [num_blocks, block_size,
+                                                    Hkv] f32 scales)
+
+— and gives each sequence a *block table*: the ordered list of pool block
+ids that hold its tokens (token position ``p`` lives at
+``(table[p // block_size], p % block_size)``). Sequences allocate blocks
+as they grow and return them the moment they finish, so the pool's
+capacity is shared by actual token usage, not worst-case reservations.
+
+`BlockKVCache` is the allocator half: device tensors plus a host-side
+free list, per-owner accounting, and conservation/fragmentation stats.
+Scheduling (who allocates when, gather/scatter through the tables) lives
+in `engine.DecodeEngine`; the TPU-native read-through-the-table attention
+kernel is `ops/pallas/decode_attn.paged_decode_attention`.
+
+Block 0 is RESERVED as the padding sink: padded rows of a bucketed decode
+step carry an all-zeros block table, so their (garbage) KV writes land in
+block 0 and can never corrupt a live sequence — the allocator simply
+never hands block 0 out.
+
+Invariant (asserted by the decode fault-injection harness):
+``allocated + free + reserved == total`` at all times, and a drained
+engine always returns to ``allocated == 0`` — no fault path may leak a
+block.
+"""
+from __future__ import annotations
+
+import math
+
+from ...analysis import locks as _locks
+
+__all__ = ["BlockKVCache", "OutOfBlocks"]
+
+#: block ids below this are never allocated (block 0 = padding sink)
+RESERVED_BLOCKS = 1
+
+
+class OutOfBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation. The engine's admission gate
+    reserves worst-case growth for every admitted sequence, so live
+    sequences never see this — it surfaces only on over-admission bugs or
+    direct allocator misuse."""
+
+
+class BlockKVCache:
+    """Device-resident paged KV pool + host-side free-list allocator.
+
+    Args:
+        num_blocks: total pool blocks (>= RESERVED_BLOCKS + 1).
+        block_size: tokens per block.
+        entry_specs: per-layer tuple of ``(suffix_shape, dtype)`` pairs —
+            one pair per cache tensor in the layer's cache-entry order
+            (``(k, v)`` for bf16, ``(kq, ks, vq, vs)`` for int8). Each
+            pool tensor is allocated as ``[num_blocks, block_size,
+            *suffix_shape]`` of the given dtype. Models build this via
+            ``init_block_pool`` so the geometry always matches their
+            ``decode_step`` cache layout.
+        quant: informational layout tag (None or "int8") carried for
+            engine fingerprinting and stats.
+    """
+
+    def __init__(self, num_blocks, block_size, entry_specs, quant=None):
+        import jax.numpy as jnp
+
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if num_blocks < RESERVED_BLOCKS + 1:
+            raise ValueError(
+                f"num_blocks must be > {RESERVED_BLOCKS} (block 0 is the "
+                f"reserved padding sink), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.quant = quant
+        #: per-layer tuples of device arrays; the engine replaces this
+        #: wholesale after each committed (prefill/decode) step
+        self.tensors = [
+            tuple(jnp.zeros((self.num_blocks, self.block_size, *suffix),
+                            dtype)
+                  for suffix, dtype in layer)
+            for layer in entry_specs]
+        self._lock = _locks.new_lock("decode.block_pool")
+        self._free = list(range(self.num_blocks - 1, RESERVED_BLOCKS - 1,
+                                -1))  # pop() hands out low ids first
+        self._owner = {}           # block id -> owner tag
+        self.allocs = 0
+        self.frees = 0
+        self.failed_allocs = 0
+        self.peak_allocated = 0
+
+    # -- geometry ----------------------------------------------------------
+    def blocks_for(self, num_tokens):
+        """Blocks needed to hold `num_tokens` cache positions."""
+        return max(1, math.ceil(num_tokens / self.block_size))
+
+    @property
+    def capacity_tokens(self):
+        """Token capacity of the allocatable (non-reserved) pool."""
+        return (self.num_blocks - RESERVED_BLOCKS) * self.block_size
+
+    # -- allocation --------------------------------------------------------
+    def alloc(self, n, owner=None):
+        """All-or-nothing allocation of `n` blocks; returns their ids.
+        Raises `OutOfBlocks` (leaving the pool untouched) when fewer than
+        `n` are free."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        with self._lock:
+            if n > len(self._free):
+                self.failed_allocs += 1
+                raise OutOfBlocks(
+                    f"pool exhausted: {n} block(s) requested, "
+                    f"{len(self._free)} free of "
+                    f"{self.num_blocks - RESERVED_BLOCKS} allocatable")
+            blocks = [self._free.pop() for _ in range(n)]
+            for b in blocks:
+                self._owner[b] = owner
+            self.allocs += n
+            self.peak_allocated = max(self.peak_allocated, len(self._owner))
+            return blocks
+
+    def free(self, blocks):
+        """Return blocks to the pool. Double-frees and reserved/unknown
+        ids raise ValueError (a conservation bug must be loud)."""
+        with self._lock:
+            for b in blocks:
+                if b not in self._owner:
+                    raise ValueError(
+                        f"block {b} is not allocated (double-free, or a "
+                        f"reserved/unknown id)")
+            for b in blocks:
+                del self._owner[b]
+                self._free.append(b)
+            self.frees += len(blocks)
+
+    def free_owned(self, owner):
+        """Free every block held by `owner`; returns how many. Idempotent
+        (an owner with no blocks frees zero) — the engine's eviction paths
+        call this so a sequence can never double-free."""
+        with self._lock:
+            mine = [b for b, o in self._owner.items() if o == owner]
+            for b in mine:
+                del self._owner[b]
+                self._free.append(b)
+            self.frees += len(mine)
+            return len(mine)
+
+    @property
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def allocated_count(self):
+        with self._lock:
+            return len(self._owner)
+
+    # -- observability -----------------------------------------------------
+    def stats(self):
+        """Snapshot. Conservation: ``allocated + free + reserved ==
+        total`` always holds (checked here, not just reported)."""
+        with self._lock:
+            allocated = len(self._owner)
+            free = len(self._free)
+            assert allocated + free + RESERVED_BLOCKS == self.num_blocks, (
+                f"block conservation violated: {allocated} allocated + "
+                f"{free} free + {RESERVED_BLOCKS} reserved != "
+                f"{self.num_blocks} total")
+            return {
+                "total": self.num_blocks,
+                "reserved": RESERVED_BLOCKS,
+                "block_size": self.block_size,
+                "quant": self.quant,
+                "free": free,
+                "allocated": allocated,
+                "peak_allocated": self.peak_allocated,
+                "allocs": self.allocs,
+                "frees": self.frees,
+                "failed_allocs": self.failed_allocs,
+                "utilization": allocated / max(
+                    1, self.num_blocks - RESERVED_BLOCKS),
+            }
+
+    def __repr__(self):
+        s = self.stats()
+        return (f"BlockKVCache(total={s['total']}, free={s['free']}, "
+                f"allocated={s['allocated']}, block_size={self.block_size},"
+                f" quant={self.quant!r})")

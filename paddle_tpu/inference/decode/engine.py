@@ -1,0 +1,901 @@
+"""paddle_tpu.inference.decode.engine — continuous-batching LLM decode.
+
+`DynamicBatcher` (batching.py) batches at *request* granularity: a formed
+batch runs one exported program end-to-end, so a generation workload
+would pay head-of-line blocking — every sequence in the batch decodes for
+as long as the longest one, and a late arrival waits for the whole batch
+to drain. Decode is memory-bound (docs/decode_perf.md: bandwidth_frac
+<= 0.53 at the bench shapes), so those wasted iterations are wasted HBM
+streaming. The fix is *iteration-level* scheduling in the style of Orca
+(OSDI '22) and vLLM/PagedAttention (SOSP '23), composed here from parts
+that already exist in-tree:
+
+* **Paged KV cache** (`block_pool.BlockKVCache`): one device-resident
+  pool of fixed-size blocks per layer; each sequence holds a block table
+  and grows block-by-block, returning blocks the moment it finishes.
+  Supports the bf16 and int8 (`cache_quant="int8"`) layouts of
+  `GPTForCausalLM.init_cache` via `init_block_pool`.
+
+* **Prefill/decode separation**: a new sequence's prompt is prefilled in
+  one chunked dispatch (padded to a prompt-length bucket), then the
+  sequence joins the RUNNING decode batch at the next step boundary —
+  no waiting for the current batch to drain. Finished / cancelled /
+  deadline-expired sequences leave at step boundaries, freeing both
+  their batch slot and their blocks.
+
+* **Bucketed AOT step executables** (`jit/aot.compile_jit`): the decode
+  step is compiled once per batch-size bucket and persisted in the
+  shared on-disk `CompileCache`, so a warm process start compiles ZERO
+  decode-step executables. Each step is a single gathered dispatch: the
+  compiled program reads every sequence's KV through its block table
+  (XLA gather — the portable path; the TPU-native read-through-the-
+  table kernel is `ops/pallas/decode_attn.paged_decode_attention`).
+
+* **Streaming through the serving runtime** (`serving.ServingPool`):
+  every dispatch runs as a request on an internal supervised pool, so a
+  wedged decode step trips the pool's EXISTING hang detection (the
+  wedged worker is retired, capacity restored, and the step — a pure
+  function of the committed state — is simply re-dispatched). Sequence
+  admission reuses the serving runtime's typed semantics: bounded
+  waiting queue (`Overloaded`), per-sequence monotonic deadlines
+  covering queue wait + generation (`DeadlineExceeded`), `PoolClosed`
+  after shutdown, and `RequestFailed` for execution faults. A failing
+  sequence is evicted ALONE — a failed multi-sequence step is re-run as
+  isolated single-sequence steps to pin the blame, mirroring the
+  batcher's split-on-failure.
+
+Determinism contract: the decode step runs the active batch as a
+`lax.scan` over per-sequence sub-steps (the serving twin of
+`compile_batched`'s `lax.map`), so the per-sequence program is IDENTICAL at
+every bucket size — per-token outputs are bit-identical to running the
+sequence alone. (A row-vectorized step is NOT row-bit-stable through XLA
+CPU matmuls; measured while building this engine.) Decoding is greedy
+(argmax) — the deterministic mode the bit-equality and fault-isolation
+invariants are proven over.
+
+Usage::
+
+    engine = DecodeEngine(model, max_length=256, block_size=16)
+    stream = engine.submit(prompt_ids, max_new_tokens=64, timeout=5.0)
+    for tok in stream:          # tokens stream out as they are decoded
+        ...
+    engine.shutdown()
+
+or through a `ServingPool(..., decode_engine=engine)` via
+`pool.submit_generate(...)`. See docs/llm_serving.md.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ...analysis import locks as _locks
+from ..serving import (Deadline, DeadlineExceeded, Overloaded, PoolClosed,
+                       RequestFailed, RetryPolicy, ServingPool,
+                       _NullPredictor)
+from .block_pool import BlockKVCache, OutOfBlocks, RESERVED_BLOCKS
+
+__all__ = ["DecodeEngine", "SequenceStream"]
+
+
+# sequence lifecycle
+_WAITING, _ACTIVE, _DONE = "waiting", "active", "done"
+
+_END = object()   # stream sentinel
+
+
+class SequenceStream:
+    """Per-sequence streaming handle returned by `DecodeEngine.submit`.
+
+    Iterate to receive tokens as they are decoded; iteration ends with
+    `StopIteration` on completion or raises the sequence's typed serving
+    error (`DeadlineExceeded` / `RequestFailed` / `PoolClosed`). The
+    deadline is enforced on the CALLER side too, so a consumer is
+    released at the deadline even if the engine is wedged. Tokens
+    delivered so far are always available as `.tokens` (including after
+    a failure — partial output is real output)."""
+
+    def __init__(self, seq_id, deadline):
+        self.id = seq_id
+        self.deadline = deadline
+        self.tokens = []          # delivered tokens (engine-appended)
+        self._q = queue.Queue()
+        self._status = "running"  # running|completed|failed|timed_out|cancelled
+        self._error = None
+        self._cancel = None       # engine-installed cancel callback
+        self._raised = False
+
+    # -- engine side -------------------------------------------------------
+    def _push(self, tok):
+        self.tokens.append(tok)
+        self._q.put(tok)
+
+    def _finish(self, status, error=None):
+        self._status = status
+        self._error = error
+        self._q.put(_END)
+
+    # -- caller side -------------------------------------------------------
+    @property
+    def status(self):
+        return self._status
+
+    def done(self):
+        return self._status != "running"
+
+    def cancel(self):
+        """Ask the engine to evict this sequence at the next step
+        boundary (its blocks return to the pool; batchmates continue)."""
+        if self._cancel is not None:
+            self._cancel()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._raised:
+            raise StopIteration
+        limit = self.deadline.remaining()
+        try:
+            if limit is not None and limit <= 0:
+                item = self._q.get_nowait()   # already-delivered beats DOA
+            else:
+                item = self._q.get(timeout=limit)
+        except queue.Empty:
+            self._raised = True
+            raise DeadlineExceeded(
+                f"sequence {self.id} exceeded its deadline while "
+                f"waiting for the next token") from None
+        if item is not _END:
+            return item
+        self._raised = True
+        if self._status == "completed":
+            raise StopIteration
+        raise self._error
+
+    def result(self):
+        """Drain the stream to completion and return the full generated
+        token list; raises the typed error on failure (partial tokens
+        stay readable via `.tokens`)."""
+        for _ in self:
+            pass
+        return list(self.tokens)
+
+
+class _Seq:
+    __slots__ = ("id", "prompt", "max_new", "deadline", "stream", "state",
+                 "blocks", "reserved_total", "outstanding", "pos",
+                 "last_token", "generated", "cancelled")
+
+    def __init__(self, sid, prompt, max_new, deadline):
+        self.id = sid
+        self.prompt = prompt           # np.int32 [prompt_len]
+        self.max_new = max_new
+        self.deadline = deadline
+        self.stream = SequenceStream(sid, deadline)
+        self.state = _WAITING
+        self.blocks = []               # pool block ids, table order
+        self.reserved_total = 0        # worst-case blocks (admission gate)
+        self.outstanding = 0           # reserved_total - len(blocks)
+        self.pos = 0                   # cache position of last_token
+        self.last_token = None
+        self.generated = 0
+        self.cancelled = False
+
+
+class DecodeEngine:
+    """Iteration-level (continuous-batching) greedy decode engine over a
+    KV-cached causal LM (`decode_step` + `init_block_pool`). See the
+    module docstring for semantics and docs/llm_serving.md for the full
+    contract and knobs."""
+
+    def __init__(self, model, *, max_length, block_size=16, num_blocks=None,
+                 decode_buckets=(1, 2, 4, 8), prefill_buckets=None,
+                 quant=None, max_waiting=64, default_timeout=None,
+                 step_timeout=30.0, step_retries=1, eos_token_id=None,
+                 pad_token_id=0, compile_cache=None, fault_hook=None,
+                 hang_grace=0.1, supervise_interval=0.02,
+                 clock=time.monotonic):
+        from ...distributed.functional import functionalize
+        from ...core.tensor import Tensor
+
+        if max_length < 2:
+            raise ValueError("max_length must be >= 2 (prompt + 1 token)")
+        bs = sorted({int(b) for b in decode_buckets})
+        if not bs or bs[0] < 1:
+            raise ValueError(f"decode_buckets must be positive ints, "
+                             f"got {decode_buckets}")
+        self.model = model
+        model.eval()   # greedy decode; dropout under trace is a bug
+        self.max_length = int(max_length)
+        self.block_size = int(block_size)
+        self.decode_buckets = tuple(bs)
+        self.max_active = self.decode_buckets[-1]
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = int(pad_token_id)
+        self.default_timeout = default_timeout
+        self.step_timeout = step_timeout
+        self._step_retries = int(step_retries)
+        self._cache = compile_cache
+        self._fault_hook = fault_hook
+        self._clock = clock
+        self._vocab = getattr(getattr(model, "cfg", None), "vocab_size",
+                              None)
+
+        if prefill_buckets is None:
+            p, buckets = min(8, self.max_length - 1), []
+            while p < self.max_length - 1:
+                buckets.append(p)
+                p *= 2
+            buckets.append(self.max_length - 1)
+            prefill_buckets = buckets
+        self.prefill_buckets = tuple(sorted({int(p) for p in
+                                             prefill_buckets}))
+        self.max_prompt = min(self.prefill_buckets[-1], self.max_length - 1)
+
+        # paged KV pool — the model owns the geometry (cache-entry order,
+        # dtypes, quant layout precedence); default capacity fits a full
+        # bucket of worst-case-length sequences
+        nb_per_seq = max(1, math.ceil(self.max_length / self.block_size))
+        self._nb = nb_per_seq
+        if num_blocks is None:
+            num_blocks = RESERVED_BLOCKS + self.max_active * nb_per_seq
+        self.pool = model.init_block_pool(num_blocks, self.block_size,
+                                          quant=quant)
+
+        # functional decode step (the generation.py idiom: swap values
+        # into the live layers, trace the python forward once)
+        def wrapped(tokens, cache_vals, pos):
+            cts = [tuple(Tensor(a) for a in entry) for entry in cache_vals]
+            logits, new_caches = model.decode_step(Tensor(tokens), cts,
+                                                   Tensor(pos))
+            return (logits._value,
+                    [tuple(t._value for t in nc) for nc in new_caches])
+
+        self._apply, self._params, self._buffers = functionalize(
+            model, method=wrapped)
+        self._fingerprint = self._make_fingerprint()
+
+        self._decode_fns = {}     # bucket -> compiled step
+        self._prefill_fns = {}    # prompt bucket -> compiled prefill
+        self._compiled = 0
+        self._disk_loaded = 0
+
+        # supervised step executor: ONE slot (steps are inherently
+        # serialized — each consumes the previous commit), supervised by
+        # the serving runtime's existing hang detection
+        self._steps = ServingPool(
+            predictor=_NullPredictor(), size=1, max_queue_depth=4,
+            default_timeout=None,
+            breaker_threshold=max(3, self._step_retries + 2),
+            breaker_reset_timeout=0.25,
+            retry=RetryPolicy(max_retries=2, base_delay=0.01,
+                              max_delay=0.05),
+            hang_grace=hang_grace, supervise_interval=supervise_interval,
+            clock=clock)
+
+        self._lock = _locks.new_lock("decode.engine")
+        self._cv = _locks.new_condition("decode.engine", lock=self._lock)
+        self._waiting = []            # admission queue (guarded by _cv)
+        self._active = []             # scheduler-owned; mutations under _cv
+        self.max_waiting = int(max_waiting)
+        self._ids = 0
+        self._closed = False
+        self._stopping = False
+        self._shutdown_called = False
+        self._drained = False
+
+        # counters (guarded by _cv's lock)
+        self._admitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._timed_out = 0
+        self._cancelled = 0
+        self._shed = 0
+        self._steps_run = 0
+        self._prefills = 0
+        self._tokens_out = 0
+        self._wedged_steps = 0
+        self._isolations = 0
+        self._step_slots = 0
+        self._step_active = 0
+
+        self._thread = threading.Thread(target=self._loop,
+                                        name="DecodeEngine-scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- identity ----------------------------------------------------------
+    def _make_fingerprint(self):
+        """Model/program identity for the persistent compile cache:
+        structure and shapes, never weight VALUES (weights are runtime
+        arguments of the step executable)."""
+        h = hashlib.sha256()
+        h.update(type(self.model).__name__.encode())
+        for n in sorted(self._params):
+            p = self._params[n]
+            h.update(f"{n}:{tuple(p.shape)}:{p.dtype}".encode())
+        for n in sorted(self._buffers):
+            b = self._buffers[n]
+            h.update(f"{n}:{tuple(b.shape)}:{b.dtype}".encode())
+        h.update(f"paged-scan-greedy-v1:{self.pool.quant}:"
+                 f"{self.block_size}:{self._nb}".encode())
+        return h.hexdigest()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens, timeout=None):
+        """Admit one generation request; returns its `SequenceStream`.
+
+        Validation errors (malformed *request*: bad dtype/rank, empty or
+        over-long prompt, out-of-vocab ids) raise `ValueError`
+        synchronously. Admission shedding mirrors `ServingPool`: a full
+        waiting queue raises `Overloaded`, a closed engine `PoolClosed`,
+        a dead-on-arrival deadline `DeadlineExceeded`. The deadline
+        (`timeout` seconds, None -> `default_timeout`, both None ->
+        unbounded) covers queue wait AND the whole generation."""
+        ids = np.asarray(prompt_ids)
+        if ids.ndim == 2 and ids.shape[0] == 1:
+            ids = ids[0]
+        if ids.ndim != 1 or not np.issubdtype(ids.dtype, np.integer):
+            raise ValueError(
+                f"prompt must be a 1-D integer id array, got shape "
+                f"{ids.shape} dtype {ids.dtype}")
+        if not 1 <= ids.shape[0] <= self.max_prompt:
+            raise ValueError(
+                f"prompt length {ids.shape[0]} outside [1, "
+                f"{self.max_prompt}] (largest prefill bucket / "
+                f"max_length - 1)")
+        if ids.size and (int(ids.min()) < 0 or (
+                self._vocab is not None and int(ids.max()) >= self._vocab)):
+            raise ValueError(
+                f"prompt ids must be in [0, {self._vocab}) — got range "
+                f"[{int(ids.min())}, {int(ids.max())}] (poisoned feed?)")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if ids.shape[0] + max_new > self.max_length:
+            raise ValueError(
+                f"prompt ({ids.shape[0]}) + max_new_tokens ({max_new}) "
+                f"exceeds max_length {self.max_length}")
+
+        eff = self.default_timeout if timeout is None else timeout
+        dl = Deadline(eff, clock=self._clock)
+        with self._cv:
+            if self._closed:
+                self._shed += 1
+                raise PoolClosed(
+                    "decode engine is shut down — admission refused")
+            if dl.expired():
+                self._shed += 1
+                raise DeadlineExceeded(
+                    "dead on arrival: deadline expired before admission")
+            if len(self._waiting) >= self.max_waiting:
+                self._shed += 1
+                raise Overloaded(
+                    f"decode waiting queue full ({self.max_waiting} deep) "
+                    f"— request shed; retry with backoff")
+            self._ids += 1
+            seq = _Seq(self._ids, ids.astype(np.int32), max_new, dl)
+            seq.stream._cancel = lambda s=seq: self._request_cancel(s)
+            self._waiting.append(seq)
+            self._admitted += 1
+            self._cv.notify()
+        return seq.stream
+
+    def generate(self, prompt_ids, max_new_tokens, timeout=None):
+        """Synchronous convenience: submit + drain; returns the generated
+        token list or raises the typed serving error."""
+        return self.submit(prompt_ids, max_new_tokens,
+                           timeout=timeout).result()
+
+    def _request_cancel(self, seq):
+        with self._cv:
+            seq.cancelled = True
+            self._cv.notify()
+
+    # -- compiled programs -------------------------------------------------
+    def _avals(self, arrays):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), arrays)
+
+    def _weight_avals(self):
+        import jax
+
+        pv = {n: jax.ShapeDtypeStruct(tuple(p.shape), p._value.dtype)
+              for n, p in self._params.items()}
+        bv = {n: jax.ShapeDtypeStruct(tuple(b.shape), b._value.dtype)
+              for n, b in self._buffers.items()}
+        return pv, bv
+
+    def _gather(self, pool_ts, table):
+        """Dense per-sequence cache view: every pool tensor gathered
+        through the block table into [1, NB*block_size, ...]."""
+        caches = []
+        for layer in pool_ts:
+            entry = []
+            for t in layer:
+                g = t[table]                       # [NB, bs, *suffix]
+                entry.append(g.reshape((1, self._nb * self.block_size)
+                                       + g.shape[2:]))
+            caches.append(tuple(entry))
+        return caches
+
+    def _scatter_row(self, pool_ts, new_caches, table, pos):
+        """Write the cache row the step produced at `pos` back into the
+        pool (the only row `decode_step` changed)."""
+        import jax
+
+        block = table[pos // self.block_size]
+        off = pos % self.block_size
+        out = []
+        for layer_ts, layer_new in zip(pool_ts, new_caches):
+            entry = []
+            for t, c in zip(layer_ts, layer_new):
+                row = jax.lax.dynamic_index_in_dim(c, pos, axis=1,
+                                                   keepdims=False)[0]
+                entry.append(t.at[block, off].set(row.astype(t.dtype)))
+            out.append(tuple(entry))
+        return out
+
+    def _decode_fn(self, bucket):
+        fn = self._decode_fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        def step(pv, bv, pool_ts, tokens, positions, tables):
+            def body(pool_ts, x):
+                tok, pos, table = x
+                caches = self._gather(pool_ts, table)
+                (logits, new_caches), _ = self._apply(
+                    pv, bv, tok.reshape(1, 1), caches, pos)
+                nxt = jnp.argmax(
+                    logits[0, -1].astype(jnp.float32), -1).astype(jnp.int32)
+                pool_ts = self._scatter_row(pool_ts, new_caches, table, pos)
+                return pool_ts, nxt
+            # scan over the batch: each sequence runs the IDENTICAL
+            # per-sequence program at every bucket size (bit-identical to
+            # running alone — compile_batched's lax.map argument), writes
+            # land in its own blocks (padded rows in reserved block 0),
+            # and the whole bucket is ONE gathered XLA dispatch
+            pool_ts, nxt = jax.lax.scan(body, pool_ts,
+                                        (tokens, positions, tables))
+            return pool_ts, nxt
+
+        pv, bv = self._weight_avals()
+        avals = (pv, bv, self._avals(self.pool.tensors),
+                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket, self._nb), jnp.int32))
+        compiled, source = aot.compile_jit(
+            step, avals, fingerprint=self._fingerprint, cache=self._cache,
+            tag=f"decode-step-b{bucket}")
+        with self._lock:
+            if source == "disk":
+                self._disk_loaded += 1
+            else:
+                self._compiled += 1
+        self._decode_fns[bucket] = compiled
+        return compiled
+
+    def _prefill_fn(self, pbucket):
+        fn = self._prefill_fns.get(pbucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        nb_written = math.ceil(pbucket / self.block_size)
+
+        def prefill(pv, bv, pool_ts, tokens, prompt_len, table):
+            caches = self._gather(pool_ts, table)
+            (logits, new_caches), _ = self._apply(
+                pv, bv, tokens, caches, jnp.asarray(0, jnp.int32))
+            last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1,
+                                                axis=0, keepdims=False)
+            nxt = jnp.argmax(last.astype(jnp.float32), -1).astype(jnp.int32)
+            # scatter the written prompt rows block-by-block; rows past
+            # the real prompt are garbage that decode overwrites
+            # position-by-position before it can ever be attended, and
+            # rows past the allocated blocks land in reserved block 0
+            out = []
+            for layer_ts, layer_new in zip(pool_ts, new_caches):
+                entry = []
+                for t, c in zip(layer_ts, layer_new):
+                    new_t = t
+                    for j in range(nb_written):
+                        lo = j * self.block_size
+                        hi = min(pbucket, lo + self.block_size)
+                        rows = c[0, lo:hi].astype(t.dtype)
+                        new_t = new_t.at[table[j], : hi - lo].set(rows)
+                    entry.append(new_t)
+                out.append(tuple(entry))
+            return out, nxt
+
+        pv, bv = self._weight_avals()
+        avals = (pv, bv, self._avals(self.pool.tensors),
+                 jax.ShapeDtypeStruct((1, pbucket), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((self._nb,), jnp.int32))
+        compiled, source = aot.compile_jit(
+            prefill, avals, fingerprint=self._fingerprint,
+            cache=self._cache, tag=f"decode-prefill-p{pbucket}")
+        with self._lock:
+            if source == "disk":
+                self._disk_loaded += 1
+            else:
+                self._compiled += 1
+        self._prefill_fns[pbucket] = compiled
+        return compiled
+
+    def warmup(self):
+        """Compile (or disk-load) every decode bucket and prefill bucket
+        up front, so traffic never stalls on XLA. Returns
+        ``{"decode": [...], "prefill": [...]}``."""
+        for b in self.decode_buckets:
+            self._decode_fn(b)
+        for p in self.prefill_buckets:
+            self._prefill_fn(p)
+        return {"decode": list(self.decode_buckets),
+                "prefill": list(self.prefill_buckets)}
+
+    # -- scheduler ---------------------------------------------------------
+    def _weights(self):
+        pv = {n: p._value for n, p in self._params.items()}
+        bv = {n: b._value for n, b in self._buffers.items()}
+        return pv, bv
+
+    def _padded_table(self, seq):
+        table = np.zeros(self._nb, np.int32)   # 0 = reserved padding sink
+        table[: len(seq.blocks)] = seq.blocks
+        return table
+
+    def _submit_step(self, run):
+        """Dispatch a step closure on the supervised step pool. A wedged
+        dispatch (pool hang detection fired: worker retired, capacity
+        restored) is re-submitted — the closure is a pure function of the
+        last COMMITTED state, so a re-run is safe and batchmates lose
+        nothing. `RequestFailed` / `PoolClosed` propagate to the caller
+        for classification."""
+        last = None
+        for _ in range(self._step_retries + 1):
+            req = self._steps.submit(run, timeout=self.step_timeout)
+            try:
+                return req.result()
+            except DeadlineExceeded as e:
+                with self._lock:
+                    self._wedged_steps += 1
+                last = e
+        raise RequestFailed(
+            f"decode step wedged {self._step_retries + 1} time(s) — "
+            f"giving up", cause=last,
+            attempts=self._step_retries + 1)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                if self._stopping:
+                    return
+                if self._closed and not self._waiting and not self._active:
+                    return
+                if not self._waiting and not self._active:
+                    self._cv.wait(0.05)
+                    continue
+            try:
+                self._sweep_waiting()
+                self._admit_waiting()
+                if self._active:
+                    self._decode_round()
+            except Exception as exc:  # noqa: BLE001 — scheduler must
+                # survive anything: fail the implicated sequences with a
+                # typed error instead of silently dying with them stuck
+                err = RequestFailed(
+                    f"decode scheduler error: {type(exc).__name__}: {exc}",
+                    cause=exc)
+                for seq in list(self._active):
+                    self._finish(seq, "failed", err)
+
+    def _sweep_waiting(self):
+        with self._cv:
+            keep = []
+            for seq in self._waiting:
+                if seq.cancelled:
+                    self._finish_locked(seq, "cancelled", PoolClosed(
+                        f"sequence {seq.id} cancelled before prefill"))
+                elif seq.deadline.expired():
+                    self._finish_locked(seq, "timed_out", DeadlineExceeded(
+                        f"sequence {seq.id} expired in the waiting queue"))
+                else:
+                    keep.append(seq)
+            self._waiting = keep
+
+    def _admit_waiting(self):
+        """Move waiting sequences into the running batch at this step
+        boundary: capacity = a free batch slot AND enough free blocks to
+        cover the newcomer's worst case on top of every active sequence's
+        remaining worst-case growth (so lazy per-step block allocation
+        can never fail mid-flight)."""
+        while True:
+            with self._cv:
+                if self._stopping or not self._waiting:
+                    return
+                if len(self._active) >= self.max_active:
+                    return
+                seq = self._waiting[0]
+                reserve = sum(s.outstanding for s in self._active)
+                seq.reserved_total = self.pool.blocks_for(
+                    len(seq.prompt) + seq.max_new)
+                if self.pool.free_count < reserve + seq.reserved_total:
+                    return      # not enough headroom yet; retry next round
+                self._waiting.pop(0)
+            try:
+                self._start_sequence(seq)
+            except Exception as exc:  # noqa: BLE001 — the sequence is in
+                # neither _waiting nor _active here, so an unexpected
+                # prefill error (e.g. an XLA compile failure) must fail
+                # it HERE or its stream hangs and its blocks leak
+                self._finish(seq, "failed", RequestFailed(
+                    f"sequence {seq.id}: prefill error: "
+                    f"{type(exc).__name__}: {exc}", cause=exc))
+
+    def _start_sequence(self, seq):
+        """Prefill one admitted sequence and add it to the running batch.
+        Prefill faults implicate only this sequence."""
+        try:
+            seq.blocks = self.pool.alloc(
+                self.pool.blocks_for(len(seq.prompt)), owner=seq.id)
+        except OutOfBlocks as e:   # admission gate guarantees this can't
+            self._finish(seq, "failed", RequestFailed(
+                f"sequence {seq.id}: block pool exhausted at prefill",
+                cause=e))
+            return
+        seq.outstanding = seq.reserved_total - len(seq.blocks)
+        plen = len(seq.prompt)
+        pbucket = next(p for p in self.prefill_buckets if p >= plen)
+        fn = self._prefill_fn(pbucket)
+        pv, bv = self._weights()
+        tokens = np.full((1, pbucket), self.pad_token_id, np.int32)
+        tokens[0, :plen] = seq.prompt
+        table = self._padded_table(seq)
+        pool_ts = self.pool.tensors
+        hook = self._fault_hook
+
+        def run(_member):
+            if hook is not None:
+                hook("prefill", [seq.id], {"bucket": pbucket})
+            with _locks.blocking_region("decode.step_dispatch"):
+                new_pool, nxt = fn(pv, bv, pool_ts, tokens,
+                                   np.asarray(plen, np.int32), table)
+                return new_pool, int(np.asarray(nxt))
+
+        try:
+            new_pool, tok = self._submit_step(run)
+        except PoolClosed as e:
+            self._finish(seq, "cancelled", e)
+            return
+        except RequestFailed as e:
+            self._finish(seq, "failed", e)
+            return
+        self.pool.tensors = new_pool
+        with self._lock:
+            self._prefills += 1
+        seq.state = _ACTIVE
+        seq.pos = plen
+        with self._cv:
+            self._active.append(seq)
+        self._deliver(seq, tok)
+
+    def _deliver(self, seq, tok):
+        """Commit one decoded token: stream it out and retire the
+        sequence if it just finished."""
+        seq.last_token = tok
+        seq.generated += 1
+        seq.stream._push(tok)
+        with self._lock:
+            self._tokens_out += 1
+        if (self.eos_token_id is not None and tok == self.eos_token_id) \
+                or seq.generated >= seq.max_new:
+            self._finish(seq, "completed")
+
+    def _decode_round(self):
+        # step-boundary sweep: cancelled / expired sequences leave before
+        # another step is spent on them
+        for seq in list(self._active):
+            if seq.cancelled:
+                self._finish(seq, "cancelled", PoolClosed(
+                    f"sequence {seq.id} cancelled mid-generation"))
+            elif seq.deadline.expired():
+                self._finish(seq, "timed_out", DeadlineExceeded(
+                    f"sequence {seq.id} exceeded its deadline "
+                    f"mid-generation"))
+        active = list(self._active)
+        if not active:
+            return
+        # lazy block growth: the admission reserve guarantees success
+        for seq in list(active):
+            if seq.pos >= len(seq.blocks) * self.block_size:
+                try:
+                    seq.blocks += self.pool.alloc(1, owner=seq.id)
+                    seq.outstanding -= 1
+                except OutOfBlocks as e:
+                    active.remove(seq)
+                    self._finish(seq, "failed", RequestFailed(
+                        f"sequence {seq.id}: block pool exhausted "
+                        f"mid-decode (admission reserve bug)", cause=e))
+        if not active:
+            return
+        try:
+            nxt = self._dispatch_decode(active)
+        except PoolClosed:
+            return           # engine stopping; shutdown fails leftovers
+        except RequestFailed as e:
+            if len(active) == 1:
+                self._finish(active[0], "failed", e)
+                return
+            # a multi-sequence step failed: blame is ambiguous, so re-run
+            # as isolated singles — only the culpable sequence fails
+            with self._lock:
+                self._isolations += 1
+            self._run_isolated(active)
+            return
+        for seq, tok in zip(active, nxt):
+            self._deliver(seq, int(tok))
+
+    def _dispatch_decode(self, active):
+        n = len(active)
+        bucket = next(b for b in self.decode_buckets if b >= n)
+        fn = self._decode_fn(bucket)
+        pv, bv = self._weights()
+        tokens = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, self._nb), np.int32)  # pad rows -> 0
+        for i, seq in enumerate(active):
+            tokens[i] = seq.last_token
+            positions[i] = seq.pos
+            tables[i] = self._padded_table(seq)
+        pool_ts = self.pool.tensors
+        hook = self._fault_hook
+        ids = [s.id for s in active]
+
+        def run(_member):
+            if hook is not None:
+                hook("decode", ids, {"bucket": bucket})
+            with _locks.blocking_region("decode.step_dispatch"):
+                new_pool, nxt = fn(pv, bv, pool_ts, tokens, positions,
+                                   tables)
+                return new_pool, np.asarray(nxt)
+
+        new_pool, nxt = self._submit_step(run)
+        self.pool.tensors = new_pool
+        for seq in active:
+            seq.pos += 1
+        with self._lock:
+            self._steps_run += 1
+            self._step_slots += bucket
+            self._step_active += n
+        return nxt[:n]
+
+    def _run_isolated(self, seqs):
+        for seq in list(seqs):
+            if seq.state != _ACTIVE:
+                continue
+            try:
+                nxt = self._dispatch_decode([seq])
+            except PoolClosed:
+                return
+            except RequestFailed as e:
+                self._finish(seq, "failed", e)
+                continue
+            self._deliver(seq, int(nxt[0]))
+
+    # -- lifecycle ---------------------------------------------------------
+    def _finish(self, seq, status, error=None):
+        with self._cv:
+            self._finish_locked(seq, status, error)
+
+    def _finish_locked(self, seq, status, error=None):
+        if seq.state == _DONE:
+            return
+        seq.state = _DONE
+        seq.outstanding = 0
+        if seq in self._active:
+            self._active.remove(seq)
+        self.pool.free_owned(seq.id)
+        if status == "completed":
+            self._completed += 1
+        elif status == "failed":
+            self._failed += 1
+        elif status == "timed_out":
+            self._timed_out += 1
+        else:
+            self._cancelled += 1
+        seq.stream._finish(status, error)
+
+    def shutdown(self, drain_timeout=30.0):
+        """Graceful drain, mirroring `ServingPool.shutdown`: stop
+        admissions, keep decoding until every live sequence finishes (or
+        `drain_timeout` passes), then fail leftovers with `PoolClosed`
+        and stop the scheduler + step pool. Returns True on a full
+        drain. Idempotent."""
+        with self._cv:
+            if self._shutdown_called:
+                return self._drained
+            self._shutdown_called = True
+            self._closed = True
+            self._cv.notify_all()
+        dl = Deadline(drain_timeout, clock=self._clock)
+        drained = True
+        while True:
+            with self._cv:
+                if not self._waiting and not self._active:
+                    break
+            if dl.expired():
+                drained = False
+                break
+            time.sleep(0.005)
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._steps.shutdown(drain_timeout=1.0)
+        self._thread.join(timeout=5.0)
+        with self._cv:
+            leftovers = self._waiting + [s for s in self._active]
+            self._waiting = []
+            for seq in leftovers:
+                self._finish_locked(seq, "cancelled", PoolClosed(
+                    f"engine shut down before sequence {seq.id} finished"))
+        self._drained = drained
+        return drained
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- observability -----------------------------------------------------
+    def stats(self):
+        """Counter snapshot. Conservation law (quiesced engine):
+        ``admitted == completed + failed + timed_out + cancelled``; at
+        any instant the right side also includes waiting + active."""
+        with self._cv:
+            used_tokens = sum(s.pos for s in self._active)
+            alloc_slots = sum(len(s.blocks) for s in self._active) \
+                * self.block_size
+            snap = {
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "timed_out": self._timed_out,
+                "cancelled": self._cancelled,
+                "shed": self._shed,
+                "waiting": len(self._waiting),
+                "active": len(self._active),
+                "steps": self._steps_run,
+                "prefills": self._prefills,
+                "tokens_out": self._tokens_out,
+                "wedged_steps": self._wedged_steps,
+                "isolation_rounds": self._isolations,
+                "occupancy": (self._step_active / self._step_slots)
+                if self._step_slots else 0.0,
+                "internal_fragmentation": (1.0 - used_tokens / alloc_slots)
+                if alloc_slots else 0.0,
+                "compiles": {"built": self._compiled,
+                             "disk": self._disk_loaded},
+                "buckets": {"decode": list(self.decode_buckets),
+                            "prefill": list(self.prefill_buckets)},
+            }
+        snap["blocks"] = self.pool.stats()
+        snap["step_pool"] = self._steps.stats()
+        return snap

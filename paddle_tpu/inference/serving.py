@@ -369,6 +369,20 @@ class _BatchTicket:
                          if bounded else requests[0].deadline)
 
 
+class _NullPredictor:
+    """Stateless stand-in member for pools that exist to supervise work
+    that does not touch an exported module: the decode engine's step
+    executor, and a `ServingPool(decode_engine=...)` built without a
+    Config/predictor. Submitted fns receive it and (by design) ignore
+    it."""
+
+    def clone(self):
+        return _NullPredictor()
+
+    def reset_handles(self):
+        pass
+
+
 # ---------------------------------------------------------------------------
 # member slot
 # ---------------------------------------------------------------------------
@@ -423,16 +437,26 @@ class ServingPool:
                  max_queue_depth=64, default_timeout=None,
                  breaker_threshold=3, breaker_reset_timeout=1.0,
                  retry=None, hang_grace=0.1, supervise_interval=0.02,
-                 fault_hook=None, batching=None, clock=time.monotonic):
+                 fault_hook=None, batching=None, decode_engine=None,
+                 clock=time.monotonic):
         if size < 1:
             raise ValueError("pool size must be >= 1")
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
+        self._engine = decode_engine
         if predictor is None:
             if config is None:
-                raise ValueError("ServingPool needs a Config or predictor=")
-            from . import Predictor
-            predictor = Predictor(config)
+                if decode_engine is not None:
+                    # generation-only pool: no exported module to serve,
+                    # members exist to run submitted fns under supervision
+                    predictor = _NullPredictor()
+                else:
+                    raise ValueError(
+                        "ServingPool needs a Config or predictor= "
+                        "(or decode_engine= for a generation-only pool)")
+            else:
+                from . import Predictor
+                predictor = Predictor(config)
         self._base = predictor
         self._batcher = None
         if batching is not None and batching is not False:
@@ -556,6 +580,32 @@ class ServingPool:
                 "warmup() needs batching: construct the pool with "
                 "batching=BatchConfig(...)")
         return self._batcher.warmup(buckets)
+
+    # -- streaming generation (continuous-batching decode engine) ----------
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None):
+        """Admit one LLM generation request on the attached
+        `DecodeEngine` (construct the pool with `decode_engine=`);
+        returns a `decode.SequenceStream` whose iterator yields tokens as
+        they are decoded. Admission and deadlines follow the pool's
+        semantics: `timeout=None` uses the pool's `default_timeout`, a
+        full engine queue raises `Overloaded`, a shut-down pool/engine
+        `PoolClosed`, and the deadline covers queue wait plus the whole
+        generation. Sequence failures are isolated: one failing sequence
+        never disturbs the others decoding beside it (its KV blocks
+        return to the pool), and a wedged decode step trips the same
+        hang detection that guards regular requests."""
+        if self._engine is None:
+            raise RuntimeError(
+                "submit_generate() needs a decode engine: construct the "
+                "pool with decode_engine=DecodeEngine(model, ...)")
+        eff = self.default_timeout if timeout is None else timeout
+        return self._engine.submit(prompt_ids, max_new_tokens, timeout=eff)
+
+    def generate(self, prompt_ids, max_new_tokens, timeout=None):
+        """Synchronous generation convenience: submit + drain; returns
+        the generated token list or raises the typed serving error."""
+        return self.submit_generate(prompt_ids, max_new_tokens,
+                                    timeout=timeout).result()
 
     def _on_caller_timeout(self, req):
         with self._lock:
@@ -979,6 +1029,16 @@ class ServingPool:
         The default is a bounded 30s so `with ServingPool(...)` can never
         hang the process on a member wedged under a deadline-less request;
         pass `drain_timeout=None` to explicitly wait indefinitely."""
+        if self._engine is not None:
+            # drain running generations first (their sequences carry
+            # their own deadlines); the engine is idempotent like us.
+            # drain_timeout bounds the WHOLE shutdown, so the pool's own
+            # drain below gets only what the engine drain left over
+            t0 = self._clock()
+            self._engine.shutdown(drain_timeout=drain_timeout)
+            if drain_timeout is not None:
+                drain_timeout = max(0.0, drain_timeout
+                                    - (self._clock() - t0))
         with self._cv:
             if self._shutdown_called:
                 already = self._drained
@@ -1065,7 +1125,7 @@ class ServingPool:
                 })
             healthy = sum(1 for m in members
                           if m["alive"] and m["breaker"] == "closed")
-            return {
+            snap = {
                 "size": len(self._slots),
                 "healthy": healthy,
                 "closed": self._closed,
@@ -1083,9 +1143,16 @@ class ServingPool:
                 "queue_depth": len(self._queue) + len(self._retry_timers),
                 "in_flight": sum(m["in_flight"] for m in members),
                 "members": members,
-                "batch": (self._batcher.stats()
-                          if self._batcher is not None else None),
             }
+        # nested components snapshot OUTSIDE self._lock: the decode
+        # engine's stats() takes its own lock and then its step pool's
+        # "serving.pool"-named lock — holding ours across that nesting
+        # would be a name-level acquisition-order cycle under lockcheck
+        snap["batch"] = (self._batcher.stats()
+                         if self._batcher is not None else None)
+        snap["decode"] = (self._engine.stats()
+                          if self._engine is not None else None)
+        return snap
 
     def __len__(self):
         return len(self._slots)

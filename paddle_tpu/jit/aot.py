@@ -36,7 +36,8 @@ import pickle
 
 from ..analysis import locks as _locks
 
-__all__ = ["CompileCache", "compile_batched", "default_cache", "cache_dir"]
+__all__ = ["CompileCache", "compile_batched", "compile_jit", "default_cache",
+           "cache_dir"]
 
 _ENV_DIR = "PADDLE_TPU_COMPILE_CACHE"
 _ENV_KEEP = "PADDLE_TPU_COMPILE_CACHE_KEEP"
@@ -195,6 +196,59 @@ def executable_key(fingerprint, bucket, input_spec, holder_shapes):
         "batched-v1", fingerprint, bucket,
         [(list(s["shape"]), str(s["dtype"])) for s in input_spec],
         holder_shapes, *_versions())
+
+
+def _aval_signature(avals):
+    """Deterministic shape/dtype signature of an aval pytree (cache-key
+    material; the tree structure itself is part of the signature so two
+    functions over differently-nested identical leaves never collide)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(avals)
+    return (str(treedef),
+            [(list(a.shape), str(a.dtype)) for a in leaves])
+
+
+def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1"):
+    """AOT-compile (or cache-load) `fn` over an aval pytree, persisting the
+    executable like `compile_batched` does for bucket executables.
+
+    `avals` is the positional-argument pytree of `jax.ShapeDtypeStruct`s
+    (weights must ride as runtime arguments — never closed over — so the
+    serialized executable holds no model state). Returns `(compiled,
+    source)` where `compiled(*args)` runs the executable and `source` is
+    "compiled" (built here, persisted when a fingerprint was given) or
+    "disk" (loaded from the persistent cache, zero XLA compilation).
+
+    This is the decode-engine analog of `compile_batched`: the continuous-
+    batching step function is compiled once per batch bucket and a warm
+    process start loads every bucket from disk instead of recompiling.
+    """
+    import jax
+    from jax.experimental import serialize_executable as _se
+
+    key = None
+    if fingerprint is not None:
+        cache = cache or default_cache()
+        key = CompileCache.key(tag, fingerprint, _aval_signature(avals),
+                               *_versions())
+        blob = cache.get(key)
+        if blob is not None:
+            try:
+                payload, in_tree, out_tree = pickle.loads(blob)
+                loaded = _se.deserialize_and_load(payload, in_tree, out_tree)
+                return loaded, "disk"
+            except Exception:  # tpu-lint: disable=TL007 — stale/corrupt
+                pass  # cache entry: recompile and overwrite below
+
+    with _locks.blocking_region("aot.compile"):
+        compiled = jax.jit(fn).lower(*avals).compile()
+    if key is not None:
+        try:
+            cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))
+        except Exception:  # tpu-lint: disable=TL007 — an unserializable
+            pass           # backend still serves from memory
+    return compiled, "compiled"
 
 
 def compile_batched(exported, holder_avals, input_spec, bucket, *,

@@ -84,6 +84,19 @@ CONFIGS = {
 }
 
 
+class CacheQuantError(ValueError):
+    """Unknown KV-cache quantization mode. Subclasses ValueError so
+    pre-existing `except ValueError` callers keep working; raised (never
+    silently ignored) for any unrecognized `quant=` argument or
+    `cache_quant` attribute."""
+
+
+#: spellings that mean "no quantization — plain parameter-dtype cache"
+#: ("bf16" is the documented name of the unquantized layout, so an
+#: explicit quant="bf16" OVERRIDES a model-level cache_quant attribute)
+_NO_QUANT = (None, "", "none", "bf16")
+
+
 def _normal_attr(std):
     return nn.ParamAttr(initializer=nn.initializer.Normal(0.0, std))
 
@@ -367,19 +380,43 @@ class GPTForCausalLM(nn.Layer):
     def forward(self, input_ids, position_ids=None):
         return self._project(self.transformer(input_ids, position_ids))
 
+    def _resolve_cache_quant(self, quant):
+        """Resolve the KV-cache quantization mode with a documented
+        precedence: an explicit `quant=` ARGUMENT always wins over the
+        model-level `cache_quant` attribute; only `quant=None` falls back
+        to the attribute (so `generate()` and the decode engine pick up a
+        model-wide default without API changes, while a caller can still
+        force the bf16 layout with `quant="bf16"` on a model whose
+        attribute says int8). Returns None (unquantized) or "int8";
+        anything else raises `CacheQuantError` — an unknown spelling must
+        never silently fall back to the bf16 layout."""
+        if quant is None:
+            quant = getattr(self, "cache_quant", None)
+        key = quant.lower() if isinstance(quant, str) else quant
+        if key in _NO_QUANT:
+            return None
+        if key == "int8":
+            return "int8"
+        raise CacheQuantError(
+            f"unsupported cache quant {quant!r} (supported: 'int8', or "
+            f"'bf16'/None for the unquantized layout)")
+
     def init_cache(self, batch_size, max_length, dtype=None, quant=None):
         """Zeroed per-layer KV caches [B, T, Hkv, D] for cached decode.
         Cache dtype follows the parameters (bf16 params -> bf16 cache:
         the KV read is the decode bandwidth bill).
 
-        quant="int8" (or a `cache_quant` attribute set on the model, so
-        `generate()` picks it up without API changes) stores int8 values
-        plus f32 per-position scales — half the per-token cache read
-        (docs/decode_perf.md names the KV read as the biggest
-        weight-independent term in the decode floor)."""
+        quant="int8" stores int8 values plus f32 per-position scales —
+        half the per-token cache read (docs/decode_perf.md names the KV
+        read as the biggest weight-independent term in the decode
+        floor). Precedence: the `quant=` argument wins; `quant=None`
+        falls back to the model's `cache_quant` attribute (so
+        `generate()` picks it up without API changes) and `quant="bf16"`
+        forces the unquantized layout even then. Unknown modes raise
+        `CacheQuantError` (a ValueError). For the paged layout used by
+        the continuous-batching decode engine, see `init_block_pool`."""
         cfg = self.cfg
-        if quant is None:
-            quant = getattr(self, "cache_quant", None)
+        quant = self._resolve_cache_quant(quant)
         if dtype is None:
             dtype = self.transformer.wte.weight.dtype
         shape = (batch_size, int(max_length), cfg.num_kv_heads, cfg.head_dim)
@@ -392,12 +429,34 @@ class GPTForCausalLM(nn.Layer):
                      Tensor(jnp.zeros(shape, jnp.int8)),
                      Tensor(jnp.zeros(sshape, jnp.float32)))
                     for _ in range(cfg.num_layers)]
-        if quant is not None:
-            raise ValueError(f"unsupported cache quant {quant!r} "
-                             "(supported: 'int8')")
         return [(Tensor(jnp.zeros(shape, dtype)),
                  Tensor(jnp.zeros(shape, dtype)))
                 for _ in range(cfg.num_layers)]
+
+    def init_block_pool(self, num_blocks, block_size, dtype=None,
+                        quant=None):
+        """Paged twin of `init_cache`: a `BlockKVCache` whose per-layer
+        pool tensors use exactly this model's cache-entry order and
+        dtypes — `(k, v)` blocks of the parameter dtype, or int8
+        `(kq, ks, vq, vs)` quads ([N, bs, Hkv, D] int8 values +
+        [N, bs, Hkv] f32 scales). Quant precedence and error semantics
+        are shared with `init_cache` (`_resolve_cache_quant`). The
+        continuous-batching `DecodeEngine` calls this so cache geometry
+        is owned by the model, not the scheduler."""
+        from ..inference.decode.block_pool import BlockKVCache
+
+        cfg = self.cfg
+        quant = self._resolve_cache_quant(quant)
+        if dtype is None:
+            dtype = self.transformer.wte.weight.dtype
+        suffix = (cfg.num_kv_heads, cfg.head_dim)
+        if quant == "int8":
+            layer = ((suffix, jnp.int8), ((cfg.num_kv_heads,), jnp.float32),
+                     (suffix, jnp.int8), ((cfg.num_kv_heads,), jnp.float32))
+        else:
+            layer = ((suffix, dtype), (suffix, dtype))
+        return BlockKVCache(num_blocks, block_size,
+                            [layer] * cfg.num_layers, quant=quant)
 
     def decode_step(self, input_ids, caches, pos):
         """Cached decode step: logits for input_ids at global offset pos

@@ -1,13 +1,19 @@
 """Fused decode-attention kernel (ops/pallas/decode_attn.py) — numerics vs
-a dense numpy reference, MHA + GQA, int8 and float caches. Runs in
-interpret mode on the CPU mesh; the on-TPU perf verdict lives in
-docs/decode_perf.md (measured: the XLA path wins at decode shapes; the
-kernel stays as the measured record)."""
+a dense numpy reference, MHA + GQA, int8 and float caches, plus the PAGED
+(block-table) variant used by the continuous-batching decode engine:
+Pallas flash-decoding kernel in interpret mode AND the XLA gather
+fallback, over ragged/odd shapes (positions mid-block, unallocated table
+tails pointing at the reserved block, GQA group sizes that don't divide
+the head count). The on-TPU perf verdict lives in docs/decode_perf.md
+(measured: the XLA path wins at today's decode shapes; the kernels stay
+as the measured record for genuinely bytes-bound regimes)."""
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
-from paddle_tpu.ops.pallas.decode_attn import decode_attention
+from paddle_tpu.ops.pallas.decode_attn import (decode_attention,
+                                               paged_decode_attention)
 
 
 def _quant(x):
@@ -70,6 +76,25 @@ def test_decode_attention_float_cache():
     np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5)
 
 
+def test_decode_attention_uneven_gqa_ratio_raises():
+    """GQA group sizes that don't divide the head count must raise, not
+    silently clamp block indices past the cache's head axis."""
+    q, k, v = _case(1, 8, 6, 4, 8, pos=3)   # 6 heads over 4 kv heads
+    ones = np.ones(k.shape[:-1] + (1,), np.float32)
+    with pytest.raises(ValueError):
+        decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(ones),
+                         jnp.asarray(v), jnp.asarray(ones), 3,
+                         interpret=True)
+    pool = np.zeros((4, 4, 4, 8), np.float32)
+    pones = np.ones((4, 4, 4, 1), np.float32)
+    with pytest.raises(ValueError):
+        paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(pool), jnp.asarray(pones),
+            jnp.asarray(pool), jnp.asarray(pones),
+            jnp.zeros((1, 2), jnp.int32), jnp.asarray([3], jnp.int32),
+            use_kernel=False)
+
+
 def test_decode_attention_mask_excludes_future():
     # positions beyond pos must not contribute: poison them with huge values
     q, k, v = _case(1, 12, 2, 2, 8, pos=4)
@@ -82,3 +107,98 @@ def test_decode_attention_mask_excludes_future():
     assert np.abs(np.asarray(out)).max() < 50.0
     ref = _ref(q, k, v, 4)
     np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5)
+
+
+# ---------------------------------------------------------------------------
+# paged (block-table) decode attention — engine layout, per-sequence pos
+# ---------------------------------------------------------------------------
+
+def _paged_case(B, H, Hkv, D, BS, NB, N, pos, seed=0):
+    """Random pool (garbage in EVERY block, including reserved block 0 and
+    blocks no table references), random distinct per-sequence tables with
+    unallocated tails pointing at block 0, per-sequence positions."""
+    rng = np.random.RandomState(seed)
+    q = rng.randn(B, 1, H, D).astype(np.float32)
+    kq = rng.randn(N, Hkv, BS, D).astype(np.float32)
+    vq = rng.randn(N, Hkv, BS, D).astype(np.float32)
+    avail = list(range(1, N))
+    rng.shuffle(avail)
+    tables = np.zeros((B, NB), np.int32)
+    for b in range(B):
+        used = pos[b] // BS + 1           # blocks the position reaches
+        tables[b, :used] = [avail.pop() for _ in range(used)]
+    return q, kq, vq, tables, np.asarray(pos, np.int32)
+
+
+def _paged_ref(q, kq, vq, tables, pos):
+    B, _, H, D = q.shape
+    N, Hkv, BS, _ = kq.shape
+    NB = tables.shape[1]
+    out = np.zeros((B, 1, H, D))
+    for b in range(B):
+        k = np.concatenate([np.transpose(kq[tables[b, j]], (1, 0, 2))
+                            for j in range(NB)], 0)       # [T, Hkv, D]
+        v = np.concatenate([np.transpose(vq[tables[b, j]], (1, 0, 2))
+                            for j in range(NB)], 0)
+        kf = np.repeat(k, H // Hkv, 1)
+        vf = np.repeat(v, H // Hkv, 1)
+        T = NB * BS
+        sc = np.einsum("qhd,khd->hqk", q[b].astype(np.float64),
+                       kf.astype(np.float64)) / np.sqrt(D)
+        sc = np.where((np.arange(T) <= pos[b])[None, None], sc, -np.inf)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        out[b] = np.einsum("hqk,khd->qhd", p, vf.astype(np.float64))
+    return out
+
+
+def _run_paged(q, kq, vq, tables, pos, use_kernel):
+    ones = np.ones(kq.shape[:-1] + (1,), np.float32)
+    return np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(ones),
+        jnp.asarray(vq), jnp.asarray(ones), jnp.asarray(tables),
+        jnp.asarray(pos), use_kernel=use_kernel, interpret=True))
+
+
+def test_paged_decode_xla_fallback_matches_dense():
+    # positions mid-block (T the query sees is NOT a block multiple) and
+    # ragged tails: seq 0 uses 2 of 3 table slots, seq 1 all 3
+    q, kq, vq, tables, pos = _paged_case(2, 4, 2, 8, BS=4, NB=3, N=8,
+                                         pos=[5, 10])
+    out = _run_paged(q, kq, vq, tables, pos, use_kernel=False)
+    np.testing.assert_allclose(out, _paged_ref(q, kq, vq, tables, pos),
+                               atol=3e-5)
+
+
+def test_paged_decode_pallas_kernel_matches_dense():
+    q, kq, vq, tables, pos = _paged_case(2, 4, 2, 8, BS=4, NB=3, N=8,
+                                         pos=[5, 10], seed=1)
+    out = _run_paged(q, kq, vq, tables, pos, use_kernel=True)
+    np.testing.assert_allclose(out, _paged_ref(q, kq, vq, tables, pos),
+                               atol=3e-5)
+
+
+def test_paged_decode_int8_pool_kernel_vs_fallback():
+    q, kq, vq, tables, pos = _paged_case(2, 4, 4, 8, BS=4, NB=2, N=6,
+                                         pos=[3, 6], seed=2)
+    kq8, ks8 = _quant(kq)
+    vq8, vs8 = _quant(vq)
+    args = [jnp.asarray(a) for a in
+            (q, kq8, ks8, vq8, vs8, tables, pos)]
+    out_k = np.asarray(paged_decode_attention(*args, use_kernel=True,
+                                              interpret=True))
+    out_x = np.asarray(paged_decode_attention(*args, use_kernel=False))
+    np.testing.assert_allclose(out_k, out_x, atol=3e-5)
+    ref = _paged_ref(q, kq8.astype(np.float32) * ks8,
+                     vq8.astype(np.float32) * vs8, tables, pos)
+    np.testing.assert_allclose(out_x, ref, atol=3e-5)
+
+
+def test_paged_decode_single_position_first_block():
+    # pos = 0: only the first row of the first block may contribute
+    q, kq, vq, tables, pos = _paged_case(1, 2, 2, 8, BS=4, NB=2, N=4,
+                                         pos=[0], seed=3)
+    for use_kernel in (False, True):
+        out = _run_paged(q, kq, vq, tables, pos, use_kernel)
+        np.testing.assert_allclose(out, _paged_ref(q, kq, vq, tables, pos),
+                                   atol=3e-5)

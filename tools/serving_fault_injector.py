@@ -50,10 +50,29 @@ split-on-failure; see docs/serving.md):
                 typed failure in its batch — every batchmate completes
                 bit-correct.
 
+Decode phases (`decode-*`) run the continuous-batching LLM engine
+(paddle_tpu/inference/decode) with mixed-length generations and prove the
+iteration-level invariants: BLOCK-POOL CONSERVATION (allocated + free +
+reserved == total, a drained engine returns to allocated == 0 — no fault
+path may leak a KV block) and SEQUENCE ISOLATION (a faulted sequence is
+the only casualty; every batchmate's tokens stay bit-identical to a
+fault-free solo run):
+
+  decode-kill    cancel one sequence mid-generation (its blocks return to
+                 the pool at the next step boundary);
+  decode-wedge   wedge one shared decode step past the step deadline (the
+                 internal step pool's EXISTING hang detection retires the
+                 wedged worker; the engine re-dispatches the pure step and
+                 nobody loses a token);
+  decode-poison  deterministically fail ONE sequence's prefill (poisoned
+                 feed) — typed RequestFailed for it alone;
+  decode-none    fault-free control (also produces the per-prompt solo
+                 reference tokens the other phases compare against).
+
 Run as a script (exits nonzero on any violation — registered as a tier-1
 test via tests/test_serving_fault_injection.py):
 
-    python tools/serving_fault_injector.py [--phases crash,batch-crash,...]
+    python tools/serving_fault_injector.py [--phases crash,decode-kill,...]
 """
 from __future__ import annotations
 
@@ -77,7 +96,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("PADDLE_TPU_LOCKCHECK", "1")
 
 PHASES = ("crash", "hang", "poison", "corrupt", "none",
-          "batch-crash", "batch-hang", "batch-poison")
+          "batch-crash", "batch-hang", "batch-poison",
+          "decode-none", "decode-kill", "decode-wedge", "decode-poison")
 
 POOL_SIZE = 3
 N_REQUESTS = 48
@@ -366,6 +386,184 @@ def run_phase(phase, model, path, verbose=True):
     return bad
 
 
+# ---------------------------------------------------------------------------
+# decode (continuous-batching) phases
+# ---------------------------------------------------------------------------
+
+DECODE_SEQS = (  # (prompt seed, prompt len, max_new) — mixed lengths
+    (1, 6, 10), (2, 5, 4), (3, 7, 8), (4, 6, 4), (5, 8, 6), (6, 5, 9))
+DECODE_VOCAB = 97
+STEP_HANG = 0.6
+STEP_TIMEOUT = 0.25
+
+
+def _decode_model():
+    """Tiny LLaMA-style config (rope + GQA + swiglu): its random init
+    emits VARIED greedy tokens, so a sequencing bug cannot hide behind a
+    degenerate repeated-token output."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt
+
+    paddle.seed(7)
+    m = gpt("gpt_tiny", vocab_size=DECODE_VOCAB, hidden_size=48,
+            num_heads=4, num_kv_heads=2, num_layers=2, rope=True,
+            swiglu=True, rms_norm=True, max_position_embeddings=64,
+            tie_word_embeddings=False)
+    m.eval()
+    return m
+
+
+def _decode_prompts():
+    import numpy as np
+
+    return {seed: np.random.RandomState(seed).randint(
+        0, DECODE_VOCAB, (n,)).astype(np.int32)
+        for seed, n, _ in DECODE_SEQS}
+
+
+def _decode_engine(model, fault_hook=None):
+    from paddle_tpu.inference import DecodeEngine
+
+    return DecodeEngine(model, max_length=32, block_size=8,
+                        decode_buckets=(1, 2, 4, 8), prefill_buckets=(8,),
+                        default_timeout=30.0, step_timeout=STEP_TIMEOUT,
+                        step_retries=2, hang_grace=0.05,
+                        supervise_interval=0.01, fault_hook=fault_hook)
+
+
+_DECODE_REFS = {}    # seed -> solo reference tokens (filled on first use)
+
+
+def _decode_references(model):
+    """Per-prompt solo reference tokens from a fault-free engine — the
+    bit-identity yardstick every decode phase compares against."""
+    if _DECODE_REFS:
+        return _DECODE_REFS
+    prompts = _decode_prompts()
+    with _decode_engine(model) as eng:
+        for seed, _, max_new in DECODE_SEQS:
+            _DECODE_REFS[seed] = eng.generate(prompts[seed], max_new)
+    return _DECODE_REFS
+
+
+def run_decode_phase(phase, model, verbose=True):
+    from paddle_tpu.inference import (DeadlineExceeded, Overloaded,
+                                      PoolClosed, RequestFailed,
+                                      ServingError)
+
+    bad = []
+    refs = _decode_references(model)
+    prompts = _decode_prompts()
+    kind = phase.split("-", 1)[1]
+    victim_idx = 2                       # DECODE_SEQS row the fault targets
+    victim_seed = DECODE_SEQS[victim_idx][0]
+    inj = {"armed": kind in ("wedge", "poison"), "injected": 0,
+           "lock": threading.Lock()}
+
+    def hook(stage, seq_ids, meta):
+        with inj["lock"]:
+            if not inj["armed"]:
+                return
+            if kind == "wedge" and stage == "decode" and len(seq_ids) > 1:
+                inj["armed"] = False
+                inj["injected"] += 1
+            elif kind == "poison" and stage == "prefill" \
+                    and seq_ids == [victim_idx + 1]:
+                inj["armed"] = False
+                inj["injected"] += 1
+                raise ValueError(
+                    f"injected poisoned feed for sequence {seq_ids[0]}")
+            else:
+                return
+        if kind == "wedge":              # sleep OUTSIDE the bookkeeping lock
+            time.sleep(STEP_HANG)
+
+    t0 = time.monotonic()
+    eng = _decode_engine(model, fault_hook=hook if kind != "none" else None)
+    streams = {}
+    try:
+        for seed, _, max_new in DECODE_SEQS:
+            # sequence ids are assigned in submission order (1-based), so
+            # the poison hook can target the victim row deterministically
+            streams[seed] = eng.submit(prompts[seed], max_new)
+        if kind == "kill":
+            v = streams[victim_seed]
+            next(iter(v))                # definitely mid-generation
+            v.cancel()
+            inj["injected"] += 1
+        outcomes = {}
+        for seed, _, _ in DECODE_SEQS:
+            s = streams[seed]
+            try:
+                toks = s.result()
+                outcomes[seed] = "ok"
+                if toks != refs[seed]:
+                    bad.append(f"[{phase}] sequence {seed} tokens diverged "
+                               f"from the solo reference: {toks} vs "
+                               f"{refs[seed]}")
+            except (DeadlineExceeded, Overloaded, PoolClosed,
+                    RequestFailed) as e:
+                outcomes[seed] = type(e).__name__
+            except ServingError as e:
+                outcomes[seed] = f"unexpected-typed:{e}"
+                bad.append(f"[{phase}] sequence {seed} -> unexpected typed "
+                           f"error: {e}")
+            except BaseException as e:  # noqa: BLE001 — untyped = violation
+                outcomes[seed] = f"untyped:{type(e).__name__}"
+                bad.append(f"[{phase}] sequence {seed} -> UNTYPED error: "
+                           f"{type(e).__name__}: {e}")
+
+        ok = sum(1 for v in outcomes.values() if v == "ok")
+        if kind in ("none", "wedge") and ok != len(DECODE_SEQS):
+            bad.append(f"[{phase}] every sequence must complete bit-correct "
+                       f"({'a wedged step is retried, not fatal' if kind == 'wedge' else 'control run'}): {outcomes}")
+        if kind == "kill":
+            if outcomes[victim_seed] == "ok" or ok != len(DECODE_SEQS) - 1:
+                bad.append(f"[{phase}] exactly the cancelled sequence must "
+                           f"fail: {outcomes}")
+            if streams[victim_seed].status != "cancelled":
+                bad.append(f"[{phase}] victim status "
+                           f"{streams[victim_seed].status} != cancelled")
+        if kind == "poison":
+            if outcomes[victim_seed] != "RequestFailed" \
+                    or ok != len(DECODE_SEQS) - 1:
+                bad.append(f"[{phase}] exactly the poisoned sequence must "
+                           f"fail (typed RequestFailed): {outcomes}")
+        if kind in ("wedge", "poison") and inj["injected"] == 0:
+            bad.append(f"[{phase}] harness error: no fault was injected")
+
+        st = eng.stats()
+        if kind == "wedge" and st["wedged_steps"] < 1:
+            bad.append(f"[{phase}] the step pool's hang detection never "
+                       f"fired: {st['step_pool']}")
+        # engine conservation law
+        lhs = st["admitted"]
+        rhs = (st["completed"] + st["failed"] + st["timed_out"]
+               + st["cancelled"])
+        if lhs != rhs or st["active"] or st["waiting"]:
+            bad.append(f"[{phase}] engine conservation violated: "
+                       f"admitted={lhs} != {rhs} (active={st['active']}, "
+                       f"waiting={st['waiting']})")
+    finally:
+        drained = eng.shutdown(drain_timeout=10.0)
+    if not drained:
+        bad.append(f"[{phase}] engine failed to drain")
+    # block-pool conservation: nothing leaked through any fault path
+    bs = eng.stats()["blocks"]
+    if bs["allocated"] != 0 or bs["free"] + bs["reserved"] != bs["total"]:
+        bad.append(f"[{phase}] BLOCK LEAK: {bs}")
+    if bs["allocs"] != bs["frees"]:
+        bad.append(f"[{phase}] alloc/free imbalance: {bs}")
+    if verbose:
+        tag = "FAIL" if bad else "ok"
+        print(f"  {phase:<13} -> {tag}  (injected={inj['injected']}, "
+              f"steps={eng.stats()['steps']}, "
+              f"wedged={eng.stats()['wedged_steps']}, "
+              f"peak_blocks={bs['peak_allocated']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--phases", default=",".join(PHASES),
@@ -381,10 +579,21 @@ def main(argv=None):
         os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE",
                               os.path.join(workdir, "compile-cache"))
         path = os.path.join(workdir, "infer")
-        model = _export_model(path)
+        serving_phases = [p for p in phases
+                          if not p.startswith("decode-")]
+        decode_phases = [p for p in phases if p.startswith("decode-")]
+        model = _export_model(path) if serving_phases else None
         print("serving fault injection (hook-at-execution):")
-        for phase in phases:
+        for phase in serving_phases:
             violations += run_phase(phase, model, path)
+        if decode_phases:
+            # decode phases share one model + one compile cache: the
+            # reference engine compiles each bucket once, later phases
+            # disk-hit (warm-start reuse is ALSO under test here)
+            dmodel = _decode_model()
+            _decode_references(dmodel)
+            for phase in decode_phases:
+                violations += run_decode_phase(phase, dmodel)
 
         if any("hang" in p for p in phases):
             # Wedged members are retired with their threads ABANDONED (by
@@ -413,6 +622,11 @@ def main(argv=None):
         # hold — require the serving stack's own named locks to be seen
         expected_locks = {"serving.pool", "serving.request",
                           "serving.breaker"}
+        if any(p.startswith("decode-") for p in phases):
+            # the decode engine's own named locks must have been observed
+            # (and the 0-cycles / 0-held-across-dispatch assertions below
+            # now cover the decode-step dispatch path too)
+            expected_locks |= {"decode.engine", "decode.block_pool"}
         missing = expected_locks - set(rep["locks"])
         if missing:
             violations.append(
