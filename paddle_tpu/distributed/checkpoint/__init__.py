@@ -22,7 +22,7 @@ tools/ckpt_fault_injector.py.
 """
 from .api import (  # noqa: F401
     save_state_dict, load_state_dict, load_extra, is_committed,
-    LocalTensorMetadata, Metadata, AsyncCheckpointSave,
+    commit_generation, LocalTensorMetadata, Metadata, AsyncCheckpointSave,
     CheckpointError, CheckpointNotCommittedError, CheckpointCorruptError,
     COMMITTED_SENTINEL,
 )

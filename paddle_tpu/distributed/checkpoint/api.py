@@ -51,9 +51,10 @@ import jax
 from ...core.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "load_extra",
-           "is_committed", "LocalTensorMetadata", "Metadata",
-           "CheckpointError", "CheckpointNotCommittedError",
-           "CheckpointCorruptError", "COMMITTED_SENTINEL"]
+           "is_committed", "commit_generation", "write_commit_sentinel",
+           "LocalTensorMetadata", "Metadata", "CheckpointError",
+           "CheckpointNotCommittedError", "CheckpointCorruptError",
+           "COMMITTED_SENTINEL"]
 
 COMMITTED_SENTINEL = "_COMMITTED"
 MANIFEST_FORMAT = 1
@@ -195,6 +196,40 @@ def is_committed(path) -> bool:
     return os.path.exists(os.path.join(path, COMMITTED_SENTINEL))
 
 
+def write_commit_sentinel(path, *, world_size=1, generation=None):
+    """Drop the `_COMMITTED` sentinel (atomic write + dir fsync, the
+    LAST step of the commit protocol). The single place the sentinel
+    format lives: `_commit` uses it for tensor checkpoints, and the
+    serving router's `commit_model_dir` uses it to bless exported-model
+    dirs through exactly the same validation path."""
+    sentinel = {"format": MANIFEST_FORMAT, "world_size": int(world_size),
+                # DELIBERATELY wall-clock: it names when the snapshot was
+                # committed for operators and cross-host tooling
+                # (monotonic is meaningless outside this process)
+                "unix_time": time.time()}  # tpu-lint: disable=TL010
+    if generation is not None:
+        # monotonic commit-id (CheckpointManager stamps the step):
+        # readable via commit_generation() without touching tensors, so
+        # a serving router can order hot-swap targets cheaply
+        sentinel["generation"] = int(generation)
+    _atomic_write(os.path.join(path, COMMITTED_SENTINEL),
+                  lambda f: f.write(json.dumps(sentinel).encode()))
+    _fsync_dir(path)
+
+
+def commit_generation(path):
+    """The monotonic generation/commit-id recorded in the `_COMMITTED`
+    sentinel, readable WITHOUT loading any tensor bytes, or None when the
+    commit predates generation stamping (or the sentinel is unreadable).
+    `CheckpointManager.save` stamps the step by default; the serving
+    router orders hot-swap targets by this field and refuses to roll back
+    to an older generation. Uncommitted directories raise
+    `CheckpointNotCommittedError` like any other load-side access."""
+    sentinel = _check_committed(path)
+    gen = sentinel.get("generation")
+    return None if gen is None else int(gen)
+
+
 # --------------------------------------------------------------------------
 # save
 # --------------------------------------------------------------------------
@@ -225,7 +260,7 @@ class AsyncCheckpointSave(threading.Thread):
         self.join()
 
 
-def _commit(path, world, process):
+def _commit(path, world, process, generation=None):
     """Steps 3-4 of the commit protocol: synchronize writers, then rank 0
     verifies all manifests exist and drops the sentinel."""
     tag = _path_tag(path)
@@ -252,15 +287,8 @@ def _commit(path, world, process):
                     f"processes {missing} after barrier")
             time.sleep(0.05)
         _maybe_crash("pre-commit")
-        sentinel = {"format": MANIFEST_FORMAT, "world_size": world,
-                    # the manifest field is DELIBERATELY wall-clock: it
-                    # names when the snapshot was committed for operators
-                    # and cross-host tooling (monotonic is meaningless
-                    # outside this process)
-                    "unix_time": time.time()}  # tpu-lint: disable=TL010
-        _atomic_write(os.path.join(path, COMMITTED_SENTINEL),
-                      lambda f: f.write(json.dumps(sentinel).encode()))
-        _fsync_dir(path)
+        write_commit_sentinel(path, world_size=world,
+                              generation=generation)
     if world > 1 and store is not None:
         # every rank returns only once the sentinel exists
         store.barrier(f"ckpt/{tag}/committed", world_size=world)
@@ -274,7 +302,7 @@ def _commit(path, world, process):
 
 
 def save_state_dict(state_dict, path, *, async_save=False, extra=None,
-                    defer=False):
+                    defer=False, generation=None):
     """Crash-atomically write every process's owned shards + metadata +
     integrity manifest, then commit (reference: save_state_dict.py:104 plus
     the commit protocol in the module docstring).
@@ -285,6 +313,8 @@ def save_state_dict(state_dict, path, *, async_save=False, extra=None,
     — join it before relying on the files; IO errors re-raise from join()
     (≈ the reference's async checkpoint path). `extra` is an optional
     JSON-serializable object written as `extra.json` by process 0.
+    `generation` is an optional monotonic commit-id stamped into the
+    `_COMMITTED` sentinel (read it back with `commit_generation`).
 
     defer=True returns the write-and-commit closure instead of running it:
     the tensor snapshot still happens NOW (synchronously), but the caller
@@ -394,7 +424,7 @@ def save_state_dict(state_dict, path, *, async_save=False, extra=None,
             _fsync_dir(path)
         finally:
             shutil.rmtree(staging, ignore_errors=True)
-        _commit(path, world, p)
+        _commit(path, world, p, generation=generation)
 
     if defer:
         return _write

@@ -34,8 +34,8 @@ import jax
 
 from ...core.tensor import Tensor
 from .api import (
-    AsyncCheckpointSave, CheckpointError, is_committed, load_extra,
-    load_state_dict, save_state_dict,
+    AsyncCheckpointSave, CheckpointError, commit_generation, is_committed,
+    load_extra, load_state_dict, save_state_dict,
 )
 
 __all__ = ["CheckpointManager", "clean_uncommitted"]
@@ -135,7 +135,8 @@ class CheckpointManager:
         self.backoff = float(backoff)
         self.max_backoff = float(max_backoff)
         self._pending = None
-        self.last_extra = None  # user extra of the last restore
+        self.last_extra = None       # user extra of the last restore
+        self.last_generation = None  # commit generation of the last restore
         os.makedirs(self.root, exist_ok=True)
 
     # -- inventory ---------------------------------------------------------
@@ -163,6 +164,18 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def generation_of(self, step):
+        """The monotonic commit-id stamped into checkpoint `step`'s
+        sentinel (None for commits predating generation stamping) —
+        readable without loading any tensor bytes, so hot-swap tooling
+        can order candidates cheaply."""
+        return commit_generation(self._step_dir(step))
+
+    def latest_generation(self):
+        """Commit-id of the newest committed checkpoint, or None."""
+        step = self.latest_step()
+        return None if step is None else self.generation_of(step)
+
     # -- save --------------------------------------------------------------
     def _with_retry(self, fn):
         delay = self.backoff
@@ -175,22 +188,27 @@ class CheckpointManager:
                 time.sleep(delay)
                 delay = min(delay * 2, self.max_backoff)
 
-    def save(self, state_dict, step, extra=None):
+    def save(self, state_dict, step, extra=None, generation=None):
         """Checkpoint `state_dict` as `step`. Waits for (and re-raises
         from) any pending async save first. Transient OSErrors retry with
         bounded exponential backoff — single-process only: a multi-process
         save re-entering the commit barriers alone would skew the counting
         epoch and hang the job, so there a failed rank fails the save and
-        the elastic relaunch path owns recovery. Returns the
+        the elastic relaunch path owns recovery. The commit sentinel is
+        stamped with a monotonic `generation` (default: the step itself)
+        so downstream consumers — the serving router's weight hot-swap —
+        can order snapshots without loading tensors. Returns the
         AsyncCheckpointSave handle in async mode, else None."""
         self.wait()
         tensors, scalars = _split_tree(state_dict)
         payload = {"state_scalars": scalars, "user_extra": extra}
         path = self._step_dir(step)
+        gen = int(step) if generation is None else int(generation)
         # snapshot NOW (defer=True still captures tensor bytes
         # synchronously): an optimizer step racing the async IO thread
         # must not tear the checkpoint across param updates
-        write = save_state_dict(tensors, path, extra=payload, defer=True)
+        write = save_state_dict(tensors, path, extra=payload, defer=True,
+                                generation=gen)
         retry = jax.process_count() == 1
 
         def _do():
@@ -224,7 +242,8 @@ class CheckpointManager:
         caller's tree untouched. strict=False tolerates target tensors
         absent from the checkpoint (e.g. optimizer accumulators
         materialized for params that had not stepped at save time).
-        Returns `step`."""
+        Returns `step`; the restored snapshot's commit generation is
+        surfaced on `self.last_generation`."""
         path = self._step_dir(step)
         tensors, _ = _split_tree(state_dict)
         scratch = _clone_tensor_tree(tensors)
@@ -233,6 +252,7 @@ class CheckpointManager:
         _adopt_values(tensors, scratch)
         _merge_scalars(state_dict, payload.get("state_scalars") or {})
         self.last_extra = payload.get("user_extra")
+        self.last_generation = commit_generation(path)
         return int(step)
 
     def restore_latest(self, state_dict, strict=True):

@@ -203,6 +203,24 @@ class Watchdog:
     def members(self) -> list[str]:
         return [k[len("/hb/"):] for k in self.store.keys("/hb/")]
 
+    def members_health(self) -> dict:
+        """Passive health snapshot for pollers (the serving router):
+        `{name: {"alive": bool, "dead": bool, "age": seconds|None}}`.
+        `age` is seconds since the member's last heartbeat receipt
+        (server-side stamp; None if never seen), `dead` reflects the
+        watchdog's current flag (set by `check()`, cleared on revival),
+        and `alive` means the heartbeat is fresh AND the member is not
+        currently flagged — a revived-but-not-yet-swept member reads
+        fresh-but-dead until the next `check()`. Pure read: no flags are
+        mutated and no on_failure/on_recovery hooks fire from here."""
+        out = {}
+        for m in self.members():
+            age = self.store.heartbeat_age(m)
+            fresh = age is not None and age <= self.ttl
+            out[m] = {"age": age, "dead": m in self.dead,
+                      "alive": fresh and m not in self.dead}
+        return out
+
     def check(self) -> list[str]:
         """One sweep; returns newly-dead member names. Members in
         `self.dead` whose heartbeat turned fresh again (rejoined elastic

@@ -23,6 +23,10 @@ __all__ = [
     "BatchConfig", "DynamicBatcher",
     # continuous-batching LLM decode engine (decode/)
     "DecodeEngine", "SequenceStream", "BlockKVCache", "OutOfBlocks",
+    # distributed serving tier (replica.py + router.py)
+    "ServingRouter", "RouterConfig", "SwapFailed", "commit_model_dir",
+    "LocalReplica", "SubprocessReplica", "LocalHeartbeats",
+    "ReplicaError", "ReplicaDead",
 ]
 
 
@@ -267,4 +271,11 @@ from .serving import (  # noqa: E402
 )
 from .decode import (  # noqa: E402
     BlockKVCache, DecodeEngine, OutOfBlocks, SequenceStream,
+)
+from .replica import (  # noqa: E402
+    LocalHeartbeats, LocalReplica, ReplicaDead, ReplicaError,
+    SubprocessReplica,
+)
+from .router import (  # noqa: E402
+    RouterConfig, ServingRouter, SwapFailed, commit_model_dir,
 )

@@ -1,0 +1,831 @@
+"""paddle_tpu.inference.replica — one member of the distributed serving tier.
+
+A *replica* is a unit of serving capacity the `ServingRouter`
+(router.py) fronts: a `ServingPool` plus a liveness heartbeat plus a
+control surface (drain / weight swap / restart). Two transports share
+one handle contract:
+
+* `LocalReplica` — threads-as-replicas: the pool lives in this process
+  and "rpc" is a direct call. This is the cheap tier-1 mode: every
+  router behavior (health marking, failover, restart supervision,
+  rolling weight swap) is byte-identical to the multi-process topology
+  because the router only ever speaks the handle contract. Fault
+  injection is first-class: `kill()` models a replica crash (the pool's
+  in-flight requests fail typed, the heartbeat stops), `wedge()` models
+  a frozen process (requests hold, heartbeats stop, the watchdog must
+  notice).
+
+* `SubprocessReplica` — a real OS process running `serve_replica()`
+  over the coordination-store transport (distributed/store.py — the
+  same native daemon rpc.py rides): requests/replies are pickled
+  payloads under `/replica/<rid>/...` keys, liveness is the store's
+  `/hb/<rid>` receipt stamp, and control (swap/stop) is a polled
+  command key. `kill()` is SIGKILL; `wedge()` is SIGSTOP — a genuinely
+  frozen process whose native heartbeat thread freezes with it.
+
+Handle contract (what router.py consumes):
+    rid, generation, model_dir
+    infer(feeds, timeout)   -> outputs | typed ServingError / ReplicaDead
+    infer_stamped(feeds, timeout) -> (outputs, generation) — the stamp is
+                            read atomically with execution (swap gate)
+    queue_depth()           -> int routing load signal
+    beat_age()              -> seconds since last heartbeat | None
+    drained()               -> bool (no queued / in-flight work)
+    probe(feeds, timeout)   -> health check (raises on failure)
+    swap(model_dir, generation)  drain-site weight hot-swap (pool.rebase)
+    restart(model_dir, generation)  rebuild after death
+    kill() / close(drain_timeout)   abrupt / graceful teardown
+
+Heartbeats: `LocalHeartbeats` duck-types the slice of the store surface
+`Watchdog` reads (`keys("/hb/")` + `heartbeat_age`), so the router runs
+the REAL `distributed.store.Watchdog` policy loop over in-process
+replicas and store-backed process replicas alike.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..analysis import locks as _locks
+from .serving import (
+    DETERMINISTIC_ERRORS, Deadline, DeadlineExceeded, PoolClosed,
+    ServingError, ServingPool,
+)
+
+__all__ = ["ReplicaError", "ReplicaDead", "LocalHeartbeats", "LocalReplica",
+           "SubprocessReplica", "serve_replica"]
+
+
+class ReplicaError(ServingError):
+    """Replica-level (transport or lifecycle) failure."""
+
+
+class ReplicaDead(ReplicaError):
+    """The replica is gone (crashed process, shut-down pool): the attempt
+    may or may not have executed. The router fails idempotent requests
+    over to a healthy replica and surfaces `RequestFailed` otherwise."""
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+class LocalHeartbeats:
+    """In-process stand-in for the coordination store's `/hb/` keyspace.
+
+    Duck-types exactly the surface `distributed.store.Watchdog` consumes
+    — `keys("/hb/")` and `heartbeat_age(name)` — so the router can run
+    the real watchdog policy loop over threads-as-replicas with zero
+    native dependencies. Stamps are monotonic-clock receipt times, like
+    the native daemon's."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = _locks.new_lock("router.heartbeats")
+        self._stamps = {}
+
+    def beat(self, name):
+        with self._lock:
+            self._stamps[name] = self._clock()
+
+    def remove(self, name):
+        """A retired member leaves the keyspace (the watchdog stops
+        monitoring it instead of flagging a forever-stale stamp)."""
+        with self._lock:
+            self._stamps.pop(name, None)
+
+    # -- Watchdog-facing surface -------------------------------------------
+    def keys(self, prefix=""):
+        with self._lock:
+            names = list(self._stamps)
+        return [k for k in (f"/hb/{n}" for n in names)
+                if k.startswith(prefix)]
+
+    def heartbeat_age(self, name):
+        with self._lock:
+            stamp = self._stamps.get(name)
+        return None if stamp is None else self._clock() - stamp
+
+
+# ---------------------------------------------------------------------------
+# in-process replica (threads-as-replicas)
+# ---------------------------------------------------------------------------
+
+class LocalReplica:
+    """One serving replica hosted in this process.
+
+    `predictor_factory(model_dir)` builds the pool's base member (a
+    `Predictor` over an exported artifact in production; any object with
+    `clone()` / `reset_handles()` / `run()` in tests) — it is re-invoked
+    on `swap()` (new weights) and `restart()` (after a kill), so the
+    factory is the single source of truth for how a model directory
+    becomes servable weights."""
+
+    def __init__(self, rid, predictor_factory, model_dir=None, generation=0,
+                 *, pool_size=1, pool_kwargs=None, heartbeat=None,
+                 heartbeat_interval=0.05, clock=time.monotonic):
+        self.rid = str(rid)
+        self.model_dir = model_dir
+        self.generation = int(generation)
+        self._factory = predictor_factory
+        self._pool_size = int(pool_size)
+        self._pool_kwargs = dict(pool_kwargs or {})
+        self._clock = clock
+        self._lock = _locks.new_lock("router.replica")
+        self._killed = False
+        self._wedged = False
+        self._blocked = 0            # callers held by a wedge
+        self._entering = 0           # callers inside infer (swap gate)
+        self._swapping = False
+        self._resume = threading.Event()
+        self._resume.set()
+        self.restarts = 0
+        self.swaps = 0
+
+        self._hb = heartbeat if heartbeat is not None else LocalHeartbeats(
+            clock=clock)
+        if isinstance(self._hb, LocalHeartbeats):
+            self._beat_fn = lambda: self._hb.beat(self.rid)
+        else:
+            # a TCPStore client: any set() refreshes the server-side
+            # receipt stamp the watchdog reads (native heartbeat parity)
+            self._beat_fn = lambda: self._hb.set(f"/hb/{self.rid}", b"1")
+        self._hb_interval = float(heartbeat_interval)
+        self._pool = self._make_pool(predictor_factory(model_dir))
+        self._beat_stop = self._start_beat_thread()
+
+    def _start_beat_thread(self):
+        """Fresh beat loop bound to its OWN stop event: a restart can
+        always start a new loop without racing the dying one (the old
+        loop holds the old, already-set event and exits)."""
+        stop = threading.Event()
+        t = threading.Thread(
+            target=self._beat_loop, args=(stop,),
+            name=f"replica-{self.rid}-heartbeat", daemon=True)
+        t.start()
+        return stop
+
+    def _make_pool(self, base):
+        kw = dict(self._pool_kwargs)
+        kw.setdefault("max_queue_depth", 16)
+        return ServingPool(predictor=base, size=self._pool_size,
+                           clock=self._clock, **kw)
+
+    # -- liveness ----------------------------------------------------------
+    def _beat_loop(self, stop):
+        # beat-first: the stamp is fresh the moment the thread exists, so
+        # a restarted replica can never be re-flagged dead off the STALE
+        # stamp of its previous life while waiting out the first interval
+        while True:
+            with self._lock:
+                if self._killed:
+                    return
+                wedged = self._wedged
+            if not wedged:      # a frozen process stops heartbeating
+                try:
+                    self._beat_fn()
+                except Exception:  # tpu-lint: disable=TL007 — a transient
+                    pass           # store fault must not kill the beat loop
+            if stop.wait(self._hb_interval):
+                return
+
+    def beat_age(self):
+        return self._hb.heartbeat_age(self.rid)
+
+    # -- serving -----------------------------------------------------------
+    def infer(self, feeds, timeout=None):
+        """Serve one request on this replica. Raises the pool's typed
+        errors; a pool torn down by replica death surfaces `ReplicaDead`
+        (the router's failover trigger) instead of `PoolClosed`."""
+        return self.infer_stamped(feeds, timeout=timeout)[0]
+
+    def infer_stamped(self, feeds, timeout=None):
+        """`(outputs, generation)` where `generation` is EXACTLY the
+        weight generation the request executed under: entry is gated
+        against a concurrent `swap()` (which in turn waits out every
+        caller already inside), so a response can never pair one
+        generation's outputs with another's stamp."""
+        dl = Deadline(timeout, clock=self._clock)
+        if self._wedged:
+            with self._lock:
+                wedged = self._wedged
+                if wedged:
+                    self._blocked += 1
+            if wedged:
+                try:
+                    self._resume.wait(dl.remaining())
+                finally:
+                    with self._lock:
+                        self._blocked -= 1
+                with self._lock:
+                    if self._wedged and not self._killed:
+                        raise DeadlineExceeded(
+                            f"replica {self.rid} wedged past the attempt "
+                            f"deadline")
+        while True:
+            with self._lock:
+                if self._killed:
+                    raise ReplicaDead(f"replica {self.rid} is dead")
+                if not self._swapping:
+                    gen = self.generation
+                    pool = self._pool
+                    self._entering += 1
+                    break
+            if dl.expired():
+                raise DeadlineExceeded(
+                    f"replica {self.rid} held the request at its swap "
+                    f"gate past the attempt deadline")
+            time.sleep(0.002)
+        try:
+            return pool.infer(feeds, timeout=dl.remaining()), gen
+        except PoolClosed as e:
+            raise ReplicaDead(
+                f"replica {self.rid} went away mid-request "
+                f"(in-flight work cancelled)") from e
+        finally:
+            with self._lock:
+                self._entering -= 1
+
+    def queue_depth(self):
+        """Routing load signal: the pool's queued + retry-pending +
+        in-flight count, plus callers a wedge is holding."""
+        with self._lock:
+            if self._killed or self._pool is None:
+                return 0
+            pool = self._pool
+            blocked = self._blocked
+        return pool.load() + blocked
+
+    def drained(self):
+        """No caller inside infer (the swap gate's `_entering` counter
+        covers the whole pool round-trip) and nothing queued."""
+        with self._lock:
+            entering = self._entering
+        return entering == 0 and self.queue_depth() == 0
+
+    def probe(self, feeds=None, timeout=None):
+        """Health probe: a real inference over `feeds` when given (the
+        router passes its configured probe batch), else a liveness
+        check. Raises a typed error on an unhealthy replica."""
+        if feeds is not None:
+            return self.infer(feeds, timeout=timeout)
+        with self._lock:
+            if self._killed:
+                raise ReplicaDead(f"replica {self.rid} is dead")
+            if self._wedged:
+                raise DeadlineExceeded(f"replica {self.rid} is wedged")
+        return None
+
+    # -- control plane -----------------------------------------------------
+    def swap(self, model_dir, generation):
+        """Hot-swap this replica's weights: rebuild the base member from
+        `model_dir` and `rebase` the pool onto it (slots re-clone through
+        the existing quarantine path). The router drains the replica
+        first; the swap gate additionally holds out any straggler caller
+        racing the drain, so no request straddles the generation cut."""
+        base = self._factory(model_dir)
+        with self._lock:
+            if self._killed:
+                raise ReplicaDead(f"replica {self.rid} is dead")
+            self._swapping = True
+        try:
+            while True:           # wait out callers already past the gate
+                with self._lock:
+                    if self._killed:
+                        raise ReplicaDead(
+                            f"replica {self.rid} died during weight swap")
+                    if self._entering == 0:
+                        pool = self._pool
+                        break
+                time.sleep(0.002)
+            try:
+                pool.rebase(base)
+            except PoolClosed as e:
+                raise ReplicaDead(
+                    f"replica {self.rid} died during weight swap") from e
+            with self._lock:
+                if self._killed:
+                    raise ReplicaDead(
+                        f"replica {self.rid} died during weight swap")
+                self.model_dir = model_dir
+                self.generation = int(generation)
+                self.swaps += 1
+        finally:
+            with self._lock:
+                self._swapping = False
+
+    def restart(self, model_dir=None, generation=None):
+        """Supervised-restart entry: rebuild the pool from the factory
+        (at the router's committed generation) and resume heartbeating.
+        Raises if the factory or pool construction fails — the router
+        backs off (jittered) and retries."""
+        model_dir = self.model_dir if model_dir is None else model_dir
+        gen = self.generation if generation is None else int(generation)
+        pool = self._make_pool(self._factory(model_dir))
+        with self._lock:
+            old, self._pool = self._pool, pool
+            self._killed = False
+            self._wedged = False
+            self._resume.set()
+            self.model_dir = model_dir
+            self.generation = gen
+            self.restarts += 1
+        if old is not None:
+            old.shutdown(drain_timeout=0)
+        if self._beat_stop.is_set():
+            self._beat_stop = self._start_beat_thread()
+        return self
+
+    # -- fault injection / teardown ----------------------------------------
+    def wedge(self):
+        """Freeze the replica: heartbeats stop, requests hold until the
+        attempt deadline (or a kill). The watchdog must notice."""
+        with self._lock:
+            self._wedged = True
+            self._resume.clear()
+
+    def unwedge(self):
+        with self._lock:
+            self._wedged = False
+            self._resume.set()
+
+    def kill(self):
+        """Abrupt death (the in-process analog of SIGKILL): the heartbeat
+        stops, wedge-held callers are released with `ReplicaDead`, and the
+        pool is torn down without drain — its queued and in-flight
+        requests fail typed so their callers can fail over. Idempotent."""
+        with self._lock:
+            if self._killed:
+                return
+            self._killed = True
+            pool = self._pool
+        self._beat_stop.set()
+        self._resume.set()
+        if pool is not None:
+            pool.shutdown(drain_timeout=0)
+
+    def close(self, drain_timeout=5.0):
+        """Graceful retirement: drain the pool, stop heartbeating, and
+        leave the heartbeat keyspace (the watchdog must not flag a
+        deliberately retired member)."""
+        with self._lock:
+            killed, self._killed = self._killed, True
+            pool = self._pool
+            self._resume.set()
+        self._beat_stop.set()
+        if not killed and pool is not None:
+            pool.shutdown(drain_timeout=drain_timeout)
+        if isinstance(self._hb, LocalHeartbeats):
+            self._hb.remove(self.rid)
+
+    def stats(self):
+        with self._lock:
+            pool = self._pool
+            snap = {
+                "rid": self.rid, "generation": self.generation,
+                "killed": self._killed, "wedged": self._wedged,
+                "restarts": self.restarts, "swaps": self.swaps,
+            }
+        snap["pool"] = pool.stats() if pool is not None and not snap[
+            "killed"] else None
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica: store-keyed transport (the rpc.py pattern)
+# ---------------------------------------------------------------------------
+
+def _req_key(rid, epoch, seq):
+    return f"/replica/{rid}/{epoch}/req/{seq}"
+
+
+def _res_key(rid, epoch, seq):
+    return f"/replica/{rid}/{epoch}/res/{seq}"
+
+
+def _ctl_key(rid, epoch, seq):
+    return f"/replica/{rid}/{epoch}/ctl/{seq}"
+
+
+def _ack_key(rid, epoch, seq):
+    return f"/replica/{rid}/{epoch}/ack/{seq}"
+
+
+def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
+                  generation=0, epoch=0, pool_size=1,
+                  heartbeat_interval=0.25, poll_interval=0.005,
+                  default_timeout=None):
+    """Replica process main loop: serve `/replica/<rid>/<epoch>/req/*`
+    requests from the coordination store with a local `ServingPool` over
+    the exported artifact at `model_prefix`, publish liveness under
+    `/hb/<rid>` (native heartbeat thread) and queue depth under
+    `/replica/<rid>/<epoch>/depth`, and obey `swap <gen> <dir-prefix>` /
+    `stop` control commands. Runs until `stop` (or the store goes away —
+    the router's watchdog then declares this replica dead).
+
+    Every key is namespaced by the spawn `epoch` (the router bumps it per
+    respawn), so a restarted replica's fresh serve loop can never be
+    stranded behind a previous life's consumed sequence counters — the
+    same stale-counter hazard distributed/rpc.py epoch-namespaces away
+    after shutdown()+init_rpc."""
+    import concurrent.futures
+    import pickle
+
+    from ..distributed.store import TCPStore
+    from . import Config, Predictor
+
+    store = TCPStore(host, port)
+    store.start_heartbeat(rid, interval=heartbeat_interval)
+    ep = int(epoch)
+    state = {"generation": int(generation), "prefix": model_prefix,
+             "entering": 0, "swapping": False}
+    gate = _locks.new_lock("router.replica")
+    pool = ServingPool(predictor=Predictor(Config(model_prefix)),
+                       size=pool_size, default_timeout=default_timeout)
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=pool_size + 2)
+
+    def _respond(seq, feeds, timeout):
+        dl = Deadline(timeout)
+        # swap gate: the stamp in the reply is EXACTLY the generation the
+        # request executed under (see LocalReplica.infer_stamped)
+        while True:
+            with gate:
+                if not state["swapping"]:
+                    state["entering"] += 1
+                    gen = state["generation"]
+                    break
+            if dl.expired():
+                store.set(_res_key(rid, ep, seq), pickle.dumps(
+                    ("err", "DeadlineExceeded",
+                     "held at the swap gate past the deadline", False)))
+                res_written.append((seq, time.monotonic()))
+                return
+            time.sleep(0.002)
+        try:
+            outs = pool.infer(feeds, timeout=dl.remaining())
+            payload = ("ok", outs, gen)
+        except ServingError as e:
+            # the deterministic flag survives the wire so the router's
+            # "malformed requests never fail over" contract holds across
+            # process replicas too
+            det = isinstance(getattr(e, "cause", None), DETERMINISTIC_ERRORS)
+            payload = ("err", type(e).__name__, str(e), det)
+        except Exception as e:  # tpu-lint: disable=TL007 — forwarded to
+            # the router as a typed RequestFailed, never swallowed
+            payload = ("err", "RequestFailed",
+                       f"{type(e).__name__}: {e}", False)
+        finally:
+            with gate:
+                state["entering"] -= 1
+        store.set(_res_key(rid, ep, seq), pickle.dumps(payload))
+        res_written.append((seq, time.monotonic()))
+
+    # response keys a timed-out caller abandoned (it deletes the key on
+    # every path it actually reads) are reaped after RES_TTL so sustained
+    # wedge/failover traffic cannot grow the store without bound
+    RES_TTL = 120.0
+    res_written: "list[tuple[int, float]]" = []
+    served = ctl_seen = 0
+    last_depth = None
+    try:
+        while True:
+            progressed = False
+            raw = store.get_nowait(_req_key(rid, ep, served))
+            if raw is not None:
+                seq, served = served, served + 1
+                store.delete_key(_req_key(rid, ep, seq))
+                payload = pickle.loads(raw)
+                if payload is None:
+                    pass  # client-side tombstone: seq consumed, no work
+                else:
+                    feeds, timeout = payload
+                    ex.submit(_respond, seq, feeds, timeout)
+                progressed = True
+            ctl = store.get_nowait(_ctl_key(rid, ep, ctl_seen))
+            if ctl is not None:
+                seq, ctl_seen = ctl_seen, ctl_seen + 1
+                store.delete_key(_ctl_key(rid, ep, seq))
+                parts = ctl.decode().split(" ", 2)
+                if parts[0] == "stop":
+                    store.set(_ack_key(rid, ep, seq), b"ok")
+                    return
+                if parts[0] == "swap":
+                    try:
+                        gen, prefix = int(parts[1]), parts[2]
+                        base = Predictor(Config(prefix))
+                        with gate:
+                            state["swapping"] = True
+                        try:
+                            while True:  # wait out in-flight stragglers
+                                with gate:
+                                    if state["entering"] == 0:
+                                        break
+                                time.sleep(0.002)
+                            pool.rebase(base)
+                            with gate:
+                                state["generation"] = gen
+                                state["prefix"] = prefix
+                        finally:
+                            with gate:
+                                state["swapping"] = False
+                        store.set(_ack_key(rid, ep, seq), b"ok")
+                    except Exception as e:  # tpu-lint: disable=TL007 —
+                        # forwarded: the router turns a nack into
+                        # SwapFailed + rollback
+                        store.set(_ack_key(rid, ep, seq),
+                                  f"err {type(e).__name__}: {e}".encode())
+                else:
+                    store.set(_ack_key(rid, ep, seq), b"err unknown-command")
+                progressed = True
+            depth = pool.load()
+            if depth != last_depth:
+                store.set(f"/replica/{rid}/{ep}/depth", str(depth).encode())
+                last_depth = depth
+            while res_written and \
+                    time.monotonic() - res_written[0][1] > RES_TTL:
+                old_seq, _ = res_written.pop(0)
+                store.delete_key(_res_key(rid, ep, old_seq))  # no-op if read
+            if not progressed:
+                time.sleep(poll_interval)
+    finally:
+        ex.shutdown(wait=False)
+        pool.shutdown(drain_timeout=1.0)
+        store.stop_heartbeat()
+        store.close()
+
+
+class SubprocessReplica:
+    """Router-side handle for a replica living in its own OS process
+    (spawned onto `serve_replica` above). Same contract as LocalReplica;
+    faults are real process faults: `kill()` is SIGKILL (the watchdog
+    sees the heartbeat stop), `wedge()` is SIGSTOP (a frozen process —
+    even its native heartbeat thread stops)."""
+
+    def __init__(self, rid, store, model_dir=None, generation=0, *,
+                 pool_size=1, artifact_name=None, start_timeout=60.0,
+                 clock=time.monotonic):
+        self.rid = str(rid)
+        self.model_dir = model_dir
+        self.generation = int(generation)
+        #: artifact layout inside a (committed) model dir: the jit.save
+        #: prefix is `<dir>/<artifact_name>`; None serves `model_dir`
+        #: itself as the prefix
+        self._artifact_name = artifact_name
+        self._store = store
+        self._pool_size = int(pool_size)
+        self._start_timeout = float(start_timeout)
+        self._clock = clock
+        self._proc = None
+        self.restarts = 0
+        self.swaps = 0
+        self._spawn()
+
+    def _prefix_for(self, model_dir):
+        import os
+
+        if self._artifact_name is None:
+            return str(model_dir)
+        return os.path.join(str(model_dir), self._artifact_name)
+
+    def _spawn(self):
+        import subprocess
+        import sys
+
+        # fresh key-space epoch per life: a respawned serve loop must
+        # never be stranded behind a previous life's consumed sequence
+        # counters (the rpc.py stale-counter hazard)
+        self._epoch = self._store.add(f"/replica/{self.rid}/epoch", 1)
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.inference.replica",
+             "--rid", self.rid, "--host", str(self._store.host),
+             "--port", str(self._store.port),
+             "--model", self._prefix_for(self.model_dir),
+             "--generation", str(self.generation),
+             "--epoch", str(self._epoch),
+             "--pool-size", str(self._pool_size)])
+        dl = Deadline(self._start_timeout, clock=self._clock)
+        while True:
+            age = self._store.heartbeat_age(self.rid)
+            if age is not None and age < 2.0:
+                return
+            if self._proc.poll() is not None:
+                raise ReplicaDead(
+                    f"replica {self.rid} process exited with "
+                    f"{self._proc.returncode} before its first heartbeat")
+            if dl.expired():
+                self._proc.kill()
+                raise ReplicaDead(
+                    f"replica {self.rid} never heartbeat within "
+                    f"{self._start_timeout}s of spawn")
+            time.sleep(0.05)
+
+    # -- serving -----------------------------------------------------------
+    def infer(self, feeds, timeout=None):
+        return self.infer_stamped(feeds, timeout=timeout)[0]
+
+    def infer_stamped(self, feeds, timeout=None):
+        """`(outputs, generation)`: the generation is read by the replica
+        process atomically with serving (its own swap gate), so the stamp
+        is exact even around a racing weight swap."""
+        import pickle
+
+        if self._proc is None or self._proc.poll() is not None:
+            raise ReplicaDead(f"replica {self.rid} process is gone")
+        # pickle BEFORE allocating the sequence number: the serve loop
+        # consumes sequences strictly in order, so a seq allocated and
+        # then never written (unpicklable feeds, failed set) would
+        # strand the loop forever on a key that cannot appear
+        blob = pickle.dumps((feeds, timeout))
+        try:
+            seq = self._store.add(f"/replica/{self.rid}/{self._epoch}/seq",
+                                  1) - 1
+        except Exception as e:
+            raise ReplicaError(
+                f"replica {self.rid}: sequence allocation failed "
+                f"({type(e).__name__}: {e})") from e
+        try:
+            self._store.set(_req_key(self.rid, self._epoch, seq), blob)
+        except Exception as e:
+            # the seq is burnt: leave a tombstone so the serve loop can
+            # step over it instead of waiting forever
+            try:
+                self._store.set(_req_key(self.rid, self._epoch, seq),
+                                pickle.dumps(None))
+            except Exception:  # tpu-lint: disable=TL007 — store down:
+                pass           # the watchdog story owns this replica now
+            raise ReplicaError(
+                f"replica {self.rid}: request send failed "
+                f"({type(e).__name__}: {e})") from e
+        dl = Deadline(timeout, clock=self._clock)
+        while True:
+            raw = self._store.get_nowait(
+                _res_key(self.rid, self._epoch, seq))
+            if raw is not None:
+                self._store.delete_key(_res_key(self.rid, self._epoch, seq))
+                payload = pickle.loads(raw)
+                if payload[0] == "ok":
+                    return payload[1], payload[2]
+                kind, msg = payload[1], payload[2]
+                deterministic = bool(payload[3]) if len(payload) > 3 \
+                    else False
+                raise _typed_error(kind, f"replica {self.rid}: {msg}",
+                                   deterministic=deterministic)
+            if self._proc.poll() is not None:
+                raise ReplicaDead(
+                    f"replica {self.rid} died mid-request "
+                    f"(exit {self._proc.returncode})")
+            if dl.expired():
+                # abandoned: a response that already landed is cleaned
+                # here; one that lands later is reaped by the serve
+                # loop's RES_TTL sweep
+                self._store.delete_key(
+                    _res_key(self.rid, self._epoch, seq))
+                raise DeadlineExceeded(
+                    f"replica {self.rid} gave no answer within the "
+                    f"attempt deadline (wedged process?)")
+            time.sleep(0.003)
+
+    def queue_depth(self):
+        try:
+            raw = self._store.get_nowait(
+                f"/replica/{self.rid}/{self._epoch}/depth")
+            return int(raw) if raw is not None else 0
+        except Exception:  # tpu-lint: disable=TL007 — the load signal
+            return 0       # degrades on a store hiccup; routing proceeds
+
+    def drained(self):
+        return self.queue_depth() == 0
+
+    def beat_age(self):
+        return self._store.heartbeat_age(self.rid)
+
+    def probe(self, feeds=None, timeout=None):
+        if feeds is not None:
+            return self.infer(feeds, timeout=timeout)
+        if self._proc is None or self._proc.poll() is not None:
+            raise ReplicaDead(f"replica {self.rid} process is gone")
+        age = self.beat_age()
+        if age is None or age > self._start_timeout:
+            raise ReplicaDead(f"replica {self.rid} has no fresh heartbeat")
+        return None
+
+    # -- control plane -----------------------------------------------------
+    def _control(self, command, timeout=30.0):
+        seq = self._store.add(
+            f"/replica/{self.rid}/{self._epoch}/ctl_seq", 1) - 1
+        self._store.set(_ctl_key(self.rid, self._epoch, seq),
+                        command.encode())
+        dl = Deadline(timeout, clock=self._clock)
+        while True:
+            raw = self._store.get_nowait(
+                _ack_key(self.rid, self._epoch, seq))
+            if raw is not None:
+                self._store.delete_key(_ack_key(self.rid, self._epoch, seq))
+                return raw.decode()
+            if self._proc is None or self._proc.poll() is not None:
+                raise ReplicaDead(
+                    f"replica {self.rid} died before acknowledging "
+                    f"{command.split()[0]!r}")
+            if dl.expired():
+                raise ReplicaError(
+                    f"replica {self.rid} did not acknowledge "
+                    f"{command.split()[0]!r} within {timeout}s")
+            time.sleep(0.01)
+
+    def swap(self, model_dir, generation):
+        ack = self._control(
+            f"swap {int(generation)} {self._prefix_for(model_dir)}")
+        if ack != "ok":
+            raise ReplicaError(
+                f"replica {self.rid} refused the weight swap: {ack}")
+        self.model_dir = model_dir
+        self.generation = int(generation)
+        self.swaps += 1
+
+    def restart(self, model_dir=None, generation=None):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+        if model_dir is not None:
+            self.model_dir = model_dir
+        if generation is not None:
+            self.generation = int(generation)
+        self._store.delete_key(f"/hb/{self.rid}")
+        self._spawn()
+        self.restarts += 1
+        return self
+
+    # -- fault injection / teardown ----------------------------------------
+    def kill(self):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+
+    def wedge(self):
+        import signal
+
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGSTOP)
+
+    def unwedge(self):
+        import signal
+
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGCONT)
+
+    def close(self, drain_timeout=5.0):
+        try:
+            if self._proc is not None and self._proc.poll() is None:
+                self._control("stop", timeout=drain_timeout)
+                self._proc.wait(timeout=drain_timeout)
+        except Exception:  # tpu-lint: disable=TL007 — best-effort
+            self.kill()    # graceful stop failed: SIGKILL ends it
+        self._store.delete_key(f"/hb/{self.rid}")
+
+    def stats(self):
+        return {"rid": self.rid, "generation": self.generation,
+                "killed": self._proc is None or self._proc.poll() is not None,
+                "wedged": False, "restarts": self.restarts,
+                "swaps": self.swaps, "pool": None}
+
+
+def _typed_error(kind, msg, deterministic=False):
+    from . import serving
+
+    cls = {
+        "DeadlineExceeded": serving.DeadlineExceeded,
+        "Overloaded": serving.Overloaded,
+        "PoolClosed": ReplicaDead,      # the replica's pool going away IS
+        "ReplicaDead": ReplicaDead,     # replica death from out here
+        "RequestFailed": serving.RequestFailed,
+    }.get(kind, serving.RequestFailed)
+    err = cls(msg)
+    if deterministic and cls is serving.RequestFailed:
+        # reconstruct the deterministic marker the wire stripped: the
+        # router keys "never fail over a malformed request" off the
+        # cause's type (the original traceback stays in the replica log)
+        err.cause = ValueError(msg)
+    return err
+
+
+def _main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="serving-tier replica process (serve_replica loop)")
+    ap.add_argument("--rid", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--model", required=True,
+                    help="exported artifact prefix (jit.save)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--generation", type=int, default=0)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--pool-size", type=int, default=1)
+    args = ap.parse_args(argv)
+    serve_replica(args.rid, args.port, args.model, host=args.host,
+                  generation=args.generation, epoch=args.epoch,
+                  pool_size=args.pool_size)
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,968 @@
+"""paddle_tpu.inference.router — the distributed serving tier's frontend.
+
+`ServingPool` (serving.py) made ONE process resilient; `ServingRouter`
+makes the SERVICE resilient: it fronts N replicas (replica.py — each a
+supervised ServingPool behind a handle contract), so one wedged host or
+one model redeploy can no longer take the tier down.
+
+* **Health-checked routing** — every replica heartbeats; the router runs
+  the real `distributed.store.Watchdog` policy loop over those beats
+  (`members_health()` snapshots + death/recovery callbacks) and routes
+  only to replicas that are READY with a fresh beat and a closed
+  breaker. The pick is least-loaded (smallest queue depth).
+
+* **Typed, contained failure** — a dead or wedged replica's in-flight
+  requests fail over to a healthy replica when `idempotent=True` (the
+  default; inference is stateless) under a `RetryPolicy` whose
+  total-elapsed budget caps the wall time layered retries can stack;
+  non-idempotent requests whose execution state is ambiguous surface
+  `RequestFailed` instead. Deterministic request errors never fail over
+  (the request is the problem). Every replica has a `CircuitBreaker`;
+  a tripped replica leaves rotation until its half-open probe passes.
+
+* **Supervised restart** — a dead replica is restarted with jittered
+  exponential backoff, health-probed, and readmitted; capacity converges
+  back to N after any single fault. Autoscale-by-queue-depth (optional)
+  spawns/retires replicas within `[min_replicas, max_replicas]`.
+
+* **Graceful degradation** — when READY capacity drops below
+  `min_healthy`, admissions shed `Overloaded` instead of piling onto the
+  survivors and collapsing them too.
+
+* **Zero-downtime weight hot-swap** — `swap_weights(ckpt_dir)` validates
+  the target is a COMMITTED snapshot (checkpoint commit protocol) with a
+  NEWER generation stamp (`commit_generation`), then rolls replica by
+  replica: stop routing to it → drain its in-flight → rebuild its base
+  member from the new weights through the pool's re-clone path
+  (`ServingPool.rebase`) → health-probe → readmit. Requests keep flowing
+  to the other replicas throughout; every response is computed under
+  exactly ONE generation and is stamped with it (`infer_stamped`). A
+  failed or interrupted roll (even a replica killed mid-swap) rolls the
+  already-swapped replicas back so the tier converges to a consistent
+  generation, and `SwapFailed` names the cause.
+
+Proof: tools/serving_fault_injector.py `router-*` phases (tier-1) kill
+and wedge replicas under load and kill a replica mid-hot-swap, asserting
+zero lost idempotent requests, bit-correct generation-stamped outputs,
+capacity convergence, and the stats conservation law below.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..analysis import locks as _locks
+from .replica import LocalHeartbeats, ReplicaDead, ReplicaError
+from .serving import (
+    DETERMINISTIC_ERRORS, CircuitBreaker, Deadline, DeadlineExceeded,
+    Overloaded, PoolClosed, RequestFailed, RetryPolicy, ServingError,
+)
+
+__all__ = ["SwapFailed", "RouterConfig", "ServingRouter",
+           "commit_model_dir"]
+
+
+class SwapFailed(ServingError):
+    """A weight hot-swap could not complete; the tier was rolled back to
+    (or converges to) the previous committed generation."""
+
+
+def commit_model_dir(path, generation):
+    """Commit-stamp a directory of exported serving artifacts (jit.save
+    output) with the checkpoint protocol's `_COMMITTED` sentinel plus a
+    monotonic `generation`, so `ServingRouter.swap_weights` accepts it
+    through exactly the validation path CheckpointManager commits pass
+    (`is_committed` + `commit_generation`). Write the artifacts into
+    `path` first; the sentinel lands last (atomic write + dir fsync),
+    mirroring the save_state_dict commit ordering — the sentinel bytes
+    come from the checkpoint protocol's own writer, so the two commit
+    flavors can never drift apart."""
+    from ..distributed.checkpoint.api import write_commit_sentinel
+
+    write_commit_sentinel(path, generation=int(generation))
+    return path
+
+
+class RouterConfig:
+    """Knobs for `ServingRouter`. Everything has a production-shaped
+    default; tests and the fault harness shrink the time constants."""
+
+    def __init__(self, *,
+                 default_timeout=None,
+                 attempt_timeout=None,
+                 failover=None,
+                 min_healthy=1,
+                 no_capacity_wait=1.0,
+                 heartbeat_ttl=2.0,
+                 supervise_interval=0.05,
+                 start_grace=10.0,
+                 restart_backoff=None,
+                 probe_feeds=None,
+                 probe_timeout=5.0,
+                 breaker_threshold=3,
+                 breaker_reset_timeout=1.0,
+                 autoscale=False,
+                 min_replicas=1,
+                 max_replicas=8,
+                 scale_up_depth=4.0,
+                 scale_down_depth=0.5,
+                 autoscale_patience=3):
+        self.default_timeout = default_timeout
+        #: per-dispatch cap (< the request deadline), so a wedged replica
+        #: costs one attempt, not the whole deadline — the failover lever
+        self.attempt_timeout = attempt_timeout
+        self.failover = failover if failover is not None else RetryPolicy(
+            max_retries=2, base_delay=0.005, max_delay=0.1, max_elapsed=30.0)
+        self.min_healthy = int(min_healthy)
+        self.no_capacity_wait = float(no_capacity_wait)
+        self.heartbeat_ttl = float(heartbeat_ttl)
+        self.supervise_interval = float(supervise_interval)
+        self.start_grace = float(start_grace)
+        self.restart_backoff = (restart_backoff if restart_backoff
+                                is not None else RetryPolicy(
+                                    max_retries=0, base_delay=0.1,
+                                    max_delay=5.0))
+        self.probe_feeds = probe_feeds
+        self.probe_timeout = float(probe_timeout)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_timeout = float(breaker_reset_timeout)
+        self.autoscale = bool(autoscale)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_depth = float(scale_up_depth)
+        self.scale_down_depth = float(scale_down_depth)
+        self.autoscale_patience = int(autoscale_patience)
+
+
+_READY, _DRAINING, _DEAD, _RETIRED = "ready", "draining", "dead", "retired"
+
+
+class _ReplicaRecord:
+    __slots__ = ("rid", "replica", "state", "breaker", "restart_attempts",
+                 "next_restart_at", "started_at", "dispatched", "completed",
+                 "deaths", "retiring", "restarting")
+
+    def __init__(self, rid, replica, breaker, started_at):
+        self.rid = rid
+        self.replica = replica
+        self.state = _READY
+        self.breaker = breaker
+        self.restart_attempts = 0
+        self.next_restart_at = None
+        self.started_at = started_at
+        self.dispatched = 0
+        self.completed = 0
+        self.deaths = 0
+        self.retiring = False
+        self.restarting = False
+
+
+class ServingRouter:
+    """Health-checked, failover-capable frontend over N serving replicas.
+
+        router = ServingRouter(factory, size=3,
+                               model_dir=committed_dir, generation=g0,
+                               config=RouterConfig(...))
+        outs = router.infer([batch], timeout=0.5)          # routed
+        outs, gen = router.infer_stamped([batch], timeout=0.5)
+        router.swap_weights(new_committed_dir)             # rolling, 0 drop
+        router.shutdown(drain_timeout=5.0)
+
+    `replica_factory(rid, model_dir, generation)` builds a replica handle
+    (replica.LocalReplica / replica.SubprocessReplica — or anything
+    honoring the handle contract). Conservation law (quiesced router):
+
+        admitted == completed + failed + timed_out + overloaded + cancelled
+
+    where `admitted` counts requests past the floor/closed admission
+    checks, `overloaded` the admitted requests later shed because every
+    routable replica refused them, and `shed` (outside the law, like the
+    pool's) the requests refused AT admission."""
+
+    def __init__(self, replica_factory, size=2, *, model_dir=None,
+                 generation=0, config=None, heartbeats=None,
+                 watchdog=None, clock=time.monotonic):
+        if size < 1:
+            raise ValueError("router needs at least one replica")
+        self.config = config if config is not None else RouterConfig()
+        self._factory = replica_factory
+        self._clock = clock
+        self._lock = _locks.new_lock("router.core")
+        self._replica_seq = itertools.count()
+        self._model_dir = model_dir
+        self._generation = int(generation)
+        self._closed = False
+        self._shutdown_called = False
+        self._drained = False
+        self._swapping = False
+        # serializes swap_weights against the generation sweep so a
+        # supervisor tick can never roll a freshly-swapped replica back
+        # mid-deploy (held across replica drains/probes — safe: those
+        # block on events in OTHER threads, never inside this one's
+        # blocking regions)
+        self._swap_mutex = _locks.new_lock("router.swap")
+
+        # counters (guarded by self._lock)
+        self._admitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._timed_out = 0
+        self._overloaded = 0
+        self._cancelled = 0
+        self._shed = 0
+        self._failovers = 0
+        self._restarts = 0
+        self._swaps = 0
+        self._swap_rollbacks = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._deaths = 0
+        self._scale_streak = 0
+        self._gen_sweep_running = False
+        self._spawning = False
+
+        self._records = []
+        self._hb = heartbeats if heartbeats is not None else LocalHeartbeats(
+            clock=clock)
+        for _ in range(size):
+            self._records.append(self._new_record())
+
+        if watchdog is not None:
+            self._watchdog = watchdog
+        else:
+            from ..distributed.store import Watchdog
+
+            # the REAL watchdog policy loop over whatever heartbeat
+            # source the replicas write to (LocalHeartbeats duck-types
+            # the store surface it reads); we drive check() from our own
+            # supervisor instead of its thread so death marking and
+            # restart scheduling share one sweep
+            self._watchdog = Watchdog(
+                self._hb, ttl=self.config.heartbeat_ttl,
+                interval=self.config.supervise_interval,
+                on_failure=self._on_watchdog_deaths)
+        self._sup_stop = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, name="ServingRouter-supervisor",
+            daemon=True)
+        self._supervisor.start()
+
+    # -- construction helpers ---------------------------------------------
+    def _new_record(self):
+        rid = f"replica-{next(self._replica_seq)}"
+        rep = self._factory(rid, self._model_dir, self._generation)
+        breaker = CircuitBreaker(self.config.breaker_threshold,
+                                 self.config.breaker_reset_timeout,
+                                 clock=self._clock)
+        return _ReplicaRecord(rid, rep, breaker, self._clock())
+
+    def heartbeats(self):
+        """The heartbeat sink replicas should write to (pass it to
+        LocalReplica(heartbeat=...) from the factory)."""
+        return self._hb
+
+    def warmup(self, feeds=None, timeout=None):
+        """Probe every replica once (compiles the served program per
+        replica, or disk-hits the compile cache) so traffic never pays a
+        cold start."""
+        feeds = feeds if feeds is not None else self.config.probe_feeds
+        for rec in self._active_records():
+            rec.replica.probe(feeds, timeout=timeout
+                              if timeout is not None
+                              else self.config.probe_timeout)
+
+    # -- admission + routing ----------------------------------------------
+    def infer(self, feeds, timeout=None, idempotent=True):
+        """Route one inference to a healthy replica; fail typed. With
+        `idempotent=True` (default — stateless inference) a dead or
+        wedged replica's request fails over to another healthy replica
+        inside the failover policy's attempt/elapsed budget; with
+        `idempotent=False` an attempt whose execution state is ambiguous
+        (replica died or went silent mid-request) surfaces
+        `RequestFailed` instead of re-executing."""
+        return self._route(feeds, timeout, idempotent)[0]
+
+    def infer_stamped(self, feeds, timeout=None, idempotent=True):
+        """Like `infer`, returning `(outputs, generation)` where
+        `generation` is the weight generation of the replica that served
+        the response — the mid-swap mixed-weights assertion hook."""
+        return self._route(feeds, timeout, idempotent)
+
+    def _route(self, feeds, timeout, idempotent):
+        cfg = self.config
+        eff = cfg.default_timeout if timeout is None else timeout
+        dl = Deadline(eff, clock=self._clock)
+        with self._lock:
+            if self._closed:
+                self._shed += 1
+                raise PoolClosed("router is shut down — admission refused")
+            healthy = sum(1 for r in self._records if r.state == _READY)
+            if healthy < max(1, cfg.min_healthy):
+                self._shed += 1
+                raise Overloaded(
+                    f"serving tier degraded below its floor: {healthy} "
+                    f"ready replicas < min_healthy={cfg.min_healthy} — "
+                    f"shedding while supervised restarts restore capacity")
+            self._admitted += 1
+        start = self._clock()
+        tried = set()
+        attempts = 0
+        last_exc = None
+        no_capacity_since = None
+        while True:
+            with self._lock:
+                if self._closed:
+                    self._cancelled += 1
+                    raise PoolClosed(
+                        "router shut down while the request was being "
+                        "routed") from last_exc
+            if dl.expired():
+                with self._lock:
+                    self._timed_out += 1
+                raise DeadlineExceeded(
+                    "request deadline elapsed while failing over"
+                    if attempts else
+                    "request deadline elapsed before any dispatch")
+            rec = self._pick(tried)
+            if rec is None and tried:
+                # every routable replica was tried: widen before giving up
+                tried.clear()
+                rec = self._pick(tried)
+            if rec is None:
+                now = self._clock()
+                if no_capacity_since is None:
+                    no_capacity_since = now
+                if now - no_capacity_since > cfg.no_capacity_wait:
+                    with self._lock:
+                        self._overloaded += 1
+                    raise Overloaded(
+                        "no routable replica (dead/draining/tripped) for "
+                        f"{cfg.no_capacity_wait}s — shed while restarts "
+                        f"restore capacity") from last_exc
+                time.sleep(min(0.005, cfg.supervise_interval))
+                continue
+            no_capacity_since = None
+            attempts += 1
+            rep = rec.replica
+            with self._lock:
+                rec.dispatched += 1
+            attempt_tmo = dl.remaining()
+            if cfg.attempt_timeout is not None:
+                attempt_tmo = (cfg.attempt_timeout if attempt_tmo is None
+                               else min(attempt_tmo, cfg.attempt_timeout))
+            try:
+                with _locks.blocking_region("router.dispatch"):
+                    outs, served_gen = rep.infer_stamped(
+                        feeds, timeout=attempt_tmo)
+            except Overloaded:
+                # replica queue full (or draining): the request was never
+                # admitted there — rerouting is safe even when not
+                # idempotent. No health penalty.
+                rec.breaker.cancel_probe()
+                tried.add(rec.rid)
+                if all(r.rid in tried for r in self._active_records()
+                       if r.state == _READY):
+                    with self._lock:
+                        self._overloaded += 1
+                    raise Overloaded(
+                        "every healthy replica shed the request "
+                        "(queues full) — back off or scale the tier")
+                continue
+            except DeadlineExceeded as e:
+                if dl.expired():
+                    # the request's own deadline died on this replica's
+                    # watch: resolve the attempt against the breaker (a
+                    # HALF_OPEN probe token must never leak) before
+                    # surfacing
+                    self._note_dispatch_failure(rec)
+                    with self._lock:
+                        self._timed_out += 1
+                    raise
+                # attempt-level timeout under a live request deadline: a
+                # wedged replica. Charge its breaker; fail over.
+                last_exc = e
+                self._note_dispatch_failure(rec)
+            except ReplicaDead as e:
+                last_exc = e
+                self._mark_dead(rec, f"died under dispatch: {e}")
+            except ReplicaError as e:
+                # transport-level failure BEFORE execution (e.g. the
+                # request send never reached the replica): charge the
+                # breaker and reroute — safe even for non-idempotent
+                # requests, nothing executed
+                last_exc = e
+                self._note_dispatch_failure(rec)
+                tried.add(rec.rid)
+                elapsed = self._clock() - start
+                if not cfg.failover.should_retry(attempts, elapsed):
+                    with self._lock:
+                        self._failed += 1
+                    err = RequestFailed(
+                        f"request send failed {attempts} time(s) "
+                        f"({type(e).__name__}: {e})",
+                        cause=e, attempts=attempts)
+                    err.__cause__ = e
+                    raise err
+                with self._lock:
+                    self._failovers += 1
+                continue
+            except RequestFailed as e:
+                if isinstance(e.cause, DETERMINISTIC_ERRORS):
+                    # the request is malformed — identical on any
+                    # replica: surface, no failover, no health penalty
+                    rec.breaker.record_success()
+                    with self._lock:
+                        self._failed += 1
+                    raise
+                last_exc = e
+                self._note_dispatch_failure(rec)
+            except Exception as e:  # noqa: BLE001 — an untyped escape
+                # from a replica handle (transport hiccup the handle
+                # failed to type) must stay inside the conservation law:
+                # charge the attempt and fail over like any transient
+                last_exc = e
+                self._note_dispatch_failure(rec)
+            else:
+                rec.breaker.record_success()
+                with self._lock:
+                    rec.completed += 1
+                    self._completed += 1
+                return outs, served_gen
+            # ---- failover tail ------------------------------------------
+            tried.add(rec.rid)
+            if not idempotent:
+                with self._lock:
+                    self._failed += 1
+                err = RequestFailed(
+                    f"attempt on replica {rec.rid} failed with execution "
+                    f"state unknown ({type(last_exc).__name__}) and the "
+                    f"request is not idempotent — refusing to re-execute",
+                    cause=last_exc, attempts=attempts)
+                err.__cause__ = last_exc
+                raise err
+            elapsed = self._clock() - start
+            if not cfg.failover.should_retry(attempts, elapsed):
+                with self._lock:
+                    self._failed += 1
+                err = RequestFailed(
+                    f"request failed over {attempts} attempt(s) across "
+                    f"replicas without success "
+                    f"(last: {type(last_exc).__name__}: {last_exc})",
+                    cause=last_exc, attempts=attempts)
+                err.__cause__ = last_exc
+                raise err
+            with self._lock:
+                self._failovers += 1
+            delay = cfg.failover.delay(attempts)
+            rem = dl.remaining()
+            if rem is not None:
+                delay = min(delay, max(0.0, rem))
+            time.sleep(delay)
+
+    def _active_records(self):
+        with self._lock:
+            return [r for r in self._records if r.state != _RETIRED]
+
+    def _pick(self, exclude):
+        """Least-loaded READY replica whose breaker admits traffic.
+        Depth polling happens OUTSIDE the router lock (for process
+        replicas it is a store round-trip — holding `router.core` across
+        it would serialize the whole tier behind one caller's network
+        latency). HALF_OPEN probe tokens granted to non-chosen candidates
+        are returned so the breaker FSM never leaks a probe."""
+        granted = []
+        with self._lock:
+            for rec in self._records:
+                if rec.state != _READY or rec.rid in exclude:
+                    continue
+                if not rec.breaker.allow():
+                    continue
+                granted.append(rec)
+        best, best_depth = None, None
+        for rec in granted:
+            try:
+                depth = rec.replica.queue_depth()
+            except Exception:  # tpu-lint: disable=TL007 — a store hiccup
+                # degrades the load signal, it must not break routing
+                depth = 0
+            if best is None or depth < best_depth:
+                best, best_depth = rec, depth
+        for rec in granted:
+            if rec is not best:
+                rec.breaker.cancel_probe()
+        if best is not None and best.state != _READY:
+            # lost a race with a death/drain transition after the
+            # snapshot: hand back the token and let the caller re-pick
+            best.breaker.cancel_probe()
+            return None
+        return best
+
+    # -- failure handling --------------------------------------------------
+    def _note_dispatch_failure(self, rec):
+        rec.breaker.record_failure()
+
+    def _on_watchdog_deaths(self, names):
+        dead = set(names)
+        for rec in self._active_records():
+            if rec.rid in dead and rec.state in (_READY, _DRAINING):
+                self._mark_dead(rec, "heartbeat went stale (watchdog)")
+
+    def _mark_dead(self, rec, reason):
+        """Idempotent death transition: out of rotation, breaker charged,
+        restart scheduled with jittered backoff, and the replica killed
+        so its in-flight requests fail typed (their callers fail over)."""
+        with self._lock:
+            if rec.state in (_DEAD, _RETIRED):
+                return
+            rec.state = _DEAD
+            rec.deaths += 1
+            self._deaths += 1
+            rec.restart_attempts = 0
+            rec.next_restart_at = (self._clock()
+                                   + self.config.restart_backoff.delay(1))
+        rec.breaker.record_failure()
+        try:
+            rec.replica.kill()
+        except Exception:  # tpu-lint: disable=TL007 — a kill that races
+            pass           # actual process death must not mask the sweep
+
+    # -- supervision -------------------------------------------------------
+    def _supervise_loop(self):
+        while not self._sup_stop.wait(self.config.supervise_interval):
+            try:
+                self._watchdog.check()
+                self._health_sweep()
+                self._restart_sweep()
+                self._generation_sweep()
+                self._autoscale_sweep()
+            except Exception:  # tpu-lint: disable=TL007 — the supervisor
+                pass           # must never die; sweeps retry next tick
+
+    def _health_sweep(self):
+        """Belt-and-braces over the watchdog callback: replicas whose
+        beat age exceeds the ttl (or that never beat within the start
+        grace) are marked dead even if the watchdog missed them (e.g. a
+        replica that died before its first heartbeat)."""
+        ttl = self.config.heartbeat_ttl
+        now = self._clock()
+        for rec in self._active_records():
+            if rec.state not in (_READY, _DRAINING):
+                continue
+            if now - rec.started_at <= ttl:
+                # readmission grace: a just-restarted replica may still
+                # carry its previous life's stale stamp for an instant —
+                # re-flagging it would flap kill/restart forever
+                continue
+            age = rec.replica.beat_age()
+            if age is None:
+                if now - rec.started_at > max(ttl, self.config.start_grace):
+                    self._mark_dead(rec, "never heartbeat after start")
+            elif age > ttl:
+                self._mark_dead(rec, f"heartbeat stale ({age:.2f}s > ttl)")
+
+    def _restart_sweep(self):
+        """Kick one restart worker per due dead replica. Restarts run on
+        their OWN threads: a process respawn can take tens of seconds
+        (interpreter + artifact load) and must not stall the watchdog
+        check / health sweep that detect the NEXT fault."""
+        now = self._clock()
+        for rec in self._active_records():
+            with self._lock:
+                if rec.state != _DEAD or rec.retiring or rec.restarting:
+                    continue
+                if rec.next_restart_at is not None \
+                        and now < rec.next_restart_at:
+                    continue
+                rec.restarting = True
+            threading.Thread(
+                target=self._do_restart, args=(rec,),
+                name=f"ServingRouter-restart-{rec.rid}",
+                daemon=True).start()
+
+    def _do_restart(self, rec):
+        try:
+            try:
+                rec.replica.restart(self._model_dir, self._generation)
+                self._probe_replica(rec.replica)
+            except Exception:  # tpu-lint: disable=TL007 — restart failure
+                # is the backoff loop's input, not a supervisor error
+                rec.restart_attempts += 1
+                rec.next_restart_at = (
+                    self._clock() + self.config.restart_backoff.delay(
+                        rec.restart_attempts + 1))
+                return
+            if self._sup_stop.is_set():
+                # shutdown raced the respawn: do not resurrect capacity
+                # the close loop already visited (an orphaned replica
+                # process would outlive the router)
+                try:
+                    rec.replica.close(drain_timeout=1.0)
+                except Exception:  # tpu-lint: disable=TL007 — teardown
+                    pass           # of a racing shutdown is best-effort
+                return
+            with self._lock:
+                if rec.state == _DEAD:
+                    rec.state = _READY
+                    rec.started_at = self._clock()
+                    rec.restart_attempts = 0
+                    rec.next_restart_at = None
+                    self._restarts += 1
+            rec.breaker.record_success()
+        finally:
+            with self._lock:
+                rec.restarting = False
+
+    def _generation_sweep(self):
+        """Convergence: a replica restarted mid-swap (or whose swap was
+        rolled back around it) can come back on a stale generation; roll
+        it to the router's committed generation before it serves. The
+        actual roll (drain + artifact load + probe — seconds) runs on a
+        maintenance thread so fault DETECTION never stalls behind it;
+        the swap mutex serializes it against swap_weights, so a
+        supervisor tick can never roll a freshly-deployed replica back
+        mid-deploy."""
+        with self._lock:
+            if self._gen_sweep_running:
+                return
+            target_gen = self._generation
+        if not any(rec.state == _READY
+                   and rec.replica.generation != target_gen
+                   for rec in self._active_records()):
+            return
+        with self._lock:
+            if self._gen_sweep_running:
+                return
+            self._gen_sweep_running = True
+        threading.Thread(target=self._do_generation_converge,
+                         name="ServingRouter-gen-converge",
+                         daemon=True).start()
+
+    def _do_generation_converge(self):
+        try:
+            if not self._swap_mutex.acquire(blocking=False):
+                return  # a deploy is rolling; converge on a later tick
+            try:
+                with self._lock:
+                    target_dir = self._model_dir
+                    target_gen = self._generation
+                for rec in self._active_records():
+                    if rec.state != _READY \
+                            or rec.replica.generation == target_gen:
+                        continue
+                    try:
+                        self._swap_one(
+                            rec, target_dir, target_gen,
+                            drain_timeout=self.config.probe_timeout)
+                    except ServingError:
+                        continue  # marked dead inside; restarts own it
+            finally:
+                self._swap_mutex.release()
+        finally:
+            with self._lock:
+                self._gen_sweep_running = False
+
+    def _probe_replica(self, rep):
+        rep.probe(self.config.probe_feeds,
+                  timeout=self.config.probe_timeout)
+
+    def _autoscale_sweep(self):
+        cfg = self.config
+        if not cfg.autoscale:
+            return
+        with self._lock:
+            ready = [r for r in self._records if r.state == _READY]
+            active = [r for r in self._records if r.state != _RETIRED]
+        if not ready:
+            return
+        # depth polls outside the lock (store round-trips for process
+        # replicas)
+        depth = sum(r.replica.queue_depth() for r in ready) / len(ready)
+        if depth > cfg.scale_up_depth and len(active) < cfg.max_replicas:
+            self._scale_streak = max(0, self._scale_streak) + 1
+            if self._scale_streak >= cfg.autoscale_patience \
+                    and not self._spawning:
+                self._scale_streak = 0
+                with self._lock:
+                    if self._spawning:
+                        return
+                    self._spawning = True
+                # artifact load + probe take seconds: never inside the
+                # supervisor tick (fault detection must keep its cadence)
+                threading.Thread(target=self._spawn_replica,
+                                 name="ServingRouter-spawn",
+                                 daemon=True).start()
+        elif depth < cfg.scale_down_depth and len(active) > cfg.min_replicas:
+            self._scale_streak = min(0, self._scale_streak) - 1
+            if -self._scale_streak >= cfg.autoscale_patience:
+                self._scale_streak = 0
+                self._retire_one(active)
+        else:
+            self._scale_streak = 0
+
+    def _spawn_replica(self):
+        try:
+            try:
+                rec = self._new_record()
+                self._probe_replica(rec.replica)
+            except Exception:  # tpu-lint: disable=TL007 — a failed spawn
+                return         # is retried on a later tick
+            with self._lock:
+                if self._closed:
+                    pass  # shutdown raced the spawn: close, don't admit
+                else:
+                    self._records.append(rec)
+                    self._scale_ups += 1
+                    return
+            try:
+                rec.replica.close(drain_timeout=1.0)
+            except Exception:  # tpu-lint: disable=TL007 — best-effort
+                pass           # teardown of a spawn that lost the race
+        finally:
+            with self._lock:
+                self._spawning = False
+
+    def _retire_one(self, active):
+        """Scale down: drain the youngest ready replica, then close it.
+        The bounded drain wait runs on its own thread (like restarts) so
+        the supervisor's fault-detection cadence never stalls behind a
+        busy replica finishing its queue."""
+        rec = active[-1]
+        with self._lock:
+            if rec.state != _READY:
+                return
+            rec.state = _DRAINING
+            rec.retiring = True
+        threading.Thread(
+            target=self._do_retire, args=(rec,),
+            name=f"ServingRouter-retire-{rec.rid}", daemon=True).start()
+
+    def _do_retire(self, rec):
+        dl = Deadline(self.config.probe_timeout, clock=self._clock)
+        while not rec.replica.drained() and not dl.expired():
+            time.sleep(0.005)
+        try:
+            rec.replica.close(drain_timeout=1.0)
+        except Exception:  # tpu-lint: disable=TL007 — best-effort close;
+            pass           # the replica is leaving the tier either way
+        with self._lock:
+            rec.state = _RETIRED
+            self._scale_downs += 1
+            # prune: a band-oscillating tier must not grow the record
+            # list (and every dispatch's scan of it) without bound
+            if rec in self._records:
+                self._records.remove(rec)
+
+    # -- weight hot-swap ---------------------------------------------------
+    def swap_weights(self, ckpt_dir, drain_timeout=30.0):
+        """Zero-downtime rolling weight update. Validates `ckpt_dir` is a
+        COMMITTED snapshot with a generation stamp NEWER than the current
+        one, then rolls every ready replica through
+        drain → rebase-on-new-weights → probe → readmit while the rest of
+        the tier keeps serving. Returns the new generation. On any
+        failure — including a replica killed mid-roll — already-swapped
+        replicas are rolled back and `SwapFailed` is raised; replicas
+        that died during the roll come back on the committed (old)
+        generation via the restart + generation sweeps, so the tier
+        always converges to ONE generation."""
+        from ..distributed.checkpoint.api import (
+            CheckpointError, commit_generation, is_committed)
+
+        try:
+            if not is_committed(ckpt_dir):
+                raise SwapFailed(
+                    f"swap target {ckpt_dir!r} has no _COMMITTED sentinel "
+                    f"— refusing to serve a torn snapshot")
+            gen = commit_generation(ckpt_dir)
+        except CheckpointError as e:
+            raise SwapFailed(f"swap target {ckpt_dir!r} failed commit "
+                             f"validation: {e}") from e
+        if gen is None:
+            raise SwapFailed(
+                f"swap target {ckpt_dir!r} carries no generation stamp "
+                f"(commit it via CheckpointManager.save or "
+                f"commit_model_dir)")
+        with self._lock:
+            if self._closed:
+                raise SwapFailed("router is shut down")
+            if self._swapping:
+                raise SwapFailed("another weight swap is in progress")
+            old_dir, old_gen = self._model_dir, self._generation
+            if gen <= old_gen:
+                raise SwapFailed(
+                    f"swap target generation {gen} is not newer than the "
+                    f"serving generation {old_gen} — refusing a rollback "
+                    f"disguised as a deploy")
+            self._swapping = True
+        # the generation sweep yields its tick while we hold this; we
+        # wait out any sweep convergence already in flight
+        self._swap_mutex.acquire()
+        swapped = []
+        try:
+            for rec in self._active_records():
+                if rec.state != _READY:
+                    continue  # dead replicas rejoin via generation sweep
+                self._swap_one(rec, ckpt_dir, gen, drain_timeout)
+                swapped.append(rec)
+            if not swapped:
+                raise SwapFailed("no ready replica to roll")
+            with self._lock:
+                self._model_dir, self._generation = ckpt_dir, gen
+                self._swaps += 1
+            return gen
+        except BaseException as e:
+            for rec in swapped:
+                try:
+                    self._swap_one(rec, old_dir, old_gen, drain_timeout)
+                except ServingError:
+                    # _swap_one marked it dead; the restart sweep brings
+                    # it back on the committed (old) generation
+                    continue
+            if swapped:
+                with self._lock:
+                    self._swap_rollbacks += 1
+            if isinstance(e, SwapFailed):
+                raise
+            err = SwapFailed(
+                f"weight swap to generation {gen} failed "
+                f"({type(e).__name__}: {e}); rolled back to generation "
+                f"{old_gen}")
+            err.__cause__ = e
+            raise err
+        finally:
+            self._swap_mutex.release()
+            with self._lock:
+                self._swapping = False
+
+    def _swap_one(self, rec, model_dir, gen, drain_timeout):
+        """One replica through the roll: out of rotation → drain → swap
+        → probe → readmit. Raises SwapFailed (replica returned to READY
+        when it is merely busy, marked DEAD when it is broken)."""
+        with self._lock:
+            if rec.state != _READY:
+                raise SwapFailed(
+                    f"replica {rec.rid} is {rec.state}, not ready")
+            rec.state = _DRAINING
+        dl = Deadline(drain_timeout, clock=self._clock)
+        while not rec.replica.drained():
+            if dl.expired():
+                with self._lock:
+                    if rec.state == _DRAINING:
+                        rec.state = _READY  # healthy, just busy
+                raise SwapFailed(
+                    f"replica {rec.rid} did not drain within "
+                    f"{drain_timeout}s")
+            time.sleep(0.005)
+        try:
+            rec.replica.swap(model_dir, gen)
+            self._probe_replica(rec.replica)
+        except BaseException as e:
+            # broken on (or during) the new weights: dead — supervised
+            # restart rebuilds it on the router's committed generation
+            self._mark_dead(rec, f"swap/probe failed: {e}")
+            err = SwapFailed(
+                f"replica {rec.rid} failed its weight swap "
+                f"({type(e).__name__}: {e})")
+            err.__cause__ = e
+            raise err
+        with self._lock:
+            if rec.state == _DRAINING:
+                rec.state = _READY
+        rec.breaker.record_success()
+
+    # -- lifecycle ---------------------------------------------------------
+    def shutdown(self, drain_timeout=30.0):
+        """Stop admissions, stop supervision, drain and close every
+        replica within `drain_timeout` total. Returns True when all
+        replicas closed gracefully. Idempotent."""
+        with self._lock:
+            if self._shutdown_called:
+                return self._drained
+            self._shutdown_called = True
+            self._closed = True
+        self._sup_stop.set()
+        self._supervisor.join(timeout=2.0)
+        dl = Deadline(drain_timeout, clock=self._clock)
+        ok = True
+        for rec in self._active_records():
+            rem = dl.remaining()
+            budget = max(0.0, rem) if rem is not None else 5.0
+            try:
+                rec.replica.close(drain_timeout=budget)
+            except Exception:  # tpu-lint: disable=TL007 — teardown must
+                ok = False     # visit every replica; reported via return
+            with self._lock:
+                rec.state = _RETIRED
+        self._drained = ok
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- observability -----------------------------------------------------
+    @property
+    def generation(self):
+        with self._lock:
+            return self._generation
+
+    def stats(self):
+        """Counter snapshot + per-replica health. Conservation law
+        (quiesced): admitted == completed + failed + timed_out +
+        overloaded + cancelled."""
+        with self._lock:
+            replicas = []
+            for rec in self._records:
+                replicas.append({
+                    "rid": rec.rid,
+                    "state": rec.state,
+                    "generation": rec.replica.generation,
+                    "breaker": rec.breaker.state,
+                    "_rec": rec,
+                    "dispatched": rec.dispatched,
+                    "completed": rec.completed,
+                    "deaths": rec.deaths,
+                })
+            ready = sum(1 for r in replicas if r["state"] == _READY)
+            snap = {
+                "replicas": len(replicas),
+                "ready": ready,
+                "generation": self._generation,
+                "model_dir": self._model_dir,
+                "swapping": self._swapping,
+                "closed": self._closed,
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "timed_out": self._timed_out,
+                "overloaded": self._overloaded,
+                "cancelled": self._cancelled,
+                "shed": self._shed,
+                "failovers": self._failovers,
+                "restarts": self._restarts,
+                "deaths": self._deaths,
+                "swaps": self._swaps,
+                "swap_rollbacks": self._swap_rollbacks,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+                "members": replicas,
+            }
+        # depth/beat polls and the watchdog snapshot run OUTSIDE the
+        # router lock: for process replicas they are store round-trips
+        for r in replicas:
+            rec = r.pop("_rec")
+            r["queue_depth"] = (rec.replica.queue_depth()
+                                if r["state"] != _RETIRED else 0)
+            r["beat_age"] = rec.replica.beat_age()
+        try:
+            snap["health"] = self._watchdog.members_health()
+        except Exception:  # tpu-lint: disable=TL007 — a store hiccup must
+            snap["health"] = None  # not break a stats read
+        return snap
+
+    def __len__(self):
+        with self._lock:
+            return sum(1 for r in self._records if r.state != _RETIRED)

@@ -223,15 +223,25 @@ class RetryPolicy:
     """Jittered exponential backoff for transient failures.
 
     `max_retries` is the number of RE-executions after the first attempt
-    (so a request is executed at most max_retries + 1 times)."""
+    (so a request is executed at most max_retries + 1 times).
+
+    `max_elapsed` is a total wall-time budget (monotonic seconds, measured
+    from the request's first admission): once it is spent, no further
+    retry is attempted even if the attempt cap has room. Layered retry
+    loops (the router failing a request over across replicas while each
+    replica's pool retries across members) multiply ATTEMPT counts, but an
+    elapsed budget composes additively — give the outer loop a budget and
+    the stack cannot accumulate unbounded wall time. None (default)
+    disables the budget."""
 
     def __init__(self, max_retries=2, base_delay=0.02, max_delay=0.5,
-                 multiplier=2.0, jitter=0.5, rng=None):
+                 multiplier=2.0, jitter=0.5, max_elapsed=None, rng=None):
         self.max_retries = int(max_retries)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
         self.multiplier = float(multiplier)
         self.jitter = float(jitter)
+        self.max_elapsed = None if max_elapsed is None else float(max_elapsed)
         self._rng = rng or random.Random()
 
     def delay(self, attempt):
@@ -240,6 +250,23 @@ class RetryPolicy:
                 self.base_delay * self.multiplier ** max(0, attempt - 1))
         # full-jitter style: uniform in [d*(1-jitter), d]
         return d * (1.0 - self.jitter * self._rng.random())
+
+    def should_retry(self, attempts, elapsed):
+        """May a request that has already executed `attempts` times and
+        been in flight for `elapsed` monotonic seconds be retried? Both
+        the attempt cap and (when set) the elapsed budget must agree; the
+        budget also accounts the (un-jittered, worst-case) backoff sleep
+        this retry would add, so the budget is a hard wall-time ceiling
+        rather than a soft one that each backoff can overshoot."""
+        if attempts > self.max_retries:
+            return False
+        if self.max_elapsed is not None and elapsed is not None:
+            next_delay = min(self.max_delay,
+                             self.base_delay
+                             * self.multiplier ** max(0, attempts - 1))
+            if elapsed + next_delay > self.max_elapsed:
+                return False
+        return True
 
 
 # ---------------------------------------------------------------------------
@@ -497,6 +524,7 @@ class ServingPool:
         self._retried = 0
         self._wedged = 0
         self._late_results = 0
+        self._rebases = 0
 
         self._slots = []
         for i in range(size):
@@ -580,6 +608,46 @@ class ServingPool:
                 "warmup() needs batching: construct the pool with "
                 "batching=BatchConfig(...)")
         return self._batcher.warmup(buckets)
+
+    def rebase(self, predictor):
+        """Swap the pool's base member for `predictor` (new weights, same
+        program shape): every slot is replaced with a fresh clone of the
+        new base through the existing quarantine re-clone path before it
+        serves another request, and future quarantine/wedge replacements
+        clone the new base too. Executions already in flight finish on the
+        member object they started with — callers needing a hard
+        generation cut drain first (`ServingRouter.swap_weights` does:
+        drain → rebase → probe → readmit). Slot breakers and counters
+        persist: the slot, not the weights, is the unit of health."""
+        with self._lock:
+            if self._stopping:
+                raise PoolClosed("cannot rebase a shut-down pool")
+            self._base = predictor
+            self._rebases += 1
+        if self._batcher is not None and hasattr(predictor, "_layer"):
+            # bucketed AOT dispatch goes through the batcher's layer;
+            # repoint it so batched requests serve the new weights (the
+            # per-bucket executables live on the layer object, so the new
+            # layer compiles-or-disk-hits its own)
+            self._batcher.layer = predictor._layer
+        for slot in list(self._slots):
+            # NOT _quarantine: that path tolerates a failed clone by
+            # keeping the old member (right for fault recovery, fatally
+            # wrong here — a slot left on the old weights would serve
+            # old-generation outputs under the new generation's stamp).
+            # A rebase clone failure must surface so the caller can fail
+            # the swap (the router then marks the replica dead and
+            # rebuilds it on the committed generation).
+            try:
+                fresh = predictor.clone()
+            except Exception as e:
+                raise RuntimeError(
+                    f"rebase: could not clone the new base for slot "
+                    f"{slot.index} — aborting the swap ({e})") from e
+            with self._lock:
+                slot.predictor = fresh
+                slot.reclones += 1
+                slot.generation += 1
 
     # -- streaming generation (continuous-batching decode engine) ----------
     def submit_generate(self, prompt_ids, max_new_tokens, timeout=None):
@@ -880,7 +948,9 @@ class ServingPool:
         self._quarantine(slot)
         delay = self._retry.delay(req.attempts)
         rem = req.deadline.remaining()
-        if req.attempts <= self._retry.max_retries \
+        elapsed = (None if req.enqueued_at is None
+                   else self._clock() - req.enqueued_at)
+        if self._retry.should_retry(req.attempts, elapsed) \
                 and (rem is None or rem > delay) and req.mark_pending():
             with self._lock:
                 self._retried += 1
@@ -1099,6 +1169,21 @@ class ServingPool:
         return False
 
     # -- observability -----------------------------------------------------
+    def load(self):
+        """Cheap routing signal: queued + retry-pending + in-flight
+        request count (a formed batch counts each batchmate). The
+        router's least-loaded pick polls this per dispatch, so it stays a
+        counter read — not the full stats() snapshot."""
+        with self._lock:
+            in_flight = 0
+            for s in self._slots:
+                cur = s.current
+                if cur is None:
+                    continue
+                in_flight += (len(cur.requests)
+                              if isinstance(cur, _BatchTicket) else 1)
+            return len(self._queue) + len(self._retry_timers) + in_flight
+
     def stats(self):
         """Counter snapshot. Conservation law (quiesced pool):
         admitted == completed + failed + timed_out + cancelled; at any
@@ -1139,6 +1224,7 @@ class ServingPool:
                 "wedged": self._wedged,
                 "late_results": self._late_results,
                 "reclones": sum(m["reclones"] for m in members),
+                "rebases": self._rebases,
                 "breaker_trips": sum(s.breaker.trips for s in self._slots),
                 "queue_depth": len(self._queue) + len(self._retry_timers),
                 "in_flight": sum(m["in_flight"] for m in members),

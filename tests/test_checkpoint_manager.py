@@ -343,3 +343,46 @@ def test_model_checkpoint_requires_root_for_snapshots(monkeypatch):
     with pytest.raises(ValueError, match="checkpoint root"):
         ModelCheckpoint(auto_resume=True)
     ModelCheckpoint()  # plain legacy use stays fine
+
+
+def test_commit_generation_sidecar_and_ordering(tmp_path):
+    """Commits carry a monotonic generation readable WITHOUT loading any
+    tensor bytes (satellite for the router's hot-swap ordering): the
+    manager stamps the step by default, accepts an override, and
+    restore/restore_latest surface it."""
+    from paddle_tpu.distributed.checkpoint import commit_generation
+
+    mgr = CheckpointManager(tmp_path, keep_last_k=4)
+    mgr.save(_state(1), step=7)
+    mgr.save(_state(2), step=9, generation=42)
+    assert mgr.generation_of(7) == 7       # default: the step
+    assert mgr.generation_of(9) == 42      # explicit override wins
+    assert mgr.latest_generation() == 42
+    # readable straight off the sentinel — no metadata/npz access needed
+    assert commit_generation(mgr._step_dir(7)) == 7
+
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 9
+    assert mgr.last_generation == 42
+    mgr.restore(_zeros_state(), step=7)
+    assert mgr.last_generation == 7
+
+    # uncommitted dirs refuse generation reads like any load-side access
+    os.remove(os.path.join(mgr._step_dir(9), COMMITTED_SENTINEL))
+    with pytest.raises(CheckpointNotCommittedError):
+        commit_generation(mgr._step_dir(9))
+
+
+def test_commit_generation_absent_on_legacy_commits(tmp_path):
+    """Pre-stamping commits (no generation field) read back None — the
+    router then refuses to hot-swap to them instead of mis-ordering."""
+    import json
+
+    from paddle_tpu.distributed.checkpoint import (
+        commit_generation, save_state_dict)
+
+    path = str(tmp_path / "legacy")
+    save_state_dict({"w": _state(1)["model"]["w"]}, path)
+    assert commit_generation(path) is None
+    with open(os.path.join(path, COMMITTED_SENTINEL)) as f:
+        assert "generation" not in json.load(f)

@@ -1,5 +1,15 @@
 """Every example must actually run (reference strategy: the docs' code
-samples are CI-executed via sampcd_processor in tools/)."""
+samples are CI-executed via sampcd_processor in tools/).
+
+The example scripts are independent subprocesses, each paying its own
+interpreter + jax import before doing any work — run serially they were
+the single worst wall-clock/test ratio in the tier-1 suite (~150s for 11
+tests). A module-scoped pool launches them concurrently (bounded, CPU
+count aware) and each test then asserts its own script's outcome, so the
+per-example pass/fail granularity (and dot count) is unchanged while the
+wall clock drops to roughly the longest script.
+"""
+import concurrent.futures
 import os
 import subprocess
 import sys
@@ -21,16 +31,36 @@ _EXAMPLES = [
 ]
 
 
-@pytest.mark.parametrize("script", _EXAMPLES)
-def test_example_runs(script):
+def _run_one(script):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env.update(EXAMPLES_SMOKE="1", JAX_PLATFORMS="cpu",
                PYTHONPATH=root)
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(root, "examples", script)],
-        capture_output=True, text=True, timeout=420, env=env)
+    argv = [sys.executable, os.path.join(root, "examples", script)]
+    try:
+        return script, subprocess.run(argv, capture_output=True, text=True,
+                                      timeout=420, env=env)
+    except subprocess.TimeoutExpired as e:
+        # synthesize a failed result so ONE hung example fails only its
+        # own test, preserving the serial version's per-example verdicts
+        out = e.stdout.decode(errors="replace") if e.stdout else ""
+        return script, subprocess.CompletedProcess(
+            argv, returncode=-1, stdout=out,
+            stderr=f"timed out after {e.timeout}s")
+
+
+@pytest.fixture(scope="module")
+def example_results():
+    """Run every example subprocess concurrently, once per module."""
+    workers = min(4, max(2, (os.cpu_count() or 2) + 1))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        return dict(ex.map(_run_one, _EXAMPLES))
+
+
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_runs(script, example_results):
+    proc = example_results[script]
     assert proc.returncode == 0, (
         f"{script} failed:\nstdout:\n{proc.stdout[-2000:]}\n"
         f"stderr:\n{proc.stderr[-2000:]}")

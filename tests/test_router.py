@@ -1,0 +1,497 @@
+"""Distributed serving tier: ServingRouter + replica handles.
+
+Fast tier-1 coverage over threads-as-replicas with stub predictors (no
+model export, no XLA): health-checked least-loaded routing, typed
+failover on replica death/wedge, the non-idempotent refusal, the
+capacity floor, supervised restart convergence, rolling weight hot-swap
+with generation stamping + ordering refusal + rollback, autoscale band,
+and the router stats conservation law. The real-model / real-process
+variants live in tools/serving_fault_injector.py (router-* phases,
+tier-1) and the slow-marked subprocess test at the bottom.
+"""
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import Watchdog
+from paddle_tpu.inference import (
+    LocalHeartbeats, LocalReplica, Overloaded, ReplicaDead, RequestFailed,
+    RouterConfig, ServingRouter, SwapFailed, commit_model_dir,
+)
+from paddle_tpu.inference.serving import RetryPolicy
+
+
+class StubPredictor:
+    """Pool-compatible fake: run() scales the feed by the 'weights'
+    (one scale per model dir) so generation changes are bit-visible."""
+
+    def __init__(self, scale, delay=0.0, fail_value=None):
+        self.scale = float(scale)
+        self.delay = float(delay)
+        self.fail_value = fail_value
+
+    def clone(self):
+        return StubPredictor(self.scale, self.delay, self.fail_value)
+
+    def reset_handles(self):
+        pass
+
+    def run(self, feeds):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail_value is not None and any(
+                np.any(np.asarray(f) == self.fail_value) for f in feeds):
+            raise ValueError("malformed request (magic fail value)")
+        return [np.asarray(f, np.float64) * self.scale for f in feeds]
+
+
+class Tier:
+    """One test topology: shared heartbeat sink + replica registry so
+    tests can reach into specific replicas to kill/wedge them."""
+
+    def __init__(self, scales=None, delay=0.0, fail_value=None,
+                 factory_hook=None):
+        self.hb = LocalHeartbeats()
+        self.scales = scales if scales is not None else {None: 1.0}
+        self.delay = delay
+        self.fail_value = fail_value
+        self.replicas = {}
+        self.factory_hook = factory_hook  # (rid, dir) -> maybe raise
+
+    def predictor(self, model_dir):
+        key = model_dir if model_dir in self.scales else None
+        return StubPredictor(self.scales[key], self.delay, self.fail_value)
+
+    def factory(self, rid, model_dir, generation):
+        if self.factory_hook is not None:
+            self.factory_hook(rid, model_dir)
+
+        def make(d):
+            if self.factory_hook is not None:
+                self.factory_hook(rid, d)
+            return self.predictor(d)
+
+        rep = LocalReplica(rid, make, model_dir, generation,
+                           heartbeat=self.hb, heartbeat_interval=0.01,
+                           pool_kwargs=dict(default_timeout=5.0,
+                                            supervise_interval=0.01,
+                                            hang_grace=0.05))
+        self.replicas[rid] = rep
+        return rep
+
+
+def fast_config(**over):
+    kw = dict(heartbeat_ttl=0.2, supervise_interval=0.02, start_grace=1.0,
+              restart_backoff=RetryPolicy(base_delay=0.03, max_delay=0.2),
+              failover=RetryPolicy(max_retries=3, base_delay=0.002,
+                                   max_delay=0.01, max_elapsed=10.0),
+              probe_timeout=2.0, breaker_reset_timeout=0.1,
+              no_capacity_wait=0.5)
+    kw.update(over)
+    return RouterConfig(**kw)
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+# ---------------------------------------------------------------------------
+# retry-policy budget (satellite: total-elapsed cap under layered retries)
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_elapsed_budget():
+    p = RetryPolicy(max_retries=100, base_delay=0.01, max_elapsed=1.0)
+    assert p.should_retry(1, 0.0)
+    assert p.should_retry(50, 0.5)
+    assert not p.should_retry(1, 1.5)      # budget spent beats attempt room
+    assert not p.should_retry(101, 0.0)    # attempt cap still binds
+    # the budget accounts the backoff sleep the retry would add
+    assert not p.should_retry(1, 0.995)
+    # None elapsed (no admission stamp) falls back to attempts-only
+    assert p.should_retry(1, None)
+    unbounded = RetryPolicy(max_retries=2)
+    assert unbounded.should_retry(2, 1e9)  # no budget → attempts only
+    assert not unbounded.should_retry(3, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# routing basics
+# ---------------------------------------------------------------------------
+
+def test_routes_and_conserves():
+    tier = Tier(scales={None: 2.0})
+    with ServingRouter(tier.factory, size=2, config=fast_config()) as r:
+        x = np.arange(4.0)
+        for _ in range(8):
+            out, = r.infer([x], timeout=2.0)
+            np.testing.assert_array_equal(out, x * 2.0)
+        outs, gen = r.infer_stamped([x], timeout=2.0)
+        assert gen == 0
+        s = r.stats()
+        assert s["ready"] == 2 and s["admitted"] == 9
+        assert s["admitted"] == (s["completed"] + s["failed"]
+                                 + s["timed_out"] + s["overloaded"]
+                                 + s["cancelled"])
+        assert s["completed"] == 9 and s["failovers"] == 0
+    assert r.stats()["closed"]
+
+
+def test_least_loaded_pick_prefers_idle_replica():
+    tier = Tier(scales={None: 1.0}, delay=0.15)
+    with ServingRouter(tier.factory, size=2, config=fast_config()) as r:
+        with concurrent.futures.ThreadPoolExecutor(4) as ex:
+            futs = [ex.submit(r.infer, [np.ones(2)], 3.0) for _ in range(4)]
+            for f in futs:
+                f.result()
+        s = r.stats()
+        # both replicas served: the pick spread load instead of piling
+        # every request onto replica-0
+        assert all(m["dispatched"] > 0 for m in s["members"])
+
+
+def test_failover_on_killed_replica_and_restart_convergence():
+    tier = Tier(scales={None: 3.0})
+    with ServingRouter(tier.factory, size=2, config=fast_config()) as r:
+        x = np.ones(3)
+        out, = r.infer([x], timeout=2.0)
+        np.testing.assert_array_equal(out, x * 3.0)
+        tier.replicas["replica-0"].kill()
+        # every idempotent request keeps succeeding through failover
+        for _ in range(10):
+            out, = r.infer([x], timeout=2.0)
+            np.testing.assert_array_equal(out, x * 3.0)
+        # capacity converges back to 2 via supervised restart
+        assert wait_until(lambda: r.stats()["ready"] == 2)
+        s = r.stats()
+        assert s["deaths"] >= 1 and s["restarts"] >= 1
+        assert s["admitted"] == s["completed"]  # zero requests lost
+        # and the revived replica serves
+        for _ in range(4):
+            out, = r.infer([x], timeout=2.0)
+            np.testing.assert_array_equal(out, x * 3.0)
+
+
+def test_non_idempotent_request_refuses_ambiguous_reexecution():
+    tier = Tier()
+    cfg = fast_config(min_healthy=1)
+    with ServingRouter(tier.factory, size=1, config=cfg) as r:
+        tier.replicas["replica-0"].kill()
+        with pytest.raises(RequestFailed) as ei:
+            r.infer([np.ones(2)], timeout=1.0, idempotent=False)
+        assert isinstance(ei.value.cause, ReplicaDead)
+        s = r.stats()
+        assert s["failed"] == 1 and s["failovers"] == 0
+
+
+def test_deterministic_request_error_never_fails_over():
+    tier = Tier(fail_value=777.0)
+    with ServingRouter(tier.factory, size=2, config=fast_config()) as r:
+        with pytest.raises(RequestFailed):
+            r.infer([np.full(2, 777.0)], timeout=2.0)
+        s = r.stats()
+        assert s["failovers"] == 0 and s["failed"] == 1
+        assert s["deaths"] == 0  # no health penalty for a bad request
+
+
+def test_floor_sheds_overloaded_instead_of_collapsing():
+    tier = Tier()
+    cfg = fast_config(min_healthy=2,
+                      restart_backoff=RetryPolicy(base_delay=0.5,
+                                                  max_delay=0.5))
+    with ServingRouter(tier.factory, size=2, config=cfg) as r:
+        tier.replicas["replica-0"].kill()
+        assert wait_until(lambda: r.stats()["ready"] == 1)
+        with pytest.raises(Overloaded):
+            r.infer([np.ones(2)], timeout=1.0)
+        s = r.stats()
+        assert s["shed"] >= 1
+        # shed requests were never admitted: the law is undisturbed
+        assert s["admitted"] == (s["completed"] + s["failed"]
+                                 + s["timed_out"] + s["overloaded"]
+                                 + s["cancelled"])
+        # once capacity is restored, admissions resume
+        assert wait_until(lambda: r.stats()["ready"] == 2, timeout=8.0)
+        r.infer([np.ones(2)], timeout=2.0)
+
+
+def test_wedged_replica_fails_over_and_is_restarted():
+    tier = Tier(scales={None: 5.0})
+    cfg = fast_config(attempt_timeout=0.15)
+    with ServingRouter(tier.factory, size=2, config=cfg) as r:
+        victim = tier.replicas["replica-1"]
+        victim.wedge()
+        x = np.ones(2)
+        ok = 0
+        for _ in range(8):
+            out, = r.infer([x], timeout=3.0)
+            np.testing.assert_array_equal(out, x * 5.0)
+            ok += 1
+        assert ok == 8  # wedged attempts failed over inside the deadline
+        # watchdog notices the stale heartbeat (a wedged replica stops
+        # beating), kills it, and the restart clears the wedge
+        assert wait_until(lambda: r.stats()["deaths"] >= 1)
+        assert wait_until(lambda: r.stats()["ready"] == 2)
+
+
+# ---------------------------------------------------------------------------
+# weight hot-swap
+# ---------------------------------------------------------------------------
+
+def _dirs(tmp_path, tier, spec):
+    """Create committed model dirs {name: (scale, generation)}."""
+    out = {}
+    for name, (scale, gen) in spec.items():
+        d = tmp_path / name
+        d.mkdir()
+        tier.scales[str(d)] = scale
+        commit_model_dir(str(d), gen)
+        out[name] = str(d)
+    return out
+
+
+def test_swap_weights_rolls_without_drops_and_stamps_generation(tmp_path):
+    tier = Tier(scales={None: 1.0})
+    dirs = _dirs(tmp_path, tier, {"g0": (1.0, 0), "g5": (4.0, 5)})
+    cfg = fast_config()
+    with ServingRouter(tier.factory, size=3, model_dir=dirs["g0"],
+                       generation=0, config=cfg) as r:
+        x = np.ones(2)
+        stop = threading.Event()
+        seen = []
+        bad = []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    outs, gen = r.infer_stamped([x], timeout=3.0)
+                except Exception as e:  # noqa: BLE001 — collected + asserted
+                    bad.append(repr(e))
+                    continue
+                want = 1.0 if gen == 0 else 4.0
+                if gen not in (0, 5) or not np.array_equal(
+                        outs[0], x * want):
+                    bad.append(f"gen {gen} -> {outs[0]!r}")
+                seen.append(gen)
+
+        threads = [threading.Thread(target=traffic) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        new_gen = r.swap_weights(dirs["g5"], drain_timeout=5.0)
+        assert new_gen == 5
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not bad, bad[:5]
+        assert 0 in seen and 5 in seen  # traffic flowed on both sides
+        # post-swap: everything serves the new weights
+        outs, gen = r.infer_stamped([x], timeout=2.0)
+        assert gen == 5
+        np.testing.assert_array_equal(outs[0], x * 4.0)
+        s = r.stats()
+        assert s["generation"] == 5 and s["swaps"] == 1
+        assert all(m["generation"] == 5 for m in s["members"])
+        assert s["admitted"] == s["completed"] + s["failed"] \
+            + s["timed_out"] + s["overloaded"] + s["cancelled"]
+        assert s["failed"] == 0 and s["timed_out"] == 0
+
+
+def test_swap_refuses_torn_and_stale_generations(tmp_path):
+    tier = Tier(scales={None: 1.0})
+    dirs = _dirs(tmp_path, tier, {"g7": (2.0, 7), "g3": (3.0, 3)})
+    torn = tmp_path / "torn"
+    torn.mkdir()
+    tier.scales[str(torn)] = 9.0
+    with ServingRouter(tier.factory, size=2, model_dir=dirs["g7"],
+                       generation=7, config=fast_config()) as r:
+        with pytest.raises(SwapFailed, match="_COMMITTED"):
+            r.swap_weights(str(torn))
+        with pytest.raises(SwapFailed, match="not newer"):
+            r.swap_weights(dirs["g3"])     # older generation refused
+        with pytest.raises(SwapFailed, match="not newer"):
+            r.swap_weights(dirs["g7"])     # same generation refused
+        assert r.stats()["generation"] == 7
+        # no generation stamp at all is refused too
+        unstamped = tmp_path / "unstamped"
+        unstamped.mkdir()
+        import json
+        import os
+        with open(os.path.join(str(unstamped), "_COMMITTED"), "w") as f:
+            json.dump({"format": 1}, f)
+        with pytest.raises(SwapFailed, match="generation stamp"):
+            r.swap_weights(str(unstamped))
+
+
+def test_failed_swap_rolls_back_to_consistent_generation(tmp_path):
+    tier = Tier(scales={None: 1.0})
+    dirs = _dirs(tmp_path, tier, {"g0": (1.0, 0), "g9": (6.0, 9)})
+    boom = {"armed": False}
+
+    def hook(rid, model_dir):
+        # the SECOND replica's rebuild on the new weights explodes
+        if boom["armed"] and rid == "replica-1" \
+                and model_dir == dirs["g9"]:
+            raise RuntimeError("injected: bad weights on replica-1")
+
+    tier.factory_hook = hook
+    with ServingRouter(tier.factory, size=2, model_dir=dirs["g0"],
+                       generation=0, config=fast_config()) as r:
+        x = np.ones(2)
+        r.infer([x], timeout=2.0)
+        boom["armed"] = True
+        with pytest.raises(SwapFailed):
+            r.swap_weights(dirs["g9"], drain_timeout=2.0)
+        boom["armed"] = False
+        s = r.stats()
+        assert s["generation"] == 0 and s["swap_rollbacks"] == 1
+        # the tier converges back to generation 0 everywhere (replica-0
+        # rolled back; replica-1 restarts on the committed generation)
+        assert wait_until(
+            lambda: all(m["generation"] == 0 and m["state"] == "ready"
+                        for m in r.stats()["members"]), timeout=8.0)
+        out, = r.infer([x], timeout=2.0)
+        np.testing.assert_array_equal(out, x * 1.0)
+
+
+# ---------------------------------------------------------------------------
+# autoscale band
+# ---------------------------------------------------------------------------
+
+def test_autoscale_spawns_under_load_and_retires_idle():
+    tier = Tier(delay=0.08)
+    cfg = fast_config(autoscale=True, min_replicas=1, max_replicas=3,
+                      scale_up_depth=1.0, scale_down_depth=0.2,
+                      autoscale_patience=2, supervise_interval=0.03)
+    with ServingRouter(tier.factory, size=1, config=cfg) as r:
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(r.infer, [np.ones(2)], 10.0)
+                    for _ in range(40)]
+            grew = wait_until(lambda: len(r) > 1, timeout=8.0)
+            for f in futs:
+                f.result()
+        assert grew and r.stats()["scale_ups"] >= 1
+        # idle: the tier shrinks back into the band floor
+        assert wait_until(lambda: len(r) == 1, timeout=8.0)
+        assert r.stats()["scale_downs"] >= 1
+        r.infer([np.ones(2)], timeout=2.0)  # survivors still serve
+
+
+# ---------------------------------------------------------------------------
+# watchdog health snapshot over local heartbeats
+# ---------------------------------------------------------------------------
+
+def test_watchdog_members_health_over_local_heartbeats():
+    hb = LocalHeartbeats()
+    hb.beat("a")
+    hb.beat("b")
+    deaths = []
+    dog = Watchdog(hb, ttl=0.15, on_failure=lambda d: deaths.extend(d))
+    h = dog.members_health()
+    assert h["a"]["alive"] and not h["a"]["dead"] and h["a"]["age"] >= 0.0
+    # "b" goes silent → flagged once (not per sweep), snapshot flips
+    deadline = time.monotonic() + 5
+    while "b" not in dog.dead and time.monotonic() < deadline:
+        hb.beat("a")
+        dog.check()
+        time.sleep(0.02)
+    for _ in range(3):
+        hb.beat("a")
+        dog.check()  # no double-fire while it stays dead
+    assert deaths == ["b"]
+    h = dog.members_health()
+    assert h["b"]["dead"] and not h["b"]["alive"] and h["b"]["age"] > 0.15
+    assert h["a"]["alive"]
+    # revival clears the flag; a re-death fires exactly once more
+    hb.beat("b")
+    dog.check()
+    assert "b" not in dog.dead and dog.members_health()["b"]["alive"]
+    deadline = time.monotonic() + 5
+    while deaths.count("b") < 2 and time.monotonic() < deadline:
+        hb.beat("a")
+        dog.check()
+        time.sleep(0.02)
+    assert deaths == ["b", "b"]
+    # a retired member leaves the keyspace entirely
+    hb.remove("b")
+    assert "b" not in dog.members_health()
+
+
+# ---------------------------------------------------------------------------
+# real processes over the store transport (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_subprocess_replicas_failover_and_swap(tmp_path):
+    """Two real replica processes behind the coordination store: kill one
+    under traffic (failover + supervised respawn), then roll a committed
+    weight swap and bit-match the new snapshot's single-process outputs."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.store import create_master_store
+    from paddle_tpu.inference import Config, Predictor, SubprocessReplica
+
+    def export(seed, d):
+        paddle.seed(seed)
+        m = nn.Linear(4, 2)
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((1, 4), np.float32))]
+        paddle.jit.save(m, str(d / "model"), input_spec=spec)
+        return str(d / "model")
+
+    d0, d1 = tmp_path / "g0", tmp_path / "g1"
+    d0.mkdir(), d1.mkdir()
+    p0, p1 = export(0, d0), export(1, d1)
+    commit_model_dir(str(d0), 1)
+    commit_model_dir(str(d1), 2)
+    store = create_master_store()
+    x = np.random.RandomState(3).rand(1, 4).astype(np.float32)
+    want0 = Predictor(Config(p0)).run([x])[0]
+    want1 = Predictor(Config(p1)).run([x])[0]
+
+    def factory(rid, model_dir, generation):
+        return SubprocessReplica(
+            rid, store, model_dir=model_dir, generation=generation,
+            artifact_name="model", start_timeout=120.0)
+
+    cfg = fast_config(heartbeat_ttl=2.0, start_grace=120.0,
+                      attempt_timeout=15.0,
+                      restart_backoff=RetryPolicy(base_delay=0.2,
+                                                  max_delay=1.0),
+                      probe_timeout=60.0)
+    # heartbeats=store: the router's Watchdog polls the REAL /hb/ keys
+    # the replica processes' native heartbeat threads publish
+    r = ServingRouter(factory, size=2, model_dir=str(d0), generation=1,
+                      config=cfg, heartbeats=store)
+    try:
+        out, = r.infer([x], timeout=60.0)
+        np.testing.assert_allclose(out, want0, rtol=1e-6)
+        # SIGKILL one process: idempotent traffic survives via failover
+        victims = [rec for rec in r.stats()["members"]]
+        r._records[0].replica.kill()
+        for _ in range(4):
+            out, = r.infer([x], timeout=60.0)
+            np.testing.assert_allclose(out, want0, rtol=1e-6)
+        assert wait_until(lambda: r.stats()["ready"] == 2, timeout=120.0)
+        # rolling weight swap: post-swap outputs bit-match snapshot 2's
+        # single-process outputs
+        gen = r.swap_weights(str(d1), drain_timeout=60.0)
+        assert gen == 2
+        for _ in range(4):
+            outs, g = r.infer_stamped([x], timeout=60.0)
+            assert g == 2
+            np.testing.assert_array_equal(outs[0], want1)
+        s = r.stats()
+        assert s["admitted"] == s["completed"]
+        assert victims  # silence the unused-var lint
+    finally:
+        r.shutdown(drain_timeout=30.0)
+        store.close()

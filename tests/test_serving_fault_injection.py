@@ -6,8 +6,15 @@ request either completes bit-correct or fails with a documented typed error
 — never hangs. The batch-crash / batch-hang / batch-poison phases run the
 same invariants with dynamic batching enabled: a failed batch retries as
 split singles, and a poison request is the ONLY typed failure in its batch.
-Running it in the suite makes resilience regressions fail CI, mirroring
-tests/test_ckpt_fault_injection.py for checkpoints."""
+The router-* phases run the DISTRIBUTED SERVING TIER (ServingRouter over
+threads-as-replicas): replica kill/wedge under load loses zero idempotent
+requests and capacity converges back to N via supervised restart; a
+rolling weight hot-swap under sustained traffic drops nothing, stamps
+every response with exactly one generation whose single-process outputs
+it bit-matches, and a swap interrupted by a replica kill rolls back to a
+consistent generation. Running it in the suite makes resilience
+regressions fail CI, mirroring tests/test_ckpt_fault_injection.py for
+checkpoints."""
 import os
 import subprocess
 import sys

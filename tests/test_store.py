@@ -149,6 +149,54 @@ def test_watchdog_revives_rejoined_member(store):
     assert failures == ["rank7", "rank7"]
 
 
+def test_watchdog_members_health_snapshot(store):
+    """The router-facing passive snapshot: alive/dead/last-beat age per
+    member, no flag mutation, and a revived-then-re-dead member is
+    flagged again without double-firing on_failure (one callback per
+    death episode, however many sweeps and snapshots run in between)."""
+    failures = []
+    dog = Watchdog(store, ttl=0.25, interval=0.05,
+                   on_failure=lambda d: failures.extend(d))
+    worker = TCPStore(port=store.port)
+    worker.start_heartbeat("rep0", interval=0.05)
+    time.sleep(0.15)
+    h = dog.members_health()
+    assert h["rep0"]["alive"] and not h["rep0"]["dead"]
+    assert 0.0 <= h["rep0"]["age"] < 0.25
+    # snapshots are pure reads: a stale member is NOT flagged by them
+    worker.stop_heartbeat()
+    worker.close()
+    deadline = time.time() + 5
+    while store.heartbeat_age("rep0") <= 0.3 and time.time() < deadline:
+        time.sleep(0.05)
+    h = dog.members_health()
+    assert not h["rep0"]["alive"] and not h["rep0"]["dead"]  # un-swept
+    assert failures == []
+    # the sweep flags it exactly once however often it re-runs
+    for _ in range(4):
+        dog.check()
+    assert failures == ["rep0"]
+    h = dog.members_health()
+    assert h["rep0"]["dead"] and not h["rep0"]["alive"]
+    # revive → fresh-but-flagged until the next sweep clears it
+    rejoined = TCPStore(port=store.port)
+    rejoined.start_heartbeat("rep0", interval=0.05)
+    deadline = time.time() + 5
+    while store.heartbeat_age("rep0") > 0.2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert not dog.members_health()["rep0"]["alive"]  # still flagged
+    dog.check()
+    assert dog.members_health()["rep0"]["alive"]
+    # re-death fires on_failure exactly once more (no double-fire)
+    rejoined.stop_heartbeat()
+    rejoined.close()
+    deadline = time.time() + 5
+    while failures.count("rep0") < 2 and time.time() < deadline:
+        dog.check()
+        time.sleep(0.05)
+    assert failures == ["rep0", "rep0"]
+
+
 def _rank_main(port, rank, world, q):
     s = TCPStore(port=port, world_size=world, timeout=20)
     s.set(f"/rdzv/{rank}", str(rank))

@@ -69,6 +69,36 @@ fault-free solo run):
   decode-none    fault-free control (also produces the per-prompt solo
                  reference tokens the other phases compare against).
 
+Router phases (`router-*`) run the DISTRIBUTED SERVING TIER
+(paddle_tpu/inference/router.py over replica.py, threads-as-replicas over
+a real exported model) and prove the tier-level invariants: zero lost
+idempotent requests across replica failover (every response bit-matches
+the single-process Predictor over the SAME exported artifact), capacity
+convergence back to N replicas via supervised restart, generation-stamped
+responses that never mix weights across a hot-swap, and the router stats
+conservation law admitted == completed + failed + timed_out + overloaded
++ cancelled:
+
+  router-none      fault-free control across 3 replicas;
+  router-kill      kill one replica under load (heartbeats stop → the
+                   watchdog flags it; in-flight + newly-routed requests
+                   fail over; the supervised restart restores capacity);
+  router-wedge     wedge one replica (requests hold, beats stop): attempts
+                   time out at the attempt deadline and fail over; the
+                   watchdog kill/restart clears the wedge;
+  router-swap      zero-downtime weight hot-swap under sustained traffic:
+                   the roll drops nothing, every response bit-matches its
+                   stamped generation's single-process outputs, post-swap
+                   traffic serves only the new snapshot;
+  router-swap-kill a replica is killed exactly as the roll reaches it:
+                   SwapFailed + rollback to the OLD generation everywhere
+                   (the dead replica restarts onto it), then a clean
+                   re-swap completes.
+
+The real multi-process replica topology (SubprocessReplica over the
+coordination store) is exercised by the slow-marked test in
+tests/test_router.py.
+
 Run as a script (exits nonzero on any violation — registered as a tier-1
 test via tests/test_serving_fault_injection.py):
 
@@ -97,7 +127,9 @@ os.environ.setdefault("PADDLE_TPU_LOCKCHECK", "1")
 
 PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "batch-crash", "batch-hang", "batch-poison",
-          "decode-none", "decode-kill", "decode-wedge", "decode-poison")
+          "decode-none", "decode-kill", "decode-wedge", "decode-poison",
+          "router-none", "router-kill", "router-wedge",
+          "router-swap", "router-swap-kill")
 
 POOL_SIZE = 3
 N_REQUESTS = 48
@@ -564,6 +596,250 @@ def run_decode_phase(phase, model, verbose=True):
     return bad
 
 
+# ---------------------------------------------------------------------------
+# router (distributed serving tier) phases
+# ---------------------------------------------------------------------------
+
+ROUTER_SIZE = 3
+ROUTER_REQUESTS = 48
+ROUTER_DEADLINE = 3.0
+ROUTER_VICTIM = "replica-1"
+GEN_A, GEN_B = 1, 2
+
+
+def _export_router_models(workdir):
+    """Two committed model dirs (different weights, same program shape)
+    plus single-process Predictor reference outputs — the bit-match
+    yardstick for every router phase."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, Predictor, commit_model_dir
+
+    rng = np.random.RandomState(11)
+    batches = [rng.rand(2, 8).astype(np.float32)
+               for _ in range(ROUTER_REQUESTS)]
+    ctx = {"batches": batches, "dirs": {}, "refs": {}}
+    for gen, seed in ((GEN_A, 0), (GEN_B, 1)):
+        d = os.path.join(workdir, f"router-gen{gen}")
+        os.makedirs(d)
+        paddle.seed(seed)
+        model = nn.Linear(8, 4)
+        model.eval()
+        x = np.zeros((2, 8), np.float32)
+        paddle.jit.save(model, os.path.join(d, "model"),
+                        input_spec=[paddle.to_tensor(x)])
+        commit_model_dir(d, gen)
+        pred = Predictor(Config(os.path.join(d, "model")))
+        ctx["dirs"][gen] = d
+        ctx["refs"][gen] = [pred.run([b])[0] for b in batches]
+    return ctx
+
+
+def run_router_phase(phase, ctx, verbose=True):
+    import numpy as np
+    from paddle_tpu.inference import (
+        Config, LocalHeartbeats, LocalReplica, Predictor, RouterConfig,
+        ServingError, ServingRouter, SwapFailed)
+    from paddle_tpu.inference.serving import RetryPolicy
+
+    bad = []
+    batches, dirs, refs = ctx["batches"], ctx["dirs"], ctx["refs"]
+    hb = LocalHeartbeats()
+    registry = {}
+    swapkill_armed = {"on": phase == "router-swap-kill"}
+
+    def factory(rid, model_dir, generation):
+        def make(d):
+            # router-swap-kill: the victim dies EXACTLY as the roll
+            # rebuilds it on the new weights — the most adversarial
+            # interruption point (mid-_swap_one, post-drain)
+            if swapkill_armed["on"] and rid == ROUTER_VICTIM \
+                    and d == dirs[GEN_B]:
+                swapkill_armed["on"] = False
+                registry[rid].kill()
+            return Predictor(Config(os.path.join(d, "model")))
+
+        rep = LocalReplica(
+            rid, make, model_dir, generation, heartbeat=hb,
+            heartbeat_interval=0.02,
+            pool_kwargs=dict(default_timeout=ROUTER_DEADLINE,
+                             supervise_interval=0.01, hang_grace=0.05,
+                             max_queue_depth=ROUTER_REQUESTS + 8))
+        registry[rid] = rep
+        return rep
+
+    cfg = RouterConfig(
+        heartbeat_ttl=0.25, supervise_interval=0.02, start_grace=5.0,
+        attempt_timeout=0.5, probe_timeout=10.0, no_capacity_wait=2.0,
+        breaker_reset_timeout=0.2,
+        restart_backoff=RetryPolicy(base_delay=0.05, max_delay=0.3),
+        failover=RetryPolicy(max_retries=4, base_delay=0.002,
+                             max_delay=0.01, max_elapsed=20.0))
+    t0 = time.monotonic()
+    router = ServingRouter(factory, size=ROUTER_SIZE,
+                           model_dir=dirs[GEN_A], generation=GEN_A,
+                           config=cfg)
+    outcomes = {"ok": 0}
+    gens_seen = set()
+    olock = threading.Lock()
+
+    def one_request(i):
+        try:
+            outs, gen = router.infer_stamped([batches[i]],
+                                             timeout=ROUTER_DEADLINE)
+        except ServingError as e:
+            with olock:
+                k = type(e).__name__
+                outcomes[k] = outcomes.get(k, 0) + 1
+            return
+        except BaseException as e:  # noqa: BLE001 — untyped = violation
+            bad.append(f"[{phase}] request {i} -> UNTYPED "
+                       f"{type(e).__name__}: {e}")
+            return
+        with olock:
+            outcomes["ok"] += 1
+            gens_seen.add(gen)
+        if gen not in refs:
+            bad.append(f"[{phase}] request {i} stamped unknown "
+                       f"generation {gen}")
+        elif not np.array_equal(outs[0], refs[gen][i]):
+            # bit-match against the stamped generation's single-process
+            # outputs: a mixed-weights response can never hide
+            bad.append(f"[{phase}] request {i} diverged from its stamped "
+                       f"generation {gen}'s single-process outputs")
+
+    try:
+        router.warmup(feeds=[batches[0]])
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            if phase in ("router-kill", "router-wedge"):
+                # deterministic mid-stream fault: land it with most of
+                # the traffic still to come (a wall-clock timer raced the
+                # traffic and could fire after it had all drained)
+                head = [ex.submit(one_request, i) for i in range(8)]
+                concurrent.futures.wait(head, timeout=30)
+                if phase == "router-kill":
+                    registry[ROUTER_VICTIM].kill()
+                else:
+                    registry[ROUTER_VICTIM].wedge()
+                futs = head + [ex.submit(one_request, i)
+                               for i in range(8, ROUTER_REQUESTS)]
+            elif phase in ("router-swap", "router-swap-kill"):
+                # sustained traffic around the roll: half the requests
+                # before/while it runs, half after
+                futs = [ex.submit(one_request, i)
+                        for i in range(ROUTER_REQUESTS // 2)]
+                time.sleep(0.05)
+                if phase == "router-swap":
+                    new_gen = router.swap_weights(dirs[GEN_B],
+                                                  drain_timeout=10.0)
+                    if new_gen != GEN_B:
+                        bad.append(f"[{phase}] swap returned generation "
+                                   f"{new_gen}, wanted {GEN_B}")
+                else:
+                    try:
+                        router.swap_weights(dirs[GEN_B], drain_timeout=10.0)
+                        bad.append(f"[{phase}] swap SUCCEEDED despite the "
+                                   f"victim dying mid-roll")
+                    except SwapFailed:
+                        pass  # expected: rollback engaged
+                    if router.stats()["generation"] != GEN_A:
+                        bad.append(f"[{phase}] interrupted swap left "
+                                   f"generation "
+                                   f"{router.stats()['generation']}, "
+                                   f"wanted rollback to {GEN_A}")
+                futs += [ex.submit(one_request, i)
+                         for i in range(ROUTER_REQUESTS // 2,
+                                        ROUTER_REQUESTS)]
+            else:
+                futs = [ex.submit(one_request, i)
+                        for i in range(ROUTER_REQUESTS)]
+            concurrent.futures.wait(futs, timeout=90)
+            hung = sum(not f.done() for f in futs)
+            if hung:
+                bad.append(f"[{phase}] {hung} requests HUNG past every "
+                           f"deadline")
+
+        # --- phase-specific invariants --------------------------------
+        if phase in ("router-none", "router-kill", "router-wedge"):
+            if outcomes["ok"] != ROUTER_REQUESTS:
+                bad.append(f"[{phase}] lost idempotent requests: "
+                           f"{outcomes} (want {ROUTER_REQUESTS} ok)")
+        if phase == "router-swap":
+            if outcomes["ok"] != ROUTER_REQUESTS:
+                bad.append(f"[{phase}] the roll dropped requests: "
+                           f"{outcomes}")
+            if gens_seen != {GEN_A, GEN_B}:
+                bad.append(f"[{phase}] traffic did not span the roll: "
+                           f"stamped generations {sorted(gens_seen)}")
+            if router.stats()["generation"] != GEN_B:
+                bad.append(f"[{phase}] router generation "
+                           f"{router.stats()['generation']} != {GEN_B}")
+
+        # --- convergence: full healthy capacity on ONE generation ------
+        want_gen = GEN_B if phase == "router-swap" else GEN_A
+        deadline_at = time.monotonic() + CONVERGE_TIMEOUT
+        stats = router.stats()
+        while time.monotonic() < deadline_at:
+            stats = router.stats()
+            if stats["ready"] == ROUTER_SIZE and all(
+                    m["generation"] == want_gen for m in stats["members"]):
+                break
+            time.sleep(0.05)
+        else:
+            bad.append(f"[{phase}] tier did NOT converge to "
+                       f"{ROUTER_SIZE} ready replicas on generation "
+                       f"{want_gen}: {stats['members']}")
+
+        if phase in ("router-kill", "router-wedge"):
+            # checked AFTER convergence: wedge detection (stale
+            # heartbeat -> watchdog) is asynchronous by design
+            if router.stats()["deaths"] < 1:
+                bad.append(f"[{phase}] the victim was never marked dead")
+            if router.stats()["failovers"] < 1:
+                bad.append(f"[{phase}] no request ever failed over "
+                           f"(40 requests followed the fault)")
+
+        if phase == "router-swap-kill":
+            # after rolling back + healing, a clean swap must complete
+            new_gen = router.swap_weights(dirs[GEN_B], drain_timeout=10.0)
+            if new_gen != GEN_B:
+                bad.append(f"[{phase}] post-heal swap returned {new_gen}")
+            want_gen = GEN_B
+
+        # post-fault correctness on the converged generation
+        for i in (0, 1, 2):
+            try:
+                outs, gen = router.infer_stamped([batches[i]], timeout=5.0)
+                if gen != want_gen or not np.array_equal(
+                        outs[0], refs[want_gen][i]):
+                    bad.append(f"[{phase}] post-fault output wrong "
+                               f"(gen {gen}, want {want_gen})")
+            except ServingError as e:
+                bad.append(f"[{phase}] post-fault request failed: {e}")
+    finally:
+        drained = router.shutdown(drain_timeout=10.0)
+    if not drained:
+        bad.append(f"[{phase}] router failed to drain on shutdown")
+    final = router.stats()
+    lhs = final["admitted"]
+    rhs = (final["completed"] + final["failed"] + final["timed_out"]
+           + final["overloaded"] + final["cancelled"])
+    if lhs != rhs:
+        bad.append(f"[{phase}] ROUTER conservation violated: "
+                   f"admitted={lhs} != completed+failed+timed_out+"
+                   f"overloaded+cancelled={rhs} ({final})")
+    if verbose:
+        tag = "FAIL" if bad else "ok"
+        print(f"  {phase:<16} -> {tag}  ({outcomes}, "
+              f"deaths={final['deaths']}, failovers={final['failovers']}, "
+              f"restarts={final['restarts']}, swaps={final['swaps']}, "
+              f"rollbacks={final['swap_rollbacks']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--phases", default=",".join(PHASES),
@@ -580,8 +856,9 @@ def main(argv=None):
                               os.path.join(workdir, "compile-cache"))
         path = os.path.join(workdir, "infer")
         serving_phases = [p for p in phases
-                          if not p.startswith("decode-")]
+                          if not p.startswith(("decode-", "router-"))]
         decode_phases = [p for p in phases if p.startswith("decode-")]
+        router_phases = [p for p in phases if p.startswith("router-")]
         model = _export_model(path) if serving_phases else None
         print("serving fault injection (hook-at-execution):")
         for phase in serving_phases:
@@ -594,6 +871,14 @@ def main(argv=None):
             _decode_references(dmodel)
             for phase in decode_phases:
                 violations += run_decode_phase(phase, dmodel)
+        if router_phases:
+            # threads-as-replicas over two committed real-model snapshots
+            # (the multi-process topology runs slow-marked in
+            # tests/test_router.py)
+            rctx = _export_router_models(workdir)
+            print("router (distributed serving tier) phases:")
+            for phase in router_phases:
+                violations += run_router_phase(phase, rctx)
 
         if any("hang" in p for p in phases):
             # Wedged members are retired with their threads ABANDONED (by
@@ -627,6 +912,12 @@ def main(argv=None):
             # (and the 0-cycles / 0-held-across-dispatch assertions below
             # now cover the decode-step dispatch path too)
             expected_locks |= {"decode.engine", "decode.block_pool"}
+        if any(p.startswith("router-") for p in phases):
+            # the distributed tier's named locks: the same 0-cycles /
+            # 0-held-across-dispatch assertions cover the router's
+            # routing, supervision, and hot-swap paths
+            expected_locks |= {"router.core", "router.replica",
+                               "router.heartbeats"}
         missing = expected_locks - set(rep["locks"])
         if missing:
             violations.append(
