@@ -544,6 +544,166 @@ def bench_serving(on_tpu, dev):
         })
 
 
+def bench_slo(on_tpu, dev):
+    """BENCH_SLO=1: the perf-SLO regression gate (docs/observability.md).
+
+    Drives the CPU serving smoke (batched ServingPool over a small
+    exported MLP at concurrency 8) with the obs metrics registry
+    attached and a live HTTP exporter scraped mid-run, plus a tiny
+    training loop, then evaluates the objectives declared in
+    paddle_tpu.obs.slo (p99 request latency, throughput floor,
+    queue-depth ceiling, steps/sec floor) against the checked-in
+    SLO_BASELINE.json ratchet — exit nonzero on any breach, exactly how
+    .tpu_lint_baseline.json gates lint. BENCH_SLO_WRITE=1 re-measures
+    and rewrites the baseline (for an intentional, explained perf
+    change). The scrape is also verified: the pool's conservation law
+    (admitted == completed + failed + timed_out + cancelled) must hold
+    in the Prometheus text exposition itself."""
+    import concurrent.futures
+    import itertools
+    import re
+    import tempfile
+    import urllib.request
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, obs
+    from paddle_tpu.obs import slo as slo_mod
+    from paddle_tpu.inference import (
+        BatchConfig, Config, ServingPool, create_predictor)
+
+    n_req = int(os.environ.get("BENCH_SLO_REQUESTS", "160"))
+    conc = int(os.environ.get("BENCH_SLO_CONCURRENCY", "8"))
+    pool_size = 2
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        slo_mod.BASELINE_FILENAME)
+    values = {}
+
+    with tempfile.TemporaryDirectory(prefix="bench-slo-") as workdir:
+        os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE",
+                              os.path.join(workdir, "compile-cache"))
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(32, 32), nn.ReLU(),
+                              nn.Linear(32, 32))
+        model.eval()
+        path = os.path.join(workdir, "infer")
+        paddle.jit.save(model, path, input_spec=[
+            paddle.to_tensor(np.zeros((1, 32), np.float32))])
+
+        rng = np.random.RandomState(0)
+        inputs = [rng.rand(1, 32).astype(np.float32) for _ in range(32)]
+
+        reg = obs.MetricsRegistry()
+        pool = ServingPool(predictor=create_predictor(Config(path)),
+                           size=pool_size, max_queue_depth=conc * 8,
+                           default_timeout=60.0,
+                           batching=BatchConfig(max_wait_ms=2.0),
+                           metrics=reg, name="slo")
+        try:
+            server = pool.serve_metrics()
+            pool.warmup()
+            feeds = list(itertools.islice(
+                itertools.cycle(range(len(inputs))), n_req))
+            hist = reg.histogram("serving.request_seconds")
+            with concurrent.futures.ThreadPoolExecutor(conc) as ex:
+                # warm every member/executable outside the measure
+                list(ex.map(lambda i: pool.infer([inputs[i]],
+                                                 timeout=30.0),
+                            feeds[:conc * 2]))
+                # window the histogram too: the p99 objective must see
+                # only the measured traffic, not the cold-start samples
+                # the warm-up just absorbed (counts-delta quantile)
+                warm_counts = hist.counts()
+                t0 = time.perf_counter()
+                list(ex.map(lambda i: pool.infer([inputs[i]],
+                                                 timeout=30.0), feeds))
+                dt = time.perf_counter() - t0
+
+            snap = reg.snapshot()
+            st = snap["collectors"]["serving.pool.slo"]
+            window = [a - b for a, b in zip(hist.counts(), warm_counts)]
+            values["serving_smoke.p99_latency_s"] = \
+                hist.quantile(0.99, window)
+            values["serving_smoke.throughput_rps"] = n_req / dt
+            values["serving_smoke.queue_depth_peak"] = \
+                st["queue_depth_peak"]
+
+            # the SAME registry must be scrapeable as Prometheus text
+            # from the live endpoint, conservation law intact
+            text = urllib.request.urlopen(
+                server.url + "/metrics", timeout=10).read().decode()
+            healthz = urllib.request.urlopen(
+                server.url + "/healthz", timeout=10).status
+
+            def scraped(field):
+                m = re.search(
+                    rf"^serving_pool_slo_{field} (\d+)$", text, re.M)
+                if m is None:
+                    raise RuntimeError(
+                        f"serving_pool_slo_{field} missing from the "
+                        f"scraped exposition")
+                return int(m.group(1))
+
+            balance = (scraped("completed") + scraped("failed")
+                       + scraped("timed_out") + scraped("cancelled"))
+            if scraped("admitted") != balance or healthz != 200:
+                print(f"bench_slo: scraped conservation broken "
+                      f"(admitted={scraped('admitted')} vs {balance}, "
+                      f"healthz={healthz})", file=sys.stderr)
+                return None
+        finally:
+            pool.shutdown(drain_timeout=10.0)
+
+    # training-dispatch floor: a tiny Engine loop (compile excluded)
+    import jax
+    import paddle_tpu.distributed as dist
+
+    paddle.seed(0)
+    tmodel = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                    parameters=tmodel.parameters())
+    mesh = dist.build_mesh(dp=-1, devices=jax.devices()[:1])
+    eng = dist.parallelize(
+        tmodel, opt, mesh=mesh,
+        loss_fn=lambda m, x, y: paddle.nn.functional.mse_loss(m(x), y))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(8, 16).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1)
+                         .rand(8, 1).astype("float32"))
+    float(eng.train_batch(x, y).numpy())  # compile + fence
+    steps = int(os.environ.get("BENCH_SLO_TRAIN_STEPS", "30"))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = eng.train_batch(x, y)
+    float(loss.numpy())                   # readback fences the chain
+    values["train_smoke.steps_per_sec"] = steps / (time.perf_counter()
+                                                   - t0)
+
+    if os.environ.get("BENCH_SLO_WRITE") == "1":
+        written = slo_mod.write_baseline(
+            baseline_path, values, slo_mod.SERVING_SMOKE,
+            note="CPU serving+train smoke bounds; re-ratchet with "
+                 "BENCH_SLO_WRITE=1 only for an intentional perf change")
+        print(f"bench_slo: wrote {len(written)} baseline bounds -> "
+              f"{baseline_path}", file=sys.stderr)
+
+    baseline = slo_mod.load_baseline(baseline_path)
+    report = slo_mod.evaluate(values, baseline, slo_mod.SERVING_SMOKE)
+    print(slo_mod.format_report(report), file=sys.stderr)
+    payload = _emit({
+        "metric": f"SLO gate ({len(report['results'])} objectives, "
+                  f"serving c={conc} n={n_req} + {steps}-step train "
+                  f"smoke)",
+        "value": len(report["results"]) - len(report["breaches"]),
+        "unit": "objectives passed",
+        "vs_baseline": 1.0 if report["ok"] else 0.0,
+        "extra": {"values": {k: round(v, 6) for k, v in values.items()},
+                  "results": report["results"],
+                  "platform": dev.platform},
+    })
+    return payload if report["ok"] else None
+
+
 def bench_decode(on_tpu, dev):
     """BENCH_DECODE=1: continuous-batching LLM decode — tokens/sec and
     p50/p99 time-to-first-token of the iteration-level `DecodeEngine`
@@ -781,6 +941,11 @@ def main():
     # one-chip bench (the driver runs on a single real TPU chip)
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "TPU" in str(dev.device_kind)
+
+    if os.environ.get("BENCH_SLO") == "1":
+        # perf-SLO regression gate: declared objectives vs the checked-in
+        # SLO_BASELINE.json ratchet; nonzero exit on breach
+        return 0 if bench_slo(on_tpu, dev) else 1
 
     if os.environ.get("BENCH_SERVING") == "1":
         # serving-throughput mode: its own one-line JSON (requests/sec,

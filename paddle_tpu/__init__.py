@@ -90,7 +90,7 @@ def synchronize():
 
 _LAZY_SUBMODULES = ("profiler", "metric", "vision", "hapi", "distribution",
                     "sparse", "quantization", "fft", "signal", "linalg",
-                    "inference", "text", "audio", "onnx", "static")
+                    "inference", "text", "audio", "onnx", "static", "obs")
 
 
 def __getattr__(name):

@@ -28,7 +28,7 @@ batch), so nothing host-side executes between micro-steps.
 """
 from __future__ import annotations
 
-from contextlib import nullcontext
+import itertools
 
 import numpy as np
 import jax
@@ -75,18 +75,21 @@ def batch_spec_for_ndim(spec, ndim):
 
 _prof_mod = None
 
+#: registry collector keys need a distinct name per engine instance
+_ENGINE_OBS_SEQ = itertools.count()
 
-def _span(name):
-    """RecordEvent span when a host profiler is actively recording, else a
-    no-op — keeps the native tracer (and its first-use build) entirely off
-    the un-profiled hot path."""
+
+def _span(name, histogram=None):
+    """`profiler.profiled_span` indirection: a RecordEvent span when a
+    host profiler is actively recording, else a no-op — keeps the native
+    tracer (and its first-use build) entirely off the un-profiled hot
+    path. With `histogram=` the span ALSO feeds that obs latency
+    histogram on every pass, recording or not."""
     global _prof_mod
     if _prof_mod is None:
         from .. import profiler as _p
         _prof_mod = _p
-    if _prof_mod.host_recording():
-        return _prof_mod.RecordEvent(name)
-    return nullcontext()
+    return _prof_mod.profiled_span(name, histogram=histogram)
 
 
 def _clip_grads(grads, clip):
@@ -223,6 +226,25 @@ class ShardedTrainStep:
         # explicit host->device transfers, for perf smoke tests that must
         # not depend on wall-clock
         self.stats = {"dispatches": 0, "device_puts": 0, "steps": 0}
+        # telemetry (paddle_tpu.obs): the SAME stats dict registered as a
+        # weakly-held collector (the registry prunes it when the engine is
+        # garbage-collected), plus a dispatch-latency histogram fed by the
+        # engine::dispatch spans below whether or not a profiler records
+        from ..obs.metrics import registry as _obs_registry
+
+        self._obs_key = f"train.engine{next(_ENGINE_OBS_SEQ)}"
+        self._h_dispatch = _obs_registry().histogram(
+            "engine.dispatch_seconds",
+            help="host-side latency of one compiled train/eval step "
+                 "dispatch (enqueue, not device completion)")
+        _obs_registry().register_collector(self._obs_key,
+                                           self._obs_collect)
+
+    # ------------------------------------------------------------------
+    def _obs_collect(self):
+        """Registry collector: the engine's dispatch counters, weakly
+        held (see __init__) so a dropped engine un-registers itself."""
+        return dict(self.stats)
 
     # ------------------------------------------------------------------
     def _cp_guard(self):
@@ -445,7 +467,7 @@ class ShardedTrainStep:
         key = self._key_scalar()
         step_no = self._step_scalar()
         self._step_count += 1
-        with _span("engine::dispatch"):
+        with _span("engine::dispatch", histogram=self._h_dispatch):
             (loss, gnorm, self.param_vals, self.opt_state, self.buffer_vals,
              self._key_dev, self._step_dev) = self._step_fn(
                 self.param_vals, self.opt_state, self.buffer_vals, placed,
@@ -539,7 +561,7 @@ class ShardedTrainStep:
         lrs = self._lr_schedule_array(n)
         key = self._key_scalar()
         step0 = self._step_scalar()
-        with _span("engine::dispatch"):
+        with _span("engine::dispatch", histogram=self._h_dispatch):
             (losses, gnorms, self.param_vals, self.opt_state,
              self.buffer_vals, self._key_dev, self._step_dev) = fn(
                 self.param_vals, self.opt_state, self.buffer_vals, placed,
@@ -591,7 +613,7 @@ class ShardedTrainStep:
             fn = self._build_eval(placed)
             self._eval_fns[sig] = fn
         key = rng_mod.next_key()
-        with _span("engine::dispatch"):
+        with _span("engine::dispatch", histogram=self._h_dispatch):
             loss = fn(self.param_vals, self.buffer_vals, placed, key)
         self.stats["dispatches"] += 1
         return Tensor(loss)

@@ -111,6 +111,12 @@ class DynamicBatcher:
         self.config = config or BatchConfig()
         self._clock = clock
         self._lock = _locks.new_lock("serving.batcher")
+        # obs histograms, installed by the owning ServingPool when its
+        # registry is on (None otherwise): per-request queue wait and
+        # per-dispatch execute time land in the same families the
+        # unbatched path observes (docs/observability.md)
+        self.h_queue_wait = None
+        self.h_execute = None
         # counters (guarded by _lock)
         self._formed = 0
         self._requests = 0
@@ -201,6 +207,8 @@ class DynamicBatcher:
             outs = fn(*stacked)
             outs = [np.asarray(o) for o in outs]  # device sync + one copy
         exec_ms = (time.perf_counter() - t0) * 1e3
+        if self.h_execute is not None:
+            self.h_execute.observe(exec_ms / 1e3)
 
         with _span("serving::batch_scatter"):
             # copy, don't slice: a view would pin the whole bucket-sized
@@ -219,6 +227,10 @@ class DynamicBatcher:
                     w = max(0.0, (now - r.enqueued_at) * 1e3)
                     self._queue_wait_ms += w
                     self._queue_wait_max_ms = max(self._queue_wait_max_ms, w)
+                    if self.h_queue_wait is not None and r.attempts == 1:
+                        # first attempt only: a retried request's stamp
+                        # includes its prior execution + backoff
+                        self.h_queue_wait.observe(w / 1e3)
         return results
 
     # -- bookkeeping hooks (pool-driven) -----------------------------------

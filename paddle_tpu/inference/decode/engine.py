@@ -67,6 +67,7 @@ or through a `ServingPool(..., decode_engine=engine)` via
 from __future__ import annotations
 
 import hashlib
+import itertools
 import math
 import queue
 import threading
@@ -170,7 +171,7 @@ class SequenceStream:
 class _Seq:
     __slots__ = ("id", "prompt", "max_new", "deadline", "stream", "state",
                  "blocks", "reserved_total", "outstanding", "pos",
-                 "last_token", "generated", "cancelled")
+                 "last_token", "generated", "cancelled", "submitted_at")
 
     def __init__(self, sid, prompt, max_new, deadline):
         self.id = sid
@@ -186,6 +187,11 @@ class _Seq:
         self.last_token = None
         self.generated = 0
         self.cancelled = False
+        self.submitted_at = None       # admission stamp (TTFT histogram)
+
+
+#: registry collector keys need a distinct name per engine instance
+_ENGINE_SEQ = itertools.count()
 
 
 class DecodeEngine:
@@ -199,7 +205,7 @@ class DecodeEngine:
                  quant=None, max_waiting=64, default_timeout=None,
                  step_timeout=30.0, step_retries=1, eos_token_id=None,
                  pad_token_id=0, compile_cache=None, fault_hook=None,
-                 hang_grace=0.1, supervise_interval=0.02,
+                 hang_grace=0.1, supervise_interval=0.02, metrics=None,
                  clock=time.monotonic):
         from ...distributed.functional import functionalize
         from ...core.tensor import Tensor
@@ -277,7 +283,8 @@ class DecodeEngine:
             retry=RetryPolicy(max_retries=2, base_delay=0.01,
                               max_delay=0.05),
             hang_grace=hang_grace, supervise_interval=supervise_interval,
-            clock=clock)
+            metrics=False,  # an internal executor, not a serving surface:
+            clock=clock)    # the engine publishes its OWN collector below
 
         self._lock = _locks.new_lock("decode.engine")
         self._cv = _locks.new_condition("decode.engine", lock=self._lock)
@@ -305,10 +312,36 @@ class DecodeEngine:
         self._step_slots = 0
         self._step_active = 0
 
+        # telemetry (paddle_tpu.obs): TTFT observed at first-token
+        # delivery plus stats() as a registry collector. TWO histograms
+        # on purpose: a PRIVATE one backing stats()["ttft"] (per-engine
+        # semantics — two engines on one registry must not read each
+        # other's TTFT) and the registry's shared process-level family;
+        # with metrics=False only the private one exists.
+        from ...obs.metrics import Histogram, registry as _obs_registry
+
+        self.name = f"engine{next(_ENGINE_SEQ)}"
+        self._h_ttft = Histogram(
+            "decode.ttft_seconds",
+            help="time to first token: admission -> first delivery")
+        if metrics is False:
+            self._metrics = None
+            self._h_ttft_shared = None
+        else:
+            self._metrics = metrics if metrics is not None \
+                else _obs_registry()
+            self._h_ttft_shared = self._metrics.histogram(
+                "decode.ttft_seconds",
+                help="time to first token: admission -> first delivery")
+
         self._thread = threading.Thread(target=self._loop,
                                         name="DecodeEngine-scheduler",
                                         daemon=True)
         self._thread.start()
+        if self._metrics is not None:
+            # last: a concurrent scrape must only see a fully-built engine
+            self._metrics.register_collector(
+                f"decode.{self.name}", self.stats)
 
     # -- identity ----------------------------------------------------------
     def _make_fingerprint(self):
@@ -381,6 +414,7 @@ class DecodeEngine:
                     f"— request shed; retry with backoff")
             self._ids += 1
             seq = _Seq(self._ids, ids.astype(np.int32), max_new, dl)
+            seq.submitted_at = self._clock()
             seq.stream._cancel = lambda s=seq: self._request_cancel(s)
             self._waiting.append(seq)
             self._admitted += 1
@@ -700,6 +734,11 @@ class DecodeEngine:
         sequence if it just finished."""
         seq.last_token = tok
         seq.generated += 1
+        if seq.generated == 1 and seq.submitted_at is not None:
+            ttft = self._clock() - seq.submitted_at
+            self._h_ttft.observe(ttft)
+            if self._h_ttft_shared is not None:
+                self._h_ttft_shared.observe(ttft)
         seq.stream._push(tok)
         with self._lock:
             self._tokens_out += 1
@@ -854,6 +893,9 @@ class DecodeEngine:
             for seq in leftovers:
                 self._finish_locked(seq, "cancelled", PoolClosed(
                     f"engine shut down before sequence {seq.id} finished"))
+        if self._metrics is not None:
+            self._metrics.unregister_collector(f"decode.{self.name}",
+                                               self.stats)
         self._drained = drained
         return drained
 
@@ -896,6 +938,9 @@ class DecodeEngine:
                 "buckets": {"decode": list(self.decode_buckets),
                             "prefill": list(self.prefill_buckets)},
             }
+        th = self._h_ttft.snapshot()
+        snap["ttft"] = {"count": th["count"], "avg_s": th["avg"],
+                        "p50_s": th["p50"], "p99_s": th["p99"]}
         snap["blocks"] = self.pool.stats()
         snap["step_pool"] = self._steps.stats()
         return snap

@@ -84,6 +84,10 @@ def commit_model_dir(path, generation):
     return path
 
 
+#: registry collector keys need a distinct name per router instance
+_ROUTER_SEQ = itertools.count()
+
+
 class RouterConfig:
     """Knobs for `ServingRouter`. Everything has a production-shaped
     default; tests and the fault harness shrink the time constants."""
@@ -182,7 +186,8 @@ class ServingRouter:
 
     def __init__(self, replica_factory, size=2, *, model_dir=None,
                  generation=0, config=None, heartbeats=None,
-                 watchdog=None, clock=time.monotonic):
+                 watchdog=None, metrics=None, name=None,
+                 clock=time.monotonic):
         if size < 1:
             raise ValueError("router needs at least one replica")
         self.config = config if config is not None else RouterConfig()
@@ -247,6 +252,22 @@ class ServingRouter:
             target=self._supervise_loop, name="ServingRouter-supervisor",
             daemon=True)
         self._supervisor.start()
+
+        # telemetry (paddle_tpu.obs): the tier's stats() — per-replica
+        # health, failovers, swap generations, the router conservation
+        # law — registered as a registry collector; metrics=False
+        # disables, serve_metrics() exports over HTTP
+        self.name = str(name) if name else f"router{next(_ROUTER_SEQ)}"
+        self._metrics_server = None
+        if metrics is False:
+            self._metrics = None
+        else:
+            from ..obs.metrics import registry as _obs_registry
+
+            self._metrics = metrics if metrics is not None \
+                else _obs_registry()
+            self._metrics.register_collector(
+                f"serving.router.{self.name}", self.stats)
 
     # -- construction helpers ---------------------------------------------
     def _new_record(self):
@@ -893,6 +914,12 @@ class ServingRouter:
                 ok = False     # visit every replica; reported via return
             with self._lock:
                 rec.state = _RETIRED
+        if self._metrics is not None:
+            self._metrics.unregister_collector(
+                f"serving.router.{self.name}", self.stats)
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.stop()
         self._drained = ok
         return ok
 
@@ -904,6 +931,40 @@ class ServingRouter:
         return False
 
     # -- observability -----------------------------------------------------
+    def serve_metrics(self, port=0, host="127.0.0.1"):
+        """Start (or return) the opt-in background HTTP exporter over
+        the router's metrics registry: ``/metrics`` (Prometheus text),
+        ``/metrics.json``, and ``/healthz`` (200 while READY capacity
+        meets `min_healthy` and admissions are open, else 503).
+        `shutdown()` stops it."""
+        if self._metrics is None:
+            raise RuntimeError(
+                "router was built with metrics=False — no registry to "
+                "serve")
+        from ..obs.http import MetricsServer
+
+        def _healthz():
+            s = self.stats()
+            ok = s["ready"] >= self.config.min_healthy \
+                and not s["closed"]
+            return ok, {"router": self.name, "ready": s["ready"],
+                        "replicas": s["replicas"],
+                        "generation": s["generation"],
+                        "closed": s["closed"]}
+
+        # atomic check-and-create under the router lock: no leaked
+        # second server on concurrent calls, and linearized against
+        # shutdown's _closed flip (see ServingPool.serve_metrics)
+        with self._lock:
+            if self._closed:
+                raise PoolClosed("cannot serve metrics from a shut-down "
+                                 "router")
+            if self._metrics_server is None:
+                self._metrics_server = MetricsServer(
+                    self._metrics, host=host, port=port,
+                    healthz=_healthz).start()
+            return self._metrics_server
+
     @property
     def generation(self):
         with self._lock:
@@ -928,6 +989,7 @@ class ServingRouter:
                 })
             ready = sum(1 for r in replicas if r["state"] == _READY)
             snap = {
+                "name": self.name,
                 "replicas": len(replicas),
                 "ready": ready,
                 "generation": self._generation,

@@ -48,7 +48,13 @@ production runtime on top of the same clone-sharing substrate:
 * **Observability** — `stats()` returns a counter snapshot obeying
       admitted == completed + failed + timed_out + cancelled
                   + queue_depth + in_flight
-  (shed requests were never admitted), plus per-member health.
+  (shed requests were never admitted), plus per-member health. The pool
+  also publishes into the process metrics registry (paddle_tpu.obs):
+  request/queue-wait/execute latency histograms on the hot path (an
+  unlocked bucket add — `metrics=False` strips even that) and its
+  `stats()` dict as a registry collector, so the conservation law above
+  is scrapeable live (`serve_metrics(port=0)` starts the HTTP
+  `/metrics` + `/healthz` endpoint; see docs/observability.md).
 
 Fault injection: the `fault_hook(slot_index, request, predictor)`
 constructor arg is invoked on the member's worker thread immediately
@@ -440,6 +446,11 @@ class _MemberSlot:
 # the pool
 # ---------------------------------------------------------------------------
 
+#: process-wide pool naming for registry collector keys: every pool needs
+#: a distinct key, auto-assigned unless the caller names it (`name=`)
+_POOL_SEQ = itertools.count()
+
+
 class ServingPool:
     """Resilient predictor pool: bounded admission, deadlines, supervised
     members, circuit breaking, retries, graceful drain. See the module
@@ -465,7 +476,7 @@ class ServingPool:
                  breaker_threshold=3, breaker_reset_timeout=1.0,
                  retry=None, hang_grace=0.1, supervise_interval=0.02,
                  fault_hook=None, batching=None, decode_engine=None,
-                 clock=time.monotonic):
+                 metrics=None, name=None, clock=time.monotonic):
         if size < 1:
             raise ValueError("pool size must be >= 1")
         if max_queue_depth < 1:
@@ -525,6 +536,36 @@ class ServingPool:
         self._wedged = 0
         self._late_results = 0
         self._rebases = 0
+        self._queue_peak = 0
+
+        # telemetry (paddle_tpu.obs): latency histograms observed on the
+        # hot path (an unlocked bucket add each — metrics=False strips
+        # even that), plus stats() registered as a collector below so
+        # the conservation law is scrapeable live
+        self.name = str(name) if name else f"pool{next(_POOL_SEQ)}"
+        self._metrics_server = None
+        if metrics is False:
+            self._metrics = None
+            self._h_latency = self._h_queue_wait = self._h_execute = None
+        else:
+            from ..obs.metrics import registry as _obs_registry
+
+            reg = metrics if metrics is not None else _obs_registry()
+            self._metrics = reg
+            self._h_latency = reg.histogram(
+                "serving.request_seconds",
+                help="end-to-end request latency, admission -> "
+                     "completion (successful requests)")
+            self._h_queue_wait = reg.histogram(
+                "serving.queue_wait_seconds",
+                help="admission-queue wait before execution starts")
+            self._h_execute = reg.histogram(
+                "serving.execute_seconds",
+                help="member execution time (one dispatch: a single "
+                     "request or a whole formed batch)")
+            if self._batcher is not None:
+                self._batcher.h_queue_wait = self._h_queue_wait
+                self._batcher.h_execute = self._h_execute
 
         self._slots = []
         for i in range(size):
@@ -541,6 +582,11 @@ class ServingPool:
             target=self._supervise_loop, name="ServingPool-supervisor",
             daemon=True)
         self._supervisor.start()
+        if self._metrics is not None:
+            # registered LAST: a concurrent scrape must only ever see a
+            # fully-constructed pool behind the collector
+            self._metrics.register_collector(
+                f"serving.pool.{self.name}", self.stats)
 
     # -- admission ---------------------------------------------------------
     def submit(self, fn, timeout=None) -> _Request:
@@ -574,6 +620,9 @@ class ServingPool:
             req.enqueued_at = self._clock()
             self._queue.append(req)
             self._admitted += 1
+            depth = len(self._queue) + len(self._retry_timers)
+            if depth > self._queue_peak:
+                self._queue_peak = depth  # SLO queue-depth ceiling signal
             self._cv.notify()
         return req
 
@@ -732,6 +781,12 @@ class ServingPool:
                 continue
             slot.current = req
             req.attempts += 1
+            t0 = self._clock()
+            if self._h_queue_wait is not None and req.attempts == 1 \
+                    and req.enqueued_at is not None:
+                # first attempt only: a retry's admission stamp includes
+                # the prior execution + backoff, which is not queue wait
+                self._h_queue_wait.observe(t0 - req.enqueued_at)
             try:
                 if self._fault_hook is not None:
                     self._fault_hook(slot.index, req, slot.predictor)
@@ -740,6 +795,9 @@ class ServingPool:
             except Exception as exc:  # noqa: BLE001 — classified below
                 self._on_execution_error(slot, req, exc)
             else:
+                done = self._clock()
+                if self._h_execute is not None:
+                    self._h_execute.observe(done - t0)
                 self._reset_member(slot)
                 if not slot.retired:
                     # a retired (wedged) worker's late success must not
@@ -751,6 +809,9 @@ class ServingPool:
                     if req.complete(result):
                         self._completed += 1
                         slot.completed += 1
+                        if self._h_latency is not None \
+                                and req.enqueued_at is not None:
+                            self._h_latency.observe(done - req.enqueued_at)
                     else:
                         self._late_results += 1
             finally:
@@ -835,6 +896,7 @@ class ServingPool:
         except Exception as exc:  # noqa: BLE001 — classified below
             self._on_batch_error(slot, live, exc)
         else:
+            done = self._clock()
             self._reset_member(slot)
             if not slot.retired:
                 br.record_success()
@@ -843,6 +905,9 @@ class ServingPool:
                     if r.complete(res):
                         self._completed += 1
                         slot.completed += 1
+                        if self._h_latency is not None \
+                                and r.enqueued_at is not None:
+                            self._h_latency.observe(done - r.enqueued_at)
                     else:
                         self._late_results += 1
         finally:
@@ -990,6 +1055,11 @@ class ServingPool:
             t = threading.Timer(delay, self._requeue, args=(req,))
             t.daemon = True
             self._retry_timers[req] = t
+            # retry scheduling also grows the effective depth — sample
+            # the peak here too or a failure burst under-reports it
+            depth = len(self._queue) + len(self._retry_timers)
+            if depth > self._queue_peak:
+                self._queue_peak = depth
             t.start()
 
     def _requeue(self, req):
@@ -1147,6 +1217,17 @@ class ServingPool:
         for slot in self._slots:
             if slot.thread is not None:
                 slot.thread.join(timeout=0.5)
+        if self._metrics is not None:
+            # the collector dies with the pool (a scrape of a shut-down
+            # pool would report a conservation law still in flux); the
+            # process-level latency histograms keep their history.
+            # fn= makes it conditional: if a later same-named pool
+            # replaced our registration, its collector survives us
+            self._metrics.unregister_collector(
+                f"serving.pool.{self.name}", self.stats)
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.stop()
         self._drained = drained
         return drained
 
@@ -1169,6 +1250,42 @@ class ServingPool:
         return False
 
     # -- observability -----------------------------------------------------
+    def serve_metrics(self, port=0, host="127.0.0.1"):
+        """Start (or return) the opt-in background HTTP exporter over
+        this pool's metrics registry: ``GET /metrics`` (Prometheus
+        text), ``/metrics.json`` (nested snapshot), and ``/healthz``
+        (200 while at least one member is healthy and the pool accepts
+        admissions, else 503). Binds an ephemeral port by default
+        (`server.port` / `server.url`); `shutdown()` stops it. Requires
+        a registry (pools built with ``metrics=False`` have none)."""
+        if self._metrics is None:
+            raise RuntimeError(
+                "pool was built with metrics=False — no registry to "
+                "serve; construct with metrics=None (default) or a "
+                "MetricsRegistry")
+        from ..obs.http import MetricsServer
+
+        def _healthz():
+            s = self.stats()
+            ok = s["healthy"] > 0 and not s["closed"]
+            return ok, {"pool": self.name, "healthy": s["healthy"],
+                        "size": s["size"], "closed": s["closed"]}
+
+        # atomic check-and-create: serializes concurrent serve_metrics
+        # calls (no leaked second server) and linearizes against
+        # shutdown's _closed flip — a server created here is always seen
+        # by shutdown's cleanup. The bind is local + fast; start() takes
+        # only obs.http, which never takes pool locks (no cycle).
+        with self._lock:
+            if self._closed:
+                raise PoolClosed("cannot serve metrics from a shut-down "
+                                 "pool")
+            if self._metrics_server is None:
+                self._metrics_server = MetricsServer(
+                    self._metrics, host=host, port=port,
+                    healthz=_healthz).start()
+            return self._metrics_server
+
     def load(self):
         """Cheap routing signal: queued + retry-pending + in-flight
         request count (a formed batch counts each batchmate). The
@@ -1211,6 +1328,7 @@ class ServingPool:
             healthy = sum(1 for m in members
                           if m["alive"] and m["breaker"] == "closed")
             snap = {
+                "name": self.name,
                 "size": len(self._slots),
                 "healthy": healthy,
                 "closed": self._closed,
@@ -1227,6 +1345,7 @@ class ServingPool:
                 "rebases": self._rebases,
                 "breaker_trips": sum(s.breaker.trips for s in self._slots),
                 "queue_depth": len(self._queue) + len(self._retry_timers),
+                "queue_depth_peak": self._queue_peak,
                 "in_flight": sum(m["in_flight"] for m in members),
                 "members": members,
             }

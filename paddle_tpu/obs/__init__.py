@@ -1,0 +1,40 @@
+"""paddle_tpu.obs — always-on production telemetry.
+
+One low-overhead surface over every subsystem's counters (ROADMAP open
+item 5): a process-wide metrics registry (`Counter` / `Gauge` /
+`Histogram` with fixed log-spaced buckets → p50/p95/p99 without
+per-sample storage), exporters (`snapshot()` nested JSON,
+`prometheus_text()` exposition, the opt-in `MetricsServer` HTTP
+endpoint with ``/metrics`` + ``/healthz``), and the SLO regression gate
+(`obs.slo` + ``SLO_BASELINE.json`` + ``BENCH_SLO=1 python bench.py``).
+
+Instrumented out of the box (each registers its existing `stats()` dict
+as a collector — single source of truth, no duplicated bookkeeping):
+
+* `inference.ServingPool` — request/queue-wait/execute latency
+  histograms, batch occupancy + flush reasons, member health
+  (``metrics=False`` disables; ``pool.serve_metrics(port=0)`` exports);
+* `inference.ServingRouter` — per-replica health, failovers, swap
+  generations (``router.serve_metrics(...)``);
+* `inference.DecodeEngine` — occupancy, fragmentation, TTFT histogram;
+* `distributed` Engine — dispatch/device_put/step counts;
+* `profiler` — `Profiler.summary()` publishes steps/sec;
+  `profiled_span(name, histogram=...)` feeds any span into a latency
+  histogram even when no native tracer is recording.
+
+See docs/observability.md for the full API, knobs, and the SLO ratchet
+workflow; tools/metrics_dump.py scrapes/dumps from the command line.
+"""
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, default_latency_buckets,
+    registry,
+)
+from .export import render_json, render_prometheus  # noqa: F401
+from .http import MetricsServer  # noqa: F401
+from . import slo  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_latency_buckets", "registry", "render_json",
+    "render_prometheus", "MetricsServer", "slo",
+]

@@ -1,0 +1,166 @@
+"""paddle_tpu.obs.export — snapshot serialization: JSON + Prometheus text.
+
+Both exporters are PURE functions over `MetricsRegistry.snapshot()`
+output — they hold no locks and touch no live objects, so the HTTP
+exporter thread (obs.http) serializes entirely lock-free.
+
+Prometheus exposition (text format 0.0.4):
+
+* metric families render with ``# TYPE`` (and ``# HELP`` when set);
+  histograms emit the standard ``_bucket{le=...}`` / ``_sum`` /
+  ``_count`` triplet with cumulative counts;
+* collector dicts (the bridged ``stats()`` snapshots) flatten to
+  untyped samples: nested keys join with ``_``, lists of dicts become
+  an ``idx`` label, numeric and bool leaves emit, strings and None are
+  JSON-only;
+* ordering is deterministic (sorted names, sorted label sets, sorted
+  flattened keys) so golden tests can pin the byte output.
+"""
+from __future__ import annotations
+
+import json
+import math
+import numbers
+import re
+
+__all__ = ["render_json", "render_prometheus", "sanitize_name",
+           "escape_label_value"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_name(name):
+    """Prometheus metric name: [a-zA-Z_][a-zA-Z0-9_]*."""
+    s = _NAME_RE.sub("_", str(name))
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def escape_label_value(v):
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_text(labels, extra=None):
+    items = dict(labels or {})
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{sanitize_name(k)}="{escape_label_value(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if not math.isfinite(f):
+        # Prometheus text-format literals — one inf/NaN value must not
+        # turn the whole scrape into a 500
+        return "NaN" if math.isnan(f) else ("+Inf" if f > 0 else "-Inf")
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _json_default(o):
+    for cast in (int, float):
+        try:
+            return cast(o)
+        except (TypeError, ValueError):
+            continue
+    return str(o)
+
+
+def render_json(snapshot, indent=None):
+    """Deterministic JSON of a registry snapshot (numpy scalars and other
+    odd leaves inside collector dicts degrade to numbers or strings)."""
+    return json.dumps(snapshot, sort_keys=True, indent=indent,
+                      default=_json_default)
+
+
+def _numeric(value):
+    """A plain number for any real-numeric leaf (int/float/bool and
+    numpy scalars, which are numbers.Real but not int/float), else
+    None. Strings never qualify — they stay JSON-only."""
+    if isinstance(value, bool):
+        return 1 if value else 0
+    if isinstance(value, numbers.Real):
+        return float(value) if value % 1 else int(value)
+    return None
+
+
+def _flatten(prefix, value, out):
+    """Collector-dict flattening: dotted/nested keys -> one sorted list
+    of (name, labels-dict-or-None, numeric-value)."""
+    num = _numeric(value)
+    if num is not None:
+        out.append((prefix, None, num))
+    elif isinstance(value, dict):
+        for k in sorted(value, key=str):
+            _flatten(f"{prefix}_{sanitize_name(k)}", value[k], out)
+    elif isinstance(value, (list, tuple)):
+        for i, item in enumerate(value):
+            if isinstance(item, dict):
+                sub = []
+                _flatten(prefix, item, sub)
+                for name, lbl, v in sub:
+                    merged = {"idx": i}
+                    if lbl:
+                        merged.update(lbl)
+                    out.append((name, merged, v))
+            else:
+                num = _numeric(item)
+                if num is not None:
+                    out.append((prefix, {"idx": i}, num))
+        # non-numeric list items are JSON-only
+    # str / None / everything else: JSON-only
+
+
+def render_prometheus(snapshot):
+    """Render `MetricsRegistry.snapshot()` as Prometheus text."""
+    lines = []
+    for name in sorted(snapshot.get("metrics", {})):
+        children = snapshot["metrics"][name]
+        pname = sanitize_name(name)
+        kind = children[0]["kind"]
+        helps = [c.get("help") for c in children if c.get("help")]
+        if helps:
+            lines.append(f"# HELP {pname} "
+                         f"{escape_label_value(helps[0])}")
+        lines.append(f"# TYPE {pname} "
+                     f"{'histogram' if kind == 'histogram' else kind}")
+        for c in sorted(children,
+                        key=lambda c: sorted(c["labels"].items())):
+            labels = c["labels"]
+            if kind == "histogram":
+                for le, cum in c["buckets"]:
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_labels_text(labels, {'le': _fmt(le) if le != '+Inf' else '+Inf'})}"
+                        f" {_fmt(cum)}")
+                lines.append(f"{pname}_sum{_labels_text(labels)} "
+                             f"{_fmt(c['sum'])}")
+                lines.append(f"{pname}_count{_labels_text(labels)} "
+                             f"{_fmt(c['count'])}")
+            else:
+                v = c.get("value")
+                if v is None:
+                    continue  # broken gauge callback: JSON carries the error
+                lines.append(f"{pname}{_labels_text(labels)} {_fmt(v)}")
+    for cname in sorted(snapshot.get("collectors", {})):
+        stats = snapshot["collectors"][cname]
+        if not isinstance(stats, dict):
+            continue
+        flat = []
+        _flatten(sanitize_name(cname), stats, flat)
+        if not flat:
+            continue
+        lines.append(f"# collector {cname}")
+        for name, lbl, v in sorted(
+                flat, key=lambda t: (t[0], sorted((t[1] or {}).items()))):
+            lines.append(f"{name}{_labels_text(None, lbl)} {_fmt(v)}")
+    return "\n".join(lines) + "\n"

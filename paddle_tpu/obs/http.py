@@ -1,0 +1,148 @@
+"""paddle_tpu.obs.http — opt-in background HTTP metrics endpoint.
+
+`MetricsServer` binds a threaded HTTP server (ephemeral port by default)
+on a daemon thread and serves:
+
+* ``GET /metrics``       — Prometheus text exposition;
+* ``GET /metrics.json``  — the nested-JSON registry snapshot;
+* ``GET /healthz``       — 200 / 503 from the attached health callable
+  (``ServingPool.serve_metrics`` wires pool health in; default: always
+  healthy) with a small JSON detail body.
+
+Lock discipline (proven by tools/serving_fault_injector.py under
+``PADDLE_TPU_LOCKCHECK=1``): the ``obs.http`` named lock guards ONLY
+start/stop state. A request handler thread holds no lock at all —
+`MetricsRegistry.snapshot()` copies references under ``obs.registry``
+and the collector callbacks + serialization run lock-free — so a slow
+scrape can never stall (or deadlock against) the serving hot path.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..analysis import locks as _locks
+from .export import render_json, render_prometheus
+from .metrics import registry as _default_registry
+
+__all__ = ["MetricsServer"]
+
+
+class MetricsServer:
+    """Background exporter over one registry.
+
+        server = MetricsServer(registry, port=0).start()
+        ... scrape server.url + "/metrics" ...
+        server.stop()                     # shutdown joins the thread
+
+    `healthz` is an optional callable returning ``(ok: bool, detail:
+    dict)``; it runs on the request thread (it may take its owner's
+    locks — the handler holds none)."""
+
+    def __init__(self, registry=None, *, host="127.0.0.1", port=0,
+                 healthz=None):
+        self.registry = registry if registry is not None \
+            else _default_registry()
+        self._host = host
+        self._want_port = int(port)
+        self._healthz = healthz
+        self._lock = _locks.new_lock("obs.http")
+        self._server = None
+        self._thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._server is not None:
+                return self
+            server = ThreadingHTTPServer((self._host, self._want_port),
+                                         _make_handler(self))
+            server.daemon_threads = True
+            self._server = server
+            self._thread = threading.Thread(
+                target=server.serve_forever, name="obs-metrics-http",
+                kwargs={"poll_interval": 0.05}, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Shut the listener down and JOIN the serve thread. Idempotent."""
+        with self._lock:
+            server, thread = self._server, self._thread
+            self._server = self._thread = None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    @property
+    def running(self):
+        with self._lock:
+            return self._server is not None
+
+    @property
+    def port(self):
+        with self._lock:
+            if self._server is None:
+                raise RuntimeError("metrics server is not running")
+            return self._server.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://{self._host}:{self.port}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- request-thread work (no MetricsServer lock held) ------------------
+    def _respond(self, path):
+        """(status, content_type, body-bytes) for one GET."""
+        if path in ("/metrics", "/"):
+            body = render_prometheus(self.registry.snapshot())
+            return 200, "text/plain; version=0.0.4; charset=utf-8", \
+                body.encode()
+        if path in ("/metrics.json", "/snapshot"):
+            return 200, "application/json", \
+                render_json(self.registry.snapshot(), indent=1).encode()
+        if path == "/healthz":
+            ok, detail = True, {}
+            if self._healthz is not None:
+                try:
+                    ok, detail = self._healthz()
+                except Exception as e:  # tpu-lint: disable=TL007 — a
+                    # broken health probe IS unhealth, not a 500
+                    ok, detail = False, {"error":
+                                         f"{type(e).__name__}: {e}"}
+            body = json.dumps({"ok": bool(ok), **(detail or {})},
+                              default=str).encode()
+            return (200 if ok else 503), "application/json", body
+        return 404, "text/plain; charset=utf-8", b"not found\n"
+
+
+def _make_handler(server: MetricsServer):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            try:
+                status, ctype, body = server._respond(
+                    self.path.split("?", 1)[0])
+            except Exception as e:  # tpu-lint: disable=TL007 — a broken
+                # snapshot must surface as a 500, not kill the listener
+                status, ctype = 500, "text/plain; charset=utf-8"
+                body = f"{type(e).__name__}: {e}\n".encode()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass  # scrapes must not spam the serving process's stderr
+
+    return Handler

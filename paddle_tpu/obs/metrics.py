@@ -1,0 +1,364 @@
+"""paddle_tpu.obs.metrics — metric primitives + process-wide registry.
+
+The framework's production pieces each kept private counters
+(`ServingPool.stats()`, `ServingRouter.stats()`, `DecodeEngine.stats()`,
+`engine.stats` dispatch counts...). This module is the ONE surface an
+operator — or the bench SLO ratchet — watches:
+
+* **`Counter` / `Gauge` / `Histogram`** — standalone metric objects. The
+  histogram uses FIXED log-spaced buckets, so p50/p95/p99 come from ~30
+  ints (interpolated within the crossing bucket) with no per-sample
+  storage and no allocation on the observe path.
+
+* **Hot-path discipline** — `Counter.inc()` / `Histogram.observe()` are
+  a dict-free int add (plus one `bisect` for the histogram): NO lock is
+  taken. Under CPython's GIL a preempted read-modify-write can in theory
+  drop an increment under extreme contention; that is an accepted
+  telemetry tolerance. Exact invariants — the serving conservation laws
+  — are published through **collector callbacks** over the owning
+  subsystem's own lock-guarded counters (`register_collector(name,
+  pool.stats)`), so the registry never duplicates bookkeeping and never
+  de-syncs from the numbers the fault harnesses already assert.
+
+* **`MetricsRegistry`** — get-or-create metric families (name + labels)
+  plus the collector table. Its named lock (``obs.registry``) is held
+  only to copy references during `snapshot()` — collector callbacks and
+  serialization run OUTSIDE it, so a scrape can never nest
+  ``obs.registry`` inside ``serving.pool`` (or vice versa) and the
+  lockcheck acquisition-order graph stays cycle-free.
+
+* **`registry()`** — the process-wide default instance every
+  instrumented subsystem registers into unless handed a private one
+  (`ServingPool(metrics=...)`); exporters (obs.export / obs.http) read
+  from whichever registry they are given.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import weakref
+
+from ..analysis import locks as _locks
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "default_latency_buckets",
+]
+
+
+def default_latency_buckets(lo=1e-4, hi=100.0, per_decade=5):
+    """Fixed log-spaced histogram bounds (seconds): `per_decade` buckets
+    per factor of 10 spanning [lo, hi] — 31 bounds at the defaults.
+    Adjacent bounds differ by ~1.58x, so an interpolated quantile is
+    within that ratio of the truth at any traffic shape."""
+    n = int(round(math.log10(float(hi) / float(lo)) * per_decade))
+    return tuple(float(lo) * (10.0 ** (i / float(per_decade)))
+                 for i in range(n + 1))
+
+
+def _label_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    __slots__ = ("name", "help", "labels")
+
+    def __init__(self, name, help="", labels=None):
+        self.name = str(name)
+        self.help = str(help)
+        self.labels = dict(labels) if labels else {}
+
+
+class Counter(_Metric):
+    """Monotonic event count. `inc()` is ONE unlocked int add (see the
+    module docstring for the GIL tolerance contract)."""
+
+    kind = "counter"
+    __slots__ = ("_value",)
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help=help, labels=labels)
+        self._value = 0
+
+    def inc(self, n=1):
+        self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return {"value": self._value}
+
+
+class Gauge(_Metric):
+    """Point-in-time value: `set()` a number, or `set_function()` a
+    callable resolved at snapshot time (a zero-bookkeeping bridge for
+    values some other object already tracks)."""
+
+    kind = "gauge"
+    __slots__ = ("_value", "_fn")
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help=help, labels=labels)
+        self._value = 0.0
+        self._fn = None
+
+    def set(self, v):
+        self._value = float(v)
+
+    def inc(self, n=1):
+        self._value += n
+
+    def dec(self, n=1):
+        self._value -= n
+
+    def set_function(self, fn):
+        self._fn = fn
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            return float(self._fn())
+        return self._value
+
+    def snapshot(self):
+        try:
+            return {"value": self.value}
+        except Exception as e:  # a broken gauge callback must not break
+            return {"value": None,  # the whole scrape
+                    "error": f"{type(e).__name__}: {e}"}
+
+
+class Histogram(_Metric):
+    """Distribution over fixed log-spaced buckets. `observe(v)` is one
+    `bisect` over the precomputed bounds plus three unlocked adds —
+    nothing is allocated and no sample is stored, so p50/p95/p99 cost
+    O(buckets) at SNAPSHOT time and ~nothing at observe time.
+
+    Quantiles interpolate linearly within the bucket where the
+    cumulative count crosses q*total; observations beyond the last bound
+    report that bound (the overflow bucket has no upper edge)."""
+
+    kind = "histogram"
+    __slots__ = ("bounds", "_counts", "_sum", "_count")
+
+    def __init__(self, name, help="", labels=None, bounds=None):
+        super().__init__(name, help=help, labels=labels)
+        bs = tuple(sorted(float(b) for b in
+                          (bounds if bounds is not None
+                           else default_latency_buckets())))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bs
+        self._counts = [0] * (len(bs) + 1)  # [-1] = overflow (+Inf)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        v = float(v)
+        self._counts[bisect.bisect_left(self.bounds, v)] += 1
+        self._sum += v
+        self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def counts(self):
+        """Copy of the per-bucket counts (last entry = overflow). With
+        `quantile(q, counts=...)` this supports windowed quantiles: diff
+        two counts() snapshots and quantile the delta (the SLO bench
+        excludes its warm-up this way)."""
+        return list(self._counts)
+
+    def quantile(self, q, counts=None):
+        """Interpolated q-quantile (q in [0, 1]) from bucket counts."""
+        counts = list(self._counts) if counts is None else counts
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if c and cum >= target:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]   # overflow: no upper edge
+                lo = self.bounds[i - 1] if i else 0.0
+                frac = (target - (cum - c)) / c
+                return lo + frac * (self.bounds[i] - lo)
+        return self.bounds[-1]
+
+    def snapshot(self):
+        # copy counts ONCE so count/sum/quantiles describe one instant
+        # even while observers keep adding
+        counts = list(self._counts)
+        total = sum(counts)
+        cum, buckets = 0, []
+        for i, b in enumerate(self.bounds):
+            cum += counts[i]
+            buckets.append([b, cum])
+        buckets.append(["+Inf", total])
+        return {
+            "count": total,
+            "sum": self._sum,
+            "avg": (self._sum / total) if total else 0.0,
+            "p50": self.quantile(0.50, counts),
+            "p95": self.quantile(0.95, counts),
+            "p99": self.quantile(0.99, counts),
+            "buckets": buckets,
+        }
+
+
+_METRIC_KINDS = {Counter.kind: Counter, Gauge.kind: Gauge,
+                 Histogram.kind: Histogram}
+
+
+class MetricsRegistry:
+    """Process-wide (or private) metric table: get-or-create families by
+    (name, labels), plus collector callbacks bridging existing `stats()`
+    dicts in — single source of truth, zero duplicated bookkeeping.
+
+    Thread-safety: the ``obs.registry`` named lock guards only the
+    tables. `snapshot()` copies references under it and then calls every
+    collector and serializes WITHOUT it, so collector callbacks are free
+    to take their owners' locks (serving.pool / router.core / ...)."""
+
+    def __init__(self):
+        self._lock = _locks.new_lock("obs.registry")
+        self._metrics = {}     # (name, label_key) -> metric
+        self._kinds = {}       # name -> metric class (family-wide)
+        self._collectors = {}  # name -> callable | weakref.WeakMethod
+
+    # -- metric families ---------------------------------------------------
+    def _get(self, cls, name, help, labels, **kw):
+        name = str(name)
+        key = (name, _label_key(labels))
+        with self._lock:
+            # kind is a FAMILY property (checked across every label
+            # set): one name holding mixed kinds would make the
+            # Prometheus exposition unrenderable
+            known = self._kinds.get(name)
+            if known is not None and known is not cls:
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{known.kind}, requested {cls.kind}")
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help=help, labels=labels, **kw)
+                self._metrics[key] = m
+                self._kinds[name] = cls
+            return m
+
+    def counter(self, name, help="", labels=None):
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=None):
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=None, bounds=None):
+        h = self._get(Histogram, name, help, labels, bounds=bounds)
+        if bounds is not None:
+            want = tuple(sorted(float(b) for b in bounds))
+            if h.bounds != want:
+                raise ValueError(
+                    f"histogram {name!r} already exists with bounds "
+                    f"{h.bounds} — conflicting bounds {want} requested "
+                    f"(observations would land in buckets the caller "
+                    f"never asked for)")
+        return h
+
+    # -- collectors --------------------------------------------------------
+    def register_collector(self, name, fn):
+        """Attach a stats-snapshot callable under `name`; its dict rides
+        in `snapshot()["collectors"][name]` and is flattened into the
+        Prometheus exposition. Bound methods are held WEAKLY (a pool
+        that is garbage-collected without shutdown() un-registers
+        itself); a collector returning None is pruned the same way.
+        Re-registering a name replaces the previous collector."""
+        if hasattr(fn, "__self__"):
+            fn = weakref.WeakMethod(fn)
+        with self._lock:
+            self._collectors[name] = fn
+
+    def unregister_collector(self, name, fn=None):
+        """Remove the collector under `name`. Pass the SAME callable that
+        was registered to make the removal conditional: if a later
+        registration replaced this one (two same-named owners — last
+        writer wins), the survivor's collector is left alone instead of
+        being torn down by the loser's shutdown."""
+        with self._lock:
+            if fn is None:
+                self._collectors.pop(name, None)
+                return
+            cur = self._collectors.get(name)
+            live = cur() if isinstance(cur, weakref.WeakMethod) else cur
+            if live is None or live == fn:
+                self._collectors.pop(name, None)
+
+    def collector_names(self):
+        with self._lock:
+            return sorted(self._collectors)
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self):
+        """Nested-JSON view: ``{"metrics": {name: [{labels, kind, ...}]},
+        "collectors": {name: stats-dict}}``. Deterministic ordering
+        (sorted names / label sets); collectors run OUTSIDE the registry
+        lock."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+            collectors = list(self._collectors.items())
+        out_m = {}
+        for (name, _), m in metrics:
+            out_m.setdefault(name, []).append(
+                {"kind": m.kind, "labels": dict(m.labels),
+                 "help": m.help, **m.snapshot()})
+        out_c = {}
+        dead = []
+        for name, fn in collectors:
+            f = fn() if isinstance(fn, weakref.WeakMethod) else fn
+            if f is None:
+                dead.append((name, fn))
+                continue
+            try:
+                stats = f()
+            except Exception as e:  # tpu-lint: disable=TL007 — a broken
+                # stats() must not break every OTHER subsystem's scrape
+                out_c[name] = {"_collector_error":
+                               f"{type(e).__name__}: {e}"}
+                continue
+            if stats is None:
+                dead.append((name, fn))
+                continue
+            out_c[name] = stats
+        if dead:
+            with self._lock:
+                for name, fn in dead:
+                    if self._collectors.get(name) is fn:
+                        del self._collectors[name]
+        return {"metrics": out_m, "collectors": out_c}
+
+    def prometheus_text(self):
+        """Text exposition (format 0.0.4) of `snapshot()`."""
+        from .export import render_prometheus
+
+        return render_prometheus(self.snapshot())
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def registry():
+    """The process-wide default registry. Constructed at first import of
+    paddle_tpu.obs — i.e. lazily, when the first instrumented subsystem
+    comes up — so a PADDLE_TPU_LOCKCHECK=1 harness observes its named
+    lock like any other framework lock."""
+    return _DEFAULT

@@ -43,18 +43,50 @@ def host_recording():
     return _cpu_recording
 
 
-def profiled_span(name):
+def profiled_span(name, histogram=None):
     """RecordEvent span when a host profiler is actively recording, else
     a zero-cost no-op context. The shared gate for hot-path
     instrumentation (the distributed engine's dispatch spans, the serving
     batcher's form/pad/dispatch/scatter spans): outside a record window
     the native tracer is never touched, so unprofiled runs pay nothing
-    — not even the tracer's first-use build."""
+    — not even the tracer's first-use build.
+
+    `histogram=` (a `paddle_tpu.obs` Histogram) additionally times the
+    span with `time.perf_counter` and observes the duration on EVERY
+    pass, whether or not a tracer is recording — one span site feeds
+    both the chrome trace (profiling sessions) and the always-on latency
+    histogram (production telemetry)."""
+    if histogram is not None:
+        return _TimedSpan(name, histogram)
     if _cpu_recording:
         return RecordEvent(name)
     from contextlib import nullcontext
 
     return nullcontext()
+
+
+class _TimedSpan:
+    """profiled_span(..., histogram=...): always-on timing feeding an obs
+    histogram, plus the native RecordEvent while a profiler records."""
+
+    __slots__ = ("name", "histogram", "_ev", "_t0")
+
+    def __init__(self, name, histogram):
+        self.name = name
+        self.histogram = histogram
+
+    def __enter__(self):
+        self._ev = RecordEvent(self.name) if _cpu_recording else None
+        if self._ev is not None:
+            self._ev.begin()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.histogram.observe(time.perf_counter() - self._t0)
+        if self._ev is not None:
+            self._ev.end()
+        return False
 
 from ..native import build_and_load
 
@@ -330,9 +362,20 @@ class Profiler:
                          f"{total / calls:>9.3f} {mx:>9.3f}")
         if self._step_times:
             ts = self._step_times
+            sps = len(ts) / sum(ts)
             lines.append(
                 f"steps: {len(ts)}  avg {sum(ts) / len(ts) * 1e3:.2f} ms"
-                f"  steps/sec {len(ts) / sum(ts):.2f}")
+                f"  steps/sec {sps:.2f}")
+            # publish into the process metrics registry: the profiler's
+            # measured steps/sec is THE training-throughput gauge the
+            # obs exporters (and the SLO gate) read — single source of
+            # truth with the printed summary
+            from ..obs.metrics import registry as _obs_registry
+
+            _obs_registry().gauge(
+                "profiler.steps_per_sec",
+                help="steps/sec over the profiler's last step window"
+            ).set(sps)
         out = "\n".join(lines)
         print(out)
         return out

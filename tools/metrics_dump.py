@@ -1,0 +1,97 @@
+"""Dump paddle_tpu telemetry: scrape a live endpoint or snapshot a
+registry.
+
+Two modes (docs/observability.md):
+
+* **Scrape** — ``--url http://host:port`` hits a running exporter
+  (`ServingPool.serve_metrics()` / `ServingRouter.serve_metrics()` /
+  `obs.MetricsServer`): ``--format prom`` fetches ``/metrics`` (text
+  exposition), ``--format json`` fetches ``/metrics.json`` (nested
+  snapshot). A URL already ending in a path is fetched verbatim.
+
+* **In-process** — no ``--url``: import the modules named by
+  ``--import`` (they are expected to register metrics/collectors into
+  the process default registry as a side effect — e.g. a module that
+  builds a pool), then dump that registry in the requested format.
+
+Exit codes: 0 on success, 1 on scrape/import failure, 2 on usage error.
+
+    python tools/metrics_dump.py --url http://127.0.0.1:9090
+    python tools/metrics_dump.py --url http://127.0.0.1:9090 --format json
+    python tools/metrics_dump.py --import myapp.serving --format prom
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _scrape(url, fmt, timeout):
+    import urllib.parse
+
+    if "//" not in url:
+        url = "http://" + url
+    # a bare host:port gets the conventional path for the format; an
+    # explicit path is the operator's business
+    if urllib.parse.urlparse(url).path in ("", "/"):
+        url = url.rstrip("/") + ("/metrics.json" if fmt == "json"
+                                 else "/metrics")
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", default=None,
+                    help="live exporter to scrape (host:port base or a "
+                         "full path); omit to snapshot this process's "
+                         "default registry")
+    ap.add_argument("--format", default="prom", choices=("prom", "json"),
+                    dest="fmt", help="output format (default: prom)")
+    ap.add_argument("--import", action="append", default=[],
+                    dest="imports", metavar="MODULE",
+                    help="module(s) to import before an in-process dump "
+                         "(their side effects populate the registry)")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="scrape timeout in seconds (default: 5)")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        try:
+            sys.stdout.write(_scrape(args.url, args.fmt, args.timeout))
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"metrics_dump: scrape of {args.url!r} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    import importlib
+
+    for mod in args.imports:
+        try:
+            importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"metrics_dump: import of {mod!r} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+    from paddle_tpu.obs import registry, render_json, render_prometheus
+
+    snap = registry().snapshot()
+    if args.fmt == "json":
+        sys.stdout.write(render_json(snap, indent=1) + "\n")
+    else:
+        sys.stdout.write(render_prometheus(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -854,6 +854,35 @@ def main(argv=None):
         # clean when the harness runs in CI)
         os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE",
                               os.path.join(workdir, "compile-cache"))
+        # Always-on telemetry rides along (paddle_tpu.obs): every pool /
+        # engine / router below registers into the process registry, and
+        # a live HTTP exporter is scraped CONCURRENTLY with the fault
+        # phases — so the obs.registry / obs.http lock discipline (no
+        # cycles, nothing held across serialization or dispatch) is
+        # proven under the same lockcheck run as the serving stack.
+        import urllib.request
+
+        from paddle_tpu.obs import MetricsServer
+
+        mserver = MetricsServer().start()
+        scrape_stop = threading.Event()
+        scrape_errors: list = []
+        scrapes = [0]
+
+        def _scrape_loop():
+            while not scrape_stop.wait(0.1):
+                try:
+                    urllib.request.urlopen(
+                        mserver.url + "/metrics", timeout=2).read()
+                    scrapes[0] += 1
+                except Exception as e:  # noqa: BLE001 — verdict-reported
+                    scrape_errors.append(
+                        f"concurrent scrape failed: "
+                        f"{type(e).__name__}: {e}")
+
+        scraper = threading.Thread(target=_scrape_loop,
+                                   name="obs-scraper", daemon=True)
+        scraper.start()
         path = os.path.join(workdir, "infer")
         serving_phases = [p for p in phases
                           if not p.startswith(("decode-", "router-"))]
@@ -879,6 +908,33 @@ def main(argv=None):
             print("router (distributed serving tier) phases:")
             for phase in router_phases:
                 violations += run_router_phase(phase, rctx)
+
+        # telemetry verdict: the concurrent scraper must have succeeded
+        # throughout, and a final scrape must expose the serving metric
+        # families (the pools' conservation-law counters were live on
+        # the endpoint for the whole run)
+        scrape_stop.set()
+        scraper.join(timeout=2.0)
+        violations += scrape_errors
+        try:
+            final = urllib.request.urlopen(
+                mserver.url + "/metrics", timeout=5).read().decode()
+            hz = urllib.request.urlopen(
+                mserver.url + "/healthz", timeout=5).status
+        except Exception as e:  # noqa: BLE001 — verdict-reported
+            violations.append(f"final metrics scrape failed: "
+                              f"{type(e).__name__}: {e}")
+        else:
+            if hz != 200:
+                violations.append(f"/healthz returned {hz}, expected 200")
+            if serving_phases and "serving_request_seconds" not in final:
+                violations.append(
+                    "final scrape is missing the serving_request_seconds "
+                    "histogram — pool instrumentation never reached the "
+                    "registry")
+            print(f"obs: {scrapes[0]} concurrent scrapes ok; final "
+                  f"exposition {len(final)} bytes")
+        mserver.stop()
 
         if any("hang" in p for p in phases):
             # Wedged members are retired with their threads ABANDONED (by
@@ -906,7 +962,14 @@ def main(argv=None):
         # report() is empty and every assertion below would trivially
         # hold — require the serving stack's own named locks to be seen
         expected_locks = {"serving.pool", "serving.request",
-                          "serving.breaker"}
+                          "serving.breaker",
+                          # telemetry: the registry lock (metric
+                          # get-or-create + snapshot bookkeeping) and
+                          # the exporter's start/stop lock, exercised by
+                          # the concurrent scraper above — both must
+                          # stay out of every cycle and never be held
+                          # across dispatch/serialization
+                          "obs.registry", "obs.http"}
         if any(p.startswith("decode-") for p in phases):
             # the decode engine's own named locks must have been observed
             # (and the 0-cycles / 0-held-across-dispatch assertions below
