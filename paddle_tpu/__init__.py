@@ -90,7 +90,8 @@ def synchronize():
 
 _LAZY_SUBMODULES = ("profiler", "metric", "vision", "hapi", "distribution",
                     "sparse", "quantization", "fft", "signal", "linalg",
-                    "inference", "text", "audio", "onnx", "static", "obs")
+                    "inference", "text", "audio", "onnx", "static", "obs",
+                    "sharding")
 
 
 def __getattr__(name):

@@ -43,6 +43,11 @@ Rule catalogue (docs/static_analysis.md has one bad/good example each):
          jump backwards or fire early/late — use `time.monotonic()`;
          suppress where wall-clock time IS the point (manifest
          timestamps, user-facing dates)
+  TL011  raw `NamedSharding(`/`PartitionSpec(` construction outside
+         `paddle_tpu/sharding/` — placement has ONE authority (the
+         sharding subsystem's factories/rule table); hand-built
+         shardings drift out of agreement with it. Legacy sites are
+         frozen in the baseline and burn down instead of growing
 
 Suppressions: append ``# tpu-lint: disable=TL001`` (comma-separate for
 several, or ``disable=all``) to the offending line (for ``except``
@@ -90,7 +95,13 @@ RULES = {
              "(concretization/retrace hazard)",
     "TL010": "wall-clock time.time() for deadline/interval math (NTP "
              "step-fragile; use time.monotonic())",
+    "TL011": "raw NamedSharding/PartitionSpec construction outside "
+             "paddle_tpu/sharding (use the sharding factories/rule "
+             "table)",
 }
+
+#: files allowed to construct shardings directly (the authority itself)
+_SHARDING_AUTHORITY = "paddle_tpu/sharding/"
 
 # Decorators / higher-order callers that put the wrapped function under a
 # JAX trace. Matched on the trailing dotted components, so `jax.jit`,
@@ -715,6 +726,58 @@ def _wallclock_findings(path, tree, suppress, findings, wall_aliases=None,
                     "<module>"))
 
 
+_SHARDING_CTORS = {"NamedSharding", "PartitionSpec"}
+
+
+def _sharding_ctor_findings(path, tree, suppress, findings):
+    """TL011 over the whole module: calls that construct
+    jax.sharding.{NamedSharding, PartitionSpec} directly. Matches the
+    from-import (with as-alias, e.g. ``PartitionSpec as P``), the module
+    path (``jax.sharding.NamedSharding``) and module aliases
+    (``import jax.sharding as jsh``). Files under `paddle_tpu/sharding/`
+    are the authority and exempt (handled by the caller)."""
+    local = {}     # local callable name -> ctor name
+    mod_alias = {}  # alias -> "jax.sharding"
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax.sharding":
+                for a in node.names:
+                    if a.name in _SHARDING_CTORS:
+                        local[a.asname or a.name] = a.name
+            elif node.module == "jax":
+                # `from jax import sharding [as jsh]` — call sites reach
+                # the ctors through the module name
+                for a in node.names:
+                    if a.name == "sharding":
+                        mod_alias[a.asname or a.name] = "jax.sharding"
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.sharding" and a.asname:
+                    mod_alias[a.asname] = "jax.sharding"
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if not callee:
+            continue
+        ctor = local.get(callee)
+        if ctor is None and "." in callee:
+            head, _, rest = callee.partition(".")
+            resolved = f"{mod_alias.get(head, head)}.{rest}" \
+                if head in mod_alias else callee
+            if resolved.startswith("jax.sharding.") and \
+                    resolved.rsplit(".", 1)[-1] in _SHARDING_CTORS:
+                ctor = resolved.rsplit(".", 1)[-1]
+        if ctor is None:
+            continue
+        if _suppressed(suppress, "TL011", node.lineno):
+            continue
+        findings.append(Finding(
+            "TL011", path, node.lineno, node.col_offset, "<module>",
+            f"raw `{ctor}(...)` — resolve placement through "
+            f"paddle_tpu.sharding (named_sharding/spec/rule table)"))
+
+
 def _static_spec(keywords):
     """(positions, names) declared static in a jit/partial keyword list."""
     positions, names = set(), set()
@@ -858,6 +921,8 @@ def lint_source(source, path="<string>"):
                         mod_aliases)
     findings.extend(f for f in wall if f.line not in tl001_lines)
     _static_arg_findings(path, tree, suppress, findings)
+    if _SHARDING_AUTHORITY not in path.replace(os.sep, "/"):
+        _sharding_ctor_findings(path, tree, suppress, findings)
     return sorted(findings, key=Finding.sort_key)
 
 

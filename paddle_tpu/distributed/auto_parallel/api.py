@@ -17,8 +17,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from ...compat import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding  # isinstance checks only
 
+from ... import sharding as _shardlib
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 
@@ -207,7 +208,7 @@ def placements_to_spec(mesh, placements, ndim):
             partials[names[mesh_dim]] = pl.reduce_type
         elif not isinstance(pl, (Replicate, type(None))):
             raise TypeError(f"unknown placement {pl!r}")
-    spec = P(*[
+    spec = _shardlib.spec(*[
         None if not e else (e[0] if len(e) == 1 else tuple(e))
         for e in entries])
     return spec, partials
@@ -262,7 +263,7 @@ def shard_tensor(data, mesh, placements, *, dtype=None, stop_gradient=None):
     if dtype is not None:
         from ...core.dtype import convert_dtype
         val = val.astype(convert_dtype(dtype))
-    out = Tensor(jax.device_put(val, NamedSharding(jmesh, spec)))
+    out = Tensor(jax.device_put(val, _shardlib.named_sharding(jmesh, spec)))
     out.stop_gradient = (t.stop_gradient if stop_gradient is None
                          else stop_gradient)
     out.process_mesh = mesh if isinstance(mesh, ProcessMesh) else None
@@ -284,7 +285,8 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     spec, partials = placements_to_spec(mesh, placements, len(probe.shape))
     if partials:
         raise ValueError("dtensor_from_fn cannot produce Partial outputs")
-    val = jax.jit(call, out_shardings=NamedSharding(jmesh, spec))()
+    val = jax.jit(call,
+                  out_shardings=_shardlib.named_sharding(jmesh, spec))()
     out = Tensor(val)
     out.process_mesh = mesh if isinstance(mesh, ProcessMesh) else None
     return out
@@ -307,7 +309,7 @@ def reshard(tensor, mesh, placements):
     resolve = [ax for ax in pending if ax not in target_partials]
     if resolve:
         cur = val.sharding.spec if isinstance(val.sharding, NamedSharding) \
-            else P(*([None] * val.ndim))
+            else _shardlib.spec(*([None] * val.ndim))
 
         def body(v):
             for ax in resolve:
@@ -319,7 +321,7 @@ def reshard(tensor, mesh, placements):
             check_vma=False)(val)
         for ax in resolve:
             pending.pop(ax)
-    val = jax.device_put(val, NamedSharding(jmesh, spec))
+    val = jax.device_put(val, _shardlib.named_sharding(jmesh, spec))
     new_partials = [ax for ax in target_partials if ax not in pending]
     if new_partials:
         # r_to_p: the value survives only on coordinate 0 of each new

@@ -33,7 +33,6 @@ import itertools
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import lazy as _lazy
 from ..core.tensor import Tensor
@@ -45,32 +44,18 @@ from .sharding_spec import (
     DEFAULT_TP_RULES, spec_for_param, opt_state_spec,
 )
 from . import topology as topo_mod
+# placement is resolved by the ONE sharding authority (paddle_tpu.sharding);
+# batch-spec helpers are re-exported under their historic names
+from ..sharding import (
+    batch_spec_for_ndim, default_batch_spec,  # noqa: F401 (re-export)
+    named_sharding as _named_sharding,
+    replicated as _replicated,
+    stacked_batch_spec as _stacked_batch_spec,
+)
 
 
 def _is_float(x):
     return jnp.issubdtype(x.dtype, jnp.floating)
-
-
-def default_batch_spec(mesh):
-    """The engine's default batch layout: dim0 over the fused data axes
-    (dp+sharding — the reference fuses them for grad sync, topology.py:228),
-    dim1 over sep when in use. Shared with prefetch_to_device so standalone
-    placement matches the engine's exactly; tolerates meshes missing axes."""
-    axes = mesh.shape
-    entries = []
-    data = tuple(a for a in ("dp", "sharding") if a in axes)
-    if data:
-        entries.append(data)
-    if axes.get("sep", 1) > 1:
-        entries.append("sep")
-    return P(*entries)
-
-
-def batch_spec_for_ndim(spec, ndim):
-    """Trim/pad a batch PartitionSpec to an array's rank."""
-    entries = list(spec)[:ndim]
-    entries += [None] * (ndim - len(entries))
-    return P(*entries)
 
 
 _prof_mod = None
@@ -161,11 +146,11 @@ class ShardedTrainStep:
         if batch_spec is None:
             batch_spec = default_batch_spec(mesh)
         self.batch_spec = batch_spec
-        self._param_sh = {n: NamedSharding(mesh, s)
+        self._param_sh = {n: _named_sharding(mesh, s)
                           for n, s in self.param_specs.items()}
-        self._state_sh = {n: NamedSharding(mesh, s)
+        self._state_sh = {n: _named_sharding(mesh, s)
                           for n, s in self.state_specs.items()}
-        self._scalar_sh = NamedSharding(mesh, P())
+        self._scalar_sh = _replicated(mesh)
         self._batch_sh_cache = {}   # ndim -> NamedSharding
 
         # ---- place values ---------------------------------------------
@@ -176,7 +161,7 @@ class ShardedTrainStep:
         self.buffer_vals = {}
         self._buf_sh = {}
         for n, b in self._buffers.items():
-            sh = NamedSharding(mesh, P(*([None] * b.ndim)))
+            sh = _replicated(mesh, b.ndim)
             self._buf_sh[n] = sh
             b._value = jax.device_put(b._value, sh)
             self.buffer_vals[n] = b._value
@@ -239,12 +224,25 @@ class ShardedTrainStep:
                  "dispatch (enqueue, not device completion)")
         _obs_registry().register_collector(self._obs_key,
                                            self._obs_collect)
+        # sharding telemetry: mesh shape + per-param shard fractions under
+        # `sharding.train.engineN` (docs/sharding.md); a bound method, so
+        # the registry holds it weakly and prunes with the engine
+        self._sharding_obs_key = f"sharding.{self._obs_key}"
+        _obs_registry().register_collector(self._sharding_obs_key,
+                                           self._sharding_obs_collect)
 
     # ------------------------------------------------------------------
     def _obs_collect(self):
         """Registry collector: the engine's dispatch counters, weakly
         held (see __init__) so a dropped engine un-registers itself."""
         return dict(self.stats)
+
+    def _sharding_obs_collect(self):
+        """`sharding.<name>` collector: mesh shape + per-param shard
+        fractions (weakly held, like _obs_collect)."""
+        from ..sharding import mesh_stats
+
+        return mesh_stats(self.mesh, self.param_specs)
 
     # ------------------------------------------------------------------
     def _cp_guard(self):
@@ -260,7 +258,7 @@ class ShardedTrainStep:
     def _batch_sharding(self, ndim):
         sh = self._batch_sh_cache.get(ndim)
         if sh is None:
-            sh = NamedSharding(self.mesh, self._batch_spec_for(ndim))
+            sh = _named_sharding(self.mesh, self._batch_spec_for(ndim))
             self._batch_sh_cache[ndim] = sh
         return sh
 
@@ -436,8 +434,8 @@ class ShardedTrainStep:
                         step_no)
 
             batch_sh = tuple(
-                NamedSharding(self.mesh, P(
-                    None, *self._batch_spec_for(a.ndim - 1)))
+                _named_sharding(self.mesh,
+                                _stacked_batch_spec(self.batch_spec, a.ndim))
                 for a in batch_avals)
 
         return jax.jit(
@@ -543,9 +541,9 @@ class ShardedTrainStep:
                 nputs = 0
                 for j in range(len(vals[0])):
                     stacked = jnp.stack([bt[j] for bt in vals])
-                    sh = NamedSharding(
+                    sh = _named_sharding(
                         self.mesh,
-                        P(None, *self._batch_spec_for(stacked.ndim - 1)))
+                        _stacked_batch_spec(self.batch_spec, stacked.ndim))
                     placed.append(jax.device_put(stacked, sh))
                     nputs += 1
                 placed = tuple(placed)

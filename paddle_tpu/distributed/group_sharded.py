@@ -16,9 +16,9 @@ engine both see sharded arrays.
 from __future__ import annotations
 
 import jax
-from jax.sharding import NamedSharding
 
 from . import topology as topo_mod
+from ..sharding import named_sharding as _named_sharding
 from .sharding_spec import DEFAULT_TP_RULES, spec_for_param
 
 _LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
@@ -48,7 +48,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     for name, p in model.named_parameters():
         spec = spec_for_param(name, p, DEFAULT_TP_RULES,
                               sharding_stage=stage, mesh=mesh)
-        sh = NamedSharding(mesh, spec)
+        sh = _named_sharding(mesh, spec)
         if offload:
             from ..compat import supports_memory_kind
 

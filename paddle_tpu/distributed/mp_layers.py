@@ -6,39 +6,42 @@ Reference analog: fleet/layers/mpu/mp_layers.py — `VocabParallelEmbedding`
 split-concat comm ops (mpu/mp_ops.py).
 
 TPU-native redesign: each layer stores the FULL logical weight and attaches
-a `dist_spec` (PartitionSpec over the 'mp' mesh axis). When fleet/the engine
-places parameters (sharding_spec.shard_params / device_put), the weight
-physically shards across the mp ring; the forward is ordinary dense math
-plus sharding *constraints* — GSPMD inserts exactly the all-reduce /
-all-gather the reference codes by hand, fused into the surrounding matmuls.
-No special backward is needed: differentiating through a constraint yields
-the dual collective (identity↔psum), the same pairing mp_ops.py implements
+*logical axis names* (`param.logical_axes`, e.g. ("embed", "mlp")). When
+fleet/the engine places parameters (sharding_spec.shard_params /
+device_put), the `paddle_tpu.sharding` rule table resolves those names
+onto whatever mesh is active — "mp" on the hybrid training topology, "tp"
+on a MeshConfig serving mesh — and the weight physically shards across
+that ring; the forward is ordinary dense math plus *logical* sharding
+constraints — GSPMD inserts exactly the all-reduce / all-gather the
+reference codes by hand, fused into the surrounding matmuls. No special
+backward is needed: differentiating through a constraint yields the dual
+collective (identity↔psum), the same pairing mp_ops.py implements
 manually.
 """
 from __future__ import annotations
 
-from jax.sharding import PartitionSpec as P
-
 from .. import nn
 from ..nn import functional as F
-from .. import ops
-from .sharding_spec import shard_constraint
+from ..sharding import with_logical_constraint
 
 
 class ColumnParallelLinear(nn.Layer):
-    """y = x @ W[:, shard] (+b). Weight [in, out] column-sharded over mp."""
+    """y = x @ W[:, shard] (+b). Weight [in, out] column-sharded over the
+    tensor-parallel axis (logical out axis "mlp" by default; pass
+    `logical_axes` to tag attention projections as "heads")."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, gather_output=True, fuse_matmul_bias=False,
-                 mp_group=None, name=None):
+                 mp_group=None, name=None, logical_axes=("embed", "mlp")):
         super().__init__()
         self.linear = nn.Linear(in_features, out_features,
                                 weight_attr=weight_attr,
                                 bias_attr=None if has_bias else False)
-        self.linear.weight.dist_spec = P(None, "mp")
+        self._out_axis = logical_axes[-1]
+        self.linear.weight.logical_axes = tuple(logical_axes)
         self.linear.weight.is_distributed = True
         if self.linear.bias is not None:
-            self.linear.bias.dist_spec = P("mp")
+            self.linear.bias.logical_axes = (self._out_axis,)
             self.linear.bias.is_distributed = True
         self.gather_output = gather_output
 
@@ -51,14 +54,16 @@ class ColumnParallelLinear(nn.Layer):
         return self.linear.bias
 
     def forward(self, x):
-        # replicate input along mp (the reference's _c_identity), compute,
-        # leave output mp-sharded on the feature dim unless gather_output.
+        # replicate input along the tp axis (the reference's _c_identity),
+        # compute, leave output tp-sharded on the feature dim unless
+        # gather_output.
         y = self.linear(x)
         ndim = y.ndim
         if self.gather_output:
-            y = shard_constraint(y, *([None] * ndim))
+            y = with_logical_constraint(y, *([None] * ndim))
         else:
-            y = shard_constraint(y, *([None] * (ndim - 1) + ["mp"]))
+            y = with_logical_constraint(
+                y, *([None] * (ndim - 1)), self._out_axis)
         return y
 
 
@@ -68,12 +73,14 @@ class RowParallelLinear(nn.Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False,
-                 fuse_matmul_bias=False, mp_group=None, name=None):
+                 fuse_matmul_bias=False, mp_group=None, name=None,
+                 logical_axes=("mlp", "embed")):
         super().__init__()
         self.linear = nn.Linear(in_features, out_features,
                                 weight_attr=weight_attr,
                                 bias_attr=None if has_bias else False)
-        self.linear.weight.dist_spec = P("mp", None)
+        self._in_axis = logical_axes[0]
+        self.linear.weight.logical_axes = tuple(logical_axes)
         self.linear.weight.is_distributed = True
         self.input_is_parallel = input_is_parallel
 
@@ -87,22 +94,24 @@ class RowParallelLinear(nn.Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = shard_constraint(x, *([None] * (x.ndim - 1) + ["mp"]))
+            x = with_logical_constraint(
+                x, *([None] * (x.ndim - 1)), self._in_axis)
         y = self.linear(x)
         # contraction over the sharded dim leaves a partial sum; constraining
         # the output replicated forces the psum (reference: mp_allreduce).
-        return shard_constraint(y, *([None] * y.ndim))
+        return with_logical_constraint(y, *([None] * y.ndim))
 
 
 class VocabParallelEmbedding(nn.Layer):
-    """Embedding with the vocab dim sharded over mp (mp_layers.py:47)."""
+    """Embedding with the vocab dim sharded over the tp axis
+    (mp_layers.py:47)."""
 
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
                  mp_group=None, name=None):
         super().__init__()
         self.embedding = nn.Embedding(num_embeddings, embedding_dim,
                                       weight_attr=weight_attr)
-        self.embedding.weight.dist_spec = P("mp", None)
+        self.embedding.weight.logical_axes = ("vocab", "embed")
         self.embedding.weight.is_distributed = True
 
     @property
@@ -111,19 +120,19 @@ class VocabParallelEmbedding(nn.Layer):
 
     def forward(self, x):
         y = self.embedding(x)
-        return shard_constraint(y, *([None] * y.ndim))
+        return with_logical_constraint(y, *([None] * y.ndim))
 
 
 class ParallelCrossEntropy(nn.Layer):
-    """Cross entropy over mp-sharded vocab logits (mp_layers.py:741). The
-    log-sum-exp over the sharded class dim compiles to an mp psum."""
+    """Cross entropy over tp-sharded vocab logits (mp_layers.py:741). The
+    log-sum-exp over the sharded class dim compiles to a tp psum."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        input = shard_constraint(
-            input, *([None] * (input.ndim - 1) + ["mp"]))
+        input = with_logical_constraint(
+            input, *([None] * (input.ndim - 1)), "vocab")
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)

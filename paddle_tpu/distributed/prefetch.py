@@ -14,11 +14,16 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding
 
 from ..core.tensor import Tensor
 from ..io import PrefetchThread
-from .engine import batch_spec_for_ndim, default_batch_spec
+# the batch layout is owned by paddle_tpu.sharding (deduplicated from the
+# engine's former per-ndim helpers) so standalone placement matches the
+# engine's exactly
+from ..sharding import (
+    batch_spec_for_ndim, default_batch_spec,
+    named_sharding as _named_sharding,
+)
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device"]
 
@@ -52,7 +57,7 @@ class DevicePrefetcher:
         elif self._mesh is not None:
             spec = self._spec if self._spec is not None \
                 else default_batch_spec(self._mesh)
-            sh = NamedSharding(self._mesh, batch_spec_for_ndim(spec, ndim))
+            sh = _named_sharding(self._mesh, batch_spec_for_ndim(spec, ndim))
         else:
             sh = None  # default device placement
         self._sh_cache[ndim] = sh

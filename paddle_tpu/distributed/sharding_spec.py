@@ -4,52 +4,111 @@ Reference analog: the SPMD rules + TensorDistAttr machinery
 (paddle/phi/infermeta/spmd_rules/, paddle/phi/core/distributed/auto_parallel/
 dist_attr.h) that annotate every tensor with a placements vector. On TPU the
 propagation engine is GSPMD inside XLA; our job is only to pin the *sources*:
-parameter shardings (by layer type or by name pattern) and batch shardings.
-GSPMD then inserts the collectives the reference's reshard functions
-implement by hand.
-"""
+parameter shardings (by logical-axis annotation, by layer type or by name
+pattern) and batch shardings. GSPMD then inserts the collectives the
+reference's reshard functions implement by hand.
+
+Since the `paddle_tpu.sharding` subsystem landed, resolution is rule-table
+driven: parameters carry *logical* axis names ("embed"/"heads"/"mlp"/
+"vocab", set by mp_layers or by name-pattern rules below) and ONE
+first-match-wins table (`sharding.rules`) maps them onto whatever mesh is
+in use — "tp" on a MeshConfig serving mesh, "mp" on the hybrid training
+topology — so every subsystem agrees on placement. Legacy physical
+`dist_spec` PartitionSpecs are still honored (axes absent from the mesh
+are dropped)."""
 from __future__ import annotations
 
 import re
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import sharding as _shardlib
 from ..core.tensor import Tensor
 
 # Megatron-style tensor-parallel rules for transformer parameter names
 # (matches paddle_tpu.models.gpt naming; users can pass their own table).
-# column-parallel: output dim sharded; row-parallel: input dim sharded;
-# vocab-parallel embedding: row (vocab) dim sharded.
+# Values are LOGICAL axis tuples resolved through the sharding rule table:
+# column-parallel weights shard the output dim ("heads"/"mlp"), row-parallel
+# weights the input dim, vocab-parallel embeddings the vocab dim. Legacy
+# tables whose values are PartitionSpecs keep working (treated as physical).
 DEFAULT_TP_RULES = [
-    (r".*\b(qkv_proj|gate_up_proj|up_proj|q_proj|k_proj|v_proj|gate_proj|fc1)\.weight$", P(None, "mp")),
-    (r".*\b(qkv_proj|gate_up_proj|up_proj|q_proj|k_proj|v_proj|gate_proj|fc1)\.bias$", P("mp")),
-    (r".*\b(out_proj|down_proj|o_proj|fc2)\.weight$", P("mp", None)),
-    (r".*\b(wte|embed_tokens|word_embeddings)\.weight$", P("mp", None)),
-    (r".*\blm_head\.weight$", P(None, "mp")),
+    (r".*\b(qkv_proj|q_proj|k_proj|v_proj)\.weight$", ("embed", "heads")),
+    (r".*\b(qkv_proj|q_proj|k_proj|v_proj)\.bias$", ("heads",)),
+    (r".*\b(gate_up_proj|up_proj|gate_proj|fc1)\.weight$", ("embed", "mlp")),
+    (r".*\b(gate_up_proj|up_proj|gate_proj|fc1)\.bias$", ("mlp",)),
+    (r".*\b(out_proj|o_proj)\.weight$", ("heads", "embed")),
+    (r".*\b(down_proj|fc2)\.weight$", ("mlp", "embed")),
+    (r".*\b(wte|embed_tokens|word_embeddings)\.weight$", ("vocab", "embed")),
+    (r".*\blm_head\.weight$", ("embed", "vocab")),
 ]
 
 
-def spec_for_param(name, param, rules=None, *, sharding_stage=0,
-                   mesh=None):
-    """Compute the NamedSharding spec for one parameter.
+def _is_physical(entries):
+    """A rule value is physical when it is a PartitionSpec (legacy user
+    tables); plain tuples/lists hold logical axis names."""
+    from jax.sharding import PartitionSpec
 
-    Priority: explicit `param.dist_spec` (set by mp_layers) > name-pattern
-    rules > replicated. If sharding_stage == 3, additionally shard the
-    largest still-unsharded dim over the 'sharding' axis (ZeRO-3 param
-    sharding ≈ GroupShardedStage3, group_sharded_stage3.py:85)."""
-    spec = getattr(param, "dist_spec", None)
+    return isinstance(entries, PartitionSpec)
+
+
+def _filter_physical(spec, mesh):
+    """Drop physical axes the mesh does not have (a legacy P("mp") spec
+    must resolve to replicated on a dp/fsdp/tp mesh, not error)."""
+    if mesh is None:
+        return spec
+    sizes = dict(mesh.shape)
+    return _shardlib.spec(*[
+        e if e is None or all(a in sizes
+                              for a in ((e,) if isinstance(e, str) else e))
+        else None
+        for e in spec])
+
+
+def spec_for_param(name, param, rules=None, *, sharding_stage=0,
+                   mesh=None, axis_rules=None):
+    """Compute the PartitionSpec for one parameter.
+
+    Priority: `param.logical_axes` (logical annotation, set by mp_layers)
+    > explicit `param.dist_spec` (physical, set by legacy layers) >
+    name-pattern `rules` > replicated. Logical names resolve through the
+    active axis-rule table (or `axis_rules`) against `mesh`. If
+    sharding_stage == 3, additionally shard the largest still-unsharded
+    dim over the 'sharding' axis (ZeRO-3 param sharding ≈
+    GroupShardedStage3, group_sharded_stage3.py:85)."""
+    spec = None
+    logical = getattr(param, "logical_axes", None)
+    if logical is not None:
+        spec = _shardlib.logical_to_spec(logical, mesh=mesh,
+                                         rules=axis_rules)
+    if spec is None:
+        spec = getattr(param, "dist_spec", None)
+        if spec is not None and not _is_physical(spec):
+            spec = _shardlib.spec(*spec)
+        if spec is not None:
+            spec = _filter_physical(spec, mesh)
     if spec is None and rules:
         for pat, s in rules:
             if re.match(pat, name):
-                spec = s
+                if _is_physical(s):
+                    spec = _filter_physical(s, mesh)
+                else:
+                    spec = _shardlib.logical_to_spec(s, mesh=mesh,
+                                                     rules=axis_rules)
                 break
     entries = list(spec) if spec is not None else [None] * param.ndim
     while len(entries) < param.ndim:
         entries.append(None)
-    if sharding_stage >= 3 and mesh is not None and mesh.shape.get("sharding", 1) > 1:
-        n_shard = mesh.shape["sharding"]
+    if mesh is not None:
+        # a dim the candidate axis does not divide replicates instead of
+        # failing placement (vocab=50257 on tp=8 stays whole; GSPMD still
+        # shards everything else)
+        from ..sharding.rules import _divisible_spec
+
+        entries = list(_divisible_spec(
+            _shardlib.spec(*entries), tuple(param.shape), mesh))
+    if sharding_stage >= 3 and mesh is not None and \
+            dict(mesh.shape).get("sharding", 1) > 1:
+        n_shard = dict(mesh.shape)["sharding"]
         # biggest free dim divisible by the axis size
         cand = sorted(
             (i for i, e in enumerate(entries) if e is None),
@@ -58,7 +117,7 @@ def spec_for_param(name, param, rules=None, *, sharding_stage=0,
             if param.shape[i] % n_shard == 0:
                 entries[i] = "sharding"
                 break
-    return P(*entries)
+    return _shardlib.spec(*entries)
 
 
 def opt_state_spec(param_spec, param_shape, mesh, *, sharding_stage=0):
@@ -69,8 +128,9 @@ def opt_state_spec(param_spec, param_shape, mesh, *, sharding_stage=0):
     entries = list(param_spec)
     while len(entries) < len(param_shape):
         entries.append(None)
-    if sharding_stage >= 1 and mesh is not None and mesh.shape.get("sharding", 1) > 1:
-        n_shard = mesh.shape["sharding"]
+    if sharding_stage >= 1 and mesh is not None and \
+            dict(mesh.shape).get("sharding", 1) > 1:
+        n_shard = dict(mesh.shape)["sharding"]
         if not any(e == "sharding" or (isinstance(e, tuple) and "sharding" in e)
                    for e in entries):
             cand = sorted(
@@ -80,7 +140,7 @@ def opt_state_spec(param_spec, param_shape, mesh, *, sharding_stage=0):
                 if param_shape[i] % n_shard == 0:
                     entries[i] = "sharding"
                     break
-    return P(*entries)
+    return _shardlib.spec(*entries)
 
 
 def shard_params(layer, mesh, rules=None, *, sharding_stage=0):
@@ -94,29 +154,30 @@ def shard_params(layer, mesh, rules=None, *, sharding_stage=0):
         spec = spec_for_param(name, p, rules, sharding_stage=sharding_stage,
                               mesh=mesh)
         specs[name] = spec
-        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+        p._value = jax.device_put(p._value,
+                                  _shardlib.named_sharding(mesh, spec))
     for name, b in layer.named_buffers():
         if isinstance(b, Tensor):
             b._value = jax.device_put(
-                b._value, NamedSharding(mesh, P(*([None] * b.ndim))))
+                b._value, _shardlib.replicated(mesh, b.ndim))
     return specs
 
 
 def shard_constraint(x, *entries):
-    """with_sharding_constraint usable on eager Tensors inside traced code;
-    outside a trace it's an eager device_put when a mesh is active (the
-    reshard of auto_parallel/api.py:282)."""
+    """with_sharding_constraint over *physical* entries, usable on eager
+    Tensors inside traced code; outside a trace it's an eager device_put
+    when a mesh is active (the reshard of auto_parallel/api.py:282). For
+    logical names use `sharding.with_logical_constraint`."""
     from . import topology as topo_mod
     mesh = topo_mod.get_mesh()
     if mesh is None:
         return x
-    spec = P(*entries)
+    sh = _shardlib.named_sharding(mesh, entries)
     if isinstance(x, Tensor):
         v = x._value
         if isinstance(v, jax.core.Tracer):
-            return Tensor(jax.lax.with_sharding_constraint(
-                v, NamedSharding(mesh, spec)))
-        return Tensor(jax.device_put(v, NamedSharding(mesh, spec)))
+            return Tensor(jax.lax.with_sharding_constraint(v, sh))
+        return Tensor(jax.device_put(v, sh))
     if isinstance(x, jax.core.Tracer):
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
-    return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.lax.with_sharding_constraint(x, sh)
+    return jax.device_put(x, sh)

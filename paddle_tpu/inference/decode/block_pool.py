@@ -89,6 +89,8 @@ class BlockKVCache:
                             dtype)
                   for suffix, dtype in layer)
             for layer in entry_specs]
+        self.mesh = None        # set by shard_() for tensor-parallel pools
+        self.shardings = None
         self._lock = _locks.new_lock("decode.block_pool")
         self._free = list(range(self.num_blocks - 1, RESERVED_BLOCKS - 1,
                                 -1))  # pop() hands out low ids first
@@ -97,6 +99,28 @@ class BlockKVCache:
         self.frees = 0
         self.failed_allocs = 0
         self.peak_allocated = 0
+
+    # -- tensor-parallel placement (paddle_tpu.sharding) -------------------
+    def shard_(self, mesh, rules=None):
+        """Shard every pool tensor along the KV-head dimension (logical
+        axis "kv", suffix dim 0 — pool layout [N, bs, Hkv, ...]) over
+        `mesh` via the axis-rule table. Head counts an axis does not
+        divide replicate instead of erroring. Returns the per-tensor
+        NamedShardings (per layer, matching `tensors` structure)."""
+        import jax
+        from ... import sharding as _shardlib
+
+        self.mesh = mesh
+        self.shardings = [
+            tuple(_shardlib.logical_to_sharding(
+                (None, None, "kv") + (None,) * (t.ndim - 3),
+                mesh, rules=rules, shape=tuple(t.shape))
+                for t in layer)
+            for layer in self.tensors]
+        self.tensors = [
+            tuple(jax.device_put(t, sh) for t, sh in zip(layer, shs))
+            for layer, shs in zip(self.tensors, self.shardings)]
+        return self.shardings
 
     # -- geometry ----------------------------------------------------------
     def blocks_for(self, num_tokens):

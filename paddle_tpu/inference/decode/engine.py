@@ -206,7 +206,7 @@ class DecodeEngine:
                  step_timeout=30.0, step_retries=1, eos_token_id=None,
                  pad_token_id=0, compile_cache=None, fault_hook=None,
                  hang_grace=0.1, supervise_interval=0.02, metrics=None,
-                 clock=time.monotonic):
+                 mesh=None, sharding_rules=None, clock=time.monotonic):
         from ...distributed.functional import functionalize
         from ...core.tensor import Tensor
 
@@ -265,6 +265,35 @@ class DecodeEngine:
 
         self._apply, self._params, self._buffers = functionalize(
             model, method=wrapped)
+
+        # tensor-parallel placement (paddle_tpu.sharding): weights shard
+        # per their logical-axis annotations / the name-pattern rules,
+        # paged KV blocks shard along the kv-head dim, and every step
+        # executable compiles partitioned over the mesh (docs/sharding.md)
+        self.mesh = mesh
+        self._sharding_rules = sharding_rules
+        self._param_sh = None
+        self._buf_sh = None
+        if mesh is not None:
+            import jax
+            from ... import sharding as _shardlib
+            from ...distributed.sharding_spec import (
+                DEFAULT_TP_RULES, spec_for_param)
+
+            self._param_sh = {}
+            for n, p in self._params.items():
+                spec = spec_for_param(n, p, DEFAULT_TP_RULES, mesh=mesh,
+                                      axis_rules=sharding_rules)
+                sh = _shardlib.named_sharding(mesh, spec)
+                p._value = jax.device_put(p._value, sh)
+                self._param_sh[n] = sh
+            self._buf_sh = {}
+            for n, b in self._buffers.items():
+                sh = _shardlib.replicated(mesh, b.ndim)
+                b._value = jax.device_put(b._value, sh)
+                self._buf_sh[n] = sh
+            self.pool.shard_(mesh, rules=sharding_rules)
+
         self._fingerprint = self._make_fingerprint()
 
         self._decode_fns = {}     # bucket -> compiled step
@@ -358,6 +387,10 @@ class DecodeEngine:
             h.update(f"{n}:{tuple(b.shape)}:{b.dtype}".encode())
         h.update(f"paged-scan-greedy-v1:{self.pool.quant}:"
                  f"{self.block_size}:{self._nb}".encode())
+        if self.mesh is not None:
+            # a TP engine compiles different programs — its disk-cache
+            # entries must never collide with the single-device ones
+            h.update(f"mesh:{sorted(dict(self.mesh.shape).items())}".encode())
         return h.hexdigest()
 
     # -- admission ---------------------------------------------------------
@@ -448,6 +481,17 @@ class DecodeEngine:
               for n, b in self._buffers.items()}
         return pv, bv
 
+    def _step_shardings(self):
+        """(pv, bv, pool, scalar) sharding pytrees for the TP step
+        executables (mesh set), else None."""
+        if self.mesh is None:
+            return None
+        from ... import sharding as _shardlib
+
+        repl = _shardlib.replicated(self.mesh)
+        pool_sh = [tuple(layer) for layer in self.pool.shardings]
+        return self._param_sh, self._buf_sh, pool_sh, repl
+
     def _gather(self, pool_ts, table):
         """Dense per-sequence cache view: every pool tensor gathered
         through the block table into [1, NB*block_size, ...]."""
@@ -510,9 +554,16 @@ class DecodeEngine:
                  jax.ShapeDtypeStruct((bucket,), jnp.int32),
                  jax.ShapeDtypeStruct((bucket,), jnp.int32),
                  jax.ShapeDtypeStruct((bucket, self._nb), jnp.int32))
+        in_sh = out_sh = None
+        sh = self._step_shardings()
+        if sh is not None:
+            pv_sh, bv_sh, pool_sh, repl = sh
+            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl)
+            out_sh = (pool_sh, repl)
         compiled, source = aot.compile_jit(
             step, avals, fingerprint=self._fingerprint, cache=self._cache,
-            tag=f"decode-step-b{bucket}")
+            tag=f"decode-step-b{bucket}", in_shardings=in_sh,
+            out_shardings=out_sh)
         with self._lock:
             if source == "disk":
                 self._disk_loaded += 1
@@ -561,9 +612,16 @@ class DecodeEngine:
                  jax.ShapeDtypeStruct((1, pbucket), jnp.int32),
                  jax.ShapeDtypeStruct((), jnp.int32),
                  jax.ShapeDtypeStruct((self._nb,), jnp.int32))
+        in_sh = out_sh = None
+        sh = self._step_shardings()
+        if sh is not None:
+            pv_sh, bv_sh, pool_sh, repl = sh
+            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl)
+            out_sh = (pool_sh, repl)
         compiled, source = aot.compile_jit(
             prefill, avals, fingerprint=self._fingerprint,
-            cache=self._cache, tag=f"decode-prefill-p{pbucket}")
+            cache=self._cache, tag=f"decode-prefill-p{pbucket}",
+            in_shardings=in_sh, out_shardings=out_sh)
         with self._lock:
             if source == "disk":
                 self._disk_loaded += 1
@@ -943,4 +1001,10 @@ class DecodeEngine:
                         "p50_s": th["p50"], "p99_s": th["p99"]}
         snap["blocks"] = self.pool.stats()
         snap["step_pool"] = self._steps.stats()
+        if self.mesh is not None:
+            from ... import sharding as _shardlib
+
+            snap["sharding"] = _shardlib.mesh_stats(
+                self.mesh, {n: sh.spec
+                            for n, sh in self._param_sh.items()})
         return snap

@@ -188,14 +188,38 @@ def _versions():
             dev.platform, str(dev.device_kind))
 
 
-def executable_key(fingerprint, bucket, input_spec, holder_shapes):
+def _sharding_sig(in_shardings):
+    """Deterministic signature of an in_shardings pytree: mesh topology +
+    per-leaf PartitionSpec. A tensor-parallel executable and a
+    single-device one must never share a persistent-cache key (and two
+    processes with the SAME mesh shape may share one)."""
+    if in_shardings is None:
+        return None
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        in_shardings, is_leaf=lambda x: hasattr(x, "spec"))
+    mesh_sig = None
+    for sh in leaves:
+        m = getattr(sh, "mesh", None)
+        if m is not None:
+            mesh_sig = tuple((str(a), int(s)) for a, s in dict(m.shape).items())
+            break
+    return (str(treedef), mesh_sig,
+            [str(getattr(sh, "spec", sh)) for sh in leaves])
+
+
+def executable_key(fingerprint, bucket, input_spec, holder_shapes,
+                   sharding_sig=None):
     """Cache key for one bucket executable: model identity x batch shape x
     software/backend identity (a jax upgrade or platform change must never
-    resurrect a stale executable)."""
+    resurrect a stale executable) x sharding signature (a TP executable is
+    a different program)."""
     return CompileCache.key(
         "batched-v1", fingerprint, bucket,
         [(list(s["shape"]), str(s["dtype"])) for s in input_spec],
-        holder_shapes, *_versions())
+        holder_shapes, *_versions(),
+        *(("shardings", sharding_sig) if sharding_sig else ()))
 
 
 def _aval_signature(avals):
@@ -209,16 +233,21 @@ def _aval_signature(avals):
             [(list(a.shape), str(a.dtype)) for a in leaves])
 
 
-def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1"):
+def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
+                in_shardings=None, out_shardings=None):
     """AOT-compile (or cache-load) `fn` over an aval pytree, persisting the
     executable like `compile_batched` does for bucket executables.
 
     `avals` is the positional-argument pytree of `jax.ShapeDtypeStruct`s
     (weights must ride as runtime arguments — never closed over — so the
-    serialized executable holds no model state). Returns `(compiled,
-    source)` where `compiled(*args)` runs the executable and `source` is
-    "compiled" (built here, persisted when a fingerprint was given) or
-    "disk" (loaded from the persistent cache, zero XLA compilation).
+    serialized executable holds no model state). `in_shardings` (a pytree
+    of NamedShardings matching `avals`) compiles the program partitioned
+    over those placements — the decode engine's tensor-parallel path; it
+    joins the cache key, so a TP executable never collides with the
+    single-device one. Returns `(compiled, source)` where
+    `compiled(*args)` runs the executable and `source` is "compiled"
+    (built here, persisted when a fingerprint was given) or "disk"
+    (loaded from the persistent cache, zero XLA compilation).
 
     This is the decode-engine analog of `compile_batched`: the continuous-
     batching step function is compiled once per batch bucket and a warm
@@ -230,8 +259,11 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1"):
     key = None
     if fingerprint is not None:
         cache = cache or default_cache()
+        sig = (_sharding_sig(in_shardings), _sharding_sig(out_shardings))
         key = CompileCache.key(tag, fingerprint, _aval_signature(avals),
-                               *_versions())
+                               *_versions(),
+                               *(("shardings", sig) if sig != (None, None)
+                                 else ()))
         blob = cache.get(key)
         if blob is not None:
             try:
@@ -242,7 +274,15 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1"):
                 pass  # cache entry: recompile and overwrite below
 
     with _locks.blocking_region("aot.compile"):
-        compiled = jax.jit(fn).lower(*avals).compile()
+        kw = {}
+        if in_shardings is not None:
+            kw["in_shardings"] = in_shardings
+        if out_shardings is not None:
+            # pinning outputs keeps carried state (e.g. the decode
+            # engine's KV pool) on the placement the NEXT dispatch's
+            # in_shardings demand — AOT executables accept exact matches
+            kw["out_shardings"] = out_shardings
+        compiled = jax.jit(fn, **kw).lower(*avals).compile()
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))
@@ -252,9 +292,16 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1"):
 
 
 def compile_batched(exported, holder_avals, input_spec, bucket, *,
-                    fingerprint=None, cache=None):
+                    fingerprint=None, cache=None, holder_shardings=None,
+                    mesh=None):
     """AOT-compile (or cache-load) the bucket-B executable for a
     deserialized `jax.export` module.
+
+    With `holder_shardings` (one NamedSharding per holder, from
+    `TranslatedLayer.shard_`) the executable is compiled tensor-parallel:
+    weights stay sharded over `mesh`, stacked inputs/outputs replicate,
+    and GSPMD inserts the tp collectives inside the lax.map body. The
+    sharding signature joins the persistent-cache key.
 
     Returns `(fn, source)` where `fn(holder_vals, *stacked_inputs)` runs
     the module over `bucket` stacked examples in one dispatch and returns
@@ -268,11 +315,19 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
 
     if bucket < 1:
         raise ValueError(f"bucket size must be >= 1, got {bucket}")
+    in_shardings = None
+    if holder_shardings is not None:
+        from .. import sharding as _shardlib
+
+        repl = _shardlib.replicated(mesh)
+        in_shardings = (list(holder_shardings),
+                        *([repl] * len(input_spec)))
     holder_shapes = [(list(a.shape), str(a.dtype)) for a in holder_avals]
     key = None
     if fingerprint is not None:
         cache = cache or default_cache()
-        key = executable_key(fingerprint, bucket, input_spec, holder_shapes)
+        key = executable_key(fingerprint, bucket, input_spec, holder_shapes,
+                             sharding_sig=_sharding_sig(in_shardings))
         blob = cache.get(key)
         if blob is not None:
             try:
@@ -295,8 +350,9 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
     stacked_avals = [
         jax.ShapeDtypeStruct((bucket, *s["shape"]), jnp.dtype(s["dtype"]))
         for s in input_spec]
-    compiled = jax.jit(batched).lower(
-        list(holder_avals), *stacked_avals).compile()
+    jitted = jax.jit(batched) if in_shardings is None else \
+        jax.jit(batched, in_shardings=in_shardings)
+    compiled = jitted.lower(list(holder_avals), *stacked_avals).compile()
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))

@@ -89,9 +89,26 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({n: np.asarray(h._value) for n, h in zip(names, holders)},
                     f, protocol=4)
+    # per-parameter sharding annotations ride along in the meta JSON so a
+    # loaded artifact can re-shard onto a serving mesh (TranslatedLayer
+    # .shard_): logical axis names resolve through the rule table at LOAD
+    # time (the serving mesh's vocabulary, not the trainer's); physical
+    # dist_spec entries are recorded as-is for legacy layers
+    shardings = {}
+    for n, h in zip(names, holders):
+        axes = getattr(h, "logical_axes", None)
+        if axes is not None:
+            shardings[n] = {"logical": list(axes)}
+            continue
+        phys = getattr(h, "dist_spec", None)
+        if phys:
+            shardings[n] = {"physical": [
+                list(e) if isinstance(e, (tuple, list)) else e
+                for e in phys]}
     meta = {
         "inputs": [{"shape": list(e.shape), "dtype": str(e.dtype)} for e in examples],
         "param_names": names,
+        "shardings": shardings,
     }
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(meta, f)
@@ -121,6 +138,11 @@ class TranslatedLayer:
         self._aot_execs: dict = {}
         self._aot_building: dict = {}   # bucket -> Event (build in flight)
         self._aot_counts = {"compiles": 0, "disk_hits": 0, "mem_hits": 0}
+        # tensor-parallel placement (shard_): mesh + resolved per-param
+        # specs; None until shard_ is called (single-device execution)
+        self._mesh = None
+        self._param_specs = None
+        self._sharding_obs_key = None
 
     def __call__(self, *inputs):
         if self._call is None:
@@ -142,8 +164,86 @@ class TranslatedLayer:
     def set_state_dict(self, state):
         for k, v in state.items():
             if k in self._params:
-                self._params[k] = v if isinstance(v, Tensor) else \
+                t = v if isinstance(v, Tensor) else \
                     Tensor(jnp.asarray(np.asarray(v)))
+                if self._mesh is not None:
+                    # a sharded layer stays sharded across weight swaps:
+                    # the TP AOT executables demand exactly this placement
+                    from .. import sharding as _shardlib
+
+                    t = Tensor(jax.device_put(
+                        t._value, _shardlib.named_sharding(
+                            self._mesh, self._param_specs[k])))
+                self._params[k] = t
+
+    # -- tensor-parallel placement (paddle_tpu.sharding) -------------------
+    def shard_(self, mesh, rules=None, registry=None):
+        """Re-place every parameter across `mesh` per the sharding
+        annotations recorded at export (logical axes resolved through the
+        active rule table, or `rules`); unannotated params replicate.
+        Subsequent `__call__`/`batched_call` executables partition over
+        the mesh (GSPMD inserts the tp collectives), so a ServingPool or
+        DecodeEngine over this layer serves tensor-parallel. Cached AOT
+        executables are dropped (they were compiled for the previous
+        placement). Returns self."""
+        import jax as _jax
+
+        from .. import sharding as _shardlib
+
+        ax_map = self._meta.get("shardings") or {}
+        specs = {}
+        for n in self._param_names:
+            t = self._params[n]
+            v = t._value
+            entry = ax_map.get(n) or {}
+            if "logical" in entry:
+                sh = _shardlib.logical_to_sharding(
+                    entry["logical"], mesh, rules=rules,
+                    shape=tuple(v.shape))
+            else:
+                phys = [tuple(e) if isinstance(e, list) else e
+                        for e in entry.get("physical", ())]
+                sizes = dict(mesh.shape)
+                entries = [e if e is None or all(
+                    a in sizes for a in ((e,) if isinstance(e, str) else e))
+                    else None for e in phys]
+                entries += [None] * (v.ndim - len(entries))
+                from ..sharding.rules import _divisible_spec
+
+                sh = _shardlib.named_sharding(mesh, _divisible_spec(
+                    _shardlib.spec(*entries[: v.ndim]), tuple(v.shape),
+                    mesh))
+            t._value = _jax.device_put(v, sh)
+            specs[n] = sh.spec
+        self._mesh = mesh
+        self._param_specs = specs
+        with self._aot_lock:
+            self._aot_execs.clear()
+        # `sharding.artifact.<fp8>` collector: mesh shape + per-param
+        # shard fractions; bound method, so the registry holds it weakly
+        from ..obs.metrics import registry as _registry
+
+        reg = registry if registry is not None else _registry()
+        fp = (self.fingerprint or "unfingerprinted")[:8]
+        self._sharding_obs_key = f"sharding.artifact.{fp}"
+        reg.register_collector(self._sharding_obs_key,
+                               self._sharding_obs_collect)
+        return self
+
+    def _sharding_obs_collect(self):
+        from .. import sharding as _shardlib
+
+        if self._mesh is None:
+            return {}
+        return _shardlib.mesh_stats(self._mesh, self._param_specs)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def param_shardings(self):
+        """{name: PartitionSpec} after shard_(); None before."""
+        return dict(self._param_specs) if self._param_specs else None
 
     @property
     def input_spec(self):
@@ -208,10 +308,19 @@ class TranslatedLayer:
         from .aot import compile_batched
 
         try:
+            holder_sh = None
+            if self._mesh is not None:
+                from .. import sharding as _shardlib
+
+                holder_sh = [
+                    _shardlib.named_sharding(self._mesh,
+                                             self._param_specs[n])
+                    for n in self._param_names]
             with _locks.blocking_region("aot.compile"):
                 raw, source = compile_batched(
                     self._exported, self._holder_avals(), self.input_spec,
-                    bucket, fingerprint=self.fingerprint, cache=cache)
+                    bucket, fingerprint=self.fingerprint, cache=cache,
+                    holder_shardings=holder_sh, mesh=self._mesh)
 
             def fn(*stacked_inputs, _raw=raw):
                 holders = [self._params[n]._value

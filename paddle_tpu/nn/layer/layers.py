@@ -21,7 +21,7 @@ from ...core import dtype as dtypes
 class Parameter(Tensor):
     """Trainable tensor (reference: EagerParamBase, base/framework.py)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed", "dist_spec", "sequence_parallel")
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed", "dist_spec", "logical_axes", "sequence_parallel")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -31,12 +31,15 @@ class Parameter(Tensor):
         self.do_model_average = None
         self.need_clip = True
         self.is_distributed = False
-        self.dist_spec = None  # PartitionSpec set by mp_layers/auto_parallel
+        self.dist_spec = None  # PartitionSpec set by legacy/auto_parallel
+        self.logical_axes = None  # logical axis names set by mp_layers,
+        #                           resolved via paddle_tpu.sharding rules
         self.persistable = True
 
     def __deepcopy__(self, memo):
         p = Parameter(self._value, trainable=self.trainable, name=self.name)
         p.dist_spec = self.dist_spec
+        p.logical_axes = self.logical_axes
         p.is_distributed = self.is_distributed
         p.need_clip = self.need_clip
         p.optimize_attr = dict(self.optimize_attr)

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
 import weakref
 
 from ..analysis import locks as _locks
@@ -231,11 +232,25 @@ class MetricsRegistry:
     collector and serializes WITHOUT it, so collector callbacks are free
     to take their owners' locks (serving.pool / router.core / ...)."""
 
-    def __init__(self):
+    #: label key every over-cardinality observation collapses onto
+    OVERFLOW_LABELS = {"_overflow": "true"}
+
+    def __init__(self, max_label_sets=None):
         self._lock = _locks.new_lock("obs.registry")
         self._metrics = {}     # (name, label_key) -> metric
         self._kinds = {}       # name -> metric class (family-wide)
         self._collectors = {}  # name -> callable | weakref.WeakMethod
+        # per-NAME label-cardinality cap: a runaway label source (e.g.
+        # request ids leaking into labels) degrades to ONE shared
+        # `_overflow` series per family instead of unbounded growth
+        if max_label_sets is None:
+            max_label_sets = int(os.environ.get(
+                "PADDLE_TPU_OBS_MAX_LABEL_SETS", "64"))
+        if max_label_sets < 1:
+            raise ValueError("max_label_sets must be >= 1")
+        self.max_label_sets = max_label_sets
+        self._label_sets = {}  # name -> count of distinct label sets
+        self.label_overflows = 0
 
     # -- metric families ---------------------------------------------------
     def _get(self, cls, name, help, labels, **kw):
@@ -252,6 +267,20 @@ class MetricsRegistry:
                     f"{known.kind}, requested {cls.kind}")
             m = self._metrics.get(key)
             if m is None:
+                if labels and \
+                        self._label_sets.get(name, 0) >= self.max_label_sets:
+                    # cardinality cap: collapse onto the family's single
+                    # _overflow series (created on first overflow; it
+                    # does NOT count against the cap)
+                    self.label_overflows += 1
+                    labels = dict(self.OVERFLOW_LABELS)
+                    key = (name, _label_key(labels))
+                    m = self._metrics.get(key)
+                    if m is not None:
+                        return m
+                else:
+                    self._label_sets[name] = \
+                        self._label_sets.get(name, 0) + 1
                 m = cls(name, help=help, labels=labels, **kw)
                 self._metrics[key] = m
                 self._kinds[name] = cls

@@ -1,0 +1,46 @@
+"""paddle_tpu.sharding — the single authority for tensor placement.
+
+Shardings used to be hand-built ``NamedSharding``s scattered across the
+training engine, the mp layers, group_sharded and auto_parallel, so no
+two subsystems agreed on how a tensor maps to the mesh — and the serving
+stack could not shard at all. This subsystem replaces every construction
+site with three declarative pieces (docs/sharding.md):
+
+* **MeshConfig** (`mesh.py`) — declarative "dp"/"fsdp"/"tp" axis sizes;
+  `build()` picks hybrid ICI×DCN construction on pod slices, the
+  mesh_utils permutation on one TPU slice, and a plain reshape for the
+  8-virtual-device CPU tier-1 mesh.
+* **AxisRules** (`rules.py`) — ONE ordered logical→physical table
+  ("batch"/"embed"/"heads"/"kv"/"mlp"/"vocab" → mesh axes),
+  first-match-wins with availability, `axis_rules(...)` override
+  context, `with_logical_constraint` for activations.
+* **Placement factories** (`placement.py`) — `named_sharding` /
+  `spec` / `replicated` plus the shared batch-spec helpers and the
+  `sharding.<name>` telemetry collector (per-parameter resolution is
+  `distributed.sharding_spec.spec_for_param`, the one resolver).
+
+Raw ``NamedSharding(``/``PartitionSpec(`` construction outside this
+package is a tracelint TL011 finding (ratcheted via
+`.tpu_lint_baseline.json`).
+"""
+from .mesh import AXES, MeshConfig, build_mesh, cpu_mesh
+from .rules import (
+    AxisRules, DEFAULT_RULES, axis_rules, get_axis_rules,
+    logical_to_spec, logical_to_sharding, resolve_axis,
+    with_logical_constraint,
+)
+from .placement import (
+    batch_spec_for_ndim, default_batch_spec, mesh_stats, named_sharding,
+    register_mesh_collector, replicated, shard_fraction,
+    spec, stacked_batch_spec,
+)
+
+__all__ = [
+    "AXES", "MeshConfig", "build_mesh", "cpu_mesh",
+    "AxisRules", "DEFAULT_RULES", "axis_rules", "get_axis_rules",
+    "logical_to_spec", "logical_to_sharding", "resolve_axis",
+    "with_logical_constraint",
+    "batch_spec_for_ndim", "default_batch_spec", "mesh_stats",
+    "named_sharding", "register_mesh_collector",
+    "replicated", "shard_fraction", "spec", "stacked_batch_spec",
+]

@@ -1,0 +1,137 @@
+"""Placement factories + batch-spec helpers + sharding telemetry.
+
+This module is the ONE place the framework constructs `NamedSharding` /
+`PartitionSpec` objects (tracelint TL011 flags raw construction outside
+`paddle_tpu/sharding/`). Everything downstream — the train engine, the
+prefetcher, group_sharded, the export/serving path — asks these
+factories, so "how does a tensor map to the mesh" has a single answer.
+"""
+from __future__ import annotations
+
+import itertools
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = [
+    "spec", "named_sharding", "replicated", "default_batch_spec",
+    "batch_spec_for_ndim", "stacked_batch_spec",
+    "shard_fraction", "mesh_stats", "register_mesh_collector",
+]
+
+
+def spec(*entries) -> PartitionSpec:
+    """PartitionSpec factory over *physical* mesh-axis entries (use
+    `rules.logical_to_spec` for logical names)."""
+    return PartitionSpec(*entries)
+
+
+def named_sharding(mesh, spec_or_entries) -> NamedSharding:
+    """NamedSharding factory: accepts a PartitionSpec or a plain sequence
+    of physical entries."""
+    if not isinstance(spec_or_entries, PartitionSpec):
+        spec_or_entries = PartitionSpec(*spec_or_entries)
+    return NamedSharding(mesh, spec_or_entries)
+
+
+def replicated(mesh, ndim=0) -> NamedSharding:
+    """Fully-replicated sharding for a rank-`ndim` tensor (ndim=0 is the
+    scalar sharding the engine uses for loss/lr/step)."""
+    return NamedSharding(mesh, PartitionSpec(*([None] * ndim)))
+
+
+# -- batch specs (deduplicated from engine.py / prefetch.py) ---------------
+
+def default_batch_spec(mesh) -> PartitionSpec:
+    """The engine's default batch layout: dim0 over the fused data axes
+    (dp+fsdp on MeshConfig meshes, dp+sharding on the hybrid topology —
+    the reference fuses them for grad sync, topology.py:228), dim1 over
+    sep when in use. Tolerates meshes missing axes."""
+    axes = dict(mesh.shape)
+    entries = []
+    data = tuple(a for a in ("dp", "fsdp", "sharding") if a in axes)
+    if data:
+        entries.append(data)
+    if axes.get("sep", 1) > 1:
+        entries.append("sep")
+    return PartitionSpec(*entries)
+
+
+def batch_spec_for_ndim(spec_, ndim) -> PartitionSpec:
+    """Trim/pad a batch PartitionSpec to an array's rank."""
+    entries = list(spec_)[:ndim]
+    entries += [None] * (ndim - len(entries))
+    return PartitionSpec(*entries)
+
+
+def stacked_batch_spec(spec_, ndim) -> PartitionSpec:
+    """Batch spec for an array with a leading scan/stack axis: the stack
+    axis is replicated, the remaining dims follow the batch spec."""
+    return PartitionSpec(None, *batch_spec_for_ndim(spec_, ndim - 1))
+
+
+# Per-parameter resolution (logical_axes > legacy dist_spec > name-pattern
+# rules > replicated, with the divisibility guard) lives in
+# distributed/sharding_spec.spec_for_param — ONE resolver, consulted by the
+# engine, group_sharded, shard_params and the decode engine alike.
+
+# -- telemetry --------------------------------------------------------------
+
+def shard_fraction(spec_, mesh) -> float:
+    """Fraction of the global tensor each device holds under `spec_` on
+    `mesh` (1.0 = fully replicated, 1/N = sharded N ways)."""
+    sizes = dict(mesh.shape)
+    ways = 1
+    for e in spec_:
+        if e is None:
+            continue
+        for a in ((e,) if isinstance(e, str) else e):
+            ways *= sizes.get(a, 1)
+    return 1.0 / ways if ways else 1.0
+
+
+def mesh_stats(mesh, specs=None):
+    """Collector payload: mesh shape + per-param shard fractions (the
+    `sharding.<name>` registry collector the obs satellite asks for)."""
+    out = {
+        "mesh_axes": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "mesh_devices": int(mesh.devices.size),
+    }
+    if specs:
+        fr = {n: shard_fraction(s, mesh) for n, s in specs.items()}
+        out["param_shard_fractions"] = fr
+        out["params_sharded"] = sum(1 for v in fr.values() if v < 1.0)
+        out["params_total"] = len(fr)
+        out["mean_shard_fraction"] = sum(fr.values()) / len(fr)
+    return out
+
+
+_COLLECTOR_SEQ = itertools.count()
+
+
+def register_mesh_collector(name, mesh, specs=None, registry=None,
+                            owner=None):
+    """Register a `sharding.<name>` collector exposing the mesh shape and
+    per-param shard fractions. Returns the collector key (pass it to
+    `registry.unregister_collector` on teardown). With `owner`, the
+    collector is tied to that object's lifetime: once the owner is
+    garbage-collected the collector returns None and the registry prunes
+    it — otherwise the closure (and the mesh's device handles) stay
+    registered until explicitly unregistered."""
+    import weakref
+
+    from ..obs.metrics import registry as _default_registry
+
+    reg = registry if registry is not None else _default_registry()
+    key = f"sharding.{name}" if not name.startswith("sharding.") else name
+    snap_specs = dict(specs) if specs else None
+    if owner is not None:
+        ref = weakref.ref(owner)
+
+        def collect():
+            return mesh_stats(mesh, snap_specs) if ref() is not None \
+                else None
+    else:
+        def collect():
+            return mesh_stats(mesh, snap_specs)
+    reg.register_collector(key, collect)
+    return key

@@ -1,0 +1,213 @@
+"""Logical→physical axis-rule table (t5x/GSPMD idiom, SNIPPETS [2][3]).
+
+Tensors are annotated with *logical* axis names describing what each
+dimension means ("batch", "embed", "heads", ...); ONE ordered rule table
+maps those names onto mesh axes. Change the table (or push an override
+with `axis_rules(...)`) and every subsystem — the train engine, the
+mp layers, group_sharded, export, the decode engine — re-partitions
+consistently. No code constructs placements by hand.
+
+Resolution is **first-match-wins with availability**: for each logical
+name, rules are scanned in order and the first whose mesh axes are all
+present in the mesh *and not already consumed by an earlier dimension of
+the same spec* is taken (a mesh axis may shard at most one dimension of
+one tensor). An unmapped name — or a name whose every candidate axis is
+unavailable — resolves to None (replicated), so a 1-device mesh or a
+mesh missing the "tp" axis degrades to replication instead of erroring.
+
+Logical axis catalogue (docs/sharding.md):
+
+    batch   leading batch dimension of activations/inputs
+    seq     sequence/time dimension
+    embed   model hidden dimension (rows of column-parallel weights)
+    heads   attention-head dimension / fused qkv output dimension
+    kv      key/value-head dimension (paged KV-cache pools shard here)
+    mlp     feed-forward intermediate dimension
+    vocab   vocabulary dimension (embedding rows / lm_head columns)
+    expert  MoE expert dimension
+
+The default table speaks BOTH physical vocabularies in use — the
+MeshConfig axes ("dp"/"fsdp"/"tp") and the legacy hybrid-topology axes
+("dp"/"sharding"/"mp") — by listing a rule per vocabulary in preference
+order, so one annotation resolves correctly on either mesh family.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from jax.sharding import PartitionSpec
+
+
+class AxisRules(tuple):
+    """Immutable ordered table of ``(logical_name, mesh_axes)`` pairs.
+    `mesh_axes` is a mesh-axis name, a tuple of them (multi-axis
+    sharding, e.g. batch over dp AND fsdp), or None (explicitly
+    replicated — stops the scan for that name)."""
+
+    def __new__(cls, pairs=()):
+        norm = []
+        for logical, phys in pairs:
+            if not isinstance(logical, str):
+                raise TypeError(f"logical axis name must be a str, "
+                                f"got {logical!r}")
+            if phys is not None and not isinstance(phys, str):
+                phys = tuple(phys)
+                if not all(isinstance(a, str) for a in phys):
+                    raise TypeError(f"mesh axes for {logical!r} must be "
+                                    f"strings, got {phys!r}")
+            norm.append((logical, phys))
+        return super().__new__(cls, norm)
+
+    def __add__(self, other):
+        return AxisRules(tuple.__add__(self, AxisRules(other)))
+
+    def candidates(self, logical):
+        """All mesh-axis entries for `logical`, in table order."""
+        return [phys for lg, phys in self if lg == logical]
+
+
+#: first-match-wins default table (see module docstring for the dual
+#: dp/fsdp/tp vs dp/sharding/mp vocabulary)
+DEFAULT_RULES = AxisRules((
+    ("batch",  ("dp", "fsdp")),
+    ("batch",  ("dp", "sharding")),
+    ("batch",  "dp"),
+    ("seq",    "sep"),
+    ("heads",  "tp"),
+    ("heads",  "mp"),
+    ("kv",     "tp"),
+    ("kv",     "mp"),
+    ("mlp",    "tp"),
+    ("mlp",    "mp"),
+    ("vocab",  "tp"),
+    ("vocab",  "mp"),
+    ("expert", "tp"),
+    ("expert", "mp"),
+    ("embed",  None),
+))
+
+_local = threading.local()
+
+
+def get_axis_rules() -> AxisRules:
+    """The active rule table (innermost `axis_rules` override, else the
+    defaults)."""
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else DEFAULT_RULES
+
+
+@contextmanager
+def axis_rules(rules, *, extend=True):
+    """Override the rule table for a scope. With ``extend=True`` (default)
+    the given pairs are PREPENDED to the current table — they win
+    first-match but everything unlisted still resolves; ``extend=False``
+    installs `rules` alone."""
+    rules = AxisRules(rules)
+    if extend:
+        rules = rules + get_axis_rules()
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    stack.append(rules)
+    try:
+        yield rules
+    finally:
+        stack.pop()
+
+
+def _axis_sizes(mesh):
+    if mesh is None:
+        return None
+    return dict(mesh.shape)
+
+
+def resolve_axis(logical, mesh=None, used=(), rules=None):
+    """One logical name -> mesh-axis entry (str | tuple | None) under
+    first-match-wins with availability (see module docstring)."""
+    if logical is None:
+        return None
+    rules = get_axis_rules() if rules is None else AxisRules(rules)
+    sizes = _axis_sizes(mesh)
+    for lg, phys in rules:
+        if lg != logical:
+            continue
+        if phys is None:
+            return None
+        axes = (phys,) if isinstance(phys, str) else phys
+        if sizes is not None and not all(a in sizes for a in axes):
+            continue                # axis not on this mesh: next rule
+        if any(a in used for a in axes):
+            continue                # already shards another dim: next rule
+        return axes[0] if len(axes) == 1 else axes
+    return None
+
+
+def logical_to_spec(names, mesh=None, rules=None) -> PartitionSpec:
+    """Tuple of logical names (None entries = replicated dims) ->
+    PartitionSpec over `mesh` under the active/given rule table."""
+    used = set()
+    entries = []
+    for nm in names:
+        e = resolve_axis(nm, mesh=mesh, used=used, rules=rules)
+        if e is not None:
+            used.update((e,) if isinstance(e, str) else e)
+        entries.append(e)
+    return PartitionSpec(*entries)
+
+
+def logical_to_sharding(names, mesh, rules=None, shape=None):
+    """Logical names -> NamedSharding on `mesh`. With `shape`, axes whose
+    size does not divide the corresponding dimension are dropped
+    (replicated) — placement must never fail on a ragged dimension."""
+    from jax.sharding import NamedSharding
+
+    spec = logical_to_spec(names, mesh=mesh, rules=rules)
+    if shape is not None:
+        spec = _divisible_spec(spec, shape, mesh)
+    return NamedSharding(mesh, spec)
+
+
+def _divisible_spec(spec, shape, mesh):
+    sizes = dict(mesh.shape)
+    entries = []
+    for i, e in enumerate(spec):
+        if e is None or i >= len(shape):
+            entries.append(e)
+            continue
+        axes = (e,) if isinstance(e, str) else tuple(e)
+        ways = 1
+        for a in axes:
+            ways *= sizes.get(a, 1)
+        if ways and shape[i] % ways == 0:
+            entries.append(e)
+        else:
+            entries.append(None)
+    return PartitionSpec(*entries)
+
+
+def with_logical_constraint(x, *names, mesh=None, rules=None):
+    """`lax.with_sharding_constraint` by logical names — inside a trace a
+    real constraint, outside it an eager `device_put`; a no-op when no
+    mesh is active (CPU fallback without topology, SNIPPETS [1])."""
+    import jax
+
+    if mesh is None:
+        from ..distributed import topology as topo_mod
+
+        mesh = topo_mod.get_mesh()
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, logical_to_spec(names, mesh=mesh, rules=rules))
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        v = x._value
+        if isinstance(v, jax.core.Tracer):
+            return Tensor(jax.lax.with_sharding_constraint(v, sh))
+        return Tensor(jax.device_put(v, sh))
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sh)
+    return jax.device_put(x, sh)

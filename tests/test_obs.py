@@ -529,6 +529,58 @@ def test_metrics_dump_cli_scrape_modes(capsys):
     assert md.main(["--url", "http://127.0.0.1:1/metrics"]) == 1
 
 
+def test_label_cardinality_cap_degrades_to_overflow():
+    """Beyond max_label_sets distinct label sets per family, new label
+    sets collapse onto ONE shared `_overflow` series instead of growing
+    the registry unboundedly (runaway label sources: request ids,
+    per-sequence tags...)."""
+    reg = MetricsRegistry(max_label_sets=3)
+    for i in range(3):
+        reg.counter("fam", labels={"k": str(i)}).inc()
+    over = reg.counter("fam", labels={"k": "runaway-1"})
+    assert over.labels == MetricsRegistry.OVERFLOW_LABELS
+    # every further new label set lands on the SAME series
+    again = reg.counter("fam", labels={"k": "runaway-2"})
+    assert again is over
+    over.inc(2)
+    assert reg.label_overflows == 2
+    # existing label sets still resolve to their own metrics
+    assert reg.counter("fam", labels={"k": "1"}).labels == {"k": "1"}
+    # the cap is per NAME: other families are unaffected
+    assert reg.counter("other", labels={"k": "x"}).labels == {"k": "x"}
+    # the exposition renders the overflow series like any other
+    assert 'fam{_overflow="true"} 2' in reg.prometheus_text()
+    with pytest.raises(ValueError):
+        MetricsRegistry(max_label_sets=0)
+
+
+def test_label_cap_env_default(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OBS_MAX_LABEL_SETS", "2")
+    reg = MetricsRegistry()
+    assert reg.max_label_sets == 2
+    reg.gauge("g", labels={"a": "1"})
+    reg.gauge("g", labels={"a": "2"})
+    assert reg.gauge("g", labels={"a": "3"}).labels == \
+        MetricsRegistry.OVERFLOW_LABELS
+
+
+def test_sharding_mesh_collector_snapshot():
+    """The `sharding.<name>` collector exposes mesh shape and per-param
+    shard fractions through a plain registry snapshot."""
+    import paddle_tpu.sharding as shardlib
+
+    reg = MetricsRegistry()
+    mesh = shardlib.MeshConfig(tp=8).build()
+    key = shardlib.register_mesh_collector(
+        "unit", mesh, {"w": shardlib.spec(None, "tp")}, registry=reg)
+    assert key == "sharding.unit"
+    snap = reg.snapshot()["collectors"]["sharding.unit"]
+    assert snap["mesh_axes"] == {"dp": 1, "fsdp": 1, "tp": 8}
+    assert snap["param_shard_fractions"]["w"] == 0.125
+    assert snap["params_sharded"] == 1
+    reg.unregister_collector(key)
+
+
 @pytest.mark.slow
 def test_bench_slo_gate_end_to_end():
     """BENCH_SLO=1 python bench.py evaluates the declared SLOs against
