@@ -13,12 +13,17 @@ Two passes, two failure families:
   (``locks.new_lock("serving.pool")``), per-thread held-sets, a global
   acquisition-order graph with cycle detection, and
   blocked-while-holding probes at the framework's dispatch/IO points.
+* `runtime_san` — an opt-in (``PADDLE_TPU_SAN=1``) **runtime
+  sanitizer** (tpu-san): retrace sentinel, host-sync detector
+  (``hot_region`` probes), donation guard, and non-finite guard, with
+  site-keyed findings ratcheted via ``.tpu_san_baseline.json`` and
+  ``tools/tpu_san.py``.
 
 See docs/static_analysis.md for the rule catalogue and workflows.
 """
-from . import lockcheck, locks  # noqa: F401
+from . import lockcheck, locks, runtime_san  # noqa: F401
 
-__all__ = ["lockcheck", "locks", "tracelint"]
+__all__ = ["lockcheck", "locks", "runtime_san", "tracelint"]
 
 
 def __getattr__(name):

@@ -1,0 +1,787 @@
+"""paddle_tpu.analysis.runtime_san — tpu-san, the runtime sanitizer.
+
+The static half of `paddle_tpu.analysis` (tracelint) catches hazards the
+AST can prove; the failure modes that actually burn a JAX/TPU stack in
+production are invisible to it because they only exist at runtime:
+
+* **silent retraces** — a jit entrypoint recompiling after warmup
+  (shape/dtype/weak_type drift, an unstable cache key) tanks steps/sec
+  with no error anywhere;
+* **host syncs inside a dispatch region** — an `np.asarray` / `.item()`
+  / `jax.device_get` on a device array in the middle of the serving or
+  training hot path serializes the pipeline;
+* **use-after-donate** — reading a buffer the engine donated to XLA
+  raises a cryptic "Array has been deleted" far from the donation site;
+* **non-finite values** — a NaN/Inf born in step 3 of a 30-step
+  `lax.scan` surfaces as a garbage loss with no blame.
+
+This module is the *dynamic sanitizer* for those four: opt-in via
+``PADDLE_TPU_SAN=1`` (or :func:`enable`), zero overhead when off —
+every probe in the framework reduces to one module-flag check, exactly
+like lockcheck's constructors. When on:
+
+* framework compile/trace points call :func:`note_trace`; an identical
+  signature compiled twice, or any new signature after the entrypoint
+  was marked warm (:func:`mark_warm`), records a **retrace** finding
+  with the shape/dtype/weak_type delta that caused it;
+* the hot paths are wrapped in :func:`hot_region` probes (the sibling
+  of lockcheck's ``blocking_region``); `numpy.asarray`/`numpy.array`
+  and `jax.device_get` are patched so a device-array→host conversion
+  mid-region records a **host-sync** finding with the offending stack
+  site.  Sanctioned readbacks (a Predictor fetching its outputs, the
+  decode engine streaming a token) sit in :func:`allow_host_sync`
+  escapes — the runtime analog of a lint suppression;
+* the engine reports its donated carry buffers via
+  :func:`note_donation`; any later use (framework choke points call
+  :func:`check_use`; the numpy/device_get patches check too) raises a
+  typed :class:`DonatedBufferError` naming the donation site, instead
+  of jax's anonymous deletion error;
+* after each dispatch the engine (and the decode engine's KV pool)
+  sweeps for NaN/Inf via :func:`check_finite`, which raises
+  :class:`NonFiniteError` blaming the FIRST offending leaf by path
+  (``param/linear.weight``, ``kv_pool/layer0/k``, ...). Disable just
+  this detector with ``PADDLE_TPU_SAN_NONFINITE=0`` (the sweep costs a
+  device reduction + readback per leaf per dispatch).
+
+Findings are keyed **site-wise and line-number-free**
+(``<site>::<detector>``, e.g. ``engine.dispatch::host-sync``) so they
+ratchet through a checked-in baseline exactly like tracelint:
+``.tpu_san_baseline.json`` at the repo root, driven by
+``tools/tpu_san.py`` (exit 0 clean / 1 new findings / 2 usage error).
+Counts also export as the ``san`` collector on the obs registry
+(``san_findings``, ``san_retrace``, ... in the Prometheus exposition).
+
+Dogfood: ``tools/serving_fault_injector.py`` runs every fault phase
+with the sanitizer live and asserts ZERO findings — the serving /
+batching / decode / router stacks are retrace-free and sync-free even
+while members crash, wedge and hot-swap.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import weakref
+
+__all__ = [
+    "enable", "disable", "enabled", "reset", "report", "findings",
+    "counts_by_key", "assert_clean", "mark_warm",
+    "note_trace", "hot_region", "allow_host_sync", "in_hot_region",
+    "note_donation", "check_use", "check_finite", "nonfinite_enabled",
+    "aval_signature", "Finding", "SanError", "DonatedBufferError",
+    "NonFiniteError", "load_baseline", "write_baseline", "new_counts",
+    "OBS_COLLECTOR",
+]
+
+_ENV = "PADDLE_TPU_SAN"
+_ENV_NONFINITE = "PADDLE_TPU_SAN_NONFINITE"
+
+DETECTORS = ("retrace", "host-sync", "donation", "non-finite")
+
+#: obs-registry collector name (docs/observability.md)
+OBS_COLLECTOR = "san"
+
+#: per-key cap on stored Finding exemplars (counts stay exact)
+_MAX_SAMPLES = 5
+#: donation-table size bound (dead weakrefs pruned past this)
+_MAX_DONATIONS = 4096
+#: retrace-entrypoint table bound: fingerprint-less compiles and churned
+#: layer instances each add an entry; a long-lived sanitized process must
+#: not grow without bound, so the OLDEST entries are dropped past this
+#: (losing their warm state — bounded memory beats perfect recall)
+_MAX_ENTRYPOINTS = 4096
+
+_off_values = ("", "0", "false", "off", "no")
+
+
+def _env_on(name, default=""):
+    return os.environ.get(name, default).strip().lower() not in _off_values
+
+
+# case-insensitive off-values, mirroring lockcheck: exporting
+# PADDLE_TPU_SAN=FALSE/off/no must not silently enable full patching
+_enabled = _env_on(_ENV)
+
+
+class SanError(RuntimeError):
+    """Base class of the sanitizer's typed errors."""
+
+
+class DonatedBufferError(SanError):
+    """A buffer donated to XLA was used again. The message names the
+    donation site (e.g. ``engine.dispatch step 12``) instead of jax's
+    anonymous "Array has been deleted"."""
+
+
+class NonFiniteError(SanError):
+    """A NaN/Inf appeared after a dispatch. The message blames the first
+    offending leaf by path."""
+
+    def __init__(self, message, site="", path=""):
+        super().__init__(message)
+        self.site = site
+        self.path = path
+
+
+class Finding:
+    """One sanitizer hit. `key` is the baseline identity — site and
+    detector only, no line numbers, no instance ids — so the ratchet
+    never churns when code moves."""
+
+    __slots__ = ("detector", "site", "message")
+
+    def __init__(self, detector, site, message):
+        self.detector = detector
+        self.site = site
+        self.message = message
+
+    @property
+    def key(self):
+        return f"{self.site}::{self.detector}"
+
+    def to_dict(self):
+        return {"detector": self.detector, "site": self.site,
+                "message": self.message}
+
+    def __repr__(self):
+        return f"[{self.detector}] {self.site}: {self.message}"
+
+
+def _caller_site():
+    """``file.py:line`` of the nearest frame outside this package plus,
+    when different, the nearest frame outside paddle_tpu entirely —
+    blame lands on the framework call AND the user code driving it."""
+    pkg = os.path.dirname(__file__)
+    root = os.path.dirname(os.path.dirname(pkg))   # repo root-ish
+    tree = os.path.dirname(pkg)                    # paddle_tpu/
+    near = far = None
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(pkg) and "numpy" not in fn:
+            if near is None:
+                near = (fn, f.f_lineno)
+            if not fn.startswith(tree):
+                far = (fn, f.f_lineno)
+                break
+        f = f.f_back
+    if near is None:
+        return "<unknown>"
+
+    def fmt(site):
+        fn, ln = site
+        try:
+            rel = os.path.relpath(fn, root)
+        except ValueError:
+            rel = fn
+        if rel.startswith(".."):
+            rel = os.path.basename(fn)
+        return f"{rel}:{ln}"
+
+    if far is not None and far != near:
+        return f"{fmt(near)} (from {fmt(far)})"
+    return fmt(near)
+
+
+def _flatten_sig(sig, out):
+    if isinstance(sig, (tuple, list)):
+        for s in sig:
+            _flatten_sig(s, out)
+    else:
+        out.append(sig)
+    return out
+
+
+def _describe_delta(old_sig, new_sig):
+    """Human-readable diff between two trace signatures (the
+    shape/dtype/weak_type drift that caused a retrace)."""
+    a = _flatten_sig(old_sig, [])
+    b = _flatten_sig(new_sig, [])
+    if len(a) != len(b):
+        return (f"signature arity/structure changed "
+                f"({len(a)} -> {len(b)} leaves)")
+    diffs = [f"leaf {i}: {x!r} -> {y!r}"
+             for i, (x, y) in enumerate(zip(a, b)) if x != y]
+    if not diffs:
+        return "identical signature"
+    shown = "; ".join(diffs[:4])
+    if len(diffs) > 4:
+        shown += f"; ... {len(diffs) - 4} more"
+    return shown
+
+
+class _Tls(threading.local):
+    def __init__(self):
+        self.regions = []      # hot-region label stack
+        self.allow = 0         # allow_host_sync nesting depth
+
+
+class _Registry:
+    """Global recorder. Guarded by a RAW threading.Lock on purpose (the
+    recorder must not observe itself through lockcheck — same rule as
+    lockcheck's own registry)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = _Tls()
+        self._counts = {}        # finding key -> exact count
+        self._samples = {}       # finding key -> [Finding] (capped)
+        self._entries = {}       # (site, entry_key) -> {"sigs", "warm", "last"}
+        self._donated = {}       # id(arr) -> (weakref, site, tag)
+        self.counters = {"traces": 0, "hot_regions": 0, "donations": 0,
+                         "finite_checks": 0, "use_checks": 0}
+
+    # -- findings ---------------------------------------------------------
+    def record(self, detector, site, message):
+        f = Finding(detector, site, message)
+        with self._mu:
+            self._counts[f.key] = self._counts.get(f.key, 0) + 1
+            samples = self._samples.setdefault(f.key, [])
+            if len(samples) < _MAX_SAMPLES:
+                samples.append(f)
+        return f
+
+    def findings(self):
+        with self._mu:
+            return [f for ss in self._samples.values() for f in ss]
+
+    def counts_by_key(self):
+        with self._mu:
+            return dict(self._counts)
+
+    # -- retrace sentinel --------------------------------------------------
+    def note_trace(self, site, entry_key, signature, per_call=False):
+        """Record one trace of `signature` at jit entrypoint
+        (site, entry_key).  per_call=True marks a call-site probe on a
+        caching jit object: a repeated signature there is a cache HIT,
+        not a retrace. At explicit compile sites (per_call=False) a
+        repeated signature means the compile cache failed — always a
+        finding. A NEW signature is a finding only once the entrypoint
+        is warm (mark_warm)."""
+        ek = (site, entry_key)
+        with self._mu:
+            ent = self._entries.pop(ek, None)
+            if ent is None:
+                if len(self._entries) >= _MAX_ENTRYPOINTS:
+                    # evict the least-recently-TOUCHED entry (the
+                    # pop/re-insert above keeps dict order ≈ recency):
+                    # plain insertion-order FIFO would evict the busy
+                    # process-lifetime entrypoints first and silently
+                    # disarm their warm state
+                    self._entries.pop(next(iter(self._entries)))
+                ent = {"sigs": set(), "warm": False, "last": None}
+            self._entries[ek] = ent
+            dup = signature in ent["sigs"]
+            warm = ent["warm"]
+            last = ent["last"]
+            ent["sigs"].add(signature)
+            ent["last"] = signature
+            if not (dup and per_call):
+                self.counters["traces"] += 1
+        if dup:
+            if per_call:
+                return
+            self.record(
+                "retrace", site,
+                f"identical signature compiled twice (the compile cache "
+                f"should have hit) at {_caller_site()}")
+        elif warm:
+            delta = _describe_delta(last, signature) if last is not None \
+                else "first signature after warm mark"
+            self.record(
+                "retrace", site,
+                f"retrace after warmup — {delta} — at {_caller_site()}")
+
+    def mark_warm(self, site=None):
+        """Declare warmup over: every signature the matching entrypoints
+        later trace is a retrace finding. site=None marks ALL entrypoints
+        seen so far (entrypoints created later start cold — a freshly
+        loaded model legitimately compiles)."""
+        with self._mu:
+            for (s, _k), ent in self._entries.items():
+                if site is None or s == site:
+                    ent["warm"] = True
+
+    # -- host-sync detector ------------------------------------------------
+    def region_enter(self, label):
+        self._tls.regions.append(label)
+        with self._mu:
+            self.counters["hot_regions"] += 1
+
+    def region_exit(self):
+        self._tls.regions.pop()
+
+    def current_region(self):
+        tls = self._tls
+        if tls.regions and not tls.allow:
+            return tls.regions[-1]
+        return None
+
+    def note_sync(self, what):
+        region = self.current_region()
+        if region is None:
+            return
+        self.record(
+            "host-sync", region,
+            f"{what} on a device array inside hot region '{region}' "
+            f"at {_caller_site()}")
+
+    # -- donation guard ----------------------------------------------------
+    def note_donation(self, site, leaves, tag=None):
+        with self._mu:
+            self.counters["donations"] += 1
+            if len(self._donated) > _MAX_DONATIONS:
+                self._donated = {i: rec for i, rec in self._donated.items()
+                                 if rec[0]() is not None}
+            for leaf in leaves:
+                try:
+                    ref = weakref.ref(leaf)
+                except TypeError:
+                    continue
+                self._donated[id(leaf)] = (ref, site, tag)
+
+    def donation_site(self, value):
+        with self._mu:
+            rec = self._donated.get(id(value))
+        if rec is not None and rec[0]() is value:
+            return rec[1], rec[2]
+        return None, None
+
+    def reset(self):
+        with self._mu:
+            self._counts = {}
+            self._samples = {}
+            self._entries = {}
+            self._donated = {}
+            self.counters = {k: 0 for k in self.counters}
+
+    def report(self):
+        with self._mu:
+            return {
+                "counts": dict(self._counts),
+                "findings": [f.to_dict() for ss in self._samples.values()
+                             for f in ss],
+                "by_detector": {
+                    d: sum(n for k, n in self._counts.items()
+                           if k.endswith("::" + d)) for d in DETECTORS},
+                "counters": dict(self.counters),
+                "entrypoints": len(self._entries),
+            }
+
+
+_registry = _Registry()
+
+
+def registry():
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# enable / disable + interposers
+# ---------------------------------------------------------------------------
+
+_np_orig = {}
+_jax_orig = {}
+
+
+def _device_array(x):
+    """The concrete jax array behind `x`, or None. Tracers are excluded:
+    a trace-time conversion raises jax's own (better) error and is
+    tracelint's territory, not a runtime host sync."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        if isinstance(x, jax.core.Tracer):
+            return None
+        if isinstance(x, jax.Array):
+            return x
+    except Exception:  # tpu-lint: disable=TL007 — exotic array-likes may
+        return None    # raise from isinstance; never break the host call
+    return None
+
+
+def _donation_of(arr):
+    """(site, tag) when `arr` is known-donated. The registry's own
+    record comes first: the CPU backend does not implement donation, so
+    a donated buffer stays physically readable there — but the same
+    program deletes it on TPU, and tier-1 must catch that bug on CPU."""
+    site, tag = _registry.donation_site(arr)
+    if site is not None:
+        return site, tag
+    if arr.is_deleted():
+        return "<unknown-donation>", None
+    return None, None
+
+
+def _precheck(x, what):
+    """Shared body of the patched converters: use-after-donate first
+    (a typed error beats jax's anonymous one anywhere, hot region or
+    not), then the mid-region sync probe."""
+    arr = _device_array(x)
+    if arr is None:
+        return
+    site, tag = _donation_of(arr)
+    if site is not None:
+        where = f"donated at {site}" + (f" ({tag})" if tag else "")
+        _registry.record("donation", site,
+                         f"{what} on a donated buffer ({where}) "
+                         f"at {_caller_site()}")
+        raise DonatedBufferError(
+            f"use-after-donate: {what} on a buffer {where}; donated "
+            f"buffers are invalidated in place — read the engine's live "
+            f"state (param_vals / Parameter) instead. At {_caller_site()}")
+    _registry.note_sync(what)
+
+
+def _install():
+    import numpy as np
+
+    if "asarray" not in _np_orig:
+        _np_orig["asarray"] = np.asarray
+        _np_orig["array"] = np.array
+
+        def asarray(a, *args, **kw):
+            _precheck(a, "np.asarray()")
+            return _np_orig["asarray"](a, *args, **kw)
+
+        def array(a, *args, **kw):
+            _precheck(a, "np.array()")
+            return _np_orig["array"](a, *args, **kw)
+
+        np.asarray = asarray
+        np.array = array
+    jax = sys.modules.get("jax")
+    if jax is not None and "device_get" not in _jax_orig:
+        _jax_orig["device_get"] = jax.device_get
+
+        def device_get(x):
+            for leaf in _iter_leaves(x):
+                _precheck(leaf, "jax.device_get()")
+            return _jax_orig["device_get"](x)
+
+        jax.device_get = device_get
+    # san.* counters on the obs registry (weak-collector semantics don't
+    # apply to a module function; unregistered again on disable())
+    try:
+        from ..obs.metrics import registry as _obs
+        _obs().register_collector(OBS_COLLECTOR, _obs_collect)
+    except Exception:  # tpu-lint: disable=TL007 — obs is optional here:
+        pass           # the sanitizer must work without the registry
+
+
+def _uninstall():
+    import numpy as np
+
+    if "asarray" in _np_orig:
+        np.asarray = _np_orig.pop("asarray")
+        np.array = _np_orig.pop("array")
+    jax = sys.modules.get("jax")
+    if jax is not None and "device_get" in _jax_orig:
+        jax.device_get = _jax_orig.pop("device_get")
+    try:
+        from ..obs.metrics import registry as _obs
+        _obs().unregister_collector(OBS_COLLECTOR)
+    except Exception:  # tpu-lint: disable=TL007 — symmetric with _install
+        pass
+
+
+def _iter_leaves(x):
+    if isinstance(x, (list, tuple)):
+        for e in x:
+            yield from _iter_leaves(e)
+    elif isinstance(x, dict):
+        for e in x.values():
+            yield from _iter_leaves(e)
+    else:
+        yield x
+
+
+def _obs_collect():
+    rep = _registry.report()
+    out = {"enabled": 1, "findings": sum(rep["counts"].values()),
+           "entrypoints": rep["entrypoints"]}
+    out.update({d.replace("-", "_"): n
+                for d, n in rep["by_detector"].items()})
+    out.update(rep["counters"])
+    return out
+
+
+def enable():
+    """Turn the sanitizer on: installs the numpy/jax interposers and the
+    obs collector. Probes constructed before this call work immediately
+    (they check the module flag per entry, unlike lockcheck's
+    construction-time decision)."""
+    global _enabled
+    _enabled = True
+    _install()
+
+
+def disable():
+    global _enabled
+    _enabled = False
+    _uninstall()
+
+
+def enabled():
+    return _enabled
+
+
+def nonfinite_enabled():
+    return _enabled and _env_on(_ENV_NONFINITE, default="1")
+
+
+def reset():
+    """Clear all recorded state (the enable flag stays)."""
+    _registry.reset()
+
+
+# install at import when the env asks for it (the interposers only need
+# numpy; jax is patched lazily if/when it is imported — see hot_region)
+if _enabled:
+    _install()
+
+
+# ---------------------------------------------------------------------------
+# probes (all free when the sanitizer is off)
+# ---------------------------------------------------------------------------
+
+class _NullRegion:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullRegion()
+
+
+class _HotRegion:
+    __slots__ = ("label",)
+
+    def __init__(self, label):
+        self.label = label
+
+    def __enter__(self):
+        _registry.region_enter(self.label)
+        return self
+
+    def __exit__(self, *exc):
+        _registry.region_exit()
+        return False
+
+
+class _AllowSync:
+    __slots__ = ()
+
+    def __enter__(self):
+        _registry._tls.allow += 1
+        return self
+
+    def __exit__(self, *exc):
+        _registry._tls.allow -= 1
+        return False
+
+
+def hot_region(label):
+    """Mark a dispatch hot path (sibling of lockcheck's
+    ``blocking_region``): any device-array→host conversion by this
+    thread inside the region records a host-sync finding. Free when the
+    sanitizer is off."""
+    if not _enabled:
+        return _NULL
+    if "device_get" not in _jax_orig and "jax" in sys.modules:
+        _install()       # jax imported after enable(): patch it now
+    return _HotRegion(label)
+
+
+def allow_host_sync(reason=""):
+    """Sanction a deliberate readback inside a hot region (result fetch,
+    token streaming) — the runtime analog of a lint suppression."""
+    if not _enabled:
+        return _NULL
+    return _AllowSync()
+
+
+def in_hot_region():
+    return _enabled and _registry.current_region() is not None
+
+
+def note_trace(site, entry_key, signature, per_call=False):
+    if _enabled:
+        _registry.note_trace(site, entry_key, signature, per_call=per_call)
+
+
+def mark_warm(site=None):
+    if _enabled:
+        _registry.mark_warm(site)
+
+
+def aval_signature(values):
+    """Hashable (shape, dtype, weak_type) signature of a pytree of
+    arrays / ShapeDtypeStructs — the retrace sentinel's cache-key
+    analog."""
+    def leaf(v):
+        # one STRING per array: the retrace delta then diffs whole
+        # avals ("(2, 8)/float32 -> (3, 8)/float32"), not digits
+        shape = tuple(getattr(v, "shape", ()))
+        dtype = str(getattr(v, "dtype", type(v).__name__))
+        weak = "/weak" if getattr(v, "weak_type", False) else ""
+        return f"{shape}/{dtype}{weak}"
+
+    def walk(v):
+        if isinstance(v, dict):
+            return tuple((k, walk(v[k])) for k in sorted(v))
+        if isinstance(v, (list, tuple)):
+            return tuple(walk(e) for e in v)
+        return leaf(v)
+
+    return walk(values)
+
+
+def note_donation(site, tree, tag=None):
+    """Record that every array leaf of `tree` was just donated to a
+    dispatch at `site` (called AFTER the dispatch; the leaves are the
+    pre-dispatch buffers). `tag` rides into the blame message."""
+    if not _enabled:
+        return
+    leaves = [v for v in _iter_leaves(tree) if _device_array(v) is not None]
+    _registry.note_donation(site, leaves, tag=tag)
+
+
+def check_use(value, context=""):
+    """Raise DonatedBufferError (naming the donation site) if `value` is
+    a donated/deleted device array. Framework choke points (batch
+    placement, external-write adoption) call this so the error surfaces
+    where the stale buffer ENTERS the engine, not inside XLA."""
+    if not _enabled:
+        return value
+    with _registry._mu:       # same discipline as every other counter
+        _registry.counters["use_checks"] += 1
+    arr = _device_array(value)
+    if arr is not None:
+        site, tag = _donation_of(arr)
+        if site is not None:
+            where = f"donated at {site}" + (f" ({tag})" if tag else "")
+            _registry.record("donation", site,
+                             f"{context or 'use'} of a donated buffer "
+                             f"({where}) at {_caller_site()}")
+            raise DonatedBufferError(
+                f"use-after-donate{': ' + context if context else ''} — "
+                f"buffer was {where}. Donated buffers are invalidated in "
+                f"place; re-read live engine state instead.")
+    return value
+
+
+def check_finite(site, named_leaves):
+    """NaN/Inf sweep over ``(path, value)`` pairs; raises NonFiniteError
+    blaming the FIRST offending leaf (and records a finding keyed to
+    `site`). Non-float leaves are skipped. No-op unless the sanitizer
+    AND its non-finite detector are on."""
+    if not nonfinite_enabled():
+        return
+    import numpy as np
+    jnp = None
+    _registry.counters["finite_checks"] += 1
+    with allow_host_sync("san.finite_sweep"):
+        for path, value in named_leaves:
+            # device-array FIRST, Tensor-unwrap second: jax's ArrayImpl
+            # has its own private `_value` (cached numpy) — a blind
+            # getattr would silently pull every device array to the
+            # host AND route bf16 through numpy's dtype lattice
+            arr = _device_array(value)
+            v = value if arr is not None else \
+                getattr(value, "_value", value)    # Tensor -> array
+            if arr is None:
+                arr = _device_array(v)
+            dt = getattr(v, "dtype", None)
+            if dt is None:
+                continue
+            if arr is not None:
+                if jnp is None:
+                    import jax.numpy as jnp
+                # jnp.issubdtype, NOT np.issubdtype: numpy does not put
+                # bfloat16 (or any ml_dtypes float) under np.floating,
+                # which would silently skip bf16 params and KV pools —
+                # the very tensors this sweep exists for
+                if not jnp.issubdtype(dt, jnp.floating):
+                    continue
+                ok = bool(jnp.isfinite(v).all())
+            else:
+                if not np.issubdtype(np.dtype(dt), np.floating):
+                    continue
+                ok = bool(np.isfinite(np.asarray(v)).all())
+            if not ok:
+                _registry.record(
+                    "non-finite", site,
+                    f"non-finite value in leaf '{path}' after dispatch "
+                    f"at {_caller_site()}")
+                raise NonFiniteError(
+                    f"non-finite value detected at '{site}': first "
+                    f"offending leaf is '{path}' "
+                    f"(shape {tuple(getattr(v, 'shape', ()))}). The "
+                    f"dispatch that produced it is the one blamed by "
+                    f"this site; earlier steps were finite.",
+                    site=site, path=path)
+
+
+# ---------------------------------------------------------------------------
+# module-level report / ratchet surface
+# ---------------------------------------------------------------------------
+
+def findings():
+    return _registry.findings()
+
+
+def counts_by_key():
+    return _registry.counts_by_key()
+
+
+def report():
+    return _registry.report()
+
+
+def assert_clean():
+    """Raise SanError if any finding was recorded (message embeds the
+    exemplars). The fault injector's final verdict."""
+    rep = _registry.report()
+    total = sum(rep["counts"].values())
+    if total:
+        lines = [f"  {f['site']} [{f['detector']}]: {f['message']}"
+                 for f in rep["findings"]]
+        raise SanError(
+            f"tpu-san found {total} finding(s):\n" + "\n".join(lines))
+    return rep
+
+
+def load_baseline(path):
+    import json
+
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "counts" not in data:
+        raise ValueError(f"{path}: not a tpu-san baseline "
+                         "(missing 'counts')")
+    return data["counts"]
+
+
+def write_baseline(path, counts):
+    """Deterministic (sorted-keys, newline-terminated) baseline dump —
+    same shape as the tracelint ratchet so the two review identically."""
+    import json
+
+    data = {"version": 1, "tool": "tpu_san", "counts": dict(counts)}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def new_counts(counts, baseline_counts):
+    """{key: (count, baselined)} for keys whose count exceeds the
+    baselined count — the ratchet's failing set."""
+    return {k: (n, baseline_counts.get(k, 0))
+            for k, n in sorted(counts.items())
+            if n > baseline_counts.get(k, 0)}

@@ -48,6 +48,12 @@ Rule catalogue (docs/static_analysis.md has one bad/good example each):
          sharding subsystem's factories/rule table); hand-built
          shardings drift out of agreement with it. Legacy sites are
          frozen in the baseline and burn down instead of growing
+  TL012  raw `threading.Lock()`/`RLock()`/`Condition()` construction
+         outside `paddle_tpu/analysis/` — anonymous locks are invisible
+         to lockcheck (no name in the acquisition-order graph, no
+         held-across-blocking attribution) and to tpu-san's reports;
+         use `analysis.locks.new_lock("subsystem.name")` and friends.
+         Legacy sites are frozen in the baseline and burn down
 
 Suppressions: append ``# tpu-lint: disable=TL001`` (comma-separate for
 several, or ``disable=all``) to the offending line (for ``except``
@@ -98,10 +104,17 @@ RULES = {
     "TL011": "raw NamedSharding/PartitionSpec construction outside "
              "paddle_tpu/sharding (use the sharding factories/rule "
              "table)",
+    "TL012": "raw threading.Lock/RLock/Condition construction outside "
+             "paddle_tpu/analysis (use analysis.locks named "
+             "constructors so lockcheck can see the lock)",
 }
 
 #: files allowed to construct shardings directly (the authority itself)
 _SHARDING_AUTHORITY = "paddle_tpu/sharding/"
+#: files allowed to construct raw threading primitives (the lock
+#: authority itself: locks.py's off-path constructors, lockcheck's and
+#: runtime_san's self-guards, which must never observe themselves)
+_LOCK_AUTHORITY = "paddle_tpu/analysis/"
 
 # Decorators / higher-order callers that put the wrapped function under a
 # JAX trace. Matched on the trailing dotted components, so `jax.jit`,
@@ -729,31 +742,38 @@ def _wallclock_findings(path, tree, suppress, findings, wall_aliases=None,
 _SHARDING_CTORS = {"NamedSharding", "PartitionSpec"}
 
 
-def _sharding_ctor_findings(path, tree, suppress, findings):
-    """TL011 over the whole module: calls that construct
-    jax.sharding.{NamedSharding, PartitionSpec} directly. Matches the
-    from-import (with as-alias, e.g. ``PartitionSpec as P``), the module
-    path (``jax.sharding.NamedSharding``) and module aliases
-    (``import jax.sharding as jsh``). Files under `paddle_tpu/sharding/`
-    are the authority and exempt (handled by the caller)."""
-    local = {}     # local callable name -> ctor name
-    mod_alias = {}  # alias -> "jax.sharding"
+def _ctor_authority_findings(path, tree, suppress, findings, *, module,
+                             ctors, rule, message):
+    """Shared skeleton of the construction-authority rules (TL011,
+    TL012): flag Call nodes that construct one of `ctors` from `module`.
+    Resolves the from-import (with as-aliases, e.g. ``PartitionSpec as
+    P`` / ``Lock as L``), module aliases (``import jax.sharding as
+    jsh`` / ``import threading as t``) and — for dotted modules —
+    ``from <parent> import <sub> [as alias]``. Same-named ctors from
+    OTHER modules (``multiprocessing.Lock``) never match: resolution is
+    to the real module path. `message` maps a ctor name to the finding
+    text. Authority-path exemption is handled by the caller."""
+    local = {}      # local callable name -> ctor name
+    mod_alias = {}  # alias -> module
+    if "." not in module:
+        mod_alias[module] = module      # plain `import threading`
+    parent, _, sub = module.rpartition(".")
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom):
-            if node.module == "jax.sharding":
+            if node.module == module:
                 for a in node.names:
-                    if a.name in _SHARDING_CTORS:
+                    if a.name in ctors:
                         local[a.asname or a.name] = a.name
-            elif node.module == "jax":
+            elif parent and node.module == parent:
                 # `from jax import sharding [as jsh]` — call sites reach
-                # the ctors through the module name
+                # the ctors through the submodule name
                 for a in node.names:
-                    if a.name == "sharding":
-                        mod_alias[a.asname or a.name] = "jax.sharding"
+                    if a.name == sub:
+                        mod_alias[a.asname or a.name] = module
         elif isinstance(node, ast.Import):
             for a in node.names:
-                if a.name == "jax.sharding" and a.asname:
-                    mod_alias[a.asname] = "jax.sharding"
+                if a.name == module and a.asname:
+                    mod_alias[a.asname] = module
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -765,17 +785,48 @@ def _sharding_ctor_findings(path, tree, suppress, findings):
             head, _, rest = callee.partition(".")
             resolved = f"{mod_alias.get(head, head)}.{rest}" \
                 if head in mod_alias else callee
-            if resolved.startswith("jax.sharding.") and \
-                    resolved.rsplit(".", 1)[-1] in _SHARDING_CTORS:
+            if resolved.startswith(module + ".") and \
+                    resolved.rsplit(".", 1)[-1] in ctors:
                 ctor = resolved.rsplit(".", 1)[-1]
         if ctor is None:
             continue
-        if _suppressed(suppress, "TL011", node.lineno):
+        if _suppressed(suppress, rule, node.lineno):
             continue
         findings.append(Finding(
-            "TL011", path, node.lineno, node.col_offset, "<module>",
+            rule, path, node.lineno, node.col_offset, "<module>",
+            message(ctor)))
+
+
+def _sharding_ctor_findings(path, tree, suppress, findings):
+    """TL011 over the whole module: calls that construct
+    jax.sharding.{NamedSharding, PartitionSpec} directly. Files under
+    `paddle_tpu/sharding/` are the authority and exempt (handled by the
+    caller)."""
+    _ctor_authority_findings(
+        path, tree, suppress, findings,
+        module="jax.sharding", ctors=_SHARDING_CTORS, rule="TL011",
+        message=lambda ctor: (
             f"raw `{ctor}(...)` — resolve placement through "
             f"paddle_tpu.sharding (named_sharding/spec/rule table)"))
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _lock_ctor_findings(path, tree, suppress, findings):
+    """TL012 over the whole module: calls that construct
+    ``threading.{Lock, RLock, Condition}`` directly — anonymous to
+    lockcheck's acquisition-order graph and to tpu-san.
+    `multiprocessing.Lock()` etc. never match. Files under
+    `paddle_tpu/analysis/` are the authority and exempt (handled by the
+    caller)."""
+    _ctor_authority_findings(
+        path, tree, suppress, findings,
+        module="threading", ctors=_LOCK_CTORS, rule="TL012",
+        message=lambda ctor: (
+            f"raw `threading.{ctor}(...)` — use `analysis.locks."
+            f"new_{ctor.lower()}(\"subsystem.name\")` so lockcheck and "
+            f"tpu-san can see and name the lock"))
 
 
 def _static_spec(keywords):
@@ -921,8 +972,11 @@ def lint_source(source, path="<string>"):
                         mod_aliases)
     findings.extend(f for f in wall if f.line not in tl001_lines)
     _static_arg_findings(path, tree, suppress, findings)
-    if _SHARDING_AUTHORITY not in path.replace(os.sep, "/"):
+    posix_path = path.replace(os.sep, "/")
+    if _SHARDING_AUTHORITY not in posix_path:
         _sharding_ctor_findings(path, tree, suppress, findings)
+    if _LOCK_AUTHORITY not in posix_path:
+        _lock_ctor_findings(path, tree, suppress, findings)
     return sorted(findings, key=Finding.sort_key)
 
 

@@ -19,10 +19,14 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+# NamedSharding is imported for the isinstance probe in _axis_sharded
+# only — construction goes through the paddle_tpu.sharding factories
+# (the ONE placement authority, tracelint TL011)
+from jax.sharding import Mesh, NamedSharding
 from ..compat import shard_map
 
 from ..core.tensor import Tensor
+from ..sharding import named_sharding as _named_sharding, spec as _spec
 from . import topology as topo_mod
 
 
@@ -203,7 +207,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return lax_red(x, axis)
 
     def out_spec(spec):
-        return P(*[_strip_axis(e, axis) for e in spec])
+        return _spec(*[_strip_axis(e, axis) for e in spec])
 
     out = _collective_over_axis(v, group.mesh, axis, body, out_spec)
     if isinstance(tensor, Tensor):
@@ -234,7 +238,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         return jax.lax.all_gather(x, axis)
 
     def out_spec(spec):
-        return P(*([None] + [_strip_axis(e, axis) for e in spec]))
+        return _spec(*([None] + [_strip_axis(e, axis) for e in spec]))
 
     out = _collective_over_axis(v, group.mesh, axis, body, out_spec)
     tensor_list.clear()
@@ -267,7 +271,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return gathered[src]
 
     def out_spec(spec):
-        return P(*[_strip_axis(e, axis) for e in spec])
+        return _spec(*[_strip_axis(e, axis) for e in spec])
 
     out = _collective_over_axis(v, group.mesh, axis, body, out_spec)
     if isinstance(tensor, Tensor):
@@ -324,7 +328,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     if not _axis_sharded(v, mesh, axis):
         # replicated input: out shard r = n * chunk_r — just scale and shard
         spec = [axis] + [None] * (v.ndim - 1)
-        out = jax.device_put(v * n, NamedSharding(mesh, P(*spec)))
+        out = jax.device_put(v * n, _named_sharding(mesh, spec))
     else:
         if (v.shape[0] // n) % n != 0:
             raise ValueError(
@@ -335,7 +339,8 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
             return jax.lax.psum_scatter(x, axis, tiled=True)
 
         def out_spec(spec):
-            return P(*[axis if i == 0 else e for i, e in enumerate(spec)])
+            return _spec(*[axis if i == 0 else e
+                           for i, e in enumerate(spec)])
 
         out = _collective_over_axis(v, mesh, axis, body, out_spec)
     if isinstance(tensor, Tensor):
@@ -379,7 +384,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             "(per-rank values live in the shards); replicated inputs have "
             "no per-rank identity on a single controller")
     stacked = jnp.stack(vals)  # [nranks, global0, ...]
-    in_spec = P(*([None] + list(vals[0].sharding.spec)))
+    in_spec = _spec(*([None] + list(vals[0].sharding.spec)))
 
     def body(x):
         # x: [nranks, shard...]; exchange dim0 across the axis ring
@@ -388,7 +393,8 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
     fn = shard_map(body, mesh=mesh, in_specs=(in_spec,), out_specs=in_spec,
                    check_vma=False)
-    out = jax.jit(fn)(jax.device_put(stacked, NamedSharding(mesh, in_spec)))
+    out = jax.jit(fn)(jax.device_put(stacked,
+                                     _named_sharding(mesh, in_spec)))
     out_tensor_list.clear()
     for i in range(group.nranks):
         out_tensor_list.append(Tensor(out[i]))

@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import runtime_san as _san
 from ..core import lazy as _lazy
 from ..core.tensor import Tensor
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
@@ -268,8 +269,14 @@ class ShardedTrainStep:
         from prefetch_to_device) are passed through untouched."""
         placed = []
         nputs = 0
+        san = _san.enabled()
         for b in batch:
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            if san:
+                # donation guard: a batch built from a buffer the engine
+                # donated last step fails HERE with the donation site,
+                # not inside XLA with "Array has been deleted"
+                _san.check_use(v, "engine.place_batch")
             sh = self._batch_sharding(v.ndim)
             if getattr(v, "sharding", None) != sh:
                 v = jax.device_put(v, sh)
@@ -313,6 +320,9 @@ class ShardedTrainStep:
         path — no property-setter work per step."""
         for n, p, ref in self._param_refs:
             if p._v_ is not ref:
+                if _san.enabled():
+                    _san.check_use(p._value,
+                                   f"engine.adopt_external_write[{n}]")
                 self.param_vals[n] = jax.device_put(p._value,
                                                     self._param_sh[n])
                 self.stats["device_puts"] += 1
@@ -459,23 +469,47 @@ class ShardedTrainStep:
         self._adopt_external_writes()
         with _span("engine::device_put"):
             placed = self._place_batch(batch)
-        if self._step_fn is None:
+        san = _san.enabled()
+        cold = self._step_fn is None
+        if san:
+            # per-call sentinel: the step jit retraces INTERNALLY on any
+            # new batch signature — a cache-keyed build hook would miss
+            # exactly the silent steady-state recompile this flags
+            _san.note_trace("engine.step", self._obs_key,
+                            _san.aval_signature(placed), per_call=True)
+        if cold:
             self._step_fn = self._build_step(placed)
         lr = self._lr_scalar()
         key = self._key_scalar()
         step_no = self._step_scalar()
         self._step_count += 1
-        with _span("engine::dispatch", histogram=self._h_dispatch):
+        donated = (self.param_vals, self.opt_state, self.buffer_vals,
+                   key, step_no) if san and self.donate else None
+        # the hot-sync probe arms only on WARM dispatches: the cold call
+        # traces user loss code (compile time, not the hot path)
+        with _span("engine::dispatch", histogram=self._h_dispatch), \
+                (_san.allow_host_sync("engine.compile") if cold
+                 else _san.hot_region("engine.dispatch")):
             (loss, gnorm, self.param_vals, self.opt_state, self.buffer_vals,
              self._key_dev, self._step_dev) = self._step_fn(
                 self.param_vals, self.opt_state, self.buffer_vals, placed,
                 lr, key, step_no)
+        if donated is not None:
+            _san.note_donation("engine.dispatch", donated,
+                               tag=f"step {self._step_count}")
         self.stats["dispatches"] += 1
         self.stats["steps"] += 1
         self.last_grad_norm = gnorm  # device scalar; float() to read
         self.last_grad_norms = None  # per-step vector: train_batches only
         with _span("engine::write_back"):
             self._write_back_buffers()
+        if san:
+            # AFTER write-back: a NonFiniteError here is meant to be
+            # caught, and the model's buffer Tensors must already point
+            # at the post-dispatch values (their old buffers were just
+            # donated)
+            _san.check_finite("engine.step", self._finite_leaves(
+                loss=loss, grad_norm=gnorm))
         # Parameters resolve lazily via their EngineRef — no per-param
         # write-back loop. LR schedulers follow the eager convention: the
         # USER calls scheduler.step(); get_lr() is re-read every batch (the
@@ -551,19 +585,32 @@ class ShardedTrainStep:
 
         sig = (n, static, tuple((tuple(a.shape), str(a.dtype))
                                 for a in placed))
+        san = _san.enabled()
+        if san:
+            _san.note_trace("engine.multi", self._obs_key, sig,
+                            per_call=True)
         fn = self._multi_fns.get(sig)
-        if fn is None:
+        cold = fn is None
+        if cold:
             fn = self._build_multi(placed, static)
             self._multi_fns[sig] = fn
 
         lrs = self._lr_schedule_array(n)
         key = self._key_scalar()
         step0 = self._step_scalar()
-        with _span("engine::dispatch", histogram=self._h_dispatch):
+        donated = (self.param_vals, self.opt_state, self.buffer_vals,
+                   key, step0) if san and self.donate else None
+        with _span("engine::dispatch", histogram=self._h_dispatch), \
+                (_san.allow_host_sync("engine.compile") if cold
+                 else _san.hot_region("engine.dispatch")):
             (losses, gnorms, self.param_vals, self.opt_state,
              self.buffer_vals, self._key_dev, self._step_dev) = fn(
                 self.param_vals, self.opt_state, self.buffer_vals, placed,
                 lrs, key, step0)
+        if donated is not None:
+            _san.note_donation("engine.dispatch", donated,
+                               tag=f"steps {self._step_count + 1}.."
+                                   f"{self._step_count + n}")
         self.stats["dispatches"] += 1
         self.stats["steps"] += n
         self._step_count += n
@@ -571,6 +618,10 @@ class ShardedTrainStep:
         self.last_grad_norm = gnorms[-1]
         with _span("engine::write_back"):
             self._write_back_buffers()
+        if san:
+            # AFTER write-back — see train_batch
+            _san.check_finite("engine.step", self._finite_leaves(
+                loss=losses, grad_norm=gnorms))
         return Tensor(losses)
 
     def _lr_schedule_array(self, n):
@@ -595,6 +646,14 @@ class ShardedTrainStep:
         self.stats["device_puts"] += 1
         return arr
 
+    def _finite_leaves(self, **scalars):
+        """(path, value) sweep order for the tpu-san non-finite guard:
+        loss and grad norm first (cheapest, most diagnostic), then every
+        parameter — so the blame names the first poisoned param path."""
+        leaves = list(scalars.items())
+        leaves.extend(("param/" + n, v) for n, v in self.param_vals.items())
+        return leaves
+
     def _write_back_buffers(self):
         for n, b in self._buffers.items():
             b._value = self.buffer_vals[n]
@@ -606,14 +665,22 @@ class ShardedTrainStep:
         with _span("engine::device_put"):
             placed = self._place_batch(batch)
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in placed)
+        if _san.enabled():
+            _san.note_trace("engine.eval", self._obs_key, sig,
+                            per_call=True)
         fn = self._eval_fns.get(sig)
-        if fn is None:
+        cold = fn is None
+        if cold:
             fn = self._build_eval(placed)
             self._eval_fns[sig] = fn
         key = rng_mod.next_key()
-        with _span("engine::dispatch", histogram=self._h_dispatch):
+        with _span("engine::dispatch", histogram=self._h_dispatch), \
+                (_san.allow_host_sync("engine.compile") if cold
+                 else _san.hot_region("engine.dispatch")):
             loss = fn(self.param_vals, self.buffer_vals, placed, key)
         self.stats["dispatches"] += 1
+        if _san.enabled():
+            _san.check_finite("engine.eval", [("loss", loss)])
         return Tensor(loss)
 
     def _build_eval(self, batch_avals):

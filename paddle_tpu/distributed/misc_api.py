@@ -50,7 +50,6 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         else jnp.asarray(in_tensor)
     if group.nranks > 1 and C._axis_sharded(v, group.mesh, group.axis):
         from ..compat import shard_map
-        from jax.sharding import NamedSharding, PartitionSpec as P
         spec = v.sharding.spec
 
         def body(x):
@@ -178,17 +177,17 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
         in_f, out_f = size
         w = paddle.randn([in_f, out_f]) * (1.0 / np.sqrt(in_f))
         if mesh is not None and mesh.shape.get("mp", 1) > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            spec = P(None, "mp") if axis == 1 else P("mp", None)
-            w._value = jax.device_put(w._value, NamedSharding(mesh, spec))
+            from ..sharding import named_sharding, spec as spec_of
+            sp = spec_of(None, "mp") if axis == 1 else spec_of("mp", None)
+            w._value = jax.device_put(w._value, named_sharding(mesh, sp))
         return paddle.matmul(x, w)
     if operation == "embedding":
         vocab, dim = size
         w = paddle.randn([vocab, dim]) * 0.02
         if mesh is not None and mesh.shape.get("mp", 1) > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            w._value = jax.device_put(w._value,
-                                      NamedSharding(mesh, P("mp", None)))
+            from ..sharding import named_sharding, spec as spec_of
+            w._value = jax.device_put(
+                w._value, named_sharding(mesh, spec_of("mp", None)))
         from ..nn.functional import embedding
         return embedding(x, w)
     raise ValueError(f"split: unknown operation {operation!r}")
@@ -223,8 +222,8 @@ def unshard_dtensor(dist_tensor):
         else dist_tensor
     sh = getattr(v, "sharding", None)
     if sh is not None and hasattr(sh, "mesh"):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        v = jax.device_put(v, NamedSharding(sh.mesh, P()))
+        from ..sharding import replicated
+        v = jax.device_put(v, replicated(sh.mesh))
     return Tensor(v)
 
 

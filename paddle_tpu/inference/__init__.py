@@ -13,6 +13,8 @@ import contextlib as _contextlib
 
 import numpy as np
 
+from ..analysis import runtime_san as _san
+
 __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorPool",
     # resilient serving runtime (serving.py)
@@ -148,7 +150,10 @@ class Predictor:
                       for n in self.get_input_names()]
         outs = self._layer(*inputs)
         outs = outs if isinstance(outs, tuple) else (outs,)
-        res = [np.asarray(o.numpy()) for o in outs]
+        # output fetch = the request's deliverable: a sanctioned sync
+        # inside the pool's serving.execute hot region (tpu-san)
+        with _san.allow_host_sync("predictor.fetch"):
+            res = [np.asarray(o.numpy()) for o in outs]
         for i, arr in enumerate(res):
             self._outputs[f"output_{i}"].copy_from_cpu(arr)
         return res

@@ -42,6 +42,7 @@ import time
 import numpy as np
 
 from ..analysis import locks as _locks
+from ..analysis import runtime_san as _san
 
 __all__ = ["BatchConfig", "DynamicBatcher"]
 
@@ -205,7 +206,10 @@ class DynamicBatcher:
         t0 = time.perf_counter()
         with _span("serving::batch_dispatch"):
             outs = fn(*stacked)
-            outs = [np.asarray(o) for o in outs]  # device sync + one copy
+            # the result readback IS the batch's deliverable — a
+            # sanctioned sync inside the pool's batch_dispatch hot region
+            with _san.allow_host_sync("serving.batch_fetch"):
+                outs = [np.asarray(o) for o in outs]  # device sync + copy
         exec_ms = (time.perf_counter() - t0) * 1e3
         if self.h_execute is not None:
             self.h_execute.observe(exec_ms / 1e3)

@@ -76,6 +76,7 @@ import time
 import numpy as np
 
 from ...analysis import locks as _locks
+from ...analysis import runtime_san as _san
 from ..serving import (Deadline, DeadlineExceeded, Overloaded, PoolClosed,
                        RequestFailed, RetryPolicy, ServingPool,
                        _NullPredictor)
@@ -641,6 +642,22 @@ class DecodeEngine:
         return {"decode": list(self.decode_buckets),
                 "prefill": list(self.prefill_buckets)}
 
+    def _san_sweep(self, pool_ts):
+        """tpu-san non-finite guard over the freshly written KV pool: a
+        NaN/Inf born in the step's logits lands in the cache rows it
+        wrote, so this per-dispatch sweep blames the first poisoned
+        layer/tensor (quantized int leaves are skipped; their f32 scale
+        leaves are checked). Runs on the step-pool member thread so a
+        hit fails THIS step through the existing typed-error and
+        isolation machinery. Free unless PADDLE_TPU_SAN=1."""
+        if not _san.enabled():
+            return
+        _san.check_finite(
+            "decode.step",
+            ((f"kv_pool/layer{i}/t{j}", t)
+             for i, layer in enumerate(pool_ts)
+             for j, t in enumerate(layer)))
+
     # -- scheduler ---------------------------------------------------------
     def _weights(self):
         pv = {n: p._value for n, p in self._params.items()}
@@ -766,9 +783,17 @@ class DecodeEngine:
             if hook is not None:
                 hook("prefill", [seq.id], {"bucket": pbucket})
             with _locks.blocking_region("decode.step_dispatch"):
-                new_pool, nxt = fn(pv, bv, pool_ts, tokens,
-                                   np.asarray(plen, np.int32), table)
-                return new_pool, int(np.asarray(nxt))
+                # the hot-sync probe covers the dispatch only; the token
+                # readback below is the step's deliverable (streaming
+                # needs the committed value on the host) and is
+                # sanctioned inside the step pool's serving.execute
+                # region
+                with _san.hot_region("decode.step_dispatch"):
+                    new_pool, nxt = fn(pv, bv, pool_ts, tokens,
+                                       np.asarray(plen, np.int32), table)
+                self._san_sweep(new_pool)
+                with _san.allow_host_sync("decode.token_fetch"):
+                    return new_pool, int(np.asarray(nxt))
 
         try:
             new_pool, tok = self._submit_step(run)
@@ -868,9 +893,12 @@ class DecodeEngine:
             if hook is not None:
                 hook("decode", ids, {"bucket": bucket})
             with _locks.blocking_region("decode.step_dispatch"):
-                new_pool, nxt = fn(pv, bv, pool_ts, tokens, positions,
-                                   tables)
-                return new_pool, np.asarray(nxt)
+                with _san.hot_region("decode.step_dispatch"):
+                    new_pool, nxt = fn(pv, bv, pool_ts, tokens, positions,
+                                       tables)
+                self._san_sweep(new_pool)
+                with _san.allow_host_sync("decode.token_fetch"):
+                    return new_pool, np.asarray(nxt)
 
         new_pool, nxt = self._submit_step(run)
         self.pool.tensors = new_pool

@@ -75,6 +75,7 @@ import time
 import numpy as np
 
 from ..analysis import locks as _locks
+from ..analysis import runtime_san as _san
 
 __all__ = [
     "ServingError", "DeadlineExceeded", "Overloaded", "PoolClosed",
@@ -790,7 +791,8 @@ class ServingPool:
             try:
                 if self._fault_hook is not None:
                     self._fault_hook(slot.index, req, slot.predictor)
-                with _locks.blocking_region("serving.execute"):
+                with _locks.blocking_region("serving.execute"), \
+                        _san.hot_region("serving.execute"):
                     result = req.fn(slot.predictor)
             except Exception as exc:  # noqa: BLE001 — classified below
                 self._on_execution_error(slot, req, exc)
@@ -891,7 +893,8 @@ class ServingPool:
             if self._fault_hook is not None:
                 for r in live:
                     self._fault_hook(slot.index, r, slot.predictor)
-            with _locks.blocking_region("serving.batch_dispatch"):
+            with _locks.blocking_region("serving.batch_dispatch"), \
+                    _san.hot_region("serving.batch_dispatch"):
                 results = self._batcher.execute(live)
         except Exception as exc:  # noqa: BLE001 — classified below
             self._on_batch_error(slot, live, exc)

@@ -35,6 +35,7 @@ import os
 import pickle
 
 from ..analysis import locks as _locks
+from ..analysis import runtime_san as _san
 
 __all__ = ["CompileCache", "compile_batched", "compile_jit", "default_cache",
            "cache_dir"]
@@ -273,6 +274,17 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
             except Exception:  # tpu-lint: disable=TL007 — stale/corrupt
                 pass  # cache entry: recompile and overwrite below
 
+    if _san.enabled():
+        # retrace sentinel (tpu-san): this is a REAL XLA compile — a
+        # duplicate (fingerprint, aval) signature here means the
+        # persistent cache failed; any compile after mark_warm() is a
+        # retrace finding
+        _san.note_trace(
+            f"aot.{tag}",
+            # no fingerprint = no persistent cache: a fresh token per
+            # call (an id() could be recycled into a warm entry)
+            fingerprint if fingerprint is not None else object(),
+            (_san.aval_signature(avals), str(_sharding_sig(in_shardings))))
     with _locks.blocking_region("aot.compile"):
         kw = {}
         if in_shardings is not None:
@@ -337,6 +349,14 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
                         loaded(list(holders), *stacked)), "disk"
             except Exception:  # tpu-lint: disable=TL007 — stale/corrupt
                 pass  # cache entry: recompile and overwrite below
+
+    if _san.enabled():
+        _san.note_trace(
+            "aot.batched",
+            fingerprint if fingerprint is not None else object(),
+            (bucket, _san.aval_signature(list(holder_avals)),
+             str([(list(s["shape"]), str(s["dtype"])) for s in input_spec]),
+             str(_sharding_sig(in_shardings))))
 
     def batched(holder_vals, *stacked):
         def body(xs):

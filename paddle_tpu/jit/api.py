@@ -21,6 +21,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..analysis import runtime_san as _san
 from ..core.tensor import Tensor
 from ..core.dispatch import no_grad, is_grad_enabled, GradNode
 from ..ops import random as rnd
@@ -108,6 +109,8 @@ class StaticFunction:
         # not poison a signature forever)
         self._fallback_sigs = {}
         self._warned_break = False
+        # tpu-san entrypoint identity (stable, never recycled like id())
+        self._san_token = object()
 
     # -- holder discovery -------------------------------------------------
     def _holders(self):
@@ -239,6 +242,12 @@ class StaticFunction:
         try:
             entry = self._cache.get(sig)
             if entry is None:
+                if _san.enabled():
+                    # retrace sentinel (tpu-san): a new signature entry
+                    # IS a fresh trace+compile of this StaticFunction
+                    _san.note_trace(
+                        f"to_static.{self._counter_name()}",
+                        self._san_token, sig)
                 pure = self._build(args, kwargs, arg_tensors, holders,
                                    training)
                 entry = _compile_entry(pure, holders, arg_tensors)

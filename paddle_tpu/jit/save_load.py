@@ -14,6 +14,7 @@ import pickle
 import threading
 
 from ..analysis import locks as _locks
+from ..analysis import runtime_san as _san
 
 import numpy as np
 import jax
@@ -135,6 +136,9 @@ class TranslatedLayer:
         # shared by every Predictor clone over this layer — a re-cloned
         # (quarantined) serving member never re-pays compilation
         self._aot_lock = _locks.new_lock("aot.layer")
+        # tpu-san entrypoint identity: a fresh object per layer instance
+        # (id() could be recycled into a warm entry after GC)
+        self._san_token = object()
         self._aot_execs: dict = {}
         self._aot_building: dict = {}   # bucket -> Event (build in flight)
         self._aot_counts = {"compiles": 0, "disk_hits": 0, "mem_hits": 0}
@@ -150,6 +154,12 @@ class TranslatedLayer:
                                "(.pdmodel missing)")
         vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
                 for i in inputs]
+        if _san.enabled():
+            # per-call retrace sentinel on the layer's caching jit: a
+            # NEW input signature means jax retraces right here — after
+            # mark_warm that's a serving-hot-path recompile finding
+            _san.note_trace("aot.layer_call", self._san_token,
+                            _san.aval_signature(vals), per_call=True)
         holder_vals = [self._params[n]._value for n in self._param_names]
         out = self._call(holder_vals, *vals)
         if isinstance(out, (list, tuple)):

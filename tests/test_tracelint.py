@@ -742,6 +742,77 @@ def test_cli_usage_errors_exit_2(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# TL012: raw threading-lock construction (named locks are lockcheck's and
+# tpu-san's visibility contract)
+# ---------------------------------------------------------------------------
+
+def test_tl012_raw_threading_ctors_flagged():
+    src = """
+        import threading
+        a = threading.Lock()
+        b = threading.RLock()
+        c = threading.Condition()
+    """
+    assert rules_of(src).count("TL012") == 3
+
+
+def test_tl012_alias_aware():
+    # module alias and from-import (with as-alias) both resolve
+    assert rules_of("""
+        import threading as t
+        mu = t.Lock()
+    """).count("TL012") == 1
+    assert rules_of("""
+        from threading import Lock as L, Condition
+        a = L()
+        b = Condition()
+    """).count("TL012") == 2
+
+
+def test_tl012_good_twins_not_flagged():
+    # the named constructors, and same-named ctors from OTHER modules
+    src = """
+        import multiprocessing
+        from paddle_tpu.analysis import locks
+        a = locks.new_lock("subsystem.name")
+        b = locks.new_condition("subsystem.name")
+        c = multiprocessing.Lock()
+        d = multiprocessing.RLock()
+    """
+    assert "TL012" not in rules_of(src)
+
+
+def test_tl012_suppression_and_authority_exemption():
+    src = ("import threading\n"
+           "mu = threading.Lock()  # tpu-lint: disable=TL012\n")
+    assert "TL012" not in [f.rule for f in tracelint.lint_source(src)]
+    # the analysis package is the lock authority: its own raw primitives
+    # (locks.py off-path, the checkers' self-guards) are exempt
+    raw = "import threading\nmu = threading.Lock()\n"
+    exempt = tracelint.lint_source(
+        raw, path="paddle_tpu/analysis/lockcheck.py")
+    assert "TL012" not in [f.rule for f in exempt]
+    flagged = tracelint.lint_source(raw, path="paddle_tpu/flags.py")
+    assert "TL012" in [f.rule for f in flagged]
+
+
+def test_tl012_legacy_baseline_frozen():
+    """The ~15 legacy raw-lock sites are baselined (burn down, never
+    grow), and the checked-in TL011 ratchet shrank below its original
+    58 after the collective/misc_api migration."""
+    with open(BASELINE) as f:
+        counts = json.load(f)["counts"]
+    tl012 = {k: v for k, v in counts.items() if "::TL012::" in k}
+    assert sum(tl012.values()) >= 10       # legacy sites are frozen...
+    assert "paddle_tpu/flags.py::TL012::<module>" in tl012
+    assert "paddle_tpu/core/monitor.py::TL012::<module>" in tl012
+    tl011 = sum(v for k, v in counts.items() if "::TL011::" in k)
+    assert tl011 <= 43                     # ...and TL011 burned down
+    assert not any("collective.py::TL011" in k or "misc_api.py::TL011" in k
+                   for k in counts)
+
+
+# ---------------------------------------------------------------------------
 # dogfood: the framework itself lints clean against the checked-in baseline
 # ---------------------------------------------------------------------------
 

@@ -124,6 +124,24 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # and no locks held across XLA dispatch or file IO — so lock-discipline
 # regressions in the serving stack fail this tier-1 harness, not prod.
 os.environ.setdefault("PADDLE_TPU_LOCKCHECK", "1")
+# ... and under the runtime sanitizer (tpu-san): each phase marks its
+# entrypoints warm once its own warmup traffic has compiled them, so ANY
+# retrace during the faulted traffic (a re-cloned member recompiling, an
+# unstable cache key), any host sync inside a dispatch hot region, any
+# use-after-donate and any NaN/Inf is a finding — and the end of main()
+# asserts there were ZERO, proving the serving/batching/decode/router
+# stacks retrace-free and sync-free under faults.
+os.environ.setdefault("PADDLE_TPU_SAN", "1")
+
+
+def _san_mark_warm():
+    """Declare this phase's warmup over (no-op when the operator
+    exported PADDLE_TPU_SAN=0): every jit entrypoint seen so far must
+    never trace again; fresh entrypoints (a restarted replica reloading
+    its model, a hot-swap loading the next generation) start cold."""
+    from paddle_tpu.analysis import runtime_san
+    if runtime_san.enabled():
+        runtime_san.mark_warm()
 
 PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "batch-crash", "batch-hang", "batch-poison",
@@ -273,6 +291,7 @@ def run_phase(phase, model, path, verbose=True):
     if batched:
         pool.warmup()
     pool.infer([batches[0]], timeout=60.0)
+    _san_mark_warm()    # faulted traffic below must never trace again
     # traffic request ids start after the warmup infer; doom a mid-run one
     inj.poison_id = 1 + N_REQUESTS // 2
     inj.active = True
@@ -512,6 +531,11 @@ def run_decode_phase(phase, model, verbose=True):
 
     t0 = time.monotonic()
     eng = _decode_engine(model, fault_hook=hook if kind != "none" else None)
+    # compile (first phase) or disk-load (later phases) every bucket,
+    # then arm the retrace sentinel: a wedged-step re-dispatch or a
+    # sequence join/leave during the faulted traffic must never compile
+    eng.warmup()
+    _san_mark_warm()
     streams = {}
     try:
         for seed, _, max_new in DECODE_SEQS:
@@ -711,6 +735,8 @@ def run_router_phase(phase, ctx, verbose=True):
 
     try:
         router.warmup(feeds=[batches[0]])
+        _san_mark_warm()   # replica restarts / swaps load FRESH layer
+        # instances (cold entrypoints) — those may compile; these must not
 
         with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
             if phase in ("router-kill", "router-wedge"):
@@ -946,6 +972,40 @@ def main(argv=None):
             # ("terminate called without an active exception") after the
             # verdict is already printed.
             time.sleep(HANG_SLEEP + 0.3)
+
+    from paddle_tpu.analysis import runtime_san
+    if not runtime_san.enabled():
+        # the operator exported PADDLE_TPU_SAN=0 on purpose (e.g. to
+        # isolate sanitizer overhead) — phases still gate the run, only
+        # the retrace/sync/donation/non-finite assertions are off
+        print("tpu-san: disabled by PADDLE_TPU_SAN="
+              f"{os.environ.get('PADDLE_TPU_SAN')!r}; "
+              "sanitizer assertions skipped")
+    else:
+        srep = runtime_san.report()
+        # guard against a VACUOUS pass: the probes must actually have
+        # run — hot regions entered on every dispatch path and traces
+        # observed during warmups. An import-order accident that left
+        # the sanitizer dark would otherwise "pass" trivially.
+        if srep["counters"]["hot_regions"] == 0:
+            violations.append(
+                "tpu-san was not effective: no hot region was ever "
+                "entered (probes dark? PADDLE_TPU_SAN="
+                f"{os.environ.get('PADDLE_TPU_SAN')!r})")
+        if srep["counters"]["traces"] == 0:
+            violations.append(
+                "tpu-san was not effective: no jit entrypoint trace was "
+                "ever observed despite the warmup compiles")
+        for f in srep["findings"]:
+            violations.append(
+                f"tpu-san {f['detector']} at {f['site']}: {f['message']}")
+        n_found = sum(srep["counts"].values())
+        c = srep["counters"]
+        print(f"tpu-san: {n_found} finding(s); traces={c['traces']}, "
+              f"hot_regions={c['hot_regions']}, "
+              f"donations={c['donations']}, "
+              f"finite_checks={c['finite_checks']} across "
+              f"{srep['entrypoints']} entrypoints")
 
     from paddle_tpu.analysis import lockcheck
     if not lockcheck.enabled():
