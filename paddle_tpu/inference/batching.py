@@ -43,6 +43,7 @@ import numpy as np
 
 from ..analysis import locks as _locks
 from ..analysis import runtime_san as _san
+from ..obs import trace as _otrace
 
 __all__ = ["BatchConfig", "DynamicBatcher"]
 
@@ -192,32 +193,64 @@ class DynamicBatcher:
         bucket = self.bucket_for(n)
         now = self._clock()
 
-        with _span("serving::batch_form"):
-            columns = list(zip(*(r.feeds for r in requests)))
-        with _span("serving::batch_pad"):
-            pad = bucket - n
-            if pad:
-                # replicate the last real example: real data, so padded
-                # lanes can never poison numerics (no zeros/NaN paths)
-                columns = [col + (col[-1],) * pad for col in columns]
-            stacked = [np.stack(col) for col in columns]
+        # A formed batch serves N DIFFERENT traces, so the batch itself
+        # is its own trace (a span can't have N parents): the batch span
+        # links every member trace id, and each member's trace receives
+        # a `serving.batch_member` event pointing back at the batch —
+        # bidirectional batch-span <-> member-span linkage. The existing
+        # profiled_span sites below nest under the batch span for free.
+        members = ([r for r in requests
+                    if r.ctx is not None and r.ctx.sampled]
+                   if _otrace.enabled() else [])
+        # the batch trace inherits the members' sampling (sampled=True
+        # here — `members` keeps only sampled ctxs): a back-link to a
+        # trace that recorded nothing would dangle
+        bspan = _otrace.null_span() if not members else _otrace.root_span(
+            "serving.batch",
+            attrs={"bucket": bucket, "n": n,
+                   "links": [r.ctx.trace_id_hex for r in members]},
+            sampled=True)
+        try:
+            for r in members:
+                _otrace.event_in(
+                    "serving.batch_member", r.ctx,
+                    attrs={"request": r.id,
+                           "batch_trace": bspan.trace_id_hex,
+                           "batch_span": bspan.span_id_hex})
+            with _span("serving::batch_form"):
+                columns = list(zip(*(r.feeds for r in requests)))
+            with _span("serving::batch_pad"):
+                pad = bucket - n
+                if pad:
+                    # replicate the last real example: real data, so
+                    # padded lanes can never poison numerics (no
+                    # zeros/NaN paths)
+                    columns = [col + (col[-1],) * pad for col in columns]
+                stacked = [np.stack(col) for col in columns]
 
-        fn = self.layer.batched_call(bucket, cache=self.config.cache)
-        t0 = time.perf_counter()
-        with _span("serving::batch_dispatch"):
-            outs = fn(*stacked)
-            # the result readback IS the batch's deliverable — a
-            # sanctioned sync inside the pool's batch_dispatch hot region
-            with _san.allow_host_sync("serving.batch_fetch"):
-                outs = [np.asarray(o) for o in outs]  # device sync + copy
-        exec_ms = (time.perf_counter() - t0) * 1e3
-        if self.h_execute is not None:
-            self.h_execute.observe(exec_ms / 1e3)
+            fn = self.layer.batched_call(bucket, cache=self.config.cache)
+            t0 = time.perf_counter()
+            with _span("serving::batch_dispatch"):
+                outs = fn(*stacked)
+                # the result readback IS the batch's deliverable — a
+                # sanctioned sync inside the pool's batch_dispatch hot
+                # region
+                with _san.allow_host_sync("serving.batch_fetch"):
+                    outs = [np.asarray(o) for o in outs]  # sync + copy
+            exec_ms = (time.perf_counter() - t0) * 1e3
+            if self.h_execute is not None:
+                self.h_execute.observe(exec_ms / 1e3)
 
-        with _span("serving::batch_scatter"):
-            # copy, don't slice: a view would pin the whole bucket-sized
-            # stacked buffer for as long as the caller keeps one result
-            results = [[o[j].copy() for o in outs] for j in range(n)]
+            with _span("serving::batch_scatter"):
+                # copy, don't slice: a view would pin the whole
+                # bucket-sized stacked buffer for as long as the caller
+                # keeps one result
+                results = [[o[j].copy() for o in outs] for j in range(n)]
+        except BaseException as exc:
+            bspan.end(error=exc)
+            raise
+        else:
+            bspan.end()
 
         with self._lock:
             self._formed += 1
@@ -234,7 +267,7 @@ class DynamicBatcher:
                     if self.h_queue_wait is not None and r.attempts == 1:
                         # first attempt only: a retried request's stamp
                         # includes its prior execution + backoff
-                        self.h_queue_wait.observe(w / 1e3)
+                        self.h_queue_wait.observe(w / 1e3, ctx=r.ctx)
         return results
 
     # -- bookkeeping hooks (pool-driven) -----------------------------------

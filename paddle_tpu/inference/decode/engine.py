@@ -77,6 +77,7 @@ import numpy as np
 
 from ...analysis import locks as _locks
 from ...analysis import runtime_san as _san
+from ...obs import trace as _otrace
 from ..serving import (Deadline, DeadlineExceeded, Overloaded, PoolClosed,
                        RequestFailed, RetryPolicy, ServingPool,
                        _NullPredictor)
@@ -172,7 +173,8 @@ class SequenceStream:
 class _Seq:
     __slots__ = ("id", "prompt", "max_new", "deadline", "stream", "state",
                  "blocks", "reserved_total", "outstanding", "pos",
-                 "last_token", "generated", "cancelled", "submitted_at")
+                 "last_token", "generated", "cancelled", "submitted_at",
+                 "span")
 
     def __init__(self, sid, prompt, max_new, deadline):
         self.id = sid
@@ -189,6 +191,7 @@ class _Seq:
         self.generated = 0
         self.cancelled = False
         self.submitted_at = None       # admission stamp (TTFT histogram)
+        self.span = _otrace.null_span()  # sequence root (obs.trace)
 
 
 #: registry collector keys need a distinct name per engine instance
@@ -449,6 +452,16 @@ class DecodeEngine:
             self._ids += 1
             seq = _Seq(self._ids, ids.astype(np.int32), max_new, dl)
             seq.submitted_at = self._clock()
+            # per-sequence root span: lives across scheduler rounds
+            # (detached from any thread stack), closed by _finish with
+            # the sequence's terminal status; child of the submitting
+            # caller's trace when one is active
+            if _otrace.enabled():
+                seq.span = _otrace.open_span(
+                    "decode.sequence",
+                    attrs={"engine": self.name, "seq": seq.id,
+                           "prompt_len": int(ids.shape[0]),
+                           "max_new": max_new})
             seq.stream._cancel = lambda s=seq: self._request_cancel(s)
             self._waiting.append(seq)
             self._admitted += 1
@@ -778,11 +791,19 @@ class DecodeEngine:
         table = self._padded_table(seq)
         pool_ts = self.pool.tensors
         hook = self._fault_hook
+        sctx = seq.span.ctx
 
         def run(_member):
             if hook is not None:
                 hook("prefill", [seq.id], {"bucket": pbucket})
-            with _locks.blocking_region("decode.step_dispatch"):
+            # prefill span in the SEQUENCE's trace (the step-pool worker
+            # thread re-enters the sequence context explicitly)
+            with _otrace.span_in(
+                    "decode.prefill", sctx,
+                    attrs=None if sctx is None else
+                    {"seq": seq.id, "bucket": pbucket,
+                     "prompt_len": plen}), \
+                    _locks.blocking_region("decode.step_dispatch"):
                 # the hot-sync probe covers the dispatch only; the token
                 # readback below is the step's deliverable (streaming
                 # needs the committed value on the host) and is
@@ -819,9 +840,14 @@ class DecodeEngine:
         seq.generated += 1
         if seq.generated == 1 and seq.submitted_at is not None:
             ttft = self._clock() - seq.submitted_at
-            self._h_ttft.observe(ttft)
+            self._h_ttft.observe(ttft, ctx=seq.span.ctx)
             if self._h_ttft_shared is not None:
-                self._h_ttft_shared.observe(ttft)
+                # exemplar: the TTFT bucket remembers this sequence's
+                # trace id (scrape -> slow-TTFT bucket -> /traces/<id>)
+                self._h_ttft_shared.observe(ttft, ctx=seq.span.ctx)
+            if seq.span.ctx is not None:
+                _otrace.event_in("decode.first_token", seq.span.ctx,
+                                 attrs={"seq": seq.id, "ttft_s": ttft})
         seq.stream._push(tok)
         with self._lock:
             self._tokens_out += 1
@@ -888,17 +914,39 @@ class DecodeEngine:
         pool_ts = self.pool.tensors
         hook = self._fault_hook
         ids = [s.id for s in active]
+        traced = ([s for s in active
+                   if s.span.ctx is not None and s.span.ctx.sampled]
+                  if _otrace.enabled() else [])
 
         def run(_member):
             if hook is not None:
                 hook("decode", ids, {"bucket": bucket})
-            with _locks.blocking_region("decode.step_dispatch"):
+            # one gathered dispatch serves N sequences: the step is its
+            # own trace (like a formed batch) LINKING every member
+            # sequence's trace id; each member's trace gets a step-join
+            # event back-linking the step, so a sequence's record shows
+            # exactly which shared dispatches carried it
+            step_span = _otrace.null_span() if not traced else \
+                _otrace.root_span(
+                    "decode.step",
+                    attrs={"bucket": bucket, "n": len(active),
+                           "links": [s.span.trace_id_hex
+                                     for s in traced]},
+                    sampled=True)  # inherit the members' sampling: a
+            #                        dangling back-link helps nobody
+            with step_span, _locks.blocking_region("decode.step_dispatch"):
                 with _san.hot_region("decode.step_dispatch"):
                     new_pool, nxt = fn(pv, bv, pool_ts, tokens, positions,
                                        tables)
                 self._san_sweep(new_pool)
                 with _san.allow_host_sync("decode.token_fetch"):
-                    return new_pool, np.asarray(nxt)
+                    out = new_pool, np.asarray(nxt)
+            for s in traced:
+                _otrace.event_in(
+                    "decode.step_join", s.span.ctx,
+                    attrs={"seq": s.id, "pos": int(s.pos),
+                           "step_trace": step_span.trace_id_hex})
+            return out
 
         new_pool, nxt = self._submit_step(run)
         self.pool.tensors = new_pool
@@ -944,6 +992,12 @@ class DecodeEngine:
             self._timed_out += 1
         else:
             self._cancelled += 1
+        # close the sequence's root span with its terminal status; a
+        # typed failure additionally pins the trace as a postmortem
+        if error is not None:
+            _otrace.pin_failure(seq.span.ctx, error)
+        seq.span.end(error=error if status != "completed" else None,
+                     status="ok" if status == "completed" else status)
         seq.stream._finish(status, error)
 
     def shutdown(self, drain_timeout=30.0):

@@ -47,6 +47,8 @@ import threading
 import time
 
 from ..analysis import locks as _locks
+from ..obs import flight as _flight
+from ..obs import trace as _otrace
 from .serving import (
     DETERMINISTIC_ERRORS, Deadline, DeadlineExceeded, PoolClosed,
     ServingError, ServingPool,
@@ -64,6 +66,8 @@ class ReplicaDead(ReplicaError):
     """The replica is gone (crashed process, shut-down pool): the attempt
     may or may not have executed. The router fails idempotent requests
     over to a healthy replica and surfaces `RequestFailed` otherwise."""
+
+    _trace_postmortem = True
 
 
 # ---------------------------------------------------------------------------
@@ -444,8 +448,28 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                        size=pool_size, default_timeout=default_timeout)
     ex = concurrent.futures.ThreadPoolExecutor(max_workers=pool_size + 2)
 
-    def _respond(seq, feeds, timeout):
+    def _respond(seq, feeds, timeout, wire=None):
         dl = Deadline(timeout)
+        # trace context off the wire: spans recorded in THIS process
+        # carry the router-minted trace id, and the reply piggybacks
+        # them back so the router-side flight recorder holds ONE merged
+        # causal record for the cross-process hop
+        ctx = (_otrace.TraceContext.from_wire(wire)
+               if wire is not None and _otrace.enabled() else None)
+
+        def _ship(payload):
+            if ctx is not None and ctx.sampled:
+                # spans_for is an O(rings x ring_cap) snapshot scan,
+                # but the replica process is small by construction
+                # (pool_size worker threads x 512 slots) and the reply
+                # already pays a pickle + store round-trip — bounded
+                # tens of microseconds on a path costing milliseconds
+                payload = payload + ([s.to_dict() for s in
+                                      _flight.recorder().spans_for(
+                                          ctx.trace_id)],)
+            store.set(_res_key(rid, ep, seq), pickle.dumps(payload))
+            res_written.append((seq, time.monotonic()))
+
         # swap gate: the stamp in the reply is EXACTLY the generation the
         # request executed under (see LocalReplica.infer_stamped)
         while True:
@@ -455,14 +479,16 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                     gen = state["generation"]
                     break
             if dl.expired():
-                store.set(_res_key(rid, ep, seq), pickle.dumps(
-                    ("err", "DeadlineExceeded",
-                     "held at the swap gate past the deadline", False)))
-                res_written.append((seq, time.monotonic()))
+                _ship(("err", "DeadlineExceeded",
+                       "held at the swap gate past the deadline", False))
                 return
             time.sleep(0.002)
         try:
-            outs = pool.infer(feeds, timeout=dl.remaining())
+            with _otrace.span_in(
+                    "replica.infer", ctx,
+                    attrs=None if ctx is None else {"rid": rid,
+                                                    "generation": gen}):
+                outs = pool.infer(feeds, timeout=dl.remaining())
             payload = ("ok", outs, gen)
         except ServingError as e:
             # the deterministic flag survives the wire so the router's
@@ -477,8 +503,7 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
         finally:
             with gate:
                 state["entering"] -= 1
-        store.set(_res_key(rid, ep, seq), pickle.dumps(payload))
-        res_written.append((seq, time.monotonic()))
+        _ship(payload)
 
     # response keys a timed-out caller abandoned (it deletes the key on
     # every path it actually reads) are reaped after RES_TTL so sustained
@@ -498,8 +523,9 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                 if payload is None:
                     pass  # client-side tombstone: seq consumed, no work
                 else:
-                    feeds, timeout = payload
-                    ex.submit(_respond, seq, feeds, timeout)
+                    feeds, timeout = payload[0], payload[1]
+                    wire = payload[2] if len(payload) > 2 else None
+                    ex.submit(_respond, seq, feeds, timeout, wire)
                 progressed = True
             ctl = store.get_nowait(_ctl_key(rid, ep, ctl_seen))
             if ctl is not None:
@@ -634,8 +660,11 @@ class SubprocessReplica:
         # pickle BEFORE allocating the sequence number: the serve loop
         # consumes sequences strictly in order, so a seq allocated and
         # then never written (unpicklable feeds, failed set) would
-        # strand the loop forever on a key that cannot appear
-        blob = pickle.dumps((feeds, timeout))
+        # strand the loop forever on a key that cannot appear. The
+        # trace context rides the payload (three plain values), so the
+        # trace id minted by the router exists inside the replica
+        # process too.
+        blob = pickle.dumps((feeds, timeout, _otrace.current_wire()))
         try:
             seq = self._store.add(f"/replica/{self.rid}/{self._epoch}/seq",
                                   1) - 1
@@ -664,10 +693,16 @@ class SubprocessReplica:
                 self._store.delete_key(_res_key(self.rid, self._epoch, seq))
                 payload = pickle.loads(raw)
                 if payload[0] == "ok":
+                    if len(payload) > 3 and payload[3]:
+                        # merge the replica process's spans (they carry
+                        # its pid) into the local flight recorder
+                        _flight.recorder().ingest(payload[3])
                     return payload[1], payload[2]
                 kind, msg = payload[1], payload[2]
                 deterministic = bool(payload[3]) if len(payload) > 3 \
                     else False
+                if len(payload) > 4 and payload[4]:
+                    _flight.recorder().ingest(payload[4])
                 raise _typed_error(kind, f"replica {self.rid}: {msg}",
                                    deterministic=deterministic)
             if self._proc.poll() is not None:

@@ -53,6 +53,7 @@ import threading
 import time
 
 from ..analysis import locks as _locks
+from ..obs import trace as _otrace
 from .replica import LocalHeartbeats, ReplicaDead, ReplicaError
 from .serving import (
     DETERMINISTIC_ERRORS, CircuitBreaker, Deadline, DeadlineExceeded,
@@ -66,6 +67,8 @@ __all__ = ["SwapFailed", "RouterConfig", "ServingRouter",
 class SwapFailed(ServingError):
     """A weight hot-swap could not complete; the tier was rolled back to
     (or converges to) the previous committed generation."""
+
+    _trace_postmortem = True  # a failed deploy retains its roll's trace
 
 
 def commit_model_dir(path, generation):
@@ -311,6 +314,33 @@ class ServingRouter:
         return self._route(feeds, timeout, idempotent)
 
     def _route(self, feeds, timeout, idempotent):
+        # the serving tier's ROOT span: one trace per request, minted
+        # here (or nested, when a traced caller is already active).
+        # Every failover attempt below is a sibling span under it, so a
+        # failover chain reads as attempt-1..N in one causal record;
+        # typed failures pin the trace into the flight recorder's
+        # postmortem buffer. PADDLE_TPU_TRACE=0: one flag check.
+        if not _otrace.enabled():
+            return self._route_impl(feeds, timeout, idempotent)
+        with _otrace.root_span("router.infer",
+                               attrs={"router": self.name}) as root:
+            outs, served_gen = self._route_impl(feeds, timeout,
+                                                idempotent)
+            root.set_attr("generation", served_gen)
+            if root.parent_id is None and root.ctx is not None:
+                # the request RECOVERED (a failed-over attempt's typed
+                # error pinned the trace at construction, then a later
+                # attempt served it): release the retention so the
+                # bounded postmortem buffer holds only requests that
+                # actually failed. Only for a TRUE root — a nested
+                # trace belongs to the outer caller, whose earlier
+                # failures we must not erase.
+                from ..obs import flight as _oflight
+
+                _oflight.recorder().unpin(root.ctx.trace_id)
+            return outs, served_gen
+
+    def _route_impl(self, feeds, timeout, idempotent):
         cfg = self.config
         eff = cfg.default_timeout if timeout is None else timeout
         dl = Deadline(eff, clock=self._clock)
@@ -372,8 +402,12 @@ class ServingRouter:
             if cfg.attempt_timeout is not None:
                 attempt_tmo = (cfg.attempt_timeout if attempt_tmo is None
                                else min(attempt_tmo, cfg.attempt_timeout))
+            att_span = _otrace.null_span() if not _otrace.enabled() \
+                else _otrace.span("router.attempt",
+                                  attrs={"rid": rec.rid,
+                                         "attempt": attempts})
             try:
-                with _locks.blocking_region("router.dispatch"):
+                with att_span, _locks.blocking_region("router.dispatch"):
                     outs, served_gen = rep.infer_stamped(
                         feeds, timeout=attempt_tmo)
             except Overloaded:
@@ -786,6 +820,16 @@ class ServingRouter:
         that died during the roll come back on the committed (old)
         generation via the restart + generation sweeps, so the tier
         always converges to ONE generation."""
+        # a deploy is a traced operation too: the roll's drains, probes
+        # and rollback decisions record under one trace, and a
+        # SwapFailed retains it as a postmortem
+        if not _otrace.enabled():
+            return self._swap_weights_impl(ckpt_dir, drain_timeout)
+        with _otrace.root_span("router.swap",
+                               attrs={"dir": str(ckpt_dir)}):
+            return self._swap_weights_impl(ckpt_dir, drain_timeout)
+
+    def _swap_weights_impl(self, ckpt_dir, drain_timeout):
         from ..distributed.checkpoint.api import (
             CheckpointError, commit_generation, is_committed)
 
@@ -859,6 +903,11 @@ class ServingRouter:
         """One replica through the roll: out of rotation → drain → swap
         → probe → readmit. Raises SwapFailed (replica returned to READY
         when it is merely busy, marked DEAD when it is broken)."""
+        with _otrace.span("router.swap_replica",
+                          attrs={"rid": rec.rid, "generation": gen}):
+            self._swap_one_impl(rec, model_dir, gen, drain_timeout)
+
+    def _swap_one_impl(self, rec, model_dir, gen, drain_timeout):
         with self._lock:
             if rec.state != _READY:
                 raise SwapFailed(

@@ -76,6 +76,7 @@ import numpy as np
 
 from ..analysis import locks as _locks
 from ..analysis import runtime_san as _san
+from ..obs import trace as _otrace
 
 __all__ = [
     "ServingError", "DeadlineExceeded", "Overloaded", "PoolClosed",
@@ -89,11 +90,27 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 class ServingError(RuntimeError):
-    """Base of every error the serving runtime raises for a request."""
+    """Base of every error the serving runtime raises for a request.
+
+    Subclasses that represent a request-level FAILURE worth a
+    postmortem (not routine shedding) set ``_trace_postmortem``:
+    constructing one under an active sampled trace context pins the
+    trace's causal record into the flight recorder (obs.trace) and
+    stamps the exception with ``.trace_id`` so the caller can fetch it
+    (``/traces/<id>`` / tools/trace_dump.py)."""
+
+    _trace_postmortem = False
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        if self._trace_postmortem:
+            _otrace.note_failure(self)
 
 
 class DeadlineExceeded(ServingError, TimeoutError):
     """The request's deadline (queue wait + execution) elapsed."""
+
+    _trace_postmortem = True
 
 
 class Overloaded(ServingError):
@@ -109,6 +126,8 @@ class RequestFailed(ServingError):
     """The request's execution raised. `cause` is the original exception,
     `attempts` how many executions were tried (1 for deterministic
     fail-fast errors)."""
+
+    _trace_postmortem = True
 
     def __init__(self, msg, cause=None, attempts=1):
         super().__init__(msg)
@@ -293,11 +312,17 @@ class _Request:
     arrays (set by `infer`) so workers can coalesce compatible requests
     into one dispatch; `fn` remains the batch=1 fallback. `no_batch` is
     set when a failed batch is split — the request then re-runs alone so
-    failure classification is per-request."""
+    failure classification is per-request.
+
+    `ctx` is the admitting thread's trace context (obs.trace), captured
+    at admission and re-entered by whichever worker thread executes the
+    request, so execution spans parent correctly across the handoff;
+    `fail()`/`abandon()` pin the trace's postmortem when the error
+    class asks for one."""
 
     __slots__ = ("id", "fn", "deadline", "attempts", "on_timeout", "feeds",
-                 "no_batch", "enqueued_at", "_lock", "_ev", "_state",
-                 "_value", "_error")
+                 "no_batch", "enqueued_at", "ctx", "_lock", "_ev",
+                 "_state", "_value", "_error")
 
     def __init__(self, rid, fn, deadline, on_timeout=None, feeds=None):
         self.id = rid
@@ -308,6 +333,7 @@ class _Request:
         self.feeds = feeds            # batchable payload (None: fn-only)
         self.no_batch = False         # split fallback: must run alone
         self.enqueued_at = None       # admission clock stamp (queue-wait)
+        self.ctx = None               # admitting trace context (or None)
         self._lock = _locks.new_lock("serving.request")
         self._ev = threading.Event()
         self._state = _PENDING
@@ -346,7 +372,8 @@ class _Request:
             self._state = _DONE
             self._error = error
             self._ev.set()
-            return True
+        _otrace.pin_failure(self.ctx, error)
+        return True
 
     def abandon(self, error):
         """Caller-side deadline: mark the request dead so a late worker
@@ -357,7 +384,8 @@ class _Request:
             self._state = _ABANDONED
             self._error = error
             self._ev.set()
-            return True
+        _otrace.pin_failure(self.ctx, error)
+        return True
 
     def done(self):
         return self._ev.is_set()
@@ -619,12 +647,20 @@ class ServingPool:
             req = _Request(next(self._ids), fn, dl,
                            on_timeout=self._on_caller_timeout, feeds=feeds)
             req.enqueued_at = self._clock()
+            if _otrace.enabled():
+                req.ctx = _otrace.current()
             self._queue.append(req)
             self._admitted += 1
             depth = len(self._queue) + len(self._retry_timers)
             if depth > self._queue_peak:
                 self._queue_peak = depth  # SLO queue-depth ceiling signal
             self._cv.notify()
+        if req.ctx is not None:
+            # admission stamp in the request's trace: queue depth at the
+            # moment it entered (the "was it the queue?" debugging hook)
+            _otrace.event("serving.admit",
+                          attrs={"pool": self.name, "request": req.id,
+                                 "queue_depth": depth})
         return req
 
     def infer(self, feeds, timeout=None):
@@ -787,11 +823,20 @@ class ServingPool:
                     and req.enqueued_at is not None:
                 # first attempt only: a retry's admission stamp includes
                 # the prior execution + backoff, which is not queue wait
-                self._h_queue_wait.observe(t0 - req.enqueued_at)
+                self._h_queue_wait.observe(t0 - req.enqueued_at,
+                                           ctx=req.ctx)
             try:
                 if self._fault_hook is not None:
                     self._fault_hook(slot.index, req, slot.predictor)
-                with _locks.blocking_region("serving.execute"), \
+                # re-enter the admitting thread's trace context: each
+                # execution attempt is one span (retries read as sibling
+                # attempts under the request's parent)
+                with _otrace.span_in(
+                        "serving.execute", req.ctx,
+                        attrs=None if req.ctx is None else
+                        {"pool": self.name, "slot": slot.index,
+                         "attempt": req.attempts}), \
+                        _locks.blocking_region("serving.execute"), \
                         _san.hot_region("serving.execute"):
                     result = req.fn(slot.predictor)
             except Exception as exc:  # noqa: BLE001 — classified below
@@ -813,7 +858,8 @@ class ServingPool:
                         slot.completed += 1
                         if self._h_latency is not None \
                                 and req.enqueued_at is not None:
-                            self._h_latency.observe(done - req.enqueued_at)
+                            self._h_latency.observe(done - req.enqueued_at,
+                                                    ctx=req.ctx)
                     else:
                         self._late_results += 1
             finally:
@@ -910,7 +956,8 @@ class ServingPool:
                         slot.completed += 1
                         if self._h_latency is not None \
                                 and r.enqueued_at is not None:
-                            self._h_latency.observe(done - r.enqueued_at)
+                            self._h_latency.observe(done - r.enqueued_at,
+                                                    ctx=r.ctx)
                     else:
                         self._late_results += 1
         finally:

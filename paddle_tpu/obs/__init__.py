@@ -22,8 +22,17 @@ as a collector — single source of truth, no duplicated bookkeeping):
   `profiled_span(name, histogram=...)` feeds any span into a latency
   histogram even when no native tracer is recording.
 
+Distributed request tracing rides on top (`obs.trace` + `obs.flight`):
+Dapper-style spans with cross-thread/process context propagation, an
+always-on bounded per-thread flight recorder, postmortem retention of
+typed-failure traces, per-bucket histogram exemplars (last trace id —
+scrape → p99 bucket → trace id → ``/traces/<id>``), and the
+``/traces`` endpoints on `MetricsServer`. ``PADDLE_TPU_TRACE=0``
+reduces every probe to a flag check.
+
 See docs/observability.md for the full API, knobs, and the SLO ratchet
-workflow; tools/metrics_dump.py scrapes/dumps from the command line.
+workflow; tools/metrics_dump.py and tools/trace_dump.py scrape/dump
+from the command line.
 """
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, default_latency_buckets,
@@ -31,10 +40,13 @@ from .metrics import (  # noqa: F401
 )
 from .export import render_json, render_prometheus  # noqa: F401
 from .http import MetricsServer  # noqa: F401
-from . import slo  # noqa: F401
+from . import flight, slo, trace  # noqa: F401
+from .flight import FlightRecorder, recorder  # noqa: F401
+from .trace import TraceContext  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_latency_buckets", "registry", "render_json",
-    "render_prometheus", "MetricsServer", "slo",
+    "render_prometheus", "MetricsServer", "slo", "trace", "flight",
+    "TraceContext", "FlightRecorder", "recorder",
 ]

@@ -120,8 +120,14 @@ def _flatten(prefix, value, out):
     # str / None / everything else: JSON-only
 
 
-def render_prometheus(snapshot):
-    """Render `MetricsRegistry.snapshot()` as Prometheus text."""
+def render_prometheus(snapshot, exemplars=False):
+    """Render `MetricsRegistry.snapshot()` as Prometheus text.
+
+    `exemplars=True` renders OPENMETRICS flavor: histogram buckets
+    carry their trace-id exemplars (``# {trace_id="..."} v`` — a parse
+    error to classic text-format 0.0.4 parsers, so it must only be
+    served under the OpenMetrics content type; obs.http negotiates)
+    and the exposition ends with the required ``# EOF`` marker."""
     lines = []
     for name in sorted(snapshot.get("metrics", {})):
         children = snapshot["metrics"][name]
@@ -137,11 +143,19 @@ def render_prometheus(snapshot):
                         key=lambda c: sorted(c["labels"].items())):
             labels = c["labels"]
             if kind == "histogram":
-                for le, cum in c["buckets"]:
-                    lines.append(
+                exs = (c.get("exemplars") or {}) if exemplars else {}
+                for i, (le, cum) in enumerate(c["buckets"]):
+                    line = (
                         f"{pname}_bucket"
                         f"{_labels_text(labels, {'le': _fmt(le) if le != '+Inf' else '+Inf'})}"
                         f" {_fmt(cum)}")
+                    ex = exs.get(i, exs.get(str(i)))
+                    if ex is not None:
+                        # OpenMetrics exemplar syntax: the LAST traced
+                        # observation that landed in this bucket
+                        line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                                 f'{_fmt(ex["value"])}')
+                    lines.append(line)
                 lines.append(f"{pname}_sum{_labels_text(labels)} "
                              f"{_fmt(c['sum'])}")
                 lines.append(f"{pname}_count{_labels_text(labels)} "
@@ -163,4 +177,6 @@ def render_prometheus(snapshot):
         for name, lbl, v in sorted(
                 flat, key=lambda t: (t[0], sorted((t[1] or {}).items()))):
             lines.append(f"{name}{_labels_text(None, lbl)} {_fmt(v)}")
+    if exemplars:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"

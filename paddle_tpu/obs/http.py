@@ -7,7 +7,13 @@ on a daemon thread and serves:
 * ``GET /metrics.json``  — the nested-JSON registry snapshot;
 * ``GET /healthz``       — 200 / 503 from the attached health callable
   (``ServingPool.serve_metrics`` wires pool health in; default: always
-  healthy) with a small JSON detail body.
+  healthy) with a small JSON detail body;
+* ``GET /traces``        — recent + retained traces from the flight
+  recorder (obs.flight), newest first;
+* ``GET /traces/<id>``   — ONE trace's merged causal record (every
+  span across threads AND processes sharing the trace id);
+  ``?format=chrome`` renders a chrome://tracing file instead of the
+  span list (load it at chrome://tracing or ui.perfetto.dev).
 
 Lock discipline (proven by tools/serving_fault_injector.py under
 ``PADDLE_TPU_LOCKCHECK=1``): the ``obs.http`` named lock guards ONLY
@@ -102,12 +108,26 @@ class MetricsServer:
         return False
 
     # -- request-thread work (no MetricsServer lock held) ------------------
-    def _respond(self, path):
+    def _respond(self, raw_path, accept=""):
         """(status, content_type, body-bytes) for one GET."""
+        path, _, query = raw_path.partition("?")
+        if path == "/traces" or path.startswith("/traces/"):
+            return self._respond_traces(path, query)
         if path in ("/metrics", "/"):
-            body = render_prometheus(self.registry.snapshot())
-            return 200, "text/plain; version=0.0.4; charset=utf-8", \
-                body.encode()
+            # content negotiation: exemplars are legal ONLY in the
+            # OpenMetrics exposition — a classic 0.0.4 parser treats
+            # '#' after a sample value as a parse error and fails the
+            # whole scrape — so they render only when the client asks
+            # (Accept: application/openmetrics-text, the header every
+            # exemplar-capable Prometheus sends, or ?openmetrics=1)
+            openmetrics = ("application/openmetrics-text" in accept
+                           or "openmetrics=1" in query)
+            body = render_prometheus(self.registry.snapshot(),
+                                     exemplars=openmetrics)
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8" if openmetrics
+                     else "text/plain; version=0.0.4; charset=utf-8")
+            return 200, ctype, body.encode()
         if path in ("/metrics.json", "/snapshot"):
             return 200, "application/json", \
                 render_json(self.registry.snapshot(), indent=1).encode()
@@ -125,13 +145,48 @@ class MetricsServer:
             return (200 if ok else 503), "application/json", body
         return 404, "text/plain; charset=utf-8", b"not found\n"
 
+    def _respond_traces(self, path, query):
+        """Flight-recorder endpoints: /traces (index) and /traces/<id>
+        (merged spans, JSON or ?format=chrome). The recorder is
+        process-global state, deliberately shared by every exporter in
+        the process — spans are not registry-scoped."""
+        from .flight import FlightRecorder, recorder
+
+        rec = recorder()
+        if path == "/traces":
+            body = json.dumps({"traces": rec.traces(),
+                               "recorder": rec.stats()},
+                              sort_keys=True, default=str).encode()
+            return 200, "application/json", body
+        tid = path.split("/", 2)[2].strip("/")
+        try:
+            int(tid, 16)
+        except ValueError:
+            return 404, "text/plain; charset=utf-8", \
+                b"malformed trace id\n"
+        spans = rec.spans_for(tid)
+        if not spans:
+            return 404, "text/plain; charset=utf-8", \
+                f"trace {tid} not found\n".encode()
+        params = dict(p.split("=", 1) for p in query.split("&")
+                      if "=" in p)
+        if params.get("format") == "chrome":
+            body = json.dumps(
+                {"traceEvents": FlightRecorder.chrome_events(spans)},
+                default=str).encode()
+            return 200, "application/json", body
+        body = json.dumps({"trace_id": tid,
+                           "spans": [s.to_dict() for s in spans]},
+                          sort_keys=True, default=str).encode()
+        return 200, "application/json", body
+
 
 def _make_handler(server: MetricsServer):
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             try:
                 status, ctype, body = server._respond(
-                    self.path.split("?", 1)[0])
+                    self.path, accept=self.headers.get("Accept", ""))
             except Exception as e:  # tpu-lint: disable=TL007 — a broken
                 # snapshot must surface as a 500, not kill the listener
                 status, ctype = 500, "text/plain; charset=utf-8"

@@ -40,6 +40,7 @@ import os
 import weakref
 
 from ..analysis import locks as _locks
+from . import trace as _trace
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
@@ -141,10 +142,18 @@ class Histogram(_Metric):
 
     Quantiles interpolate linearly within the bucket where the
     cumulative count crosses q*total; observations beyond the last bound
-    report that bound (the overflow bucket has no upper edge)."""
+    report that bound (the overflow bucket has no upper edge).
+
+    **Exemplars** (OpenMetrics-style): when an observation happens under
+    a sampled trace context (obs.trace — or one is passed as `ctx=`),
+    the bucket it lands in remembers that trace id and value — one
+    unlocked slot write, no history. A scrape can then walk from "the
+    p99 bucket grew" to the LAST request that landed there
+    (``/traces/<id>``). With tracing off the exemplar path is one
+    module-flag check."""
 
     kind = "histogram"
-    __slots__ = ("bounds", "_counts", "_sum", "_count")
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_exemplars")
 
     def __init__(self, name, help="", labels=None, bounds=None):
         super().__init__(name, help=help, labels=labels)
@@ -157,12 +166,19 @@ class Histogram(_Metric):
         self._counts = [0] * (len(bs) + 1)  # [-1] = overflow (+Inf)
         self._sum = 0.0
         self._count = 0
+        self._exemplars = [None] * (len(bs) + 1)  # (trace_hex, value)
 
-    def observe(self, v):
+    def observe(self, v, ctx=None):
         v = float(v)
-        self._counts[bisect.bisect_left(self.bounds, v)] += 1
+        i = bisect.bisect_left(self.bounds, v)
+        self._counts[i] += 1
         self._sum += v
         self._count += 1
+        if _trace.enabled():
+            if ctx is None:
+                ctx = _trace.current()
+            if ctx is not None and ctx.sampled:
+                self._exemplars[i] = (ctx.trace_id_hex, v)
 
     @property
     def count(self):
@@ -197,6 +213,27 @@ class Histogram(_Metric):
                 return lo + frac * (self.bounds[i] - lo)
         return self.bounds[-1]
 
+    def exemplar_for(self, q, counts=None):
+        """The `(trace_id_hex, value)` exemplar of the bucket the
+        q-quantile falls in (walking down to the nearest bucket that
+        holds one), or None — the "which request blew the p99" hook."""
+        counts = list(self._counts) if counts is None else counts
+        total = sum(counts)
+        if total == 0:
+            return None
+        target = q * total
+        cum = 0
+        crossing = len(counts) - 1
+        for i, c in enumerate(counts):
+            cum += c
+            if c and cum >= target:
+                crossing = i
+                break
+        for i in range(crossing, -1, -1):
+            if self._exemplars[i] is not None:
+                return self._exemplars[i]
+        return None
+
     def snapshot(self):
         # copy counts ONCE so count/sum/quantiles describe one instant
         # even while observers keep adding
@@ -207,7 +244,7 @@ class Histogram(_Metric):
             cum += counts[i]
             buckets.append([b, cum])
         buckets.append(["+Inf", total])
-        return {
+        snap = {
             "count": total,
             "sum": self._sum,
             "avg": (self._sum / total) if total else 0.0,
@@ -216,6 +253,13 @@ class Histogram(_Metric):
             "p99": self.quantile(0.99, counts),
             "buckets": buckets,
         }
+        exemplars = {}
+        for i, ex in enumerate(self._exemplars):
+            if ex is not None:
+                exemplars[i] = {"trace_id": ex[0], "value": ex[1]}
+        if exemplars:  # absent entirely when no trace ever landed, so
+            snap["exemplars"] = exemplars  # untraced goldens stay stable
+        return snap
 
 
 _METRIC_KINDS = {Counter.kind: Counter, Gauge.kind: Gauge,

@@ -25,6 +25,8 @@ import threading
 import time
 from collections import defaultdict
 
+from ..obs import trace as _obs_trace
+
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "benchmark",
@@ -43,7 +45,7 @@ def host_recording():
     return _cpu_recording
 
 
-def profiled_span(name, histogram=None):
+def profiled_span(name, histogram=None, attrs=None):
     """RecordEvent span when a host profiler is actively recording, else
     a zero-cost no-op context. The shared gate for hot-path
     instrumentation (the distributed engine's dispatch spans, the serving
@@ -55,9 +57,19 @@ def profiled_span(name, histogram=None):
     span with `time.perf_counter` and observes the duration on EVERY
     pass, whether or not a tracer is recording — one span site feeds
     both the chrome trace (profiling sessions) and the always-on latency
-    histogram (production telemetry)."""
-    if histogram is not None:
-        return _TimedSpan(name, histogram)
+    histogram (production telemetry).
+
+    **Tracing** (obs.trace): when the calling thread is inside an
+    active trace context, the same call site ALSO opens a child trace
+    span recorded into the flight recorder — the per-thread context
+    stack gives every profiled_span a parent link, so nested and
+    concurrent spans export properly nested instead of interleaving
+    flat. One instrumentation point, three consumers (native chrome
+    trace, latency histogram, distributed trace); with
+    ``PADDLE_TPU_TRACE=0`` the tracing path is one flag check."""
+    traced = _obs_trace.enabled() and _obs_trace.current() is not None
+    if histogram is not None or traced:
+        return _TimedSpan(name, histogram, traced, attrs)
     if _cpu_recording:
         return RecordEvent(name)
     from contextlib import nullcontext
@@ -66,24 +78,36 @@ def profiled_span(name, histogram=None):
 
 
 class _TimedSpan:
-    """profiled_span(..., histogram=...): always-on timing feeding an obs
-    histogram, plus the native RecordEvent while a profiler records."""
+    """profiled_span(..., histogram=... / under a trace): always-on
+    timing feeding an obs histogram and/or a child trace span, plus the
+    native RecordEvent while a profiler records."""
 
-    __slots__ = ("name", "histogram", "_ev", "_t0")
+    __slots__ = ("name", "histogram", "attrs", "_traced", "_ev", "_t0",
+                 "_tspan")
 
-    def __init__(self, name, histogram):
+    def __init__(self, name, histogram, traced=False, attrs=None):
         self.name = name
         self.histogram = histogram
+        self.attrs = attrs
+        self._traced = traced
+        self._tspan = None
 
     def __enter__(self):
         self._ev = RecordEvent(self.name) if _cpu_recording else None
         if self._ev is not None:
             self._ev.begin()
+        if self._traced:
+            self._tspan = _obs_trace.span(self.name, attrs=self.attrs)
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
-        self.histogram.observe(time.perf_counter() - self._t0)
+    def __exit__(self, exc_type, exc, tb):
+        if self.histogram is not None:
+            # observed with the trace span still current, so the bucket
+            # exemplar carries this request's trace id
+            self.histogram.observe(time.perf_counter() - self._t0)
+        if self._tspan is not None:
+            self._tspan.end(error=exc)
         if self._ev is not None:
             self._ev.end()
         return False

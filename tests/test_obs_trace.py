@@ -1,0 +1,579 @@
+"""Distributed request tracing + flight recorder (paddle_tpu/obs/trace.py
++ flight.py): context propagation across threads and the serving stack,
+deterministic sampling, bounded ring/postmortem memory, histogram
+exemplars resolving to traces over the HTTP endpoint, batch-span <->
+member-span links, and the tracing-off zero-overhead contract.
+
+Kept cheap (ROADMAP suite-budget caveat): stub predictors only — no XLA
+program is ever compiled here; the cross-PROCESS merge proof
+(SubprocessReplica over the coordination store) is slow-marked at the
+bottom. Named test_obs_trace so it runs right after test_obs, well
+before the tier-1 timeout's alphabetical cutoff.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs import MetricsRegistry, MetricsServer, flight, trace
+from paddle_tpu.obs.flight import FlightRecorder, Span
+from paddle_tpu.obs.trace import TraceContext
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    """Every test starts traced at rate 1.0 with an empty recorder and
+    leaves the global state the way it found it."""
+    was = trace.enabled()
+    rate = trace.sample_rate()
+    trace.enable()
+    trace.set_sample_rate(1.0)
+    flight.recorder().reset()
+    yield
+    flight.recorder().reset()
+    trace.set_sample_rate(rate)
+    (trace.enable if was else trace.disable)()
+
+
+class Stub:
+    def clone(self):
+        return Stub()
+
+    def reset_handles(self):
+        pass
+
+
+def make_pool(**kw):
+    from paddle_tpu.inference.serving import ServingPool
+
+    kw.setdefault("size", 2)
+    kw.setdefault("metrics", False)
+    kw.setdefault("default_timeout", 10.0)
+    return ServingPool(predictor=Stub(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# span primitives
+# ---------------------------------------------------------------------------
+
+def test_span_tree_parent_links_and_status():
+    with trace.root_span("root", attrs={"k": "v"}) as root:
+        with trace.span("child"):
+            trace.event("mark", attrs={"n": 1})
+    spans = flight.recorder().spans_for(root.trace_id)
+    by = {s.name: s for s in spans}
+    assert set(by) == {"root", "child", "mark"}
+    assert by["root"].parent_id is None
+    assert by["child"].parent_id == by["root"].span_id
+    assert by["mark"].parent_id == by["child"].span_id
+    assert all(s.trace_id == root.trace_id for s in spans)
+    assert by["root"].attrs == {"k": "v"} and by["root"].status == "ok"
+    assert by["mark"].t1 >= by["mark"].t0
+
+
+def test_span_error_status_and_nested_root_joins():
+    with pytest.raises(RuntimeError):
+        with trace.root_span("outer") as outer:
+            # a root_span under an active trace NESTS (one trace per
+            # request even when a traced caller re-enters the tier)
+            with trace.root_span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                raise RuntimeError("boom")
+    by = {s.name: s for s in flight.recorder().spans_for(outer.trace_id)}
+    assert by["inner"].parent_id == by["outer"].span_id
+    assert by["inner"].status == "RuntimeError"
+    assert "boom" in by["inner"].error
+    assert by["outer"].status == "RuntimeError"
+
+
+def test_cross_thread_handoff_span_in():
+    got = {}
+
+    def worker(ctx):
+        assert trace.current() is None      # fresh thread: no context
+        with trace.span_in("work", ctx, attrs={"w": 1}):
+            got["inner"] = trace.current()
+        assert trace.current() is None      # both pops happened
+
+    with trace.root_span("caller") as root:
+        ctx = trace.current()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    by = {s.name: s for s in flight.recorder().spans_for(root.trace_id)}
+    assert by["work"].parent_id == by["caller"].span_id
+    assert got["inner"].trace_id == root.trace_id
+
+
+def test_wire_roundtrip_and_deterministic_sampling():
+    with trace.root_span("r"):
+        wire = trace.current_wire()
+    ctx = TraceContext.from_wire(wire)
+    assert (ctx.trace_id, ctx.span_id, ctx.sampled) == wire
+    assert TraceContext.from_wire(None) is None
+    # sampling is a pure function of the trace id: every process agrees
+    trace.set_sample_rate(0.5)
+    decisions = {tid: trace._sampled(tid) for tid in range(1, 2000, 7)}
+    assert any(decisions.values()) and not all(decisions.values())
+    assert decisions == {tid: trace._sampled(tid) for tid in decisions}
+    trace.set_sample_rate(0.0)
+    with trace.root_span("dark") as dark:
+        trace.event("inside")
+    assert flight.recorder().spans_for(dark.ctx.trace_id) == []
+
+
+def test_tracing_off_zero_overhead_probes():
+    """PADDLE_TPU_TRACE=0 contract: every probe reduces to a flag check
+    returning shared no-op singletons — nothing records, allocates
+    rings, or consults thread-local state."""
+    trace.disable()
+    assert trace.span("x") is trace.null_span()
+    assert trace.root_span("x") is trace.null_span()
+    assert trace.span_in("x", None) is trace.null_span()
+    assert trace.attach(None) is trace.null_span()
+    assert trace.open_span("x") is trace.null_span()
+    with trace.root_span("x"):
+        assert trace.current() is None
+    err = RuntimeError("e")
+    trace.note_failure(err)                 # no-op, no attribute
+    assert not hasattr(err, "trace_id")
+    assert flight.recorder().recorded == 0
+    # the obs <=2x pattern, tracing edition: throughput through a real
+    # pool with tracing ON (root span + admit event + execute span per
+    # request) stays within 4x of tracing OFF, interleaved so scheduler
+    # drift hits both modes. The bound is LOOSER than obs's 2.5x on
+    # purpose: the denominator is a stub pool at ~25us/request, so
+    # three ~7us spans land near 2x even on a quiet machine — this
+    # guards against a catastrophic regression (a lock, a syscall, an
+    # O(ring) walk on the span path), not tracing's intrinsic cost
+    n = 200
+
+    def drive(pool, traced):
+        t0 = time.perf_counter()
+        if traced:
+            reqs = []
+            for _ in range(n):
+                with trace.root_span("req"):
+                    reqs.append(pool.submit(lambda p: 0, timeout=30.0))
+        else:
+            reqs = [pool.submit(lambda p: 0, timeout=30.0)
+                    for _ in range(n)]
+        for r in reqs:
+            r.result(timeout=30.0)
+        return time.perf_counter() - t0
+
+    pool = make_pool(max_queue_depth=n + 8)
+    best = {"on": float("inf"), "off": float("inf")}
+    try:
+        drive(pool, False)                  # warm the workers
+        trace.enable()
+        drive(pool, True)                   # ... and the span/ring path
+        for _ in range(5):
+            trace.disable()
+            best["off"] = min(best["off"], drive(pool, False))
+            trace.enable()
+            best["on"] = min(best["on"], drive(pool, True))
+    finally:
+        trace.disable()
+        pool.shutdown(drain_timeout=10.0)
+    assert best["on"] <= best["off"] * 4.0, best
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_ring_wrap_bounded_memory():
+    rec = FlightRecorder(ring_spans=8, max_postmortems=4)
+    for i in range(50):
+        rec.record(Span(7, i + 1, None, f"s{i}", 0.0, 1.0))
+    spans = rec.spans_for(7)
+    assert len(spans) == 8                   # bounded: only the last 8
+    assert {s.name for s in spans} == {f"s{i}" for i in range(42, 50)}
+    assert rec.dropped_wraps == 42 and rec.recorded == 50
+    st = rec.stats()
+    assert st["ring_spans"] == 8 and st["rings"] == 1
+
+
+def test_postmortem_pin_survives_wrap_and_evicts_fifo():
+    rec = FlightRecorder(ring_spans=4, max_postmortems=2)
+    rec.record(Span(1, 10, None, "doomed", 0.0, 1.0))
+    rec.pin(1, reason="DeadlineExceeded")
+    for i in range(20):                      # wrap the ring completely
+        rec.record(Span(99, 100 + i, None, "noise", 0.0, 1.0))
+    # late span of the pinned trace recorded AFTER the pin is appended
+    rec.record(Span(1, 11, 10, "late-child", 2.0, 3.0))
+    assert [s.name for s in rec.spans_for(1)] == ["doomed", "late-child"]
+    assert rec.postmortems()[0][:2] == (1, "DeadlineExceeded")
+    rec.pin(2, reason="a")
+    rec.pin(3, reason="b")                   # bound 2: trace 1 evicted
+    assert rec.postmortem_ids() == {2, 3}
+
+
+def test_ingest_merges_foreign_process_spans():
+    rec = FlightRecorder(ring_spans=8)
+    rec.record(Span(5, 1, None, "router.infer", 0.0, 2.0))
+    wire = [Span(5, 2, 1, "replica.infer", 0.5, 1.5, pid=4242,
+                 thread="remote").to_dict()]
+    assert rec.ingest(wire) == 1
+    # a replica re-ships its full per-trace history on every reply
+    # (retries/failovers): re-ingest must dedup by (pid, span_id)
+    rec.pin(5, reason="RequestFailed")
+    assert rec.ingest(wire) == 0
+    assert rec.postmortems()[0][2] == 2          # no duplicate spans
+    spans = rec.spans_for(5)
+    assert [s.name for s in spans] == ["router.infer", "replica.infer"]
+    assert spans[1].pid == 4242 and spans[1].parent_id == 1
+    evs = FlightRecorder.chrome_events(spans)
+    assert {e["pid"] for e in evs} == {spans[0].pid, 4242}
+    assert all(e["ph"] == "X" for e in evs)
+    d2 = Span.from_dict(spans[1].to_dict()).to_dict()
+    assert d2 == spans[1].to_dict()          # wire format roundtrips
+
+
+# ---------------------------------------------------------------------------
+# serving-stack propagation (stub pools — no XLA)
+# ---------------------------------------------------------------------------
+
+def test_pool_execution_spans_cross_worker_thread():
+    pool = make_pool()
+    try:
+        with trace.root_span("caller") as root:
+            assert pool.submit(lambda p: 7).result() == 7
+    finally:
+        pool.shutdown(drain_timeout=10.0)
+    by = {s.name: s for s in flight.recorder().spans_for(root.trace_id)}
+    assert {"caller", "serving.admit", "serving.execute"} <= set(by)
+    assert by["serving.execute"].parent_id == by["caller"].span_id
+    assert by["serving.execute"].thread != by["caller"].thread
+    assert by["serving.execute"].attrs["attempt"] == 1
+
+
+def test_pool_failure_pins_postmortem_with_trace_id():
+    from paddle_tpu.inference.serving import RequestFailed
+
+    pool = make_pool()
+    try:
+        with trace.root_span("failing") as root:
+            with pytest.raises(RequestFailed) as ei:
+                pool.submit(lambda p: (_ for _ in ()).throw(
+                    ValueError("malformed"))).result()
+    finally:
+        pool.shutdown(drain_timeout=10.0)
+    assert ei.value.trace_id == root.trace_id_hex
+    assert root.trace_id in flight.recorder().postmortem_ids()
+    spans = flight.recorder().spans_for(root.trace_id)
+    exe = [s for s in spans if s.name == "serving.execute"]
+    assert exe and exe[0].status == "ValueError"
+
+
+def test_caller_side_deadline_pins_postmortem():
+    from paddle_tpu.inference.serving import DeadlineExceeded
+
+    pool = make_pool(size=1)
+    try:
+        with trace.root_span("slow") as root:
+            with pytest.raises(DeadlineExceeded) as ei:
+                pool.submit(lambda p: time.sleep(0.4),
+                            timeout=0.05).result()
+    finally:
+        pool.shutdown(drain_timeout=10.0)
+    assert ei.value.trace_id == root.trace_id_hex
+    assert root.trace_id in flight.recorder().postmortem_ids()
+
+
+def test_untraced_pool_requests_record_nothing():
+    pool = make_pool()
+    try:
+        assert pool.submit(lambda p: 1).result() == 1
+    finally:
+        pool.shutdown(drain_timeout=10.0)
+    assert flight.recorder().recorded == 0   # no context -> no spans
+
+
+def test_router_failover_attempts_are_siblings_under_root():
+    """A request that fails over reads as attempt-1 (typed failure) and
+    attempt-2 (ok) SIBLINGS under one router.infer root — the causal
+    record the ROADMAP traffic tier debugging story needs."""
+    from paddle_tpu.inference.replica import LocalHeartbeats, LocalReplica
+    from paddle_tpu.inference.router import RouterConfig, ServingRouter
+    from paddle_tpu.inference.serving import RetryPolicy
+
+    class FlakyOnce(Stub):
+        fails = {"left": 1}                  # first replica-0 run dies
+
+        def __init__(self, tag):
+            self.tag = tag
+
+        def clone(self):
+            return FlakyOnce(self.tag)
+
+        def run(self, feeds):
+            if self.tag == "replica-0" and FlakyOnce.fails["left"] > 0:
+                FlakyOnce.fails["left"] -= 1
+                raise RuntimeError("injected member fault")
+            return [np.asarray(f) * 2 for f in feeds]
+
+    hb = LocalHeartbeats()
+
+    def factory(rid, model_dir, generation):
+        return LocalReplica(
+            rid, lambda d, r=rid: FlakyOnce(r), model_dir, generation,
+            heartbeat=hb,
+            pool_kwargs=dict(default_timeout=5.0,
+                             retry=RetryPolicy(max_retries=0)))
+
+    router = ServingRouter(
+        factory, size=2,
+        config=RouterConfig(failover=RetryPolicy(max_retries=3,
+                                                 base_delay=0.001,
+                                                 max_delay=0.005)))
+    try:
+        out, = router.infer([np.ones(3, np.float32)], timeout=5.0)
+        assert np.array_equal(out, np.ones(3) * 2)
+    finally:
+        router.shutdown()
+    roots = [t for t in flight.recorder().traces()
+             if t["root"] == "router.infer"]
+    assert roots
+    spans = flight.recorder().spans_for(roots[0]["trace_id"])
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    attempts = sorted(by_name["router.attempt"],
+                      key=lambda s: s.attrs["attempt"])
+    assert len(attempts) >= 2
+    root_id = by_name["router.infer"][0].span_id
+    assert all(a.parent_id == root_id for a in attempts)  # siblings
+    assert attempts[0].status != "ok" and attempts[-1].status == "ok"
+    # the request RECOVERED: the transient attempt's pinned postmortem
+    # must have been released when the root completed ok
+    tid = int(roots[0]["trace_id"], 16)
+    assert tid not in flight.recorder().postmortem_ids()
+
+
+def test_batcher_links_batch_span_to_member_traces():
+    """DynamicBatcher.execute: the batch is its own trace whose span
+    links every member trace id, and each member trace carries a
+    serving.batch_member event pointing back at the batch."""
+    from paddle_tpu.inference.batching import BatchConfig, DynamicBatcher
+
+    class FakeLayer:
+        input_spec = [{"shape": (2,), "dtype": "float32"}]
+
+        def batched_call(self, bucket, cache=None):
+            def fn(x):
+                return [x * 2]
+            return fn
+
+    class FakeReq:
+        def __init__(self, rid, feeds, ctx):
+            self.id = rid
+            self.feeds = feeds
+            self.ctx = ctx
+            self.attempts = 1
+            self.enqueued_at = None
+
+    bt = DynamicBatcher(FakeLayer(), BatchConfig(buckets=(4,)))
+    roots, reqs = [], []
+    for i in range(3):
+        r = trace.open_span(f"req{i}")
+        roots.append(r)
+        reqs.append(FakeReq(i, [np.ones(2, np.float32) * i], r.ctx))
+    results = bt.execute(reqs)
+    for r in roots:
+        r.end()
+    assert [np.array_equal(res[0], np.ones(2) * 2 * i)
+            for i, res in enumerate(results)] == [True] * 3
+    batch_traces = [t for t in flight.recorder().traces()
+                    if t["root"] == "serving.batch"]
+    assert len(batch_traces) == 1
+    bspans = flight.recorder().spans_for(batch_traces[0]["trace_id"])
+    batch = next(s for s in bspans if s.name == "serving.batch")
+    # batch -> members: the links attr names every member trace
+    assert sorted(batch.attrs["links"]) == sorted(
+        r.trace_id_hex for r in roots)
+    assert batch.attrs["bucket"] == 4 and batch.attrs["n"] == 3
+    # the profiled_span stages nest under the batch span
+    stages = {s.name: s for s in bspans if s.name.startswith("serving::")}
+    assert {"serving::batch_form", "serving::batch_pad",
+            "serving::batch_dispatch",
+            "serving::batch_scatter"} <= set(stages)
+    assert all(s.parent_id == batch.span_id for s in stages.values())
+    # members -> batch: every member trace got the back-link event
+    for r in roots:
+        ms = flight.recorder().spans_for(r.trace_id)
+        link = next(s for s in ms if s.name == "serving.batch_member")
+        assert link.attrs["batch_trace"] == f"{batch.trace_id:016x}"
+        assert link.attrs["batch_span"] == f"{batch.span_id:016x}"
+    # sub-1.0 sample rates: the batch trace INHERITS the members'
+    # sampling (a back-link to a trace that recorded nothing dangles)
+    r2 = trace.open_span("req-s")        # sampled (rate still 1.0)
+    trace.set_sample_rate(0.0)           # fresh ids now sample False
+    bt.execute([FakeReq(9, [np.ones(2, np.float32)], r2.ctx)])
+    r2.end()
+    link2 = next(s for s in flight.recorder().spans_for(r2.trace_id)
+                 if s.name == "serving.batch_member")
+    assert flight.recorder().spans_for(link2.attrs["batch_trace"]), \
+        "batch link trace recorded no spans (sampling not inherited)"
+    trace.set_sample_rate(1.0)
+
+
+def test_exemplar_scrape_resolves_to_trace():
+    """The operator workflow end-to-end (minus the subprocess hop):
+    scrape /metrics, read the bucket exemplar's trace id, fetch
+    /traces/<id> and find the request's causal record."""
+    reg = MetricsRegistry()
+    h = reg.histogram("unit.lat", bounds=(0.001, 1.0))
+    with trace.root_span("the-slow-request") as root:
+        h.observe(0.5)
+    with MetricsServer(reg) as s:
+        # classic 0.0.4 exposition: exemplars MUST NOT render ('#'
+        # after a sample value is a parse error to plain Prometheus)
+        plain = urllib.request.urlopen(s.url + "/metrics",
+                                       timeout=5).read().decode()
+        assert "# {trace_id=" not in plain and "# EOF" not in plain
+        # OpenMetrics negotiation (what exemplar-capable scrapers send)
+        req = urllib.request.Request(
+            s.url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        resp = urllib.request.urlopen(req, timeout=5)
+        assert "openmetrics-text" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+        assert text.rstrip().endswith("# EOF")
+        ex_lines = [ln for ln in text.splitlines() if "# {trace_id=" in ln]
+        assert ex_lines, text
+        tid = ex_lines[0].split('trace_id="')[1].split('"')[0]
+        assert tid == root.trace_id_hex
+        # ...and the query-param spelling for curl-driven operators
+        q = urllib.request.urlopen(s.url + "/metrics?openmetrics=1",
+                                   timeout=5).read().decode()
+        assert "# {trace_id=" in q
+        body = json.loads(urllib.request.urlopen(
+            s.url + f"/traces/{tid}", timeout=5).read())
+        assert [sp["name"] for sp in body["spans"]] == ["the-slow-request"]
+        # index + chrome variants + 404 contract
+        idx = json.loads(urllib.request.urlopen(
+            s.url + "/traces", timeout=5).read())
+        assert any(t["trace_id"] == tid for t in idx["traces"])
+        chrome = json.loads(urllib.request.urlopen(
+            s.url + f"/traces/{tid}?format=chrome", timeout=5).read())
+        assert chrome["traceEvents"][0]["name"] == "the-slow-request"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(s.url + "/traces/feedfacefeedface",
+                                   timeout=5)
+        assert ei.value.code == 404
+    # exemplars stay OUT of snapshots that never saw a traced observe
+    h2 = reg.histogram("unit.cold", bounds=(1.0,))
+    h2.observe(0.5)
+    assert "exemplars" not in h2.snapshot()
+    # p99 walk-down helper returns the traced bucket's exemplar
+    assert h.exemplar_for(0.99)[0] == root.trace_id_hex
+
+
+def test_trace_dump_cli_modes(capsys):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_dump", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "trace_dump.py"))
+    td = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(td)
+    with trace.root_span("cli-span") as root:
+        pass
+    reg = MetricsRegistry()
+    with MetricsServer(reg) as s:
+        assert td.main(["--url", f"127.0.0.1:{s.port}"]) == 0
+        idx = json.loads(capsys.readouterr().out)
+        assert any(t["trace_id"] == root.trace_id_hex
+                   for t in idx["traces"])
+        assert td.main(["--url", s.url, root.trace_id_hex]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert body["spans"][0]["name"] == "cli-span"
+        assert td.main(["--url", s.url, root.trace_id_hex,
+                        "--format", "chrome"]) == 0
+        assert json.loads(capsys.readouterr().out)["traceEvents"]
+    # in-process dump, usage error, and not-found exit codes
+    assert td.main([]) == 0
+    assert root.trace_id_hex in capsys.readouterr().out
+    assert td.main([root.trace_id_hex]) == 0
+    capsys.readouterr()
+    assert td.main(["--format", "chrome"]) == 2
+    assert td.main(["feedfacefeedface"]) == 1
+    assert td.main(["--url", "http://127.0.0.1:1/"]) == 1
+    # a full non-/traces path + a trace id is a usage CONFLICT (2), not
+    # a silent wrong-output success
+    assert td.main(["--url", "http://127.0.0.1:9/metrics", "abc123"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# cross-process merge proof (SubprocessReplica) — slow like test_router's
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_subprocess_replica_trace_merges_across_processes(tmp_path):
+    """A trace id minted by the router appears in spans RECORDED INSIDE
+    a real replica process (carried over the coordination-store
+    transport), and /traces/<id> serves the merged record: router spans
+    with this pid, replica.infer + serving.execute spans with the
+    replica process's pid."""
+    import os
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.store import create_master_store
+    from paddle_tpu.inference.replica import SubprocessReplica
+    from paddle_tpu.inference.router import RouterConfig, ServingRouter
+
+    d = tmp_path / "model"
+    d.mkdir()
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    model.eval()
+    x = np.zeros((2, 8), np.float32)
+    paddle.jit.save(model, str(d / "model"),
+                    input_spec=[paddle.to_tensor(x)])
+
+    store = create_master_store()
+    try:
+        def factory(rid, model_dir, generation):
+            return SubprocessReplica(rid, store, model_dir, generation,
+                                     artifact_name="model",
+                                     start_timeout=120.0)
+
+        router = ServingRouter(
+            factory, size=1, model_dir=str(d), heartbeats=store,
+            config=RouterConfig(heartbeat_ttl=5.0, start_grace=120.0,
+                                attempt_timeout=60.0,
+                                probe_timeout=120.0))
+        try:
+            batch = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+            router.warmup(feeds=[batch])
+            with trace.root_span("e2e") as root:
+                outs, gen = router.infer_stamped([batch], timeout=120.0)
+            spans = flight.recorder().spans_for(root.trace_id)
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s.name, []).append(s)
+            assert "router.attempt" in by_name
+            assert "replica.infer" in by_name      # recorded in the child
+            remote = by_name["replica.infer"][0]
+            assert remote.pid != os.getpid()
+            assert {s.pid for s in by_name["serving.execute"]} == \
+                {remote.pid}
+            # the merged record is served over HTTP by trace id
+            server = router.serve_metrics()
+            body = json.loads(urllib.request.urlopen(
+                server.url + f"/traces/{root.trace_id_hex}",
+                timeout=5).read())
+            pids = {sp["pid"] for sp in body["spans"]}
+            assert os.getpid() in pids and remote.pid in pids
+        finally:
+            router.shutdown(drain_timeout=10.0)
+    finally:
+        store.close()

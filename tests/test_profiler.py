@@ -87,6 +87,58 @@ def test_benchmark_timer():
     assert rep["steps_per_sec"] > 0
 
 
+def test_profiled_span_nesting_parent_links():
+    """The profiled_span nesting fix: concurrent/nested spans used to
+    export flat (no parent linkage) — now each profiled_span threads the
+    obs.trace per-thread context stack, so nested spans carry proper
+    parent ids into the flight recorder (and chrome-trace export of the
+    trace nests instead of interleaving)."""
+    import threading
+
+    from paddle_tpu.obs import flight, trace
+    from paddle_tpu.profiler import profiled_span
+
+    was = trace.enabled()
+    trace.enable()
+    flight.recorder().reset()
+    try:
+        with trace.root_span("outer") as outer:
+            with profiled_span("mid"):
+                with profiled_span("leaf"):
+                    pass
+            with profiled_span("mid2"):
+                pass
+
+        # concurrent spans on ANOTHER thread must parent under their own
+        # thread's stack, never interleave into this one's
+        def other():
+            with trace.root_span("t2-root"):
+                with profiled_span("t2-span"):
+                    pass
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+
+        by = {s.name: s for s in
+              flight.recorder().spans_for(outer.trace_id)}
+        assert by["leaf"].parent_id == by["mid"].span_id
+        assert by["mid"].parent_id == by["outer"].span_id
+        assert by["mid2"].parent_id == by["outer"].span_id
+        assert "t2-span" not in by
+        t2 = [tr for tr in flight.recorder().traces()
+              if tr["root"] == "t2-root"]
+        assert t2 and t2[0]["spans"] == 2
+        # outside any trace context, profiled_span stays the no-op
+        trace.disable()
+        from contextlib import nullcontext
+
+        assert isinstance(profiled_span("idle"), nullcontext)
+    finally:
+        flight.recorder().reset()
+        (trace.enable if was else trace.disable)()
+
+
 def test_back_to_back_cycles_clear_buffer(tmp_path):
     """Traces must not accumulate across record cycles (closed=0, ready=0)."""
     traces = []

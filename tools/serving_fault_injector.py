@@ -132,6 +132,34 @@ os.environ.setdefault("PADDLE_TPU_LOCKCHECK", "1")
 # asserts there were ZERO, proving the serving/batching/decode/router
 # stacks retrace-free and sync-free under faults.
 os.environ.setdefault("PADDLE_TPU_SAN", "1")
+# ... and with distributed tracing LIVE (obs.trace — the default, made
+# explicit here so an inherited opt-out is visible): every phase's
+# requests run under root spans, the flight recorder's obs.trace /
+# obs.flight locks are part of the lockcheck cycle assertions, and each
+# phase asserts that every request failing with a postmortem-class typed
+# error (DeadlineExceeded / RequestFailed) left a RETAINED trace behind.
+os.environ.setdefault("PADDLE_TPU_TRACE", "1")
+
+
+def _trace_on():
+    from paddle_tpu.obs import trace
+    return trace.enabled()
+
+
+def _assert_postmortems(phase, failed_trace_ids, bad):
+    """Every postmortem-class failure must resolve to a retained trace
+    in the flight recorder (the operator's debugging contract)."""
+    if not _trace_on():
+        return
+    from paddle_tpu.obs import flight
+    pinned = flight.recorder().postmortem_ids()
+    for i, tid in failed_trace_ids:
+        if tid is None:
+            bad.append(f"[{phase}] request {i} failed typed but carries "
+                       f"no trace_id (postmortem capture dark)")
+        elif int(tid, 16) not in pinned:
+            bad.append(f"[{phase}] request {i}'s failure trace {tid} "
+                       f"was not retained in the postmortem buffer")
 
 
 def _san_mark_warm():
@@ -296,6 +324,8 @@ def run_phase(phase, model, path, verbose=True):
     inj.poison_id = 1 + N_REQUESTS // 2
     inj.active = True
 
+    from paddle_tpu.obs import trace as otrace
+
     def one_request(i):
         def fn(pred):
             inj.enter_member(pred)
@@ -307,24 +337,29 @@ def run_phase(phase, model, path, verbose=True):
                 return pred.run()
             finally:
                 inj.exit_member(pred)
-        try:
-            if batched:
-                # feeds-style: the coalescible path batching operates on
-                out, = pool.infer([batches[i]], timeout=deadline)
-            else:
-                out, = pool.submit(fn, timeout=deadline).result()
-        except DeadlineExceeded:
-            return i, "deadline", None
-        except Overloaded:
-            return i, "overloaded", None
-        except RequestFailed:
-            return i, "failed", None
-        except ServingError as e:  # any other typed error is still a bug
-            return i, f"unexpected-typed:{type(e).__name__}: {e}", None
-        except BaseException as e:  # noqa: BLE001 — untyped = violation
-            return i, f"untyped:{type(e).__name__}: {e}", None
-        return i, "ok", out
+        # every request runs under its own root span (the pool has no
+        # router above it here): worker/batcher spans hang off it and a
+        # typed failure must pin it as a postmortem
+        with otrace.root_span("injector.request", attrs={"i": i}):
+            try:
+                if batched:
+                    # feeds-style: the coalescible path batching uses
+                    out, = pool.infer([batches[i]], timeout=deadline)
+                else:
+                    out, = pool.submit(fn, timeout=deadline).result()
+            except DeadlineExceeded as e:
+                return i, "deadline", getattr(e, "trace_id", None)
+            except Overloaded:
+                return i, "overloaded", None
+            except RequestFailed as e:
+                return i, "failed", getattr(e, "trace_id", None)
+            except ServingError as e:  # any other typed error: a bug
+                return i, f"unexpected-typed:{type(e).__name__}: {e}", None
+            except BaseException as e:  # noqa: BLE001 — untyped = bug
+                return i, f"untyped:{type(e).__name__}: {e}", None
+            return i, "ok", out
 
+    failed_trace_ids = []
     t0 = time.monotonic()
     with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
         futs = [ex.submit(one_request, i) for i in range(N_REQUESTS)]
@@ -338,6 +373,8 @@ def run_phase(phase, model, path, verbose=True):
                                    f"WRONG output (stale/corrupt handles?)")
                 elif kind in outcomes:
                     outcomes[kind] += 1
+                    if kind in ("deadline", "failed") and _trace_on():
+                        failed_trace_ids.append((i, out))
                 else:
                     bad.append(f"[{phase}] request {i} -> {kind}")
         except concurrent.futures.TimeoutError:
@@ -347,6 +384,9 @@ def run_phase(phase, model, path, verbose=True):
             for f in futs:
                 f.cancel()
     wall = time.monotonic() - t0
+
+    # postmortem contract: each typed failure above left a retained trace
+    _assert_postmortems(phase, failed_trace_ids, bad)
 
     if inj.max_concurrency > 1:
         bad.append(f"[{phase}] double-lease: {inj.max_concurrency} requests "
@@ -548,6 +588,7 @@ def run_decode_phase(phase, model, verbose=True):
             v.cancel()
             inj["injected"] += 1
         outcomes = {}
+        seq_errors = {}
         for seed, _, _ in DECODE_SEQS:
             s = streams[seed]
             try:
@@ -560,6 +601,7 @@ def run_decode_phase(phase, model, verbose=True):
             except (DeadlineExceeded, Overloaded, PoolClosed,
                     RequestFailed) as e:
                 outcomes[seed] = type(e).__name__
+                seq_errors[seed] = e
             except ServingError as e:
                 outcomes[seed] = f"unexpected-typed:{e}"
                 bad.append(f"[{phase}] sequence {seed} -> unexpected typed "
@@ -585,6 +627,12 @@ def run_decode_phase(phase, model, verbose=True):
                     or ok != len(DECODE_SEQS) - 1:
                 bad.append(f"[{phase}] exactly the poisoned sequence must "
                            f"fail (typed RequestFailed): {outcomes}")
+            # the failed sequence's per-sequence trace (prefill span,
+            # typed status) must be retained as a postmortem
+            _assert_postmortems(
+                phase,
+                [(victim_seed, getattr(seq_errors.get(victim_seed),
+                                       "trace_id", None))], bad)
         if kind in ("wedge", "poison") and inj["injected"] == 0:
             bad.append(f"[{phase}] harness error: no fault was injected")
 
@@ -706,6 +754,7 @@ def run_router_phase(phase, ctx, verbose=True):
                            config=cfg)
     outcomes = {"ok": 0}
     gens_seen = set()
+    failed_trace_ids = []
     olock = threading.Lock()
 
     def one_request(i):
@@ -716,6 +765,12 @@ def run_router_phase(phase, ctx, verbose=True):
             with olock:
                 k = type(e).__name__
                 outcomes[k] = outcomes.get(k, 0) + 1
+                if getattr(type(e), "_trace_postmortem", False) \
+                        and _trace_on():
+                    # the router minted the root span; its typed
+                    # failures must resolve to retained traces
+                    failed_trace_ids.append(
+                        (i, getattr(e, "trace_id", None)))
             return
         except BaseException as e:  # noqa: BLE001 — untyped = violation
             bad.append(f"[{phase}] request {i} -> UNTYPED "
@@ -846,6 +901,7 @@ def run_router_phase(phase, ctx, verbose=True):
                 bad.append(f"[{phase}] post-fault request failed: {e}")
     finally:
         drained = router.shutdown(drain_timeout=10.0)
+    _assert_postmortems(phase, failed_trace_ids, bad)
     if not drained:
         bad.append(f"[{phase}] router failed to drain on shutdown")
     final = router.stats()
@@ -1007,6 +1063,30 @@ def main(argv=None):
               f"finite_checks={c['finite_checks']} across "
               f"{srep['entrypoints']} entrypoints")
 
+    from paddle_tpu.obs import trace as _otrace_verdict
+    if not _otrace_verdict.enabled():
+        # the operator exported PADDLE_TPU_TRACE=0 on purpose — phases
+        # still gate the run, only the trace/postmortem assertions and
+        # the obs.trace/obs.flight lock expectations are off
+        print("trace: disabled by PADDLE_TPU_TRACE="
+              f"{os.environ.get('PADDLE_TPU_TRACE')!r}; "
+              "trace assertions skipped")
+    else:
+        from paddle_tpu.obs import flight as _oflight_verdict
+        fstats = _oflight_verdict.recorder().stats()
+        # vacuity guard (like tpu-san's): tracing must actually have
+        # recorded spans during the phases, or the postmortem
+        # assertions above passed trivially
+        if fstats["recorded"] == 0:
+            violations.append(
+                "tracing was not effective: no span was ever recorded "
+                "(probes dark? PADDLE_TPU_TRACE="
+                f"{os.environ.get('PADDLE_TPU_TRACE')!r})")
+        print(f"trace: {fstats['recorded']} spans across "
+              f"{fstats['rings']} rings, {fstats['pinned_traces']} "
+              f"postmortem trace(s), {fstats['dropped_wraps']} ring "
+              f"wraps")
+
     from paddle_tpu.analysis import lockcheck
     if not lockcheck.enabled():
         # the operator exported PADDLE_TPU_LOCKCHECK=0 on purpose (e.g.
@@ -1030,6 +1110,12 @@ def main(argv=None):
                           # stay out of every cycle and never be held
                           # across dispatch/serialization
                           "obs.registry", "obs.http"}
+        from paddle_tpu.obs import trace as _otrace_mod
+        if _otrace_mod.enabled():
+            # tracing live: the span-id generator lock and the flight
+            # recorder's registry/postmortem lock are on every traced
+            # request path — same 0-cycles / 0-held-across-dispatch bar
+            expected_locks |= {"obs.trace", "obs.flight"}
         if any(p.startswith("decode-") for p in phases):
             # the decode engine's own named locks must have been observed
             # (and the 0-cycles / 0-held-across-dispatch assertions below

@@ -1,0 +1,149 @@
+"""Dump paddle_tpu request traces: scrape a live flight recorder or
+snapshot this process's.
+
+The tracing twin of tools/metrics_dump.py (docs/observability.md,
+"Distributed tracing"). Two modes:
+
+* **Scrape** — ``--url http://host:port`` hits a running exporter
+  (`ServingPool.serve_metrics()` / `ServingRouter.serve_metrics()` /
+  `obs.MetricsServer`): with no trace id it fetches ``/traces`` (the
+  recent + retained index); with a TRACE_ID argument it fetches
+  ``/traces/<id>`` — the trace's merged causal record across every
+  thread and process that touched it. ``--format chrome`` asks for a
+  chrome://tracing file instead of the span list (load it at
+  chrome://tracing or ui.perfetto.dev).
+
+* **In-process** — no ``--url``: import the modules named by
+  ``--import`` (their side effects run traced work), then dump the
+  process flight recorder.
+
+Typical workflow: scrape ``/metrics``, find the p99 bucket's exemplar
+trace id (``# {trace_id="..."}``), then::
+
+    python tools/trace_dump.py --url http://127.0.0.1:9090 <trace_id>
+    python tools/trace_dump.py --url ... <trace_id> --format chrome > t.json
+
+Exit codes: 0 on success, 1 on scrape/import/not-found failure, 2 on
+usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class _UsageError(Exception):
+    pass
+
+
+def _scrape(url, trace_id, fmt, timeout):
+    import urllib.parse
+
+    if "//" not in url:
+        url = "http://" + url
+    path = urllib.parse.urlparse(url).path.rstrip("/")
+    if path in ("", "/traces"):
+        url = url.rstrip("/") if path else url.rstrip("/") + "/traces"
+        if trace_id:
+            url += f"/{trace_id}"
+            if fmt == "chrome":
+                url += "?format=chrome"
+    elif trace_id:
+        # an explicit non-/traces path is fetched verbatim — silently
+        # dropping the trace id would print the wrong thing with exit 0
+        raise _UsageError(
+            f"--url already carries the path {path!r}; pass a base "
+            f"host:port (or .../traces) when also giving a trace id")
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("trace_id", nargs="?", default=None,
+                    help="16-hex trace id (omit to list recent traces)")
+    ap.add_argument("--url", default=None,
+                    help="live exporter to scrape (host:port base or a "
+                         "full path); omit to dump this process's "
+                         "flight recorder")
+    ap.add_argument("--format", default="json",
+                    choices=("json", "chrome"), dest="fmt",
+                    help="span list (json, default) or a chrome://"
+                         "tracing file (chrome; needs a trace id)")
+    ap.add_argument("--import", action="append", default=[],
+                    dest="imports", metavar="MODULE",
+                    help="module(s) to import before an in-process dump "
+                         "(their side effects record traces)")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="scrape timeout in seconds (default: 5)")
+    args = ap.parse_args(argv)
+
+    if args.fmt == "chrome" and not args.trace_id:
+        print("trace_dump: --format chrome needs a trace id",
+              file=sys.stderr)
+        return 2
+
+    if args.url:
+        try:
+            sys.stdout.write(_scrape(args.url, args.trace_id, args.fmt,
+                                     args.timeout))
+            sys.stdout.write("\n")
+        except _UsageError as e:
+            print(f"trace_dump: {e}", file=sys.stderr)
+            return 2
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"trace_dump: scrape of {args.url!r} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    import importlib
+
+    for mod in args.imports:
+        try:
+            importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"trace_dump: import of {mod!r} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+    from paddle_tpu.obs.flight import FlightRecorder, recorder
+
+    rec = recorder()
+    if args.trace_id is None:
+        print(json.dumps({"traces": rec.traces(),
+                          "recorder": rec.stats()},
+                         indent=1, sort_keys=True, default=str))
+        return 0
+    try:
+        spans = rec.spans_for(args.trace_id)
+    except ValueError:
+        print(f"trace_dump: malformed trace id {args.trace_id!r}",
+              file=sys.stderr)
+        return 2
+    if not spans:
+        print(f"trace_dump: trace {args.trace_id} not found",
+              file=sys.stderr)
+        return 1
+    if args.fmt == "chrome":
+        print(json.dumps(
+            {"traceEvents": FlightRecorder.chrome_events(spans)},
+            default=str))
+    else:
+        print(json.dumps({"trace_id": args.trace_id,
+                          "spans": [s.to_dict() for s in spans]},
+                         indent=1, sort_keys=True, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
