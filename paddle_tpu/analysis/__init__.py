@@ -18,12 +18,19 @@ Two passes, two failure families:
   (``hot_region`` probes), donation guard, and non-finite guard, with
   site-keyed findings ratcheted via ``.tpu_san_baseline.json`` and
   ``tools/tpu_san.py``.
+* `graphcheck` — an opt-in (``PADDLE_TPU_GRAPHCHECK=1``) **graph
+  auditor**: statically walks the jaxpr/compiled HLO of every framework
+  entrypoint (engine steps, AOT bucket executables, exported layer
+  calls, decode steps) for unexpected collectives, accidental full
+  replication, conv-region layout changes, host transfers, unaliased
+  donation and a live-memory watermark; ratcheted via
+  ``.graphcheck_baseline.json`` and ``tools/graph_audit.py``.
 
 See docs/static_analysis.md for the rule catalogue and workflows.
 """
-from . import lockcheck, locks, runtime_san  # noqa: F401
+from . import graphcheck, lockcheck, locks, runtime_san  # noqa: F401
 
-__all__ = ["lockcheck", "locks", "runtime_san", "tracelint"]
+__all__ = ["graphcheck", "lockcheck", "locks", "runtime_san", "tracelint"]
 
 
 def __getattr__(name):

@@ -68,7 +68,8 @@ __all__ = [
     "counts_by_key", "assert_clean", "mark_warm",
     "note_trace", "hot_region", "allow_host_sync", "in_hot_region",
     "note_donation", "check_use", "check_finite", "nonfinite_enabled",
-    "aval_signature", "Finding", "SanError", "DonatedBufferError",
+    "aval_signature", "sharding_signature", "Finding", "SanError",
+    "DonatedBufferError",
     "NonFiniteError", "load_baseline", "write_baseline", "new_counts",
     "OBS_COLLECTOR",
 ]
@@ -192,22 +193,42 @@ def _flatten_sig(sig, out):
     return out
 
 
+_SHARDING_TAG = "sharding:"
+
+
 def _describe_delta(old_sig, new_sig):
     """Human-readable diff between two trace signatures (the
-    shape/dtype/weak_type drift that caused a retrace)."""
+    shape/dtype/weak_type drift that caused a retrace). Leaves carrying
+    the ``sharding:`` tag (see :func:`sharding_signature`) are rendered
+    as a placement change — a mesh/spec swap that forces a recompile is
+    named as such instead of surfacing as an anonymous leaf diff."""
     a = _flatten_sig(old_sig, [])
     b = _flatten_sig(new_sig, [])
     if len(a) != len(b):
         return (f"signature arity/structure changed "
                 f"({len(a)} -> {len(b)} leaves)")
-    diffs = [f"leaf {i}: {x!r} -> {y!r}"
-             for i, (x, y) in enumerate(zip(a, b)) if x != y]
-    if not diffs:
+    diffs, shard = [], []
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x == y:
+            continue
+        if isinstance(x, str) and isinstance(y, str) and \
+                x.startswith(_SHARDING_TAG) and y.startswith(_SHARDING_TAG):
+            shard.append(f"{x[len(_SHARDING_TAG):]} -> "
+                         f"{y[len(_SHARDING_TAG):]}")
+        else:
+            diffs.append(f"leaf {i}: {x!r} -> {y!r}")
+    if not diffs and not shard:
         return "identical signature"
-    shown = "; ".join(diffs[:4])
-    if len(diffs) > 4:
-        shown += f"; ... {len(diffs) - 4} more"
-    return shown
+    parts = []
+    if shard:
+        parts.append("sharding signature changed (mesh/spec): "
+                     + "; ".join(shard))
+    if diffs:
+        shown = "; ".join(diffs[:4])
+        if len(diffs) > 4:
+            shown += f"; ... {len(diffs) - 4} more"
+        parts.append(shown)
+    return " | ".join(parts)
 
 
 class _Tls(threading.local):
@@ -640,6 +661,37 @@ def aval_signature(values):
         return leaf(v)
 
     return walk(values)
+
+
+def sharding_signature(mesh, specs=None):
+    """One tagged, hashable signature leaf describing a placement (mesh
+    axis sizes + optional per-name PartitionSpecs). Ride it alongside
+    :func:`aval_signature` in a ``note_trace`` signature: when the only
+    delta after warmup is this leaf, the retrace finding is blamed as a
+    *sharding signature change* (a mesh swap, a ``shard_()`` re-place, a
+    rule-table edit) instead of a generic leaf diff."""
+    if mesh is None:
+        base = "none"
+    else:
+        try:
+            base = "mesh(" + ",".join(
+                f"{a}={int(s)}" for a, s in dict(mesh.shape).items()) + ")"
+        except Exception:  # tpu-lint: disable=TL007 — mesh-likes vary;
+            base = "mesh(?)"   # a best-effort label beats a crash
+    if specs:
+        try:
+            items = sorted(specs.items()) if isinstance(specs, dict) \
+                else list(enumerate(specs))
+            body = ";".join(
+                f"{k}={tuple(v) if v is not None else ()}"
+                for k, v in items)
+        except Exception:  # tpu-lint: disable=TL007 — same best-effort
+            body = "?"
+        if len(body) > 256:
+            import hashlib
+            body = hashlib.sha1(body.encode()).hexdigest()[:16]
+        base += "|" + body
+    return _SHARDING_TAG + base
 
 
 def note_donation(site, tree, tag=None):

@@ -14,9 +14,9 @@ import contextlib
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
+from ..sharding import named_sharding, replicated
 from ..nn.layer.layers import Layer
 from . import topology as topo_mod
 
@@ -36,11 +36,11 @@ class DataParallel(Layer):
         # replicate params across all axes (pure DP)
         for _, p in layers.named_parameters():
             p._value = jax.device_put(
-                p._value, NamedSharding(self.mesh, P(*([None] * p.ndim))))
+                p._value, replicated(self.mesh, p.ndim))
         for _, b in layers.named_buffers():
             if isinstance(b, Tensor):
                 b._value = jax.device_put(
-                    b._value, NamedSharding(self.mesh, P(*([None] * b.ndim))))
+                    b._value, replicated(self.mesh, b.ndim))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*self.scatter(inputs), **kwargs)
@@ -54,7 +54,7 @@ class DataParallel(Layer):
                     x.shape[0] % (self.mesh.shape["dp"] * self.mesh.shape["sharding"]) == 0:
                 spec = [("dp", "sharding")] + [None] * (x.ndim - 1)
                 out.append(Tensor(jax.device_put(
-                    x._value, NamedSharding(self.mesh, P(*spec))),
+                    x._value, named_sharding(self.mesh, spec)),
                     stop_gradient=x.stop_gradient))
             else:
                 out.append(x)

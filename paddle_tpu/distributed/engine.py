@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import graphcheck as _gc
 from ..analysis import runtime_san as _san
 from ..core import lazy as _lazy
 from ..core.tensor import Tensor
@@ -219,6 +220,10 @@ class ShardedTrainStep:
         from ..obs.metrics import registry as _obs_registry
 
         self._obs_key = f"train.engine{next(_ENGINE_OBS_SEQ)}"
+        # the engine's mesh never changes, so its tpu-san sharding
+        # signature is computed once (the per-call probes below ride it
+        # on the dispatch hot path)
+        self._san_mesh_sig = _san.sharding_signature(mesh)
         self._h_dispatch = _obs_registry().histogram(
             "engine.dispatch_seconds",
             help="host-side latency of one compiled train/eval step "
@@ -460,6 +465,22 @@ class ShardedTrainStep:
     def _batch_spec_for(self, ndim):
         return batch_spec_for_ndim(self.batch_spec, ndim)
 
+    def _audit_graph(self, site, fn, args):
+        """Graph auditor (PADDLE_TPU_GRAPHCHECK=1): statically audit the
+        freshly built step program — collectives vs the declared specs,
+        conv-region layout changes, host transfers, donation actually
+        aliased, live-memory watermark. Costs one extra AOT
+        lower+compile per cold entrypoint; free when off.
+        `expect_sharded_params` stays False: fsdp-style training gathers
+        params in-graph by design (serving entrypoints pass True)."""
+        param_avals = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for n, v in self.param_vals.items()}
+        _gc.audit_executable(
+            site, jit_obj=fn, args=args, mesh=self.mesh,
+            axes_specs=[*self.param_specs.values(), self.batch_spec],
+            param_avals=param_avals, param_specs=self.param_specs,
+            expect_sharded_params=False)
+
     # ---- public step APIs ----------------------------------------------
     def train_batch(self, *batch):
         """Run one optimizer step; returns the (device) loss Tensor."""
@@ -475,13 +496,19 @@ class ShardedTrainStep:
             # per-call sentinel: the step jit retraces INTERNALLY on any
             # new batch signature — a cache-keyed build hook would miss
             # exactly the silent steady-state recompile this flags
-            _san.note_trace("engine.step", self._obs_key,
-                            _san.aval_signature(placed), per_call=True)
+            _san.note_trace(
+                "engine.step", self._obs_key,
+                (_san.aval_signature(placed), self._san_mesh_sig),
+                per_call=True)
         if cold:
             self._step_fn = self._build_step(placed)
         lr = self._lr_scalar()
         key = self._key_scalar()
         step_no = self._step_scalar()
+        if cold and _gc.enabled():
+            self._audit_graph("engine.step", self._step_fn,
+                              (self.param_vals, self.opt_state,
+                               self.buffer_vals, placed, lr, key, step_no))
         self._step_count += 1
         donated = (self.param_vals, self.opt_state, self.buffer_vals,
                    key, step_no) if san and self.donate else None
@@ -587,8 +614,8 @@ class ShardedTrainStep:
                                 for a in placed))
         san = _san.enabled()
         if san:
-            _san.note_trace("engine.multi", self._obs_key, sig,
-                            per_call=True)
+            _san.note_trace("engine.multi", self._obs_key,
+                            (sig, self._san_mesh_sig), per_call=True)
         fn = self._multi_fns.get(sig)
         cold = fn is None
         if cold:
@@ -598,6 +625,10 @@ class ShardedTrainStep:
         lrs = self._lr_schedule_array(n)
         key = self._key_scalar()
         step0 = self._step_scalar()
+        if cold and _gc.enabled():
+            self._audit_graph("engine.multi", fn,
+                              (self.param_vals, self.opt_state,
+                               self.buffer_vals, placed, lrs, key, step0))
         donated = (self.param_vals, self.opt_state, self.buffer_vals,
                    key, step0) if san and self.donate else None
         with _span("engine::dispatch", histogram=self._h_dispatch), \
@@ -666,14 +697,18 @@ class ShardedTrainStep:
             placed = self._place_batch(batch)
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in placed)
         if _san.enabled():
-            _san.note_trace("engine.eval", self._obs_key, sig,
-                            per_call=True)
+            _san.note_trace("engine.eval", self._obs_key,
+                            (sig, self._san_mesh_sig), per_call=True)
         fn = self._eval_fns.get(sig)
         cold = fn is None
         if cold:
             fn = self._build_eval(placed)
             self._eval_fns[sig] = fn
         key = rng_mod.next_key()
+        if cold and _gc.enabled():
+            self._audit_graph("engine.eval", fn,
+                              (self.param_vals, self.buffer_vals, placed,
+                               key))
         with _span("engine::dispatch", histogram=self._h_dispatch), \
                 (_san.allow_host_sync("engine.compile") if cold
                  else _san.hot_region("engine.dispatch")):

@@ -76,6 +76,7 @@ import time
 import numpy as np
 
 from ...analysis import locks as _locks
+from ...analysis import graphcheck as _gc
 from ...analysis import runtime_san as _san
 from ...obs import trace as _otrace
 from ..serving import (Deadline, DeadlineExceeded, Overloaded, PoolClosed,
@@ -577,7 +578,7 @@ class DecodeEngine:
         compiled, source = aot.compile_jit(
             step, avals, fingerprint=self._fingerprint, cache=self._cache,
             tag=f"decode-step-b{bucket}", in_shardings=in_sh,
-            out_shardings=out_sh)
+            out_shardings=out_sh, audit_ctx=self._audit_ctx(pv))
         with self._lock:
             if source == "disk":
                 self._disk_loaded += 1
@@ -635,7 +636,8 @@ class DecodeEngine:
         compiled, source = aot.compile_jit(
             prefill, avals, fingerprint=self._fingerprint,
             cache=self._cache, tag=f"decode-prefill-p{pbucket}",
-            in_shardings=in_sh, out_shardings=out_sh)
+            in_shardings=in_sh, out_shardings=out_sh,
+            audit_ctx=self._audit_ctx(pv))
         with self._lock:
             if source == "disk":
                 self._disk_loaded += 1
@@ -643,6 +645,18 @@ class DecodeEngine:
                 self._compiled += 1
         self._prefill_fns[pbucket] = compiled
         return compiled
+
+    def _audit_ctx(self, pv):
+        """Graph-auditor context for the step executables: on a TP mesh
+        the parameters must STAY sharded (a full-size all-gather of a
+        sharded weight means the rule table failed — GC001). None when
+        the auditor is off, so compile_jit's hook stays free."""
+        if not _gc.enabled():
+            return None
+        specs = {n: sh.spec for n, sh in (self._param_sh or {}).items()}
+        return {"mesh": self.mesh, "param_avals": pv,
+                "param_specs": specs,
+                "expect_sharded_params": self.mesh is not None}
 
     def warmup(self):
         """Compile (or disk-load) every decode bucket and prefill bucket

@@ -34,6 +34,7 @@ import hashlib
 import os
 import pickle
 
+from ..analysis import graphcheck as _gc
 from ..analysis import locks as _locks
 from ..analysis import runtime_san as _san
 
@@ -235,7 +236,7 @@ def _aval_signature(avals):
 
 
 def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
-                in_shardings=None, out_shardings=None):
+                in_shardings=None, out_shardings=None, audit_ctx=None):
     """AOT-compile (or cache-load) `fn` over an aval pytree, persisting the
     executable like `compile_batched` does for bucket executables.
 
@@ -284,7 +285,10 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
             # no fingerprint = no persistent cache: a fresh token per
             # call (an id() could be recycled into a warm entry)
             fingerprint if fingerprint is not None else object(),
-            (_san.aval_signature(avals), str(_sharding_sig(in_shardings))))
+            # the "sharding:" tag routes a placement-only delta into the
+            # retrace blame as a sharding-signature change
+            (_san.aval_signature(avals),
+             "sharding:" + str(_sharding_sig(in_shardings))))
     with _locks.blocking_region("aot.compile"):
         kw = {}
         if in_shardings is not None:
@@ -294,7 +298,16 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
             # engine's KV pool) on the placement the NEXT dispatch's
             # in_shardings demand — AOT executables accept exact matches
             kw["out_shardings"] = out_shardings
-        compiled = jax.jit(fn, **kw).lower(*avals).compile()
+        lowered = jax.jit(fn, **kw).lower(*avals)
+        compiled = lowered.compile()
+    if _gc.enabled():
+        # graph auditor: every REAL compile is audited (disk loads were
+        # audited when first built); `audit_ctx` carries the caller's
+        # placement context (decode engine, sharded layers)
+        _gc.audit_executable(f"aot.{tag}", fn=fn, args=avals,
+                             lowered=lowered, compiled=compiled,
+                             in_shardings=in_shardings,
+                             **(audit_ctx or {}))
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))
@@ -305,7 +318,7 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
 
 def compile_batched(exported, holder_avals, input_spec, bucket, *,
                     fingerprint=None, cache=None, holder_shardings=None,
-                    mesh=None):
+                    mesh=None, audit_ctx=None):
     """AOT-compile (or cache-load) the bucket-B executable for a
     deserialized `jax.export` module.
 
@@ -356,7 +369,7 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
             fingerprint if fingerprint is not None else object(),
             (bucket, _san.aval_signature(list(holder_avals)),
              str([(list(s["shape"]), str(s["dtype"])) for s in input_spec]),
-             str(_sharding_sig(in_shardings))))
+             "sharding:" + str(_sharding_sig(in_shardings))))
 
     def batched(holder_vals, *stacked):
         def body(xs):
@@ -372,7 +385,15 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
         for s in input_spec]
     jitted = jax.jit(batched) if in_shardings is None else \
         jax.jit(batched, in_shardings=in_shardings)
-    compiled = jitted.lower(list(holder_avals), *stacked_avals).compile()
+    lowered = jitted.lower(list(holder_avals), *stacked_avals)
+    compiled = lowered.compile()
+    if _gc.enabled():
+        ctx = dict(audit_ctx or {})
+        ctx.setdefault("mesh", mesh)
+        _gc.audit_executable("aot.batched", fn=batched,
+                             args=(list(holder_avals), *stacked_avals),
+                             lowered=lowered, compiled=compiled,
+                             in_shardings=in_shardings, **ctx)
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))

@@ -13,6 +13,7 @@ import os
 import pickle
 import threading
 
+from ..analysis import graphcheck as _gc
 from ..analysis import locks as _locks
 from ..analysis import runtime_san as _san
 
@@ -139,6 +140,9 @@ class TranslatedLayer:
         # tpu-san entrypoint identity: a fresh object per layer instance
         # (id() could be recycled into a warm entry after GC)
         self._san_token = object()
+        # graph auditor: signatures already audited (one audit per input
+        # signature per layer — the audit pays its own lower+compile)
+        self._gc_sigs = set()
         self._aot_execs: dict = {}
         self._aot_building: dict = {}   # bucket -> Event (build in flight)
         self._aot_counts = {"compiles": 0, "disk_hits": 0, "mem_hits": 0}
@@ -157,10 +161,25 @@ class TranslatedLayer:
         if _san.enabled():
             # per-call retrace sentinel on the layer's caching jit: a
             # NEW input signature means jax retraces right here — after
-            # mark_warm that's a serving-hot-path recompile finding
-            _san.note_trace("aot.layer_call", self._san_token,
-                            _san.aval_signature(vals), per_call=True)
+            # mark_warm that's a serving-hot-path recompile finding; the
+            # sharding signature rides along so a shard_() recompile is
+            # blamed as a placement change, not a shape delta
+            _san.note_trace(
+                "aot.layer_call", self._san_token,
+                (_san.aval_signature(vals),
+                 _san.sharding_signature(self._mesh, self._param_specs)),
+                per_call=True)
         holder_vals = [self._params[n]._value for n in self._param_names]
+        if _gc.enabled():
+            sig = _san.aval_signature(vals)
+            with self._aot_lock:      # check-then-act under the lock:
+                fresh = sig not in self._gc_sigs    # concurrent workers
+                if fresh:                           # must not double-pay
+                    self._gc_sigs.add(sig)          # the audit compile
+            if fresh:
+                _gc.audit_executable("aot.layer_call", jit_obj=self._call,
+                                     args=(holder_vals, *vals),
+                                     **self._gc_ctx())
         out = self._call(holder_vals, *vals)
         if isinstance(out, (list, tuple)):
             return tuple(Tensor(o) for o in out)
@@ -229,6 +248,7 @@ class TranslatedLayer:
         self._param_specs = specs
         with self._aot_lock:
             self._aot_execs.clear()
+            self._gc_sigs.clear()  # new placement -> new programs: re-audit
         # `sharding.artifact.<fp8>` collector: mesh shape + per-param
         # shard fractions; bound method, so the registry holds it weakly
         from ..obs.metrics import registry as _registry
@@ -246,6 +266,19 @@ class TranslatedLayer:
         if self._mesh is None:
             return {}
         return _shardlib.mesh_stats(self._mesh, self._param_specs)
+
+    def _gc_ctx(self):
+        """Graph-auditor context: after shard_() the parameters must
+        STAY sharded through every executable (GC001 full-gather check);
+        single-device layers audit the structural rules only."""
+        param_avals = {
+            n: jax.ShapeDtypeStruct(self._params[n]._value.shape,
+                                    self._params[n]._value.dtype)
+            for n in self._param_names}
+        return {"mesh": self._mesh, "param_avals": param_avals,
+                "param_specs": dict(self._param_specs or {}),
+                "axes_specs": list((self._param_specs or {}).values()),
+                "expect_sharded_params": self._mesh is not None}
 
     @property
     def mesh(self):
@@ -330,7 +363,8 @@ class TranslatedLayer:
                 raw, source = compile_batched(
                     self._exported, self._holder_avals(), self.input_spec,
                     bucket, fingerprint=self.fingerprint, cache=cache,
-                    holder_shardings=holder_sh, mesh=self._mesh)
+                    holder_shardings=holder_sh, mesh=self._mesh,
+                    audit_ctx=self._gc_ctx() if _gc.enabled() else None)
 
             def fn(*stacked_inputs, _raw=raw):
                 holders = [self._params[n]._value

@@ -1,0 +1,425 @@
+"""Graph auditor (paddle_tpu/analysis/graphcheck): per-rule bad/good
+jaxpr pairs on tiny functions, a planted layout-transpose in a conv
+block caught at the engine site key, donation-declared-but-unaliased on
+the CPU mesh, baseline determinism, the graph_audit CLI exit-code
+contract, and the acceptance proof — the checked-in baseline is exact
+(no stale keys) and a planted regression flips the CLI to exit 1.
+
+Named to sort BEFORE test_op_schema (tier-1 tail files get truncated by
+the suite timeout). Everything here runs on the 8-virtual-device CPU
+platform conftest forces; only the full-CLI dogfood pays a subprocess.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import graphcheck as gc
+from paddle_tpu.sharding import cpu_mesh, named_sharding, replicated, spec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "graph_audit.py")
+BASELINE = os.path.join(REPO, ".graphcheck_baseline.json")
+
+
+@pytest.fixture(autouse=True)
+def _live_auditor():
+    """Each test starts from an enabled, empty auditor and leaves the
+    process back in the off state (other test files must not audit)."""
+    gc.enable()
+    gc.reset()
+    yield
+    gc.reset()
+    gc.disable()
+
+
+def keys():
+    return set(gc.counts_by_key())
+
+
+# ---------------------------------------------------------------------------
+# per-rule bad/good pairs (tiny functions, direct audits)
+# ---------------------------------------------------------------------------
+
+def test_gc003_transpose_in_conv_block_flagged_good_pair_clean():
+    def bad(w, x):                     # NCHW smuggled in via a transpose
+        x = x.transpose(0, 2, 3, 1)
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def good(w, x):                    # NHWC end-to-end
+        return jax.nn.relu(jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+
+    w = jnp.ones((3, 3, 3, 4))
+    gc.audit_executable("conv.bad", jit_obj=jax.jit(bad),
+                        args=(w, jnp.ones((2, 3, 8, 8))))
+    gc.audit_executable("conv.good", jit_obj=jax.jit(good),
+                        args=(w, jnp.ones((2, 8, 8, 3))))
+    assert keys() == {"conv.bad::GC003"}
+    f, = gc.findings()
+    assert "transpose" in f.message and "conv" in f.message
+
+
+def test_gc003_transpose_far_from_conv_not_flagged():
+    # a transpose with no conv anywhere near it is NOT a layout finding
+    def fn(a):
+        return jnp.transpose(a) @ a
+
+    gc.audit_executable("t.matmul", jit_obj=jax.jit(fn),
+                        args=(jnp.ones((4, 4)),))
+    assert keys() == set()
+
+
+def test_gc004_host_callback_flagged():
+    def bad(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    gc.audit_executable("t.cb", jit_obj=jax.jit(bad), args=(jnp.ones(3),))
+    assert "t.cb::GC004" in keys()
+
+
+def test_gc005_unaliased_donation_flagged_aliasable_clean():
+    # the CPU-mesh catch: donation is declared but the executable cannot
+    # alias it (dtype change kills every candidate output)
+    bad = jax.jit(lambda w: (w.astype(jnp.bfloat16) * 2).sum(),
+                  donate_argnums=(0,))
+    good = jax.jit(lambda w, x: w + x, donate_argnums=(0,))
+    w = jnp.ones((8, 8))
+    gc.audit_executable("t.don_bad", jit_obj=bad, args=(w,))
+    gc.audit_executable("t.don_good", jit_obj=good, args=(w, w))
+    assert keys() == {"t.don_bad::GC005"}
+
+
+def test_gc005_pruned_unused_arg_no_false_positive():
+    # jax prunes unused arguments from the compiled module, shifting HLO
+    # parameter numbering: the donated (and correctly aliased) arg here
+    # is flat leaf 1 but HLO parameter 0 — must NOT be a finding
+    f = jax.jit(lambda unused, w: w * 2, donate_argnums=(1,))
+    gc.audit_executable("t.pruned", jit_obj=f,
+                        args=(jnp.ones(3), jnp.ones((4, 4))))
+    # and an arg that is donated but entirely unused is pruned, not a
+    # donation-aliasing failure
+    g = jax.jit(lambda dead, x: x + 1, donate_argnums=(0,))
+    gc.audit_executable("t.dead", jit_obj=g,
+                        args=(jnp.ones((4, 4)), jnp.ones(3)))
+    assert keys() == set()
+
+
+def test_gc005_sharded_engine_style_donation_clean_on_cpu_mesh():
+    # sharded carry donated and returned with the same placement must
+    # alias (the engine contract) — proven on the 8-device CPU mesh
+    mesh = cpu_mesh(tp=8)
+    sh = named_sharding(mesh, spec("tp"))
+    f = jax.jit(lambda w: w * 2, in_shardings=(sh,), out_shardings=sh,
+                donate_argnums=(0,))
+    gc.audit_executable("t.don_mesh",
+                        jit_obj=f, args=(jax.device_put(jnp.ones((8, 8)),
+                                                        sh),),
+                        mesh=mesh, axes_specs=[spec("tp")])
+    assert keys() == set()
+
+
+def test_gc001_collective_under_replicated_placement_flagged():
+    mesh = cpu_mesh(tp=8)
+    repl = replicated(mesh)
+
+    def bad(x):
+        y = jax.lax.with_sharding_constraint(
+            x, named_sharding(mesh, spec("tp")))
+        return jax.lax.with_sharding_constraint(y * 2, repl)
+
+    f = jax.jit(bad, in_shardings=(repl,), out_shardings=repl)
+    gc.audit_executable("t.coll", jit_obj=f, args=(jnp.ones((8, 8)),),
+                        mesh=mesh, axes_specs=[spec()])
+    assert "t.coll::GC001" in keys()
+    f, = [x for x in gc.findings() if x.rule == "GC001"]
+    assert "declared placement is fully replicated" in f.message
+
+
+def test_gc001_expected_tp_collective_clean():
+    # a row-parallel matmul's all-reduce is EXPECTED when the declared
+    # placement uses the tp axis
+    mesh = cpu_mesh(tp=8)
+    repl = replicated(mesh)
+    f = jax.jit(lambda w, x: x @ w,
+                in_shardings=(named_sharding(mesh, spec("tp", None)), repl),
+                out_shardings=repl)
+    gc.audit_executable("t.tp_ok", jit_obj=f,
+                        args=(jnp.ones((64, 16)), jnp.ones((4, 64))),
+                        mesh=mesh, axes_specs=[spec("tp", None)])
+    assert keys() == set()
+
+
+def test_gc001_full_gather_of_sharded_param_flagged():
+    # serving context (expect_sharded_params): an all-gather that
+    # materializes a declared-sharded weight means the rule table failed
+    mesh = cpu_mesh(tp=8)
+    repl = replicated(mesh)
+    sh = named_sharding(mesh, spec("tp"))
+
+    def bad(w, x):
+        return x @ jax.lax.with_sharding_constraint(w, repl)
+
+    f = jax.jit(bad, in_shardings=(sh, repl))
+    wa = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    gc.audit_executable(
+        "t.gather", jit_obj=f,
+        args=(jnp.ones((64, 64)), jnp.ones((4, 64))),
+        mesh=mesh, axes_specs=[spec("tp")], param_avals={"w": wa},
+        param_specs={"w": spec("tp")}, expect_sharded_params=True)
+    hits = [x for x in gc.findings() if x.rule == "GC001"]
+    assert hits and "parameter 'w'" in hits[0].message
+    # the SAME graph in a training context (expect_sharded_params=False,
+    # e.g. fsdp gathering in-graph by design) is not a finding
+    gc.reset()
+    gc.audit_executable(
+        "t.gather_train", jit_obj=f,
+        args=(jnp.ones((64, 64)), jnp.ones((4, 64))),
+        mesh=mesh, axes_specs=[spec("tp")], param_avals={"w": wa},
+        param_specs={"w": spec("tp")}, expect_sharded_params=False)
+    assert not [x for x in gc.findings() if "parameter" in x.message]
+
+
+def test_gc002_large_replicated_operand_on_model_mesh(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_GRAPHCHECK_REPL_MB", "1")
+    mesh = cpu_mesh(tp=8)
+    big = jax.ShapeDtypeStruct((512, 1024), jnp.float32)   # 2 MiB
+    gc.audit_executable("t.repl", fn=lambda w: w * 2, args=(big,),
+                        mesh=mesh, param_avals={"big": big},
+                        param_specs={"big": spec()})
+    assert "t.repl::GC002" in keys()
+    # sharded twin is clean; dp-only meshes replicate by design
+    gc.reset()
+    gc.audit_executable("t.repl_ok", fn=lambda w: w * 2, args=(big,),
+                        mesh=mesh, param_avals={"big": big},
+                        param_specs={"big": spec("tp")})
+    gc.audit_executable("t.repl_dp", fn=lambda w: w * 2, args=(big,),
+                        mesh=cpu_mesh(dp=8), param_avals={"big": big},
+                        param_specs={"big": spec()})
+    assert keys() == set()
+
+
+def test_gc006_watermark_estimate_and_ratchet():
+    def small(x):
+        return x + 1.0
+
+    def big(x):
+        y = jnp.tile(x, (64,))      # a fat intermediate
+        return y.sum() + x.sum()
+
+    x = jnp.ones((128,), jnp.float32)
+    wm_small = gc.jaxpr_watermark(jax.jit(small).trace(x).jaxpr)
+    wm_big = gc.jaxpr_watermark(jax.jit(big).trace(x).jaxpr)
+    assert wm_big > wm_small >= x.nbytes
+    # ratchet math: regression past slack fails, within slack passes,
+    # unbaselined sites pass
+    assert gc.new_watermarks({"s": 200}, {"s": 100}, slack=0.25) == \
+        {"s": (200, 100)}
+    assert gc.new_watermarks({"s": 110}, {"s": 100}, slack=0.25) == {}
+    assert gc.new_watermarks({"s": 200}, {}, slack=0.25) == {}
+
+
+def test_gc006_budget_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_GRAPHCHECK_MEM_MB", "0.001")  # ~1 KB
+    gc.audit_executable("t.budget", fn=lambda x: x * 2,
+                        args=(jnp.ones((4096,), jnp.float32),))
+    assert "t.budget::GC006" in keys()
+
+
+def test_gc000_auditor_failure_is_a_finding_not_a_crash():
+    gc.audit_executable("t.broken", jit_obj=object(), args=())
+    assert keys() == {"t.broken::GC000"}
+
+
+# ---------------------------------------------------------------------------
+# framework hooks: the engine blames its own site key
+# ---------------------------------------------------------------------------
+
+def test_planted_conv_transpose_caught_at_engine_site():
+    """A conv block fed through a layout transpose is caught by the
+    engine.step hook with the engine's site key — the NHWC regression
+    guard ROADMAP item 1 rides on."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.engine import parallelize
+
+    class NCHWStem(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 4, 3, padding=1, data_format="NHWC")
+
+        def forward(self, x):           # x arrives NCHW: the planted bug
+            x = paddle.transpose(x, [0, 2, 3, 1])
+            y = self.conv(x)
+            return y.mean(axis=[1, 2, 3])
+
+    paddle.seed(0)
+    model = NCHWStem()
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+    eng = parallelize(model, opt,
+                      loss_fn=lambda m, x, y: ((m(x) - y) ** 2).mean())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(8, 3, 8, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.rand(8).astype(np.float32))
+    eng.train_batch(x, y)
+    assert "engine.step::GC003" in keys()
+    # donation stays aliased and nothing else fires on the engine's own
+    # executable — the finding is exactly the planted one
+    assert not {k for k in keys() if not k.endswith("GC003")}
+
+
+def test_obs_collector_registered():
+    from paddle_tpu.obs.metrics import registry
+
+    snap = registry().snapshot()
+    payload = snap.get("collectors", snap)
+    flat = json.dumps(payload)
+    assert "graphcheck" in flat
+    gc.disable()
+    snap = registry().snapshot()
+    assert "graphcheck" not in json.dumps(
+        snap.get("collectors", snap))
+
+
+# ---------------------------------------------------------------------------
+# baseline determinism + CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_deterministic(tmp_path):
+    counts = {"b::GC001": 2, "a::GC005": 1}
+    wm = {"site.z": 123, "site.a": 55}
+    p1, p2 = str(tmp_path / "b1.json"), str(tmp_path / "b2.json")
+    gc.write_baseline(p1, counts, wm)
+    gc.write_baseline(p2, dict(reversed(counts.items())),
+                      dict(reversed(wm.items())))
+    b1, b2 = open(p1).read(), open(p2).read()
+    assert b1 == b2 and b1.endswith("\n")
+    data = gc.load_baseline(p1)
+    assert data["counts"] == counts and data["watermarks"] == wm
+    assert gc.new_counts({"a::GC005": 2, "b::GC001": 2},
+                         data["counts"]) == {"a::GC005": (2, 1)}
+    with pytest.raises(ValueError):
+        json.dump({"nope": 1}, open(str(tmp_path / "bad.json"), "w"))
+        gc.load_baseline(str(tmp_path / "bad.json"))
+
+
+def _cli_main(argv):
+    """graph_audit.main in-process (argparse-level paths run no smokes)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import graph_audit
+        return graph_audit, graph_audit.main(argv)
+    finally:
+        sys.path.pop(0)
+
+
+def test_cli_usage_errors(tmp_path):
+    assert _cli_main(["--smoke", "nope"])[1] == 2
+    bad = tmp_path / "corrupt.json"
+    bad.write_text("{not json")
+    assert _cli_main(["--baseline", str(bad)])[1] == 2
+    assert _cli_main(["--baseline",
+                      str(tmp_path / "missing.json")])[1] == 2
+
+
+def test_cli_planted_regression_flips_exit_1(tmp_path, monkeypatch):
+    """Acceptance: a planted regression (layout transpose in a conv
+    region) beyond the checked-in baseline flips the CLI to exit 1 with
+    the offending site::rule key."""
+    import importlib
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        graph_audit = importlib.import_module("graph_audit")
+    finally:
+        sys.path.pop(0)
+
+    real = graph_audit._smoke_export
+
+    def planted(workdir):
+        real(workdir)
+
+        def bad(w, x):
+            x = x.transpose(0, 2, 3, 1)
+            return jax.lax.conv_general_dilated(
+                x, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        gc.audit_executable(
+            "aot.layer_call", jit_obj=jax.jit(bad),
+            args=(jnp.ones((3, 3, 3, 4)), jnp.ones((2, 3, 8, 8))))
+
+    monkeypatch.setattr(graph_audit, "_smoke_export", planted)
+    import io
+    from contextlib import redirect_stdout
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = graph_audit.main(["--smoke", "export", "--format", "json"])
+    assert rc == 1
+    payload = json.loads(out.getvalue())
+    assert "aot.layer_call::GC003" in payload["new"]
+    # and the un-planted smoke is exit 0 against the checked-in baseline
+    out = io.StringIO()
+    monkeypatch.setattr(graph_audit, "_smoke_export", real)
+    with redirect_stdout(out):
+        rc = graph_audit.main(["--smoke", "export"])
+    assert rc == 0
+
+
+def test_cli_all_smokes_exit0_and_baseline_exact():
+    """Acceptance + the no-stale-keys dogfood: engine + decode + export
+    smokes run LIVE (in-process — conftest already pins the same 8
+    virtual devices the CLI forces), exit 0 against the checked-in
+    baseline, and the baseline is EXACT — every committed count key and
+    watermark site is reproduced by the run (a stale key would rot the
+    ratchet silently)."""
+    import io
+    from contextlib import redirect_stdout
+
+    graph_audit, _ = _cli_main(["--smoke", "nope"])   # import only
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = graph_audit.main(["--format", "json"])
+    assert rc == 0, out.getvalue()
+    payload = json.loads(out.getvalue())
+    with open(BASELINE) as f:
+        base = json.load(f)
+    assert payload["counts"] == base["counts"]          # no stale counts
+    assert set(payload["watermarks"]) == set(base["watermarks"])
+
+
+@pytest.mark.slow
+def test_cli_subprocess_clean():
+    """The CI-shaped invocation: a fresh process (the CLI pins its own
+    platform/device-count env) exits 0 against the checked-in
+    baseline."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, CLI], capture_output=True,
+                       text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_checked_in_baseline_holds_zero_findings():
+    """The committed contract, asserted without a subprocess: the
+    framework's baseline freezes ZERO findings (the auditor's job is to
+    keep it that way) and every watermark site is a known entrypoint."""
+    with open(BASELINE) as f:
+        base = json.load(f)
+    assert base["counts"] == {}
+    assert base["watermarks"]
+    for site in base["watermarks"]:
+        assert site.split("::")[0].startswith(
+            ("engine.", "aot.")), site

@@ -133,6 +133,50 @@ def test_retrace_per_call_probe_treats_repeats_as_cache_hits(san):
     assert san.counts_by_key() == {"aot.layer_call::retrace": 1}
 
 
+def test_retrace_sharding_delta_blamed_as_placement_change(san):
+    """PR-12 satellite: a recompile forced by a mesh/spec change is
+    named a sharding-signature change, not reported as a shape delta."""
+    from paddle_tpu.sharding import cpu_mesh, spec
+
+    sig = ("(2, 8)/float32",)
+    san.note_trace("aot.layer_call", "L",
+                   (sig, san.sharding_signature(None)), per_call=True)
+    san.mark_warm()
+    mesh = cpu_mesh(tp=8)
+    san.note_trace(
+        "aot.layer_call", "L",
+        (sig, san.sharding_signature(mesh, {"w": spec("tp")})),
+        per_call=True)
+    [f] = san.findings()
+    assert "sharding signature changed (mesh/spec)" in f.message
+    assert "tp=8" in f.message and "'w'" not in f.message  # readable form
+    assert "leaf" not in f.message       # NOT an anonymous leaf diff
+    # mixed delta: shape AND sharding changed -> both named
+    san.reset()
+    san.note_trace("engine.step", "e",
+                   (("(2, 8)/float32",), san.sharding_signature(None)))
+    san.mark_warm()
+    san.note_trace(
+        "engine.step", "e",
+        (("(4, 8)/float32",), san.sharding_signature(mesh)))
+    [f] = san.findings()
+    assert "sharding signature changed" in f.message
+    assert "'(2, 8)/float32' -> '(4, 8)/float32'" in f.message
+
+
+def test_sharding_signature_stable_and_bounded(san):
+    from paddle_tpu.sharding import cpu_mesh, spec
+
+    mesh = cpu_mesh(tp=8)
+    a = san.sharding_signature(mesh, {"w": spec("tp"), "b": spec()})
+    b = san.sharding_signature(mesh, {"b": spec(), "w": spec("tp")})
+    assert a == b and a.startswith("sharding:")      # order-insensitive
+    assert san.sharding_signature(None) == "sharding:none"
+    # giant spec tables stay hashable and bounded (digest tail)
+    many = {f"p{i}": spec("tp") for i in range(200)}
+    assert len(san.sharding_signature(mesh, many)) < 120
+
+
 def test_mark_warm_does_not_cover_future_entrypoints(san):
     san.note_trace("aot.batched", "old-model", (1,))
     san.mark_warm()

@@ -380,13 +380,26 @@ def f(mesh):
 
     def test_baseline_ratchets_package(self):
         """Current TL011 findings never exceed the checked-in baseline
-        (legacy sites burn down instead of growing)."""
+        (legacy sites burn down instead of growing). Narrowed to the
+        directories that hold every baselined TL011 site plus the
+        placement-heavy subsystems (suite-budget trim: the whole-package
+        ratchet already runs once per suite in test_tracelint's CLI
+        dogfood — re-linting all ~300 files here duplicated ~9s of
+        tier-1 wall; the first loop keeps the narrowing honest)."""
         from paddle_tpu.analysis import tracelint
 
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         baseline = tracelint.load_baseline(
             os.path.join(root, ".tpu_lint_baseline.json"))
+        dirs = ("paddle_tpu/distributed", "paddle_tpu/models",
+                "paddle_tpu/jit", "paddle_tpu/sharding",
+                "paddle_tpu/inference")
+        for k in baseline:
+            if "::TL011::" in k:
+                assert k.startswith(dirs), \
+                    f"TL011 baseline key outside the linted dirs: {k}"
         findings = tracelint.lint_paths(
-            [os.path.join(root, "paddle_tpu")], relative_to=root)
-        fresh = tracelint.new_findings(findings, baseline)
+            [os.path.join(root, d) for d in dirs], relative_to=root)
+        fresh = tracelint.new_findings(
+            [f for f in findings if f.rule == "TL011"], baseline)
         assert not fresh, fresh

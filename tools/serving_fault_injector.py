@@ -132,6 +132,13 @@ os.environ.setdefault("PADDLE_TPU_LOCKCHECK", "1")
 # asserts there were ZERO, proving the serving/batching/decode/router
 # stacks retrace-free and sync-free under faults.
 os.environ.setdefault("PADDLE_TPU_SAN", "1")
+# ... and under the graph auditor (graphcheck): every executable this
+# harness compiles — serving AOT buckets, exported layer calls, decode
+# prefill/decode steps — is statically audited at build time (unexpected
+# collectives, conv-region layout changes, host transfers, unaliased
+# donation, live-memory watermark), and the end of main() asserts ZERO
+# findings on the framework's own executables.
+os.environ.setdefault("PADDLE_TPU_GRAPHCHECK", "1")
 # ... and with distributed tracing LIVE (obs.trace — the default, made
 # explicit here so an inherited opt-out is visible): every phase's
 # requests run under root spans, the flight recorder's obs.trace /
@@ -1062,6 +1069,32 @@ def main(argv=None):
               f"donations={c['donations']}, "
               f"finite_checks={c['finite_checks']} across "
               f"{srep['entrypoints']} entrypoints")
+
+    from paddle_tpu.analysis import graphcheck
+    if not graphcheck.enabled():
+        # the operator exported PADDLE_TPU_GRAPHCHECK=0 on purpose —
+        # phases still gate the run, only the graph-audit assertions
+        # are off
+        print("graphcheck: disabled by PADDLE_TPU_GRAPHCHECK="
+              f"{os.environ.get('PADDLE_TPU_GRAPHCHECK')!r}; "
+              "graph-audit assertions skipped")
+    else:
+        grep = graphcheck.report()
+        # vacuity guard (same bar as tpu-san's): the phases above
+        # compiled real executables, so the auditor must have run
+        if grep["counters"]["audits"] == 0:
+            violations.append(
+                "graphcheck was not effective: no executable was ever "
+                "audited despite the warmup compiles "
+                "(PADDLE_TPU_GRAPHCHECK="
+                f"{os.environ.get('PADDLE_TPU_GRAPHCHECK')!r})")
+        for f in grep["findings"]:
+            violations.append(
+                f"graphcheck {f['rule']} at {f['site']}: {f['message']}")
+        print(f"graphcheck: {sum(grep['counts'].values())} finding(s); "
+              f"audits={grep['counters']['audits']}, "
+              f"collectives={grep['counters']['collectives_seen']}, "
+              f"watermarked_sites={len(grep['watermarks'])}")
 
     from paddle_tpu.obs import trace as _otrace_verdict
     if not _otrace_verdict.enabled():

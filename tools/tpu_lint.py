@@ -21,6 +21,13 @@ everything. ``--write-baseline`` regenerates it deterministically
 
 Pure AST: this never imports the linted code, so it runs identically on
 accelerator-less CI boxes.
+
+``--changed-only`` restricts the run to files touched vs
+``git merge-base HEAD origin/main`` (fallback refs origin/master, main,
+master; override with ``--base REF``) plus untracked files — the
+sub-second pre-commit loop. The exit-code contract is unchanged: only
+the changed files are linted, and the ratchet compares just their keys
+(a violation in an untouched file neither fails nor hides the run).
 """
 from __future__ import annotations
 
@@ -28,6 +35,7 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -81,6 +89,70 @@ def _resolve_package(name):
     return None
 
 
+def _git(git_dir, args_):
+    """stdout of a git command run from `git_dir`, or None on failure."""
+    try:
+        r = subprocess.run(["git", *args_], cwd=git_dir,
+                           capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return r.stdout if r.returncode == 0 else None
+
+
+_BASE_REFS = ("origin/main", "origin/master", "main", "master")
+
+
+def _changed_files(git_dir, base=None):
+    """(toplevel, [changed paths relative to toplevel]) — files touched
+    vs the merge-base of HEAD and the base ref (committed, staged and
+    working-tree changes) plus untracked files; None when git/the ref
+    can't resolve."""
+    top = _git(git_dir, ["rev-parse", "--show-toplevel"])
+    if not top:
+        return None
+    top = top.strip()
+    mb = None
+    for ref in ((base,) if base else _BASE_REFS):
+        out = _git(top, ["merge-base", "HEAD", ref])
+        if out:
+            mb = out.strip()
+            break
+    if mb is None:
+        return None
+    out = _git(top, ["diff", "--name-only", mb])
+    if out is None:
+        return None
+    files = set(out.splitlines())
+    extra = _git(top, ["ls-files", "--others", "--exclude-standard"])
+    if extra:
+        files |= set(extra.splitlines())
+    return top, sorted(f for f in files if f)
+
+
+def _select_changed(roots, base):
+    """The changed .py files under `roots` (None = git failure). Deleted
+    files are skipped; the git repo is the one containing the first
+    root (so scratch --paths repos resolve their own history)."""
+    first = os.path.abspath(roots[0])
+    git_dir = first if os.path.isdir(first) else os.path.dirname(first)
+    got = _changed_files(git_dir, base)
+    if got is None:
+        return None
+    top, rels = got
+    universe = [os.path.abspath(r) for r in roots]
+    sel = []
+    for rel in rels:
+        if not rel.endswith(".py"):
+            continue
+        p = os.path.join(top, rel)
+        ap_ = os.path.abspath(p)
+        if not os.path.exists(ap_):
+            continue
+        if any(ap_ == u or ap_.startswith(u + os.sep) for u in universe):
+            sel.append(ap_)
+    return sel
+
+
 def _render_text(all_findings, fresh, baseline_used, out):
     for f in fresh:
         print(f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.scope}] "
@@ -120,6 +192,12 @@ def main(argv=None):
     ap.add_argument("--write-baseline", action="store_true",
                     help="regenerate the baseline from current findings "
                          "(sorted keys) and exit 0")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs the merge-base with "
+                         "origin/main (see --base) — the pre-commit loop")
+    ap.add_argument("--base", default=None,
+                    help="base ref for --changed-only (default: first of "
+                         f"{', '.join(_BASE_REFS)} that resolves)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     try:
         args = ap.parse_args(argv)
@@ -145,7 +223,24 @@ def main(argv=None):
               file=sys.stderr)
         return USAGE_ERROR
 
-    findings = tracelint.lint_paths(roots, relative_to=REPO)
+    if args.changed_only:
+        if args.write_baseline:
+            # a partial lint must never clobber the full ratchet
+            print("tpu_lint: --changed-only cannot --write-baseline "
+                  "(the baseline covers the whole tree)", file=sys.stderr)
+            return USAGE_ERROR
+        selected = _select_changed(roots, args.base)
+        if selected is None:
+            print("tpu_lint: --changed-only needs a git repo with a "
+                  f"resolvable base ref ({args.base or ', '.join(_BASE_REFS)}"
+                  "); pass --base REF", file=sys.stderr)
+            return USAGE_ERROR
+        # no changed files in scope = trivially clean (still honoring the
+        # baseline/render/exit contract below)
+        findings = tracelint.lint_paths(selected, relative_to=REPO) \
+            if selected else []
+    else:
+        findings = tracelint.lint_paths(roots, relative_to=REPO)
 
     if args.write_baseline:
         written = [f for f in findings if f.rule != "TL000"]
