@@ -704,6 +704,159 @@ def bench_slo(on_tpu, dev):
     return payload if report["ok"] else None
 
 
+def _bench_decode_shared_prefix(model, on_tpu):
+    """BENCH_DECODE sub-row: copy-on-write prefix sharing. N sequences
+    extend ONE system prompt; the sharing engine holds a single physical
+    copy of the shared KV blocks (refcounts) and skips their prefill,
+    multiplying admission headroom at a FIXED pool size. Outputs are
+    checked bit-equal against unshared (prefix_cache=False) decode; the
+    CPU-smoke gate is >= 1.5x admission headroom (peak blocks,
+    deterministic block math) or >= 1.3x useful-tokens/sec."""
+    import concurrent.futures
+
+    from paddle_tpu.inference import DecodeEngine
+
+    n_seq = int(os.environ.get("BENCH_DECODE_SHARED_SEQS", "8"))
+    sys_len, sfx_len, max_new = 24, 8, 8
+    vocab = model.cfg.vocab_size
+    rng = np.random.RandomState(3)
+    system = rng.randint(0, vocab, (sys_len,)).astype(np.int32)
+    prompts = [np.concatenate([
+        system, rng.randint(0, vocab, (sfx_len,)).astype(np.int32)])
+        for _ in range(n_seq)]
+
+    # a DELIBERATELY tight pool (15 allocatable blocks): each private
+    # sequence reserves 5 worst-case blocks, so unshared decode can hold
+    # ~3 residents — sharing cuts the FRESH reservation to 2 (the prefix
+    # blocks exist once), so the same pool admits ~2x the residents.
+    # That resident multiplier IS the admission headroom the gate
+    # measures; with block math, it is deterministic on CPU.
+    rows = {}
+    outs = {}
+    for mode, share in (("shared", True), ("unshared", False)):
+        eng = DecodeEngine(
+            model, max_length=48, block_size=8,
+            decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16, 32),
+            prefill_chunk=8, prefix_cache=share, num_blocks=16,
+            default_timeout=600.0)
+        try:
+            eng.warmup()
+            eng.generate(system, 1)      # canary: seeds (or not) the cache
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(n_seq) as ex:
+                outs[mode] = list(ex.map(
+                    lambda p: eng.generate(p, max_new), prompts))
+            dt = time.perf_counter() - t0
+            st = eng.stats()
+            rows[mode] = {
+                "useful_tokens_per_sec": round(n_seq * max_new / dt, 1),
+                "peak_resident_seqs": st["peak_resident"],
+                "peak_blocks": st["blocks"]["peak_allocated"],
+                "prompt_tokens_reused": st["prefix_cache"]["tokens_reused"],
+                "prefill_chunks": st["prefill_chunks"],
+                "cow_copies": st["cow_copies"],
+            }
+        finally:
+            eng.shutdown(drain_timeout=30.0)
+
+    mismatches = sum(1 for a, b in zip(outs["shared"], outs["unshared"])
+                     if a != b)
+    total_prompt = n_seq * (sys_len + sfx_len) + sys_len
+    headroom = rows["shared"]["peak_resident_seqs"] \
+        / max(1, rows["unshared"]["peak_resident_seqs"])
+    tps_ratio = (rows["shared"]["useful_tokens_per_sec"]
+                 / max(1e-9, rows["unshared"]["useful_tokens_per_sec"]))
+    return {
+        "modes": rows,
+        "sequences": n_seq,
+        "mismatches": mismatches,
+        "admission_headroom": round(headroom, 3),
+        "tokens_per_sec_ratio": round(tps_ratio, 3),
+        "prefill_frac_avoided": round(
+            rows["shared"]["prompt_tokens_reused"] / total_prompt, 3),
+    }
+
+
+def _bench_decode_chunked_ttft(model, on_tpu):
+    """BENCH_DECODE sub-row: chunked prefill vs monolithic on a
+    long-prompt mixed workload. A 96-token prompt lands in a live engine
+    followed immediately by short prompts: monolithic prefill stalls
+    them for one giant dispatch; chunking (+ shortest-remaining-first
+    prefill scheduling) lets the shorts' prefills and the running
+    batch's decode steps interleave between chunks. Gate: measured
+    TTFT-p99 improvement for the short sequences."""
+    import concurrent.futures
+
+    from paddle_tpu.inference import DecodeEngine
+
+    n_short = int(os.environ.get("BENCH_DECODE_TTFT_SHORTS", "6"))
+    long_len, short_len = 192, 6
+    vocab = model.cfg.vocab_size
+    rng = np.random.RandomState(5)
+    long_prompt = rng.randint(0, vocab, (long_len,)).astype(np.int32)
+    shorts = [rng.randint(0, vocab, (short_len,)).astype(np.int32)
+              for _ in range(n_short)]
+
+    rows = {}
+    outs = {}
+    for mode, chunk in (("chunked", 16), ("monolithic", False)):
+        eng = DecodeEngine(
+            model, max_length=256, block_size=8,
+            decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16, 192),
+            prefill_chunk=chunk, prefix_cache=False, num_blocks=65,
+            default_timeout=600.0)
+        ttfts = []
+        try:
+            eng.warmup()
+            # a running batch the long prefill would stall
+            bg = [eng.submit(shorts[0], 32), eng.submit(shorts[1], 32)]
+            for s in bg:
+                next(iter(s))
+
+            def one_short(p):
+                t0 = time.perf_counter()
+                s = eng.submit(p, 4)
+                first = next(iter(s))
+                ttfts.append(time.perf_counter() - t0)
+                return [first] + [t for t in s]
+
+            long_s = eng.submit(long_prompt, 4)
+            # land the shorts while the long prefill is IN FLIGHT (the
+            # head-of-line scenario): wait for its admission, then one
+            # beat for the scheduler to dispatch its (first or only)
+            # prefill
+            deadline = time.perf_counter() + 5.0
+            while (eng.stats()["prefilling"] < 1
+                   and time.perf_counter() < deadline):
+                time.sleep(0.001)
+            time.sleep(0.004)
+            with concurrent.futures.ThreadPoolExecutor(n_short) as ex:
+                outs[mode] = list(ex.map(one_short, shorts[2:]
+                                         + shorts[:2]))
+            outs[mode].append(long_s.result())
+            for s in bg:
+                s.result()
+            rows[mode] = {
+                "ttft_p50_ms": round(
+                    float(np.percentile(ttfts, 50)) * 1e3, 1),
+                "ttft_p99_ms": round(
+                    float(np.percentile(ttfts, 99)) * 1e3, 1),
+                "prefill_chunks": eng.stats()["prefill_chunks"],
+            }
+        finally:
+            eng.shutdown(drain_timeout=30.0)
+
+    mismatches = sum(1 for a, b in zip(outs["chunked"], outs["monolithic"])
+                     if a != b)
+    return {
+        "modes": rows,
+        "mismatches": mismatches,
+        "ttft_p99_improvement": round(
+            rows["monolithic"]["ttft_p99_ms"]
+            / max(1e-9, rows["chunked"]["ttft_p99_ms"]), 3),
+    }
+
+
 def bench_decode(on_tpu, dev):
     """BENCH_DECODE=1: continuous-batching LLM decode — tokens/sec and
     p50/p99 time-to-first-token of the iteration-level `DecodeEngine`
@@ -823,6 +976,13 @@ def bench_decode(on_tpu, dev):
             if a != b)
         speedup = (results["continuous"]["tokens_per_sec"]
                    / results["request_level"]["tokens_per_sec"])
+
+        # Decode speed 2.0 rows: copy-on-write prefix sharing and
+        # chunked prefill, each bit-equality-checked against its
+        # private/monolithic twin and CPU-smoke gated below
+        shared = _bench_decode_shared_prefix(model, on_tpu)
+        ttft = _bench_decode_chunked_ttft(model, on_tpu)
+
         payload = _emit({
             "metric": f"continuous-batching decode tokens/sec "
                       f"(concurrency={conc}, mixed max_new "
@@ -833,6 +993,8 @@ def bench_decode(on_tpu, dev):
             "vs_baseline": round(speedup, 3),
             "extra": {"modes": results, "requests": n_req,
                       "mismatches": mismatches,
+                      "shared_prefix": shared,
+                      "chunked_prefill": ttft,
                       "platform": dev.platform},
         })
         if mismatches:
@@ -842,6 +1004,29 @@ def bench_decode(on_tpu, dev):
         if conc >= 8 and speedup < 1.5:
             print(f"bench_decode: speedup {speedup:.2f}x below the 1.5x "
                   f"gate at concurrency {conc}", file=sys.stderr)
+            return None
+        if shared["mismatches"]:
+            print(f"bench_decode: {shared['mismatches']} shared-prefix "
+                  f"request(s) diverged from unshared decode",
+                  file=sys.stderr)
+            return None
+        if shared["admission_headroom"] < 1.5 \
+                and shared["tokens_per_sec_ratio"] < 1.3:
+            print(f"bench_decode: prefix sharing gate failed — headroom "
+                  f"{shared['admission_headroom']:.2f}x < 1.5x AND "
+                  f"tokens/sec {shared['tokens_per_sec_ratio']:.2f}x "
+                  f"< 1.3x", file=sys.stderr)
+            return None
+        if ttft["mismatches"]:
+            print(f"bench_decode: {ttft['mismatches']} chunked-prefill "
+                  f"request(s) diverged from monolithic decode",
+                  file=sys.stderr)
+            return None
+        if ttft["ttft_p99_improvement"] < 1.1:
+            print(f"bench_decode: chunked prefill gate failed — TTFT p99 "
+                  f"improvement {ttft['ttft_p99_improvement']:.2f}x "
+                  f"< 1.1x on the long-prompt mixed workload",
+                  file=sys.stderr)
             return None
         return payload
 

@@ -18,11 +18,21 @@ ids that hold its tokens (token position ``p`` lives at
 as they grow and return them the moment they finish, so the pool's
 capacity is shared by actual token usage, not worst-case reservations.
 
+Blocks are REFCOUNTED: beyond its allocating owner, a block can be
+referenced by other owners (`incref`) — the engine's prefix cache and
+prefix-sharing sequences hold one reference each, so N sequences over a
+shared system prompt keep ONE physical copy of the shared blocks. A
+block returns to the free list when its LAST reference drops (`decref` /
+`free_owned`); a holder that must mutate a block it does not exclusively
+own copies it first (`copy_block` — copy-on-write, orchestrated by the
+engine).
+
 `BlockKVCache` is the allocator half: device tensors plus a host-side
-free list, per-owner accounting, and conservation/fragmentation stats.
-Scheduling (who allocates when, gather/scatter through the tables) lives
-in `engine.DecodeEngine`; the TPU-native read-through-the-table attention
-kernel is `ops/pallas/decode_attn.paged_decode_attention`.
+free list, per-owner reference accounting, and conservation/fragmentation
+stats. Scheduling (who allocates when, gather/scatter through the tables,
+COW policy) lives in `engine.DecodeEngine`; the TPU-native
+read-through-the-table attention kernel is
+`ops/pallas/decode_attn.paged_decode_attention`.
 
 Block 0 is RESERVED as the padding sink: padded rows of a bucketed decode
 step carry an all-zeros block table, so their (garbage) KV writes land in
@@ -30,9 +40,10 @@ block 0 and can never corrupt a live sequence — the allocator simply
 never hands block 0 out.
 
 Invariant (asserted by the decode fault-injection harness):
-``allocated + free + reserved == total`` at all times, and a drained
-engine always returns to ``allocated == 0`` — no fault path may leak a
-block.
+``allocated + free + reserved == total`` at all times (a block is
+"allocated" while it has >= 1 reference, however many holders share it),
+and a drained engine always returns to ``allocated == 0`` — no fault
+path may leak a block or a reference.
 """
 from __future__ import annotations
 
@@ -94,9 +105,11 @@ class BlockKVCache:
         self._lock = _locks.new_lock("decode.block_pool")
         self._free = list(range(self.num_blocks - 1, RESERVED_BLOCKS - 1,
                                 -1))  # pop() hands out low ids first
-        self._owner = {}           # block id -> owner tag
+        self._refs = {}            # block id -> list of holder tags
         self.allocs = 0
         self.frees = 0
+        self.increfs = 0
+        self.decrefs = 0
         self.failed_allocs = 0
         self.peak_allocated = 0
 
@@ -134,9 +147,9 @@ class BlockKVCache:
 
     # -- allocation --------------------------------------------------------
     def alloc(self, n, owner=None):
-        """All-or-nothing allocation of `n` blocks; returns their ids.
-        Raises `OutOfBlocks` (leaving the pool untouched) when fewer than
-        `n` are free."""
+        """All-or-nothing allocation of `n` blocks (one reference each,
+        held by `owner`); returns their ids. Raises `OutOfBlocks`
+        (leaving the pool untouched) when fewer than `n` are free."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         with self._lock:
@@ -148,36 +161,111 @@ class BlockKVCache:
                     f"{self.num_blocks - RESERVED_BLOCKS} allocatable")
             blocks = [self._free.pop() for _ in range(n)]
             for b in blocks:
-                self._owner[b] = owner
+                self._refs[b] = [owner]
             self.allocs += n
-            self.peak_allocated = max(self.peak_allocated, len(self._owner))
+            self.peak_allocated = max(self.peak_allocated, len(self._refs))
             return blocks
 
-    def free(self, blocks):
-        """Return blocks to the pool. Double-frees and reserved/unknown
-        ids raise ValueError (a conservation bug must be loud)."""
+    def incref(self, blocks, owner=None):
+        """Add one `owner`-held reference to each allocated block — the
+        prefix-sharing move: a sequence (or the prefix cache) joins an
+        existing physical copy instead of allocating its own. Unknown /
+        reserved ids raise ValueError."""
         with self._lock:
             for b in blocks:
-                if b not in self._owner:
+                if b not in self._refs:
+                    raise ValueError(
+                        f"block {b} is not allocated — cannot add a "
+                        f"reference (reserved/unknown id?)")
+            for b in blocks:
+                self._refs[b].append(owner)
+            self.increfs += len(blocks)
+
+    def decref(self, blocks, owner=None):
+        """Drop one `owner`-held reference per block; a block whose last
+        reference drops returns to the free list. An owner dropping a
+        reference it does not hold raises ValueError (a refcount bug must
+        be loud). Returns how many blocks were physically freed."""
+        with self._lock:
+            for b in blocks:
+                holders = self._refs.get(b)
+                if holders is None or owner not in holders:
+                    raise ValueError(
+                        f"block {b} holds no reference for owner "
+                        f"{owner!r} (double-decref, or a reserved/unknown "
+                        f"id)")
+            freed = 0
+            for b in blocks:
+                holders = self._refs[b]
+                holders.remove(owner)
+                self.decrefs += 1
+                if not holders:
+                    del self._refs[b]
+                    self._free.append(b)
+                    self.frees += 1
+                    freed += 1
+            return freed
+
+    def refcount(self, block):
+        """Current reference count of `block` (0 if free/unknown)."""
+        with self._lock:
+            return len(self._refs.get(block, ()))
+
+    def free(self, blocks):
+        """Return exclusively-held blocks to the pool. Double-frees and
+        reserved/unknown ids raise ValueError (a conservation bug must be
+        loud), as does freeing a SHARED block — a holder of a shared
+        block must `decref` with its owner tag instead."""
+        with self._lock:
+            for b in blocks:
+                holders = self._refs.get(b)
+                if holders is None:
                     raise ValueError(
                         f"block {b} is not allocated (double-free, or a "
                         f"reserved/unknown id)")
+                if len(holders) != 1:
+                    raise ValueError(
+                        f"block {b} is SHARED ({len(holders)} refs) — "
+                        f"free() is for exclusive blocks; use decref()")
             for b in blocks:
-                del self._owner[b]
+                del self._refs[b]
                 self._free.append(b)
+            self.decrefs += len(blocks)
             self.frees += len(blocks)
 
     def free_owned(self, owner):
-        """Free every block held by `owner`; returns how many. Idempotent
-        (an owner with no blocks frees zero) — the engine's eviction paths
-        call this so a sequence can never double-free."""
+        """Drop every reference held by `owner` (freeing blocks whose
+        last reference that was); returns how many references were
+        dropped. Idempotent (an owner with no references drops zero) —
+        the engine's eviction paths call this so a sequence can never
+        double-free, shared prefix blocks included."""
         with self._lock:
-            mine = [b for b, o in self._owner.items() if o == owner]
-            for b in mine:
-                del self._owner[b]
-                self._free.append(b)
-            self.frees += len(mine)
-            return len(mine)
+            dropped = 0
+            for b in [b for b, hs in self._refs.items() if owner in hs]:
+                holders = self._refs[b]
+                n = holders.count(owner)
+                self._refs[b] = holders = [h for h in holders
+                                           if h != owner]
+                dropped += n
+                self.decrefs += n
+                if not holders:
+                    del self._refs[b]
+                    self._free.append(b)
+                    self.frees += 1
+            return dropped
+
+    # -- copy-on-write -----------------------------------------------------
+    def copy_block(self, src, dst):
+        """Device-copy block `src`'s rows into block `dst` across every
+        layer tensor — the eager reference implementation of the
+        copy-on-write primitive (each `at[].set` functionally
+        re-materializes its whole pool tensor, so this is for tests and
+        small pools). The engine's hot path uses a compiled DONATED
+        single-dispatch copy instead (`DecodeEngine._cow_fn`), which
+        aliases the pool buffers in place."""
+        self.tensors = [
+            tuple(t.at[dst].set(t[src]) for t in layer)
+            for layer in self.tensors]
 
     @property
     def free_count(self):
@@ -187,19 +275,26 @@ class BlockKVCache:
     @property
     def allocated_count(self):
         with self._lock:
-            return len(self._owner)
+            return len(self._refs)
 
     # -- observability -----------------------------------------------------
     def stats(self):
         """Snapshot. Conservation: ``allocated + free + reserved ==
-        total`` always holds (checked here, not just reported)."""
+        total`` always holds (checked here, not just reported) — a block
+        counts as allocated while ANY holder references it;
+        ``shared_refs`` reports how many references ride on top of the
+        first (the capacity multiplier prefix sharing buys)."""
         with self._lock:
-            allocated = len(self._owner)
+            allocated = len(self._refs)
             free = len(self._free)
             assert allocated + free + RESERVED_BLOCKS == self.num_blocks, (
                 f"block conservation violated: {allocated} allocated + "
                 f"{free} free + {RESERVED_BLOCKS} reserved != "
                 f"{self.num_blocks} total")
+            shared_blocks = sum(1 for hs in self._refs.values()
+                                if len(hs) > 1)
+            shared_refs = sum(len(hs) - 1 for hs in self._refs.values()
+                              if len(hs) > 1)
             return {
                 "total": self.num_blocks,
                 "reserved": RESERVED_BLOCKS,
@@ -207,9 +302,13 @@ class BlockKVCache:
                 "quant": self.quant,
                 "free": free,
                 "allocated": allocated,
+                "shared_blocks": shared_blocks,
+                "shared_refs": shared_refs,
                 "peak_allocated": self.peak_allocated,
                 "allocs": self.allocs,
                 "frees": self.frees,
+                "increfs": self.increfs,
+                "decrefs": self.decrefs,
                 "failed_allocs": self.failed_allocs,
                 "utilization": allocated / max(
                     1, self.num_blocks - RESERVED_BLOCKS),
@@ -218,5 +317,5 @@ class BlockKVCache:
     def __repr__(self):
         s = self.stats()
         return (f"BlockKVCache(total={s['total']}, free={s['free']}, "
-                f"allocated={s['allocated']}, block_size={self.block_size},"
-                f" quant={self.quant!r})")
+                f"allocated={s['allocated']}, shared={s['shared_refs']}, "
+                f"block_size={self.block_size}, quant={self.quant!r})")

@@ -16,12 +16,28 @@ that already exist in-tree:
   Supports the bf16 and int8 (`cache_quant="int8"`) layouts of
   `GPTForCausalLM.init_cache` via `init_block_pool`.
 
-* **Prefill/decode separation**: a new sequence's prompt is prefilled in
-  one chunked dispatch (padded to a prompt-length bucket), then the
-  sequence joins the RUNNING decode batch at the next step boundary —
-  no waiting for the current batch to drain. Finished / cancelled /
-  deadline-expired sequences leave at step boundaries, freeing both
-  their batch slot and their blocks.
+* **Prefill/decode separation with chunked prefill** (Sarathi-Serve,
+  OSDI '24): a new sequence's prompt is prefilled in block-aligned
+  chunks — one chunk per scheduler round, interleaved with the running
+  batch's decode steps, shortest-remaining prompt first — so a long
+  prompt never stalls running sequences for a monolithic prefill and a
+  short prompt never queues behind one. The sequence joins the RUNNING
+  decode batch at the step boundary after its last chunk. Finished /
+  cancelled / deadline-expired sequences leave at step boundaries,
+  freeing both their batch slot and their blocks.
+
+* **Copy-on-write prefix sharing** (the vLLM move): completed prefills
+  publish their prompt KV blocks into a prefix cache keyed by token
+  content (full-prompt entries plus every chunk boundary). `submit()`
+  matches the longest cached prefix and bumps block REFCOUNTS instead
+  of re-prefilling those tokens — N sequences over one system prompt
+  hold ONE physical copy of the shared blocks, multiplying effective
+  KV capacity and admission headroom. A sequence that must write into
+  a shared block (its first private token lands mid-block) COW-copies
+  that one block first. Cache entries are LRU-evicted under admission
+  pressure; sharing is bit-exact because chunk boundaries are absolute,
+  so a reused prefix was computed by the IDENTICAL dispatches the new
+  sequence would have run itself.
 
 * **Bucketed AOT step executables** (`jit/aot.compile_jit`): the decode
   step is compiled once per batch-size bucket and persisted in the
@@ -88,7 +104,10 @@ __all__ = ["DecodeEngine", "SequenceStream"]
 
 
 # sequence lifecycle
-_WAITING, _ACTIVE, _DONE = "waiting", "active", "done"
+_WAITING, _PREFILL, _ACTIVE, _DONE = "waiting", "prefill", "active", "done"
+
+#: reference-owner tag for blocks pinned by the engine's prefix cache
+_CACHE_OWNER = "prefix-cache"
 
 _END = object()   # stream sentinel
 
@@ -174,8 +193,8 @@ class SequenceStream:
 class _Seq:
     __slots__ = ("id", "prompt", "max_new", "deadline", "stream", "state",
                  "blocks", "reserved_total", "outstanding", "pos",
-                 "last_token", "generated", "cancelled", "submitted_at",
-                 "span")
+                 "prefill_pos", "matched_tokens", "last_token", "generated",
+                 "cancelled", "submitted_at", "span")
 
     def __init__(self, sid, prompt, max_new, deadline):
         self.id = sid
@@ -185,9 +204,11 @@ class _Seq:
         self.stream = SequenceStream(sid, deadline)
         self.state = _WAITING
         self.blocks = []               # pool block ids, table order
-        self.reserved_total = 0        # worst-case blocks (admission gate)
-        self.outstanding = 0           # reserved_total - len(blocks)
+        self.reserved_total = 0        # worst-case FRESH blocks (admission)
+        self.outstanding = 0           # fresh allocations still to come
         self.pos = 0                   # cache position of last_token
+        self.prefill_pos = 0           # prompt tokens already in the cache
+        self.matched_tokens = 0        # prefix-cache hit length (tokens)
         self.last_token = None
         self.generated = 0
         self.cancelled = False
@@ -211,7 +232,9 @@ class DecodeEngine:
                  step_timeout=30.0, step_retries=1, eos_token_id=None,
                  pad_token_id=0, compile_cache=None, fault_hook=None,
                  hang_grace=0.1, supervise_interval=0.02, metrics=None,
-                 mesh=None, sharding_rules=None, clock=time.monotonic):
+                 mesh=None, sharding_rules=None, clock=time.monotonic,
+                 prefix_cache=True, prefix_cache_blocks=None,
+                 prefill_chunk=None):
         from ...distributed.functional import functionalize
         from ...core.tensor import Tensor
 
@@ -249,15 +272,62 @@ class DecodeEngine:
                                              prefill_buckets}))
         self.max_prompt = min(self.prefill_buckets[-1], self.max_length - 1)
 
+        # chunked prefill (Sarathi-Serve): prompts longer than the chunk
+        # are prefilled one block-aligned chunk per scheduler round, so a
+        # long prompt never stalls the running decode batch for a full
+        # monolithic prefill. The chunk must BE a prefill bucket (chunk
+        # dispatches reuse the bucket executables — zero new signatures
+        # after warmup) and a multiple of block_size (chunk boundaries
+        # are block-table boundaries, which is also what makes
+        # chunk-boundary prefix-cache entries exact).
+        self._prefix_on = bool(prefix_cache)
+        chunk_candidates = [b for b in self.prefill_buckets
+                            if b % self.block_size == 0]
+        if prefill_chunk is None:
+            # auto: the largest aligned bucket a prompt can span at least
+            # twice — chunking only matters when prompts outgrow it
+            fits = [b for b in chunk_candidates if 2 * b <= self.max_prompt]
+            self._chunk = fits[-1] if fits else 0
+        elif not prefill_chunk:
+            self._chunk = 0
+        else:
+            c = int(prefill_chunk)
+            if c not in chunk_candidates:
+                raise ValueError(
+                    f"prefill_chunk {c} must be one of the prefill "
+                    f"buckets {self.prefill_buckets} and a multiple of "
+                    f"block_size {self.block_size}")
+            self._chunk = c
+
         # paged KV pool — the model owns the geometry (cache-entry order,
         # dtypes, quant layout precedence); default capacity fits a full
-        # bucket of worst-case-length sequences
+        # bucket of worst-case-length sequences (+1 copy-on-write block
+        # per slot when prefix sharing is on: a sequence whose shared
+        # prompt tail ends mid-block COW-copies that one block)
         nb_per_seq = max(1, math.ceil(self.max_length / self.block_size))
         self._nb = nb_per_seq
         if num_blocks is None:
-            num_blocks = RESERVED_BLOCKS + self.max_active * nb_per_seq
+            num_blocks = RESERVED_BLOCKS + self.max_active * (
+                nb_per_seq + (1 if self._prefix_on else 0))
         self.pool = model.init_block_pool(num_blocks, self.block_size,
                                           quant=quant)
+
+        # prefix->block-table cache (scheduler-thread owned; counters and
+        # structure reads ride _cv): entries pin their blocks with
+        # _CACHE_OWNER references and are LRU-evicted under admission
+        # pressure or the block cap
+        self._prefix_cache = {}        # key -> entry dict
+        self._lru = itertools.count()
+        if prefix_cache_blocks is None:
+            prefix_cache_blocks = max(
+                0, (self.pool.num_blocks - RESERVED_BLOCKS) // 2)
+        self._prefix_cap = int(prefix_cache_blocks)
+        # prefill dispatches can pad past max_length (a chunk's bucket
+        # tail): extend the PREFILL-side dense view with extra padding
+        # rows so the model's in-graph dynamic_update_slice never clamps
+        # — the tail rows scatter into reserved block 0 (garbage sink)
+        self._prefill_tail = math.ceil(self.prefill_buckets[-1]
+                                       / self.block_size)
 
         # functional decode step (the generation.py idiom: swap values
         # into the live layers, trace the python forward once)
@@ -303,6 +373,7 @@ class DecodeEngine:
 
         self._decode_fns = {}     # bucket -> compiled step
         self._prefill_fns = {}    # prompt bucket -> compiled prefill
+        self._cow_fn_c = None     # compiled donated block-copy (COW)
         self._compiled = 0
         self._disk_loaded = 0
 
@@ -323,6 +394,7 @@ class DecodeEngine:
         self._lock = _locks.new_lock("decode.engine")
         self._cv = _locks.new_condition("decode.engine", lock=self._lock)
         self._waiting = []            # admission queue (guarded by _cv)
+        self._prefill_q = []          # admitted, prompt not fully cached
         self._active = []             # scheduler-owned; mutations under _cv
         self.max_waiting = int(max_waiting)
         self._ids = 0
@@ -340,11 +412,19 @@ class DecodeEngine:
         self._shed = 0
         self._steps_run = 0
         self._prefills = 0
+        self._prefill_chunks = 0
         self._tokens_out = 0
         self._wedged_steps = 0
         self._isolations = 0
         self._step_slots = 0
         self._step_active = 0
+        self._peak_resident = 0
+        self._prefix_hits = 0
+        self._prefix_full_hits = 0
+        self._prefix_misses = 0
+        self._prefix_tokens_reused = 0
+        self._prefix_evictions = 0
+        self._cow_copies = 0
 
         # telemetry (paddle_tpu.obs): TTFT observed at first-token
         # delivery plus stats() as a registry collector. TWO histograms
@@ -390,8 +470,9 @@ class DecodeEngine:
         for n in sorted(self._buffers):
             b = self._buffers[n]
             h.update(f"{n}:{tuple(b.shape)}:{b.dtype}".encode())
-        h.update(f"paged-scan-greedy-v1:{self.pool.quant}:"
-                 f"{self.block_size}:{self._nb}".encode())
+        h.update(f"paged-scan-greedy-v2:{self.pool.quant}:"
+                 f"{self.block_size}:{self._nb}:{self._prefill_tail}"
+                 .encode())
         if self.mesh is not None:
             # a TP engine compiles different programs — its disk-cache
             # entries must never collide with the single-device ones
@@ -433,6 +514,13 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({ids.shape[0]}) + max_new_tokens ({max_new}) "
                 f"exceeds max_length {self.max_length}")
+        worst = self.pool.blocks_for(ids.shape[0] + max_new) + (
+            1 if self._prefix_on and ids.shape[0] % self.block_size else 0)
+        if worst > self.pool.num_blocks - RESERVED_BLOCKS:
+            raise ValueError(
+                f"request needs {worst} worst-case blocks but the pool "
+                f"holds only {self.pool.num_blocks - RESERVED_BLOCKS} "
+                f"allocatable — it could never be admitted")
 
         eff = self.default_timeout if timeout is None else timeout
         dl = Deadline(eff, clock=self._clock)
@@ -507,15 +595,19 @@ class DecodeEngine:
         pool_sh = [tuple(layer) for layer in self.pool.shardings]
         return self._param_sh, self._buf_sh, pool_sh, repl
 
-    def _gather(self, pool_ts, table):
+    def _gather(self, pool_ts, table, nb=None):
         """Dense per-sequence cache view: every pool tensor gathered
-        through the block table into [1, NB*block_size, ...]."""
+        through the block table into [1, NB*block_size, ...]. Prefill
+        passes an EXTENDED table (`nb = _nb + _prefill_tail`, tail rows
+        pointing at reserved block 0) so a chunk's bucket padding can
+        never clamp the in-graph cache update."""
+        nb = self._nb if nb is None else nb
         caches = []
         for layer in pool_ts:
             entry = []
             for t in layer:
                 g = t[table]                       # [NB, bs, *suffix]
-                entry.append(g.reshape((1, self._nb * self.block_size)
+                entry.append(g.reshape((1, nb * self.block_size)
                                        + g.shape[2:]))
             caches.append(tuple(entry))
         return caches
@@ -596,18 +688,25 @@ class DecodeEngine:
         from ...jit import aot
 
         nb_written = math.ceil(pbucket / self.block_size)
+        nb_table = self._nb + self._prefill_tail
 
-        def prefill(pv, bv, pool_ts, tokens, prompt_len, table):
-            caches = self._gather(pool_ts, table)
+        def prefill(pv, bv, pool_ts, tokens, start, valid_len, table):
+            # chunk-aware prefill: tokens [1, pbucket] hold prompt
+            # positions [start, start + valid_len); `start` is always
+            # block-aligned (0 for a monolithic prefill). Attention over
+            # already-written earlier chunks rides the same gathered view.
+            caches = self._gather(pool_ts, table, nb=nb_table)
             (logits, new_caches), _ = self._apply(
-                pv, bv, tokens, caches, jnp.asarray(0, jnp.int32))
-            last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1,
+                pv, bv, tokens, caches, start)
+            last = jax.lax.dynamic_index_in_dim(logits[0], valid_len - 1,
                                                 axis=0, keepdims=False)
             nxt = jnp.argmax(last.astype(jnp.float32), -1).astype(jnp.int32)
-            # scatter the written prompt rows block-by-block; rows past
-            # the real prompt are garbage that decode overwrites
-            # position-by-position before it can ever be attended, and
-            # rows past the allocated blocks land in reserved block 0
+            # scatter the written rows block-by-block from the chunk's
+            # start block; rows past the real tokens are garbage that
+            # decode overwrites position-by-position before it can ever
+            # be attended, and rows past the allocated blocks land in
+            # reserved block 0 (the padding sink)
+            sb = start // self.block_size
             out = []
             for layer_ts, layer_new in zip(pool_ts, new_caches):
                 entry = []
@@ -616,8 +715,10 @@ class DecodeEngine:
                     for j in range(nb_written):
                         lo = j * self.block_size
                         hi = min(pbucket, lo + self.block_size)
-                        rows = c[0, lo:hi].astype(t.dtype)
-                        new_t = new_t.at[table[j], : hi - lo].set(rows)
+                        rows = jax.lax.dynamic_slice_in_dim(
+                            c[0], start + lo, hi - lo, axis=0
+                        ).astype(t.dtype)
+                        new_t = new_t.at[table[sb + j], : hi - lo].set(rows)
                     entry.append(new_t)
                 out.append(tuple(entry))
             return out, nxt
@@ -626,12 +727,13 @@ class DecodeEngine:
         avals = (pv, bv, self._avals(self.pool.tensors),
                  jax.ShapeDtypeStruct((1, pbucket), jnp.int32),
                  jax.ShapeDtypeStruct((), jnp.int32),
-                 jax.ShapeDtypeStruct((self._nb,), jnp.int32))
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((nb_table,), jnp.int32))
         in_sh = out_sh = None
         sh = self._step_shardings()
         if sh is not None:
             pv_sh, bv_sh, pool_sh, repl = sh
-            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl)
+            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl, repl)
             out_sh = (pool_sh, repl)
         compiled, source = aot.compile_jit(
             prefill, avals, fingerprint=self._fingerprint,
@@ -658,14 +760,57 @@ class DecodeEngine:
                 "param_specs": specs,
                 "expect_sharded_params": self.mesh is not None}
 
+    def _cow_fn(self):
+        """Compiled copy-on-write block copy: ONE donated dispatch that
+        rewrites a single block's rows across every layer tensor. With
+        the pool donated, XLA aliases input to output buffers, so the
+        copy costs one block's traffic — an eager per-tensor `at[].set`
+        would functionally re-materialize the ENTIRE pool per COW, a
+        per-admission latency spike scaling with pool size."""
+        if self._cow_fn_c is not None:
+            return self._cow_fn_c
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        def cow(pool_ts, src, dst):
+            return [tuple(t.at[dst].set(t[src]) for t in layer)
+                    for layer in pool_ts]
+
+        avals = (self._avals(self.pool.tensors),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32))
+        in_sh = out_sh = None
+        sh = self._step_shardings()
+        if sh is not None:
+            _, _, pool_sh, repl = sh
+            in_sh = (pool_sh, repl, repl)
+            out_sh = pool_sh
+        compiled, source = aot.compile_jit(
+            cow, avals, fingerprint=self._fingerprint, cache=self._cache,
+            tag="decode-cow-copy", donate_argnums=(0,),
+            in_shardings=in_sh, out_shardings=out_sh,
+            audit_ctx=None if not _gc.enabled() else {"mesh": self.mesh})
+        with self._lock:
+            if source == "disk":
+                self._disk_loaded += 1
+            else:
+                self._compiled += 1
+        self._cow_fn_c = compiled
+        return compiled
+
     def warmup(self):
         """Compile (or disk-load) every decode bucket and prefill bucket
-        up front, so traffic never stalls on XLA. Returns
+        (plus the COW block-copy when prefix sharing is on) up front, so
+        traffic never stalls on XLA — and so the tpu-san retrace
+        sentinel can treat any later compile as a finding. Returns
         ``{"decode": [...], "prefill": [...]}``."""
         for b in self.decode_buckets:
             self._decode_fn(b)
         for p in self.prefill_buckets:
             self._prefill_fn(p)
+        if self._prefix_on:
+            self._cow_fn()
         return {"decode": list(self.decode_buckets),
                 "prefill": list(self.prefill_buckets)}
 
@@ -691,8 +836,9 @@ class DecodeEngine:
         bv = {n: b._value for n, b in self._buffers.items()}
         return pv, bv
 
-    def _padded_table(self, seq):
-        table = np.zeros(self._nb, np.int32)   # 0 = reserved padding sink
+    def _padded_table(self, seq, length=None):
+        # 0 = reserved padding sink
+        table = np.zeros(self._nb if length is None else length, np.int32)
         table[: len(seq.blocks)] = seq.blocks
         return table
 
@@ -722,14 +868,21 @@ class DecodeEngine:
             with self._cv:
                 if self._stopping:
                     return
-                if self._closed and not self._waiting and not self._active:
+                if self._closed and not self._waiting and not self._active \
+                        and not self._prefill_q:
                     return
-                if not self._waiting and not self._active:
+                if not self._waiting and not self._active \
+                        and not self._prefill_q:
                     self._cv.wait(0.05)
                     continue
             try:
                 self._sweep_waiting()
                 self._admit_waiting()
+                self._sweep_prefilling()
+                # ONE prefill chunk per round, interleaved with the
+                # decode step below: a long prompt advances chunk by
+                # chunk while the running batch keeps streaming tokens
+                self._prefill_round()
                 if self._active:
                     self._decode_round()
             except Exception as exc:  # noqa: BLE001 — scheduler must
@@ -738,7 +891,7 @@ class DecodeEngine:
                 err = RequestFailed(
                     f"decode scheduler error: {type(exc).__name__}: {exc}",
                     cause=exc)
-                for seq in list(self._active):
+                for seq in list(self._active) + list(self._prefill_q):
                     self._finish(seq, "failed", err)
 
     def _sweep_waiting(self):
@@ -756,67 +909,164 @@ class DecodeEngine:
             self._waiting = keep
 
     def _admit_waiting(self):
-        """Move waiting sequences into the running batch at this step
+        """Move waiting sequences toward the running batch at this step
         boundary: capacity = a free batch slot AND enough free blocks to
-        cover the newcomer's worst case on top of every active sequence's
-        remaining worst-case growth (so lazy per-step block allocation
-        can never fail mid-flight)."""
+        cover the newcomer's worst-case FRESH growth (worst case minus
+        whatever a prefix-cache hit lets it share, plus one COW block
+        when a shared prompt tail ends mid-block) on top of every live
+        sequence's remaining worst-case growth — so lazy per-step block
+        allocation can never fail mid-flight. Under pressure, LRU
+        prefix-cache entries are evicted to make headroom."""
         while True:
             with self._cv:
                 if self._stopping or not self._waiting:
                     return
-                if len(self._active) >= self.max_active:
+                if len(self._active) + len(self._prefill_q) \
+                        >= self.max_active:
                     return
                 seq = self._waiting[0]
-                reserve = sum(s.outstanding for s in self._active)
+                plen = len(seq.prompt)
+                cow = 1 if (self._prefix_on
+                            and plen % self.block_size) else 0
                 seq.reserved_total = self.pool.blocks_for(
-                    len(seq.prompt) + seq.max_new)
-                if self.pool.free_count < reserve + seq.reserved_total:
+                    plen + seq.max_new) + cow
+                entry = self._match_prefix(seq.prompt) \
+                    if self._prefix_on else None
+                matched = len(entry["blocks"]) if entry else 0
+                reserve = sum(s.outstanding for s in self._active) \
+                    + sum(s.outstanding for s in self._prefill_q)
+                fresh = seq.reserved_total - matched
+                if self.pool.free_count < reserve + fresh \
+                        and not self._evict_for(reserve + fresh,
+                                                keep=entry):
                     return      # not enough headroom yet; retry next round
                 self._waiting.pop(0)
             try:
-                self._start_sequence(seq)
+                self._begin_sequence(seq, entry)
             except Exception as exc:  # noqa: BLE001 — the sequence is in
-                # neither _waiting nor _active here, so an unexpected
-                # prefill error (e.g. an XLA compile failure) must fail
-                # it HERE or its stream hangs and its blocks leak
+                # neither _waiting nor _prefill_q nor _active here, so an
+                # unexpected error must fail it HERE or its stream hangs
+                # and its blocks leak
                 self._finish(seq, "failed", RequestFailed(
                     f"sequence {seq.id}: prefill error: "
                     f"{type(exc).__name__}: {exc}", cause=exc))
 
-    def _start_sequence(self, seq):
-        """Prefill one admitted sequence and add it to the running batch.
-        Prefill faults implicate only this sequence."""
-        try:
-            seq.blocks = self.pool.alloc(
-                self.pool.blocks_for(len(seq.prompt)), owner=seq.id)
-        except OutOfBlocks as e:   # admission gate guarantees this can't
-            self._finish(seq, "failed", RequestFailed(
-                f"sequence {seq.id}: block pool exhausted at prefill",
-                cause=e))
-            return
-        seq.outstanding = seq.reserved_total - len(seq.blocks)
+    def _begin_sequence(self, seq, entry):
+        """Attach an admitted sequence to its prefix-cache hit (bumping
+        refcounts instead of re-prefilling the shared tokens) and route
+        it: a full-prompt hit joins the running batch immediately — zero
+        prompt compute — anything else enters the chunked-prefill queue."""
         plen = len(seq.prompt)
-        pbucket = next(p for p in self.prefill_buckets if p >= plen)
+        if entry is not None:
+            self.pool.incref(entry["blocks"], owner=seq.id)
+            seq.blocks = list(entry["blocks"])
+            seq.prefill_pos = seq.matched_tokens = entry["t"]
+            with self._cv:
+                self._prefix_hits += 1
+                self._prefix_tokens_reused += entry["t"]
+                if entry["t"] == plen:
+                    self._prefix_full_hits += 1
+        elif self._prefix_on:
+            with self._cv:
+                self._prefix_misses += 1
+        seq.outstanding = seq.reserved_total - len(seq.blocks)
+        if seq.prefill_pos == plen:
+            # complete prefix: the whole prompt (and its next token) is
+            # cached — the sequence starts decoding this very round
+            seq.state = _ACTIVE
+            seq.pos = plen
+            with self._cv:
+                self._active.append(seq)
+                self._peak_resident = max(
+                    self._peak_resident,
+                    len(self._active) + len(self._prefill_q))
+            self._deliver(seq, int(entry["next_token"]))
+            return
+        seq.state = _PREFILL
+        with self._cv:
+            self._prefill_q.append(seq)
+            self._peak_resident = max(
+                self._peak_resident,
+                len(self._active) + len(self._prefill_q))
+
+    def _sweep_prefilling(self):
+        with self._cv:
+            for seq in list(self._prefill_q):
+                if seq.cancelled:
+                    self._finish_locked(seq, "cancelled", PoolClosed(
+                        f"sequence {seq.id} cancelled during prefill"))
+                elif seq.deadline.expired():
+                    self._finish_locked(seq, "timed_out", DeadlineExceeded(
+                        f"sequence {seq.id} expired during prefill"))
+
+    def _prefill_round(self):
+        """Run ONE prefill chunk for the queued sequence with the fewest
+        remaining prompt tokens (shortest-remaining-first: a short prompt
+        is never stuck behind a 1024-token monolith — the head-of-line
+        fix chunking exists for). Faults implicate only that sequence."""
+        with self._cv:
+            if self._stopping or not self._prefill_q:
+                return
+            seq = min(self._prefill_q,
+                      key=lambda s: (len(s.prompt) - s.prefill_pos, s.id))
+        try:
+            self._prefill_chunk(seq)
+        except PoolClosed as e:
+            self._finish(seq, "cancelled", e)
+        except RequestFailed as e:
+            self._finish(seq, "failed", e)
+        except Exception as exc:  # noqa: BLE001 — e.g. an XLA compile
+            # failure: fail THIS sequence, not the scheduler
+            self._finish(seq, "failed", RequestFailed(
+                f"sequence {seq.id}: prefill error: "
+                f"{type(exc).__name__}: {exc}", cause=exc))
+
+    def _prefill_chunk(self, seq):
+        """Dispatch the next prompt chunk of `seq` (the whole remainder
+        when chunking is off or the prompt fits one chunk). On the final
+        chunk the sequence publishes its prefix-cache entries and joins
+        the running batch."""
+        plen = len(seq.prompt)
+        start = seq.prefill_pos
+        remaining = plen - start
+        this_len = self._chunk if (self._chunk
+                                   and remaining > self._chunk) \
+            else remaining
+        pbucket = next(p for p in self.prefill_buckets if p >= this_len)
+        # fresh blocks to hold positions [len(blocks)*bs, start+this_len)
+        need = self.pool.blocks_for(start + this_len) - len(seq.blocks)
+        if need > 0:
+            try:
+                seq.blocks += self.pool.alloc(need, owner=seq.id)
+                seq.outstanding -= need
+            except OutOfBlocks as e:  # admission gate guarantees this
+                raise RequestFailed(   # can't — an over-admission bug
+                    f"sequence {seq.id}: block pool exhausted at prefill",
+                    cause=e) from e
         fn = self._prefill_fn(pbucket)
         pv, bv = self._weights()
         tokens = np.full((1, pbucket), self.pad_token_id, np.int32)
-        tokens[0, :plen] = seq.prompt
-        table = self._padded_table(seq)
+        tokens[0, :this_len] = seq.prompt[start:start + this_len]
+        table = self._padded_table(seq, self._nb + self._prefill_tail)
         pool_ts = self.pool.tensors
         hook = self._fault_hook
         sctx = seq.span.ctx
+        chunked = this_len < remaining or start > 0
 
         def run(_member):
             if hook is not None:
-                hook("prefill", [seq.id], {"bucket": pbucket})
-            # prefill span in the SEQUENCE's trace (the step-pool worker
-            # thread re-enters the sequence context explicitly)
+                hook("prefill", [seq.id], {"bucket": pbucket,
+                                           "start": start,
+                                           "tokens": this_len})
+            # chunk span in the SEQUENCE's trace (the step-pool worker
+            # thread re-enters the sequence context explicitly), so a
+            # chunked TTFT decomposes chunk by chunk in /traces/<id>
             with _otrace.span_in(
-                    "decode.prefill", sctx,
+                    "decode.prefill_chunk" if chunked
+                    else "decode.prefill", sctx,
                     attrs=None if sctx is None else
-                    {"seq": seq.id, "bucket": pbucket,
-                     "prompt_len": plen}), \
+                    {"seq": seq.id, "bucket": pbucket, "start": start,
+                     "tokens": this_len, "prompt_len": plen}), \
                     _locks.blocking_region("decode.step_dispatch"):
                 # the hot-sync probe covers the dispatch only; the token
                 # readback below is the step's deliverable (streaming
@@ -825,27 +1075,135 @@ class DecodeEngine:
                 # region
                 with _san.hot_region("decode.step_dispatch"):
                     new_pool, nxt = fn(pv, bv, pool_ts, tokens,
-                                       np.asarray(plen, np.int32), table)
+                                       np.asarray(start, np.int32),
+                                       np.asarray(this_len, np.int32),
+                                       table)
                 self._san_sweep(new_pool)
                 with _san.allow_host_sync("decode.token_fetch"):
                     return new_pool, int(np.asarray(nxt))
 
-        try:
-            new_pool, tok = self._submit_step(run)
-        except PoolClosed as e:
-            self._finish(seq, "cancelled", e)
-            return
-        except RequestFailed as e:
-            self._finish(seq, "failed", e)
-            return
+        new_pool, tok = self._submit_step(run)
         self.pool.tensors = new_pool
+        seq.prefill_pos = done = start + this_len
+        with self._lock:
+            self._prefill_chunks += 1
+        if self._prefix_on and self._chunk and done % self._chunk == 0:
+            # a full chunk boundary: publish tokens[0:done] for reuse —
+            # chunk boundaries are absolute multiples of the chunk size,
+            # so any later prompt sharing these tokens computes (or now
+            # skips) the IDENTICAL dispatches, keeping reuse bit-exact
+            with self._cv:
+                self._prefix_insert(
+                    "chunk", seq.prompt[:done],
+                    seq.blocks[:done // self.block_size], tok)
+        if done < plen:
+            return
+        # prompt complete: publish the full-prompt entry (identical
+        # resubmissions skip prefill entirely; a mid-block tail is shared
+        # too — the writer COW-copies it before its first private token),
+        # then join the running batch and stream the first token
+        if self._prefix_on and not (self._chunk
+                                    and plen % self._chunk == 0):
+            with self._cv:
+                self._prefix_insert("full", seq.prompt, seq.blocks, tok)
         with self._lock:
             self._prefills += 1
         seq.state = _ACTIVE
         seq.pos = plen
         with self._cv:
+            if seq in self._prefill_q:
+                self._prefill_q.remove(seq)
             self._active.append(seq)
         self._deliver(seq, tok)
+
+    # -- prefix cache (copy-on-write block sharing) ------------------------
+    # All helpers below run on the scheduler thread with _cv held (the
+    # stats() reader snapshots under the same lock). Entries pin their
+    # blocks with _CACHE_OWNER references; sequences that match bump
+    # refcounts instead of re-prefilling, and a holder that must write
+    # into a shared block COW-copies it first (engine._decode_round).
+
+    @staticmethod
+    def _digest(ids, t):
+        return hashlib.sha1(
+            np.ascontiguousarray(ids[:t]).tobytes()).hexdigest()
+
+    def _match_prefix(self, ids):
+        """Longest cached prefix of `ids`: the full-prompt entry first
+        (total reuse — prefill skipped entirely), then chunk boundaries
+        descending. Token contents are verified, never just hashes."""
+        plen = len(ids)
+        e = self._prefix_cache.get(
+            ("full", plen, self._digest(ids, plen)))
+        if e is not None and np.array_equal(e["tokens"], ids):
+            e["stamp"] = next(self._lru)
+            return e
+        if self._chunk:
+            t = (plen // self._chunk) * self._chunk
+            while t >= self._chunk:
+                e = self._prefix_cache.get(
+                    ("chunk", t, self._digest(ids, t)))
+                if e is not None and np.array_equal(e["tokens"], ids[:t]):
+                    e["stamp"] = next(self._lru)
+                    return e
+                t -= self._chunk
+        return None
+
+    def _prefix_insert(self, kind, toks, blocks, next_token):
+        """Publish `blocks` (holding the KV of `toks`) for reuse; the
+        cache takes its own reference on every block. Bounded by the
+        block cap (LRU evictions make room; an oversized entry is simply
+        not cached)."""
+        key = (kind, len(toks), self._digest(toks, len(toks)))
+        e = self._prefix_cache.get(key)
+        if e is not None:
+            e["stamp"] = next(self._lru)
+            return
+        # the cap bounds PHYSICAL pinned blocks: entries at successive
+        # chunk boundaries overlap on their shared prefix blocks, so the
+        # per-entry sum would overcount quadratically and evict far
+        # before the budget is actually reached
+        want = set(blocks)
+
+        def held():
+            return len({b for x in self._prefix_cache.values()
+                        for b in x["blocks"]} | want)
+
+        while self._prefix_cache and held() > self._prefix_cap:
+            self._evict_one()
+        if held() > self._prefix_cap:
+            return
+        self.pool.incref(blocks, owner=_CACHE_OWNER)
+        self._prefix_cache[key] = {
+            "key": key, "tokens": np.array(toks, np.int32),
+            "t": len(toks), "blocks": list(blocks),
+            "next_token": int(next_token), "stamp": next(self._lru)}
+
+    def _evict_one(self, keep=None):
+        """Drop the least-recently-used cache entry (never `keep`) and
+        release its block references. Returns the entry or None."""
+        victims = [e for e in self._prefix_cache.values()
+                   if e is not keep]
+        if not victims:
+            return None
+        e = min(victims, key=lambda x: x["stamp"])
+        del self._prefix_cache[e["key"]]
+        self.pool.decref(e["blocks"], owner=_CACHE_OWNER)
+        self._prefix_evictions += 1
+        return e
+
+    def _evict_for(self, need_free, keep=None):
+        """Evict LRU entries until `need_free` blocks are free (admission
+        pressure beats cached prefixes). True when satisfied."""
+        while self.pool.free_count < need_free:
+            if self._evict_one(keep=keep) is None:
+                return False
+        return True
+
+    def _clear_prefix_cache_locked(self):
+        for e in list(self._prefix_cache.values()):
+            self.pool.decref(e["blocks"], owner=_CACHE_OWNER)
+        self._prefix_cache.clear()
 
     def _deliver(self, seq, tok):
         """Commit one decoded token: stream it out and retire the
@@ -883,17 +1241,39 @@ class DecodeEngine:
         active = list(self._active)
         if not active:
             return
-        # lazy block growth: the admission reserve guarantees success
+        # lazy block growth + copy-on-write: the admission reserve
+        # guarantees success of both. This step writes each sequence's
+        # row at seq.pos — a write landing in a block some OTHER holder
+        # (the prefix cache, or a prefix-sharing batchmate) also
+        # references must not be visible to them, so the sequence copies
+        # that one block first and drops its shared reference.
         for seq in list(active):
-            if seq.pos >= len(seq.blocks) * self.block_size:
-                try:
+            try:
+                if seq.pos >= len(seq.blocks) * self.block_size:
                     seq.blocks += self.pool.alloc(1, owner=seq.id)
                     seq.outstanding -= 1
-                except OutOfBlocks as e:
-                    active.remove(seq)
-                    self._finish(seq, "failed", RequestFailed(
-                        f"sequence {seq.id}: block pool exhausted "
-                        f"mid-decode (admission reserve bug)", cause=e))
+                else:
+                    bi = seq.pos // self.block_size
+                    if self.pool.refcount(seq.blocks[bi]) > 1:
+                        new = self.pool.alloc(1, owner=seq.id)[0]
+                        # one donated dispatch: the pool buffers are
+                        # aliased in place, so this costs one block's
+                        # traffic (pool.copy_block — the eager fallback
+                        # — would re-materialize every pool tensor)
+                        self.pool.tensors = self._cow_fn()(
+                            self.pool.tensors,
+                            np.asarray(seq.blocks[bi], np.int32),
+                            np.asarray(new, np.int32))
+                        self.pool.decref([seq.blocks[bi]], owner=seq.id)
+                        seq.blocks[bi] = new
+                        seq.outstanding -= 1
+                        with self._lock:
+                            self._cow_copies += 1
+            except OutOfBlocks as e:
+                active.remove(seq)
+                self._finish(seq, "failed", RequestFailed(
+                    f"sequence {seq.id}: block pool exhausted "
+                    f"mid-decode (admission reserve bug)", cause=e))
         if not active:
             return
         try:
@@ -997,6 +1377,10 @@ class DecodeEngine:
         seq.outstanding = 0
         if seq in self._active:
             self._active.remove(seq)
+        if seq in self._prefill_q:
+            self._prefill_q.remove(seq)
+        # drops every reference this sequence holds: exclusive blocks
+        # free, shared prefix blocks stay for their other holders
         self.pool.free_owned(seq.id)
         if status == "completed":
             self._completed += 1
@@ -1030,7 +1414,8 @@ class DecodeEngine:
         drained = True
         while True:
             with self._cv:
-                if not self._waiting and not self._active:
+                if not self._waiting and not self._active \
+                        and not self._prefill_q:
                     break
             if dl.expired():
                 drained = False
@@ -1042,11 +1427,16 @@ class DecodeEngine:
         self._steps.shutdown(drain_timeout=1.0)
         self._thread.join(timeout=5.0)
         with self._cv:
-            leftovers = self._waiting + [s for s in self._active]
+            leftovers = (self._waiting + list(self._prefill_q)
+                         + list(self._active))
             self._waiting = []
             for seq in leftovers:
                 self._finish_locked(seq, "cancelled", PoolClosed(
                     f"engine shut down before sequence {seq.id} finished"))
+            # release the prefix cache's block references: a shut-down
+            # engine returns the pool to allocated == 0 (the conservation
+            # bar the fault injector holds every phase to)
+            self._clear_prefix_cache_locked()
         if self._metrics is not None:
             self._metrics.unregister_collector(f"decode.{self.name}",
                                                self.stats)
@@ -1069,6 +1459,7 @@ class DecodeEngine:
             used_tokens = sum(s.pos for s in self._active)
             alloc_slots = sum(len(s.blocks) for s in self._active) \
                 * self.block_size
+            lookups = self._prefix_hits + self._prefix_misses
             snap = {
                 "admitted": self._admitted,
                 "completed": self._completed,
@@ -1077,9 +1468,14 @@ class DecodeEngine:
                 "cancelled": self._cancelled,
                 "shed": self._shed,
                 "waiting": len(self._waiting),
+                "prefilling": len(self._prefill_q),
                 "active": len(self._active),
+                # most sequences ever resident (prefilling + decoding)
+                # at once: what admission headroom actually buys
+                "peak_resident": self._peak_resident,
                 "steps": self._steps_run,
                 "prefills": self._prefills,
+                "prefill_chunks": self._prefill_chunks,
                 "tokens_out": self._tokens_out,
                 "wedged_steps": self._wedged_steps,
                 "isolation_rounds": self._isolations,
@@ -1087,10 +1483,32 @@ class DecodeEngine:
                 if self._step_slots else 0.0,
                 "internal_fragmentation": (1.0 - used_tokens / alloc_slots)
                 if alloc_slots else 0.0,
+                "prefix_hit_rate": (self._prefix_hits / lookups)
+                if lookups else 0.0,
+                "cow_copies": self._cow_copies,
+                "prefix_cache": {
+                    "enabled": self._prefix_on,
+                    "entries": len(self._prefix_cache),
+                    "blocks": sum(len(e["blocks"])
+                                  for e in self._prefix_cache.values()),
+                    # distinct pool blocks the cache pins (entries may
+                    # share blocks): a quiesced engine holds exactly
+                    # these — anything beyond is a leak
+                    "physical_blocks": len(
+                        {b for e in self._prefix_cache.values()
+                         for b in e["blocks"]}),
+                    "block_cap": self._prefix_cap,
+                    "hits": self._prefix_hits,
+                    "full_hits": self._prefix_full_hits,
+                    "misses": self._prefix_misses,
+                    "tokens_reused": self._prefix_tokens_reused,
+                    "evictions": self._prefix_evictions,
+                },
                 "compiles": {"built": self._compiled,
                              "disk": self._disk_loaded},
                 "buckets": {"decode": list(self.decode_buckets),
-                            "prefill": list(self.prefill_buckets)},
+                            "prefill": list(self.prefill_buckets),
+                            "prefill_chunk": self._chunk},
             }
         th = self._h_ttft.snapshot()
         snap["ttft"] = {"count": th["count"], "avg_s": th["avg"],

@@ -236,7 +236,8 @@ def _aval_signature(avals):
 
 
 def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
-                in_shardings=None, out_shardings=None, audit_ctx=None):
+                in_shardings=None, out_shardings=None, audit_ctx=None,
+                donate_argnums=None):
     """AOT-compile (or cache-load) `fn` over an aval pytree, persisting the
     executable like `compile_batched` does for bucket executables.
 
@@ -291,6 +292,11 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
              "sharding:" + str(_sharding_sig(in_shardings))))
     with _locks.blocking_region("aot.compile"):
         kw = {}
+        if donate_argnums is not None:
+            # donation is TAG-scoped (callers donating must use a tag no
+            # non-donating executable shares), so the persistent-cache
+            # key needs no extra component
+            kw["donate_argnums"] = donate_argnums
         if in_shardings is not None:
             kw["in_shardings"] = in_shardings
         if out_shardings is not None:

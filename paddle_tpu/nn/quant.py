@@ -64,23 +64,35 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
 
 def _pack_int4(q):
     """[out, in] int8 in [-7, 7] -> [out, in//2] halves-packed nibbles.
-    The LOW nibble stores w+8 (biased to [1, 15]) so the kernel unpacks
-    without a sign fixup; the HIGH nibble stores w (signed, recovered by
-    an arithmetic >>4). See ops/pallas/weight_only.py _kernel_int4."""
+    BOTH nibbles store w as a raw two's-complement nibble (low: w & 15,
+    high: w << 4): the kernel sign-extends each with pure arithmetic
+    shifts — no bias, so no rank-1 rowsum correction rides the matmul
+    (the old biased low-nibble encoding charged one k/2-length reduction
+    + fused multiply-subtract per x-row per dispatch). See
+    ops/pallas/weight_only.py _kernel_int4.
+
+    LAYOUT v2 (PR 13) — BREAKS persisted v1 artifacts: v1 stored the
+    low nibble biased (+8) and the two encodings are byte-
+    indistinguishable, so an int4 weight quantized before this change
+    decodes every low-half element off by ±8 with no error raised.
+    Re-quantize from the float checkpoint (`weight_quantize` /
+    `quantize_for_inference`); docs/decode_perf.md round 6 records the
+    change."""
     if q.shape[1] % 2:
         raise ValueError(
             f"int4 packing needs an even in-dim, got {q.shape[1]}")
     k2 = q.shape[1] // 2
-    low = jnp.bitwise_and(q[:, :k2] + 8, 15)
+    low = jnp.bitwise_and(q[:, :k2], 15)
     high = jnp.left_shift(q[:, k2:], 4)
     return jnp.bitwise_or(low, high).astype(jnp.int8)
 
 
 def _unpack_int4(p):
-    """[out, in//2] packed -> [out, in] int8 (inverse of _pack_int4)."""
+    """[out, in//2] packed -> [out, in] int8 (inverse of _pack_int4):
+    arithmetic shifts sign-extend both two's-complement nibbles."""
     p32 = p.astype(jnp.int32)
     high = p32 >> 4
-    low = jnp.bitwise_and(p32, 15) - 8
+    low = (p32 << 28) >> 28
     return jnp.concatenate([low, high], axis=1).astype(jnp.int8)
 
 

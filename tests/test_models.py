@@ -96,8 +96,8 @@ def test_conformer_ctc_trains():
     opt = paddle.optimizer.AdamW(learning_rate=1e-3,
                                  parameters=model.parameters())
     losses = []
-    for _ in range(6):
-        loss = model.loss(feats, labels)
+    for _ in range(4):   # suite-budget trim: 6 -> 4 eager steps (same
+        loss = model.loss(feats, labels)   # decreasing-loss assertion)
         loss.backward()
         opt.step()
         opt.clear_grad()

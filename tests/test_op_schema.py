@@ -185,15 +185,16 @@ def test_op_grad(spec):
         base = np.asarray(args[i], "float32")
         numeric = np.zeros(base.size, "float64")
         flat_idx = range(base.size)
-        if base.size > 8:  # cap forward evals; subsample elements
-            # (suite-budget trim: 24 -> 12 -> 8 shrinks the 2-sided
+        if base.size > 6:  # cap forward evals; subsample elements
+            # (suite-budget trim: 24 -> 12 -> 8 -> 6 shrinks the 2-sided
             # numeric sweep — the dominant cost of this file, which is
             # where the tier-1 870s timeout used to land; the latest cut
-            # offsets tests/test_runtime_san.py. The check stays a
-            # random-element statistical one, just over fewer probes,
-            # with the same per-element tolerance)
+            # offsets tests/test_decode_prefix.py and the decode-cow
+            # injector phase. The check stays a random-element
+            # statistical one, just over fewer probes, with the same
+            # per-element tolerance)
             flat_idx = np.random.default_rng(7).choice(
-                base.size, 8, replace=False)
+                base.size, 6, replace=False)
         checked = np.zeros(base.size, bool)
         for j in flat_idx:
             checked[j] = True

@@ -67,7 +67,14 @@ fault-free solo run):
   decode-poison  deterministically fail ONE sequence's prefill (poisoned
                  feed) — typed RequestFailed for it alone;
   decode-none    fault-free control (also produces the per-prompt solo
-                 reference tokens the other phases compare against).
+                 reference tokens the other phases compare against);
+  decode-cow     N sequences share a cached prompt prefix (refcounted
+                 blocks, one physical copy; chunked prefill); one is
+                 cancelled mid-decode. Refcount conservation must hold,
+                 survivors must stay bit-exact against PRIVATE-COPY
+                 (prefix_cache=False) solo references, copy-on-write must
+                 have fired for every mid-block tail writer, and zero
+                 blocks or references may leak.
 
 Router phases (`router-*`) run the DISTRIBUTED SERVING TIER
 (paddle_tpu/inference/router.py over replica.py, threads-as-replicas over
@@ -181,6 +188,7 @@ def _san_mark_warm():
 PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "batch-crash", "batch-hang", "batch-poison",
           "decode-none", "decode-kill", "decode-wedge", "decode-poison",
+          "decode-cow",
           "router-none", "router-kill", "router-wedge",
           "router-swap", "router-swap-kill")
 
@@ -675,6 +683,156 @@ def run_decode_phase(phase, model, verbose=True):
     return bad
 
 
+COW_PREFIX_LEN = 20      # shared system-prompt prefix (mid-block tail:
+#                          20 % block_size 8 != 0 — the COW trigger)
+COW_SUFFIXES = 4         # sequences extending the prefix privately
+
+
+def _decode_cow_engine(model, prefix_cache):
+    """Prefix-sharing engine pair config: IDENTICAL geometry for the
+    sharing engine and the private-copy reference engine (including
+    num_blocks, so both disk-hit the same compiled executables)."""
+    from paddle_tpu.inference import DecodeEngine
+
+    return DecodeEngine(model, max_length=48, block_size=8,
+                        decode_buckets=(1, 2, 4, 8),
+                        prefill_buckets=(8, 16, 24), prefill_chunk=8,
+                        num_blocks=57, prefix_cache=prefix_cache,
+                        default_timeout=30.0, step_timeout=STEP_TIMEOUT,
+                        step_retries=2, hang_grace=0.05,
+                        supervise_interval=0.01)
+
+
+def run_decode_cow_phase(phase, model, verbose=True):
+    """Prefix-sharing + COW under a mid-decode cancel: one physical copy
+    of the shared blocks, survivors bit-exact vs PRIVATE-COPY decode,
+    refcount conservation, zero leaked blocks/references."""
+    import numpy as np
+    from paddle_tpu.inference import (DeadlineExceeded, Overloaded,
+                                      PoolClosed, RequestFailed,
+                                      ServingError)
+
+    bad = []
+    t0 = time.monotonic()
+    common = np.random.RandomState(100).randint(
+        0, DECODE_VOCAB, (COW_PREFIX_LEN,)).astype(np.int32)
+    suffixed = [np.concatenate(
+        [common, np.random.RandomState(101 + i).randint(
+            0, DECODE_VOCAB, (4,)).astype(np.int32)])
+        for i in range(COW_SUFFIXES)]
+    prompts = {"canary": common, "dup": common,
+               **{f"sfx{i}": p for i, p in enumerate(suffixed)}}
+    max_new = {"canary": 4, "dup": 6,
+               **{f"sfx{i}": 8 for i in range(COW_SUFFIXES)}}
+    victim = "sfx1"
+
+    # private-copy references: same geometry + chunk decomposition, no
+    # sharing — the bit-identity yardstick the acceptance bar names
+    refs = {}
+    with _decode_cow_engine(model, prefix_cache=False) as peng:
+        peng.warmup()
+        _san_mark_warm()
+        for name, p in prompts.items():
+            refs[name] = peng.generate(p, max_new[name])
+
+    eng = _decode_cow_engine(model, prefix_cache=True)
+    eng.warmup()
+    _san_mark_warm()   # faulted shared traffic must never trace again
+    outcomes = {}
+    try:
+        # the canary prefills the shared prefix and publishes it (chunk
+        # entries at 8/16 + the full 20-token entry with its mid-block
+        # tail); everyone after shares instead of re-prefilling
+        if eng.generate(prompts["canary"], max_new["canary"]) \
+                != refs["canary"]:
+            bad.append(f"[{phase}] canary diverged from its private ref")
+        streams = {n: eng.submit(prompts[n], max_new[n])
+                   for n in prompts if n != "canary"}
+        firsts = {n: next(iter(s)) for n, s in streams.items()}
+        for n, tok in firsts.items():
+            if tok != refs[n][0]:
+                bad.append(f"[{phase}] sequence {n} first token {tok} != "
+                           f"private ref {refs[n][0]}")
+        # every live sequence + the cache reference the SAME physical
+        # prefix blocks: sharing must be observable mid-flight
+        bs = eng.stats()["blocks"]
+        if bs["shared_refs"] < 1:
+            bad.append(f"[{phase}] no shared references observed with "
+                       f"{len(streams)} prefix-sharing sequences live: "
+                       f"{bs}")
+        streams[victim].cancel()
+        for n, s in streams.items():
+            try:
+                toks = s.result()
+                outcomes[n] = "ok"
+                if toks != refs[n]:
+                    bad.append(f"[{phase}] survivor {n} diverged from its "
+                               f"private-copy reference: {toks} vs "
+                               f"{refs[n]}")
+            except PoolClosed:
+                outcomes[n] = "cancelled"
+            except (DeadlineExceeded, Overloaded, RequestFailed) as e:
+                outcomes[n] = type(e).__name__
+                bad.append(f"[{phase}] sequence {n} failed unexpectedly: "
+                           f"{e}")
+            except ServingError as e:
+                outcomes[n] = f"unexpected-typed:{e}"
+                bad.append(f"[{phase}] {n} -> unexpected typed error: {e}")
+            except BaseException as e:  # noqa: BLE001 — untyped = bug
+                outcomes[n] = f"untyped:{type(e).__name__}"
+                bad.append(f"[{phase}] {n} -> UNTYPED error: "
+                           f"{type(e).__name__}: {e}")
+        if outcomes.get(victim) != "cancelled":
+            bad.append(f"[{phase}] victim outcome {outcomes.get(victim)} "
+                       f"!= cancelled")
+        if sum(1 for v in outcomes.values() if v == "ok") \
+                != len(streams) - 1:
+            bad.append(f"[{phase}] exactly the cancelled sequence must "
+                       f"fail: {outcomes}")
+        st = eng.stats()
+        pc = st["prefix_cache"]
+        # the dup full-hit skipped prefill entirely; every suffixed
+        # sequence matched the 16-token chunk boundary
+        if pc["full_hits"] < 1 or pc["hits"] < 1 + COW_SUFFIXES:
+            bad.append(f"[{phase}] prefix cache never shared: {pc}")
+        if pc["tokens_reused"] < 16 * COW_SUFFIXES + COW_PREFIX_LEN:
+            bad.append(f"[{phase}] too few prompt tokens reused: {pc}")
+        # canary + dup both write into the shared mid-block tail -> COW
+        if st["cow_copies"] < 2:
+            bad.append(f"[{phase}] copy-on-write never fired "
+                       f"(cow_copies={st['cow_copies']})")
+        lhs = st["admitted"]
+        rhs = (st["completed"] + st["failed"] + st["timed_out"]
+               + st["cancelled"])
+        if lhs != rhs or st["active"] or st["waiting"]:
+            bad.append(f"[{phase}] engine conservation violated: "
+                       f"admitted={lhs} != {rhs}")
+    finally:
+        drained = eng.shutdown(drain_timeout=10.0)
+    if not drained:
+        bad.append(f"[{phase}] engine failed to drain")
+    bs = eng.stats()["blocks"]
+    # refcount conservation with sharing: one physical block per id no
+    # matter how many holders, nothing leaked through cancel/COW/eviction
+    if bs["allocated"] != 0 or bs["free"] + bs["reserved"] != bs["total"]:
+        bad.append(f"[{phase}] BLOCK LEAK: {bs}")
+    if bs["allocs"] != bs["frees"]:
+        bad.append(f"[{phase}] alloc/free imbalance: {bs}")
+    if bs["shared_refs"] != 0:
+        bad.append(f"[{phase}] dangling shared references after "
+                   f"shutdown: {bs}")
+    if verbose:
+        tag = "FAIL" if bad else "ok"
+        st = eng.stats()
+        print(f"  {phase:<13} -> {tag}  (hits={st['prefix_cache']['hits']}, "
+              f"full={st['prefix_cache']['full_hits']}, "
+              f"reused={st['prefix_cache']['tokens_reused']}, "
+              f"cow={st['cow_copies']}, chunks={st['prefill_chunks']}, "
+              f"peak_blocks={bs['peak_allocated']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
 # ---------------------------------------------------------------------------
 # router (distributed serving tier) phases
 # ---------------------------------------------------------------------------
@@ -986,9 +1144,13 @@ def main(argv=None):
             # reference engine compiles each bucket once, later phases
             # disk-hit (warm-start reuse is ALSO under test here)
             dmodel = _decode_model()
-            _decode_references(dmodel)
+            if [p for p in decode_phases if p != "decode-cow"]:
+                _decode_references(dmodel)
             for phase in decode_phases:
-                violations += run_decode_phase(phase, dmodel)
+                if phase == "decode-cow":
+                    violations += run_decode_cow_phase(phase, dmodel)
+                else:
+                    violations += run_decode_phase(phase, dmodel)
         if router_phases:
             # threads-as-replicas over two committed real-model snapshots
             # (the multi-process topology runs slow-marked in
