@@ -82,6 +82,38 @@ def _measure_with_retry(make_engine, batch, steps, attempts=6,
     return _retry_transient(attempt, attempts=attempts, label=label)
 
 
+def _multistep_k(steps):
+    """Steps-per-dispatch for the pipelined `Engine.train_batches` hot
+    path: the largest divisor of `steps` at most BENCH_MULTISTEP
+    (default 5). k=1 falls back to one dispatch per step."""
+    ms = int(os.environ.get("BENCH_MULTISTEP", "5"))
+    return max(i for i in range(1, max(1, min(ms, steps)) + 1)
+               if steps % i == 0)
+
+
+def _measure_multistep_with_retry(make_engine, batch, steps, k,
+                                  label="bench"):
+    """Warmup + timed loop over the fused k-step `train_batches` path
+    (every micro-batch the same object -> the scan-invariant variant:
+    zero per-step host work, docs/performance.md). The vision flagships
+    ride this too now — ROADMAP item 1 lever (a): they dispatched per
+    step while the gpt config measured +51% tok/s CPU from fusion
+    alone."""
+
+    def attempt():
+        eng = make_engine()
+        lv = eng.train_batches([batch] * k)   # warmup/compile fused step
+        float(lv.numpy()[-1])                 # readback fence
+        t0 = time.perf_counter()
+        for _ in range(steps // k):
+            lv = eng.train_batches([batch] * k)
+        final_loss = float(lv.numpy()[-1])
+        dt = time.perf_counter() - t0
+        return final_loss, dt
+
+    return _retry_transient(attempt, label=label)
+
+
 def _export_profile(make_engine, batch, steps=3):
     """BENCH_PROFILE=1: capture host spans (engine dispatch / device_put /
     write-back plus eager op dispatches) over a few post-compile steps and
@@ -122,6 +154,70 @@ def _emit(payload):
     return payload
 
 
+CONV_BASELINE_FILENAME = "CONV_BASELINE.json"
+
+
+def _conv_objectives(row, on_tpu):
+    """Declared ratchet objectives for one conv bench row. CPU smokes
+    ratchet images/sec (generous slack: machine-to-machine variance);
+    TPU rows ratchet the MFU itself — the number ROADMAP item 1 is
+    actually about."""
+    from paddle_tpu.obs.slo import Objective
+
+    if on_tpu:
+        return [Objective(
+            f"{row}.tpu_mfu", "min",
+            description=f"TPU train-step MFU of the {row} bench row",
+            unit="mfu", slack=1.25)]
+    return [Objective(
+        f"{row}.cpu_images_per_sec", "min",
+        description=f"CPU-smoke train images/sec of the {row} bench row",
+        unit="img/s", slack=3.0)]
+
+
+def _conv_gate(row, on_tpu, ips, mfu):
+    """vs_baseline ratchet for the conv bench rows (ROADMAP item 1
+    lever (c)), mirroring the BENCH_SLO gate shape: the measured row is
+    evaluated against the checked-in CONV_BASELINE.json bound and a
+    regression beyond the slack FAILS the bench like a correctness bug
+    (e.g. the vision flagships silently falling off the multi-step scan
+    path, or an NHWC relayout creeping back in). BENCH_CONV_WRITE=1
+    re-ratchets THIS row's bound (merging — resnet50/ppyoloe/TPU rows
+    ratchet independently). A platform with no ratcheted bound yet (no
+    TPU conv rows exist) notes it and passes — the checked-in CPU
+    bounds keep the gate real where measurement exists."""
+    from paddle_tpu.obs import slo as slo_mod
+
+    objectives = _conv_objectives(row, on_tpu)
+    values = {o.name: (mfu if on_tpu else ips) for o in objectives}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        CONV_BASELINE_FILENAME)
+    try:
+        entries = slo_mod.load_baseline(path)
+    except FileNotFoundError:
+        entries = {}
+
+    if os.environ.get("BENCH_CONV_WRITE") == "1":
+        entries = slo_mod.write_baseline(
+            path, values, objectives,
+            note="conv bench ratchet bounds (ROADMAP item 1c); "
+                 "re-ratchet one row with BENCH_CONV_WRITE=1 only "
+                 "for an intentional, explained perf change",
+            merge=entries)
+        print(f"conv gate: ratcheted {[o.name for o in objectives]} -> "
+              f"{path}", file=sys.stderr)
+
+    missing = [o.name for o in objectives if o.name not in entries]
+    if missing:
+        print(f"conv gate: no ratcheted bound yet for {missing} on this "
+              f"platform — BENCH_CONV_WRITE=1 ratchets one; gate skipped",
+              file=sys.stderr)
+        return True
+    report = slo_mod.evaluate(values, entries, objectives)
+    print(slo_mod.format_report(report), file=sys.stderr)
+    return report["ok"]
+
+
 def bench_resnet50(on_tpu, dev):
     """BASELINE config 1: ResNet-50 ImageNet-shape train step, images/sec."""
     import jax
@@ -158,20 +254,27 @@ def bench_resnet50(on_tpu, dev):
     x = paddle.to_tensor(rng.randn(*img_shape).astype("float32"))
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
 
-    final_loss, dt = _measure_with_retry(make_engine, (x, y), steps,
-                                         label="resnet bench")
+    k = _multistep_k(steps)
+    if k > 1:
+        final_loss, dt = _measure_multistep_with_retry(
+            make_engine, (x, y), steps, k, label="resnet bench")
+    else:
+        final_loss, dt = _measure_with_retry(make_engine, (x, y), steps,
+                                             label="resnet bench")
     ips = batch * steps / dt
     peak = 197e12 if on_tpu else float("inf")
     mfu = ips * train_flops_img / peak
-    return _emit({
+    payload = _emit({
         "metric": f"resnet50 train images/sec ({size}px, bs={batch}, "
                   f"{fmt}, bf16)",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
         "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
+                  "steps_per_dispatch": k,
                   "platform": dev.platform},
     })
+    return payload if _conv_gate("resnet50", on_tpu, ips, mfu) else None
 
 
 def bench_bert_finetune(on_tpu, dev):
@@ -282,8 +385,14 @@ def bench_ppyoloe(on_tpu, dev):
         (np.arange(max_boxes)[None] < 8).repeat(batch, 0)
         .astype("float32"))
 
-    final_loss, dt = _measure_with_retry(make_engine, (img, gb, gl, gm),
-                                         steps, label="ppyoloe bench")
+    k = _multistep_k(steps)
+    if k > 1:
+        final_loss, dt = _measure_multistep_with_retry(
+            make_engine, (img, gb, gl, gm), steps, k,
+            label="ppyoloe bench")
+    else:
+        final_loss, dt = _measure_with_retry(
+            make_engine, (img, gb, gl, gm), steps, label="ppyoloe bench")
     ips = batch * steps / dt
 
     # forward FLOPs of the model actually benched, from XLA cost analysis
@@ -319,7 +428,7 @@ def bench_ppyoloe(on_tpu, dev):
 
     peak = 197e12 if on_tpu else float("inf")
     mfu = (ips * flops_img / peak) if flops_img else 0.0
-    return _emit({
+    payload = _emit({
         "metric": f"ppyoloe_s detector train images/sec ({size}px, "
                   f"bs={batch}, {fmt}, bf16)",
         "value": round(ips, 2),
@@ -328,8 +437,10 @@ def bench_ppyoloe(on_tpu, dev):
         "extra": {"mfu": round(mfu, 4), "loss": round(final_loss, 4),
                   "train_gflops_per_img": round(flops_img / 1e9, 2)
                   if flops_img else None,
+                  "steps_per_dispatch": k,
                   "platform": dev.platform},
     })
+    return payload if _conv_gate("ppyoloe", on_tpu, ips, mfu) else None
 
 
 def bench_lora_decode(on_tpu, dev):
@@ -857,6 +968,114 @@ def _bench_decode_chunked_ttft(model, on_tpu):
     }
 
 
+def _bench_decode_speculative(on_tpu):
+    """BENCH_DECODE sub-row: speculative decoding (draft-proposed,
+    one-dispatch verified, docs/llm_serving.md). The workload is the
+    real speculative setting built by construction instead of
+    distillation (a bench cannot train a draft): the draft is a 2-layer
+    model, the target is the SAME two layers plus extra residual blocks
+    whose output projections are scaled near zero — so the draft
+    approximates the target closely (high acceptance, like a distilled
+    draft would) while the target costs ~4x the draft per forward. The
+    measured delta is the speculative machinery alone: K+1 tokens
+    committed per target dispatch instead of 1. Outputs are checked
+    bit-equal to `speculate_k=0` greedy decode — the acceptance
+    criterion — and the CPU-smoke gate is >= 1.3x tokens/sec (each mode
+    timed best-of-2; the TPU row lands with BENCH_r06)."""
+    import concurrent.futures
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import DecodeEngine
+    from paddle_tpu.models import gpt
+
+    n_seq = int(os.environ.get("BENCH_DECODE_SPEC_SEQS", "6"))
+    k = int(os.environ.get("BENCH_DECODE_SPEC_K", "8"))
+    n_layers = 8
+    tiny = dict(vocab_size=97, hidden_size=48, num_heads=4,
+                num_kv_heads=2, rope=True, swiglu=True, rms_norm=True,
+                max_position_embeddings=64, tie_word_embeddings=False)
+    paddle.seed(7)
+    target = gpt("gpt_tiny", num_layers=n_layers, **tiny)
+    paddle.seed(7)
+    draft = gpt("gpt_tiny", num_layers=2, **tiny)
+    target.eval()
+    draft.eval()
+    tp = dict(target.named_parameters())
+    for name, p in draft.named_parameters():
+        p._value = tp[name]._value     # shared early stack + emb + head
+    for name, p in target.named_parameters():
+        if any(f"layers.{i}." in name for i in range(2, n_layers)) \
+                and ("out_proj" in name or "down_proj" in name):
+            p._value = p._value * 0.05  # extra blocks ~ identity
+
+    lens = [24, 32, 40, 32]
+    want = [lens[i % len(lens)] for i in range(n_seq)]
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, 97, (8,)).astype(np.int32)
+               for _ in range(n_seq)]
+
+    rows, outs = {}, {}
+    for mode in ("speculative", "greedy"):
+        eng = DecodeEngine(
+            target, max_length=64, block_size=8,
+            decode_buckets=(1, 2, 4), prefill_buckets=(8,),
+            prefix_cache=False, default_timeout=600.0,
+            draft_model=draft if mode == "speculative" else None,
+            speculate_k=k if mode == "speculative" else 0)
+        try:
+            eng.warmup()
+            best, out, st0 = float("inf"), None, None
+            for i in range(2):         # best-of-2: CPU timing variance
+                # counters below are reported as deltas over the FINAL
+                # run (each run commits the identical greedy tokens, so
+                # per-run dispatch counts are deterministic) while
+                # tokens/sec uses the best run's time — without the
+                # snapshot the published dispatch/rollback counts would
+                # be two-run totals, 2x the workload's
+                if i == 1:
+                    st0 = eng.stats()
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(n_seq) as ex:
+                    out = list(ex.map(
+                        lambda i: eng.generate(prompts[i], want[i]),
+                        range(n_seq)))
+                best = min(best, time.perf_counter() - t0)
+            outs[mode] = out
+            st = eng.stats()
+            sp, sp0 = st["speculative"], st0["speculative"]
+            proposed = sp["proposed"] - sp0["proposed"]
+            accepted = sp["accepted"] - sp0["accepted"]
+            committed = sp["committed"] - sp0["committed"]
+            verifies = sp["verify_dispatches"] - sp0["verify_dispatches"]
+            rows[mode] = {
+                "tokens_per_sec": round(sum(want) / best, 1),
+                "target_dispatches": (st["steps"] - st0["steps"])
+                + verifies + (st["prefills"] - st0["prefills"]),
+                "acceptance_rate": round(accepted / proposed, 3)
+                if proposed else 0.0,
+                "accepted_per_dispatch": round(committed / verifies, 2)
+                if verifies else 0.0,
+                "rolled_back": sp["rejected"] - sp0["rejected"],
+                "fallback_rounds": sp["fallbacks"] - sp0["fallbacks"],
+            }
+        finally:
+            eng.shutdown(drain_timeout=30.0)
+
+    mismatches = sum(1 for a, b in zip(outs["speculative"],
+                                       outs["greedy"]) if a != b)
+    ratio = (rows["speculative"]["tokens_per_sec"]
+             / max(1e-9, rows["greedy"]["tokens_per_sec"]))
+    return {
+        "modes": rows,
+        "k": k,
+        "sequences": n_seq,
+        "target_layers": n_layers,
+        "draft_layers": 2,
+        "mismatches": mismatches,
+        "tokens_per_sec_ratio": round(ratio, 3),
+    }
+
+
 def bench_decode(on_tpu, dev):
     """BENCH_DECODE=1: continuous-batching LLM decode — tokens/sec and
     p50/p99 time-to-first-token of the iteration-level `DecodeEngine`
@@ -977,11 +1196,13 @@ def bench_decode(on_tpu, dev):
         speedup = (results["continuous"]["tokens_per_sec"]
                    / results["request_level"]["tokens_per_sec"])
 
-        # Decode speed 2.0 rows: copy-on-write prefix sharing and
-        # chunked prefill, each bit-equality-checked against its
-        # private/monolithic twin and CPU-smoke gated below
+        # Decode speed 2.0 rows: copy-on-write prefix sharing, chunked
+        # prefill, and speculative decoding — each bit-equality-checked
+        # against its private/monolithic/greedy twin and CPU-smoke
+        # gated below
         shared = _bench_decode_shared_prefix(model, on_tpu)
         ttft = _bench_decode_chunked_ttft(model, on_tpu)
+        spec = _bench_decode_speculative(on_tpu)
 
         payload = _emit({
             "metric": f"continuous-batching decode tokens/sec "
@@ -995,6 +1216,7 @@ def bench_decode(on_tpu, dev):
                       "mismatches": mismatches,
                       "shared_prefix": shared,
                       "chunked_prefill": ttft,
+                      "speculative": spec,
                       "platform": dev.platform},
         })
         if mismatches:
@@ -1026,6 +1248,18 @@ def bench_decode(on_tpu, dev):
             print(f"bench_decode: chunked prefill gate failed — TTFT p99 "
                   f"improvement {ttft['ttft_p99_improvement']:.2f}x "
                   f"< 1.1x on the long-prompt mixed workload",
+                  file=sys.stderr)
+            return None
+        if spec["mismatches"]:
+            print(f"bench_decode: {spec['mismatches']} speculative "
+                  f"request(s) diverged from speculate_k=0 greedy decode",
+                  file=sys.stderr)
+            return None
+        if spec["tokens_per_sec_ratio"] < 1.3:
+            print(f"bench_decode: speculative gate failed — "
+                  f"{spec['tokens_per_sec_ratio']:.2f}x tokens/sec "
+                  f"< 1.3x vs speculate_k=0 (acceptance "
+                  f"{spec['modes']['speculative']['acceptance_rate']})",
                   file=sys.stderr)
             return None
         return payload
@@ -1161,10 +1395,18 @@ def main():
         os.environ.pop("BENCH_WEIGHT_DTYPE", None)
         os.environ.pop("BENCH_KV_DTYPE", None)
         payloads = [_emit(bench_gpt(on_tpu, dev))]
+        gate_failed = False
         for fn in (bench_resnet50, bench_bert_finetune, bench_ppyoloe,
                    bench_lora_decode):
             os.environ.pop("BENCH_MODEL", None)
-            payloads.append(fn(on_tpu, dev))
+            p = fn(on_tpu, dev)
+            if p is None:
+                # a ratchet gate breached (conv vs_baseline rows): keep
+                # measuring the rest, fail the run at the end — a perf
+                # regression fails like a correctness bug
+                gate_failed = True
+            else:
+                payloads.append(p)
         for wdtype, kv in (("int8", ""), ("int4", ""), ("int8", "int8")):
             # weight-only decode variants + the fully-quantized row; both
             # env knobs are forced per row so shell-exported values cannot
@@ -1193,7 +1435,7 @@ def main():
                                "BENCH_ALL.json"), "w") as f:
             json.dump(payloads, f, indent=1)
         print(json.dumps(payloads[0]))
-        return 0
+        return 1 if gate_failed else 0
 
     mode = os.environ.get("BENCH_MODEL", "")
     if mode.startswith("resnet"):

@@ -1,10 +1,12 @@
 """paddle_tpu.inference.decode — continuous-batching LLM decode engine.
 
 Composes the paged KV-cache allocator (`block_pool.BlockKVCache`), the
-iteration-level scheduler (`engine.DecodeEngine`) and streaming output
-through the resilient serving runtime. See docs/llm_serving.md for the
-architecture and contract; `ops/pallas/decode_attn.paged_decode_attention`
-is the TPU-native read-through-the-block-table attention kernel.
+iteration-level scheduler (`engine.DecodeEngine` — prefix sharing,
+chunked prefill, and draft-model speculative decoding with bit-exact
+greedy verification) and streaming output through the resilient serving
+runtime. See docs/llm_serving.md for the architecture and contract;
+`ops/pallas/decode_attn.paged_decode_attention` is the TPU-native
+read-through-the-block-table attention kernel.
 """
 from __future__ import annotations
 

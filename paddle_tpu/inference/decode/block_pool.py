@@ -79,9 +79,14 @@ class BlockKVCache:
             ``decode_step`` cache layout.
         quant: informational layout tag (None or "int8") carried for
             engine fingerprinting and stats.
+        name: informational pool tag carried in stats()/repr — the
+            speculative decode engine runs TWO pools (the target model's
+            and the draft model's, same conservation law each), and a
+            leak report must say which one leaked.
     """
 
-    def __init__(self, num_blocks, block_size, entry_specs, quant=None):
+    def __init__(self, num_blocks, block_size, entry_specs, quant=None,
+                 name=None):
         import jax.numpy as jnp
 
         if block_size < 1:
@@ -93,6 +98,7 @@ class BlockKVCache:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.quant = quant
+        self.name = name
         #: per-layer tuples of device arrays; the engine replaces this
         #: wholesale after each committed (prefill/decode) step
         self.tensors = [
@@ -296,6 +302,7 @@ class BlockKVCache:
             shared_refs = sum(len(hs) - 1 for hs in self._refs.values()
                               if len(hs) > 1)
             return {
+                "name": self.name,
                 "total": self.num_blocks,
                 "reserved": RESERVED_BLOCKS,
                 "block_size": self.block_size,
@@ -316,6 +323,11 @@ class BlockKVCache:
 
     def __repr__(self):
         s = self.stats()
+        if self.name:
+            return (f"BlockKVCache[{self.name}](total={s['total']}, "
+                    f"free={s['free']}, allocated={s['allocated']}, "
+                    f"shared={s['shared_refs']}, "
+                    f"block_size={self.block_size}, quant={self.quant!r})")
         return (f"BlockKVCache(total={s['total']}, free={s['free']}, "
                 f"allocated={s['allocated']}, shared={s['shared_refs']}, "
                 f"block_size={self.block_size}, quant={self.quant!r})")

@@ -39,6 +39,27 @@ that already exist in-tree:
   so a reused prefix was computed by the IDENTICAL dispatches the new
   sequence would have run itself.
 
+* **Speculative decoding** (Leviathan et al. 2023): a small DRAFT model
+  (`draft_model=`, `speculate_k=K`) autoregressively proposes K tokens
+  per scheduler round from its own paged KV state (one compiled
+  K-step dispatch), then the TARGET model scores all K+1 positions in
+  ONE bucketed verification dispatch. Greedy verification accepts the
+  longest prefix where draft argmax == target argmax and commits the
+  accepted tokens plus the target's one correction (or bonus) token;
+  the draft's KV for rejected positions is rolled back positionally
+  (rows past the committed position are rewritten before they can ever
+  be attended — the same garbage-row argument chunked prefill makes).
+  Decode is memory-bound (bandwidth_frac <= 0.53), so verifying K
+  tokens under one streaming of the target weights is nearly free
+  throughput. The verify step is a `lax.scan` of the IDENTICAL
+  per-position decode body the plain decode step runs, so the target's
+  argmax at every verified position is bit-identical to sequential
+  greedy decode — which makes speculative output provably BIT-IDENTICAL
+  to `speculate_k=0` at every bucket size, int8 KV and prefix sharing
+  included. Draft and target each own a refcounted `BlockKVCache`
+  (same conservation law; COW rules unchanged), and admission reserves
+  the draft's worst-case blocks alongside the target's.
+
 * **Bucketed AOT step executables** (`jit/aot.compile_jit`): the decode
   step is compiled once per batch-size bucket and persisted in the
   shared on-disk `CompileCache`, so a warm process start compiles ZERO
@@ -194,7 +215,9 @@ class _Seq:
     __slots__ = ("id", "prompt", "max_new", "deadline", "stream", "state",
                  "blocks", "reserved_total", "outstanding", "pos",
                  "prefill_pos", "matched_tokens", "last_token", "generated",
-                 "cancelled", "submitted_at", "span")
+                 "cancelled", "submitted_at", "span", "draft_blocks",
+                 "draft_pos", "draft_outstanding", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, sid, prompt, max_new, deadline):
         self.id = sid
@@ -214,6 +237,12 @@ class _Seq:
         self.cancelled = False
         self.submitted_at = None       # admission stamp (TTFT histogram)
         self.span = _otrace.null_span()  # sequence root (obs.trace)
+        # speculative decoding (draft model) bookkeeping
+        self.draft_blocks = []         # draft-pool block ids, table order
+        self.draft_pos = 0             # valid draft KV rows (rollback line)
+        self.draft_outstanding = 0     # draft fresh allocations to come
+        self.spec_proposed = 0         # draft tokens proposed for this seq
+        self.spec_accepted = 0         # proposals the target agreed with
 
 
 #: registry collector keys need a distinct name per engine instance
@@ -234,7 +263,8 @@ class DecodeEngine:
                  hang_grace=0.1, supervise_interval=0.02, metrics=None,
                  mesh=None, sharding_rules=None, clock=time.monotonic,
                  prefix_cache=True, prefix_cache_blocks=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, draft_model=None, speculate_k=0,
+                 draft_num_blocks=None):
         from ...distributed.functional import functionalize
         from ...core.tensor import Tensor
 
@@ -310,7 +340,68 @@ class DecodeEngine:
             num_blocks = RESERVED_BLOCKS + self.max_active * (
                 nb_per_seq + (1 if self._prefix_on else 0))
         self.pool = model.init_block_pool(num_blocks, self.block_size,
-                                          quant=quant)
+                                          quant=quant, name="target")
+
+        # speculative decoding: a draft model proposes speculate_k tokens
+        # per round from ITS OWN paged pool (same geometry: max_length /
+        # block_size shared, layer/head shapes the draft model's own);
+        # the target verifies them in one bucketed dispatch. Off unless
+        # both a draft model and speculate_k >= 1 are given —
+        # speculate_k=0 is the plain-greedy reference mode the
+        # bit-identity gate compares against.
+        self._k = int(speculate_k)
+        if self._k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {speculate_k}")
+        self._spec_on = draft_model is not None and self._k > 0
+        self.draft_model = draft_model if self._spec_on else None
+        self.draft_pool = None
+        if self._spec_on:
+            if draft_model is model and mesh is not None:
+                raise ValueError(
+                    "draft_model must be a distinct model instance when "
+                    "a mesh is set: a self-draft shares the target's "
+                    "parameter holders, so replicating the draft would "
+                    "clobber the target's sharded placement (and a "
+                    "self-draft buys no speedup anyway — use a smaller "
+                    "draft, or drop the mesh)")
+            draft_model.eval()
+            dvocab = getattr(getattr(draft_model, "cfg", None),
+                             "vocab_size", None)
+            if (self._vocab is not None and dvocab is not None
+                    and dvocab != self._vocab):
+                raise ValueError(
+                    f"draft model vocab {dvocab} != target vocab "
+                    f"{self._vocab} — proposals would be meaningless")
+            if draft_num_blocks is None:
+                draft_num_blocks = RESERVED_BLOCKS \
+                    + self.max_active * nb_per_seq
+            self.draft_pool = draft_model.init_block_pool(
+                draft_num_blocks, self.block_size, quant=quant,
+                name="draft")
+            # draft catch-up chunks at block-aligned starts; a span
+            # beyond the largest prefill bucket needs an aligned bucket
+            # to chunk with — reject the doomed configuration here, not
+            # one user request at a time mid-generation
+            if not any(b % self.block_size == 0
+                       for b in self.prefill_buckets) \
+                    and self.prefill_buckets[-1] < self.max_length - 1:
+                raise ValueError(
+                    f"speculative draft catch-up needs a prefill bucket "
+                    f"that is a multiple of block_size "
+                    f"{self.block_size} (got {self.prefill_buckets}) — "
+                    f"or a largest bucket spanning max_length - 1 so "
+                    f"catch-up never has to chunk")
+
+            def wrapped_draft(tokens, cache_vals, pos):
+                cts = [tuple(Tensor(a) for a in entry)
+                       for entry in cache_vals]
+                logits, new_caches = draft_model.decode_step(
+                    Tensor(tokens), cts, Tensor(pos))
+                return (logits._value,
+                        [tuple(t._value for t in nc) for nc in new_caches])
+
+            self._d_apply, self._d_params, self._d_buffers = functionalize(
+                draft_model, method=wrapped_draft)
 
         # prefix->block-table cache (scheduler-thread owned; counters and
         # structure reads ride _cv): entries pin their blocks with
@@ -368,11 +459,29 @@ class DecodeEngine:
                 b._value = jax.device_put(b._value, sh)
                 self._buf_sh[n] = sh
             self.pool.shard_(mesh, rules=sharding_rules)
+            if self._spec_on:
+                # the draft is small by construction: replicate it (and
+                # its pool) instead of sharding — every chip proposes the
+                # same K tokens, the TP win stays on the target verify
+                for holders in (self._d_params, self._d_buffers):
+                    for n, h in holders.items():
+                        h._value = jax.device_put(
+                            h._value, _shardlib.replicated(mesh, h.ndim))
+                self.draft_pool.tensors = [
+                    tuple(jax.device_put(
+                        t, _shardlib.replicated(mesh, t.ndim))
+                        for t in layer)
+                    for layer in self.draft_pool.tensors]
 
         self._fingerprint = self._make_fingerprint()
+        self._draft_fingerprint = self._make_draft_fingerprint() \
+            if self._spec_on else None
 
         self._decode_fns = {}     # bucket -> compiled step
         self._prefill_fns = {}    # prompt bucket -> compiled prefill
+        self._verify_fns = {}     # bucket -> compiled K+1-position verify
+        self._propose_fns = {}    # bucket -> compiled K-step draft propose
+        self._draft_prefill_fns = {}   # prompt bucket -> draft catch-up
         self._cow_fn_c = None     # compiled donated block-copy (COW)
         self._compiled = 0
         self._disk_loaded = 0
@@ -425,6 +534,18 @@ class DecodeEngine:
         self._prefix_tokens_reused = 0
         self._prefix_evictions = 0
         self._cow_copies = 0
+        # speculative decoding counters (guarded by _lock like the other
+        # dispatch-side counters)
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rejected = 0
+        self._spec_bonus = 0
+        self._spec_committed = 0
+        self._spec_verify_dispatches = 0
+        self._spec_draft_dispatches = 0
+        self._spec_catchup_chunks = 0
+        self._spec_fallbacks = 0
 
         # telemetry (paddle_tpu.obs): TTFT observed at first-token
         # delivery plus stats() as a registry collector. TWO histograms
@@ -479,6 +600,28 @@ class DecodeEngine:
             h.update(f"mesh:{sorted(dict(self.mesh.shape).items())}".encode())
         return h.hexdigest()
 
+    def _make_draft_fingerprint(self):
+        """Identity of the DRAFT model's compiled programs (propose +
+        catch-up prefill): draft structure/shapes, never values — kept
+        separate from the target fingerprint so the target's decode /
+        prefill / verify executables are shared with a draft-less engine
+        over the same target model."""
+        h = hashlib.sha256()
+        h.update(type(self.draft_model).__name__.encode())
+        for n in sorted(self._d_params):
+            p = self._d_params[n]
+            h.update(f"{n}:{tuple(p.shape)}:{p.dtype}".encode())
+        for n in sorted(self._d_buffers):
+            b = self._d_buffers[n]
+            h.update(f"{n}:{tuple(b.shape)}:{b.dtype}".encode())
+        h.update(f"spec-draft-v2:{self.draft_pool.quant}:"
+                 f"{self.block_size}:{self._nb}:{self._prefill_tail}"
+                 .encode())
+        if self.mesh is not None:
+            h.update(f"mesh:{sorted(dict(self.mesh.shape).items())}"
+                     .encode())
+        return h.hexdigest()
+
     # -- admission ---------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens, timeout=None):
         """Admit one generation request; returns its `SequenceStream`.
@@ -521,6 +664,14 @@ class DecodeEngine:
                 f"request needs {worst} worst-case blocks but the pool "
                 f"holds only {self.pool.num_blocks - RESERVED_BLOCKS} "
                 f"allocatable — it could never be admitted")
+        if self._spec_on:
+            dworst = self._draft_worst(ids.shape[0], max_new)
+            if dworst > self.draft_pool.num_blocks - RESERVED_BLOCKS:
+                raise ValueError(
+                    f"request needs {dworst} worst-case DRAFT blocks but "
+                    f"the draft pool holds only "
+                    f"{self.draft_pool.num_blocks - RESERVED_BLOCKS} "
+                    f"allocatable — it could never be admitted")
 
         eff = self.default_timeout if timeout is None else timeout
         dl = Deadline(eff, clock=self._clock)
@@ -583,6 +734,15 @@ class DecodeEngine:
         bv = {n: jax.ShapeDtypeStruct(tuple(b.shape), b._value.dtype)
               for n, b in self._buffers.items()}
         return pv, bv
+
+    def _note_compile(self, source):
+        """Count one executable build ("compiled") or persistent-cache
+        load ("disk") — every program builder funnels through this."""
+        with self._lock:
+            if source == "disk":
+                self._disk_loaded += 1
+            else:
+                self._compiled += 1
 
     def _step_shardings(self):
         """(pv, bv, pool, scalar) sharding pytrees for the TP step
@@ -671,21 +831,18 @@ class DecodeEngine:
             step, avals, fingerprint=self._fingerprint, cache=self._cache,
             tag=f"decode-step-b{bucket}", in_shardings=in_sh,
             out_shardings=out_sh, audit_ctx=self._audit_ctx(pv))
-        with self._lock:
-            if source == "disk":
-                self._disk_loaded += 1
-            else:
-                self._compiled += 1
+        self._note_compile(source)
         self._decode_fns[bucket] = compiled
         return compiled
 
-    def _prefill_fn(self, pbucket):
-        fn = self._prefill_fns.get(pbucket)
-        if fn is not None:
-            return fn
+    def _make_prefill_body(self, pbucket, apply):
+        """The traced chunk-prefill program, shared by the target
+        prefill and the draft catch-up prefill (`apply` selects whose
+        weights run the forward). The block-wise scatter below is the
+        bit-exactness-critical core both chunked prefill and draft
+        catch-up rest on — one implementation, two compilers."""
         import jax
         import jax.numpy as jnp
-        from ...jit import aot
 
         nb_written = math.ceil(pbucket / self.block_size)
         nb_table = self._nb + self._prefill_tail
@@ -696,8 +853,7 @@ class DecodeEngine:
             # block-aligned (0 for a monolithic prefill). Attention over
             # already-written earlier chunks rides the same gathered view.
             caches = self._gather(pool_ts, table, nb=nb_table)
-            (logits, new_caches), _ = self._apply(
-                pv, bv, tokens, caches, start)
+            (logits, new_caches), _ = apply(pv, bv, tokens, caches, start)
             last = jax.lax.dynamic_index_in_dim(logits[0], valid_len - 1,
                                                 axis=0, keepdims=False)
             nxt = jnp.argmax(last.astype(jnp.float32), -1).astype(jnp.int32)
@@ -723,6 +879,18 @@ class DecodeEngine:
                 out.append(tuple(entry))
             return out, nxt
 
+        return prefill
+
+    def _prefill_fn(self, pbucket):
+        fn = self._prefill_fns.get(pbucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        nb_table = self._nb + self._prefill_tail
+        prefill = self._make_prefill_body(pbucket, self._apply)
         pv, bv = self._weight_avals()
         avals = (pv, bv, self._avals(self.pool.tensors),
                  jax.ShapeDtypeStruct((1, pbucket), jnp.int32),
@@ -740,11 +908,7 @@ class DecodeEngine:
             cache=self._cache, tag=f"decode-prefill-p{pbucket}",
             in_shardings=in_sh, out_shardings=out_sh,
             audit_ctx=self._audit_ctx(pv))
-        with self._lock:
-            if source == "disk":
-                self._disk_loaded += 1
-            else:
-                self._compiled += 1
+        self._note_compile(source)
         self._prefill_fns[pbucket] = compiled
         return compiled
 
@@ -759,6 +923,212 @@ class DecodeEngine:
         return {"mesh": self.mesh, "param_avals": pv,
                 "param_specs": specs,
                 "expect_sharded_params": self.mesh is not None}
+
+    # -- speculative decoding programs -------------------------------------
+    def _draft_worst(self, plen, max_new):
+        """Worst-case draft-pool blocks one sequence can ever hold: the
+        draft writes rows `pos .. pos+K-1` per round with `pos` at most
+        `plen + max_new - 2` (eligibility also caps rows below the table
+        span, so `_nb` bounds it either way)."""
+        return min(self._nb,
+                   self.draft_pool.blocks_for(plen + max_new - 1 + self._k))
+
+    def _d_weights(self):
+        pv = {n: p._value for n, p in self._d_params.items()}
+        bv = {n: b._value for n, b in self._d_buffers.items()}
+        return pv, bv
+
+    def _draft_weight_avals(self):
+        import jax
+
+        pv = {n: jax.ShapeDtypeStruct(tuple(p.shape), p._value.dtype)
+              for n, p in self._d_params.items()}
+        bv = {n: jax.ShapeDtypeStruct(tuple(b.shape), b._value.dtype)
+              for n, b in self._d_buffers.items()}
+        return pv, bv
+
+    def _draft_shardings(self, n_scalars):
+        """Fully-replicated (in, out) sharding tuples for the draft
+        programs on a TP mesh (the draft is replicated by construction),
+        else (None, None)."""
+        if self.mesh is None:
+            return None, None
+        from ... import sharding as _shardlib
+
+        repl = _shardlib.replicated(self.mesh)
+        return (tuple([repl] * (3 + n_scalars)), (repl, repl))
+
+    def _verify_fn(self, bucket):
+        """Target-side verification step for `bucket` sequences: scores
+        K+1 positions per sequence — the last committed token plus the K
+        draft proposals — as ONE chunk-shaped forward per sequence (the
+        chunked-prefill idiom: tokens [1, K+1] at offset `pos`), inside
+        one bucketed dispatch. The target's weights stream once per
+        dispatch for all K+1 positions — on memory-bound decode hardware
+        that is the whole speculative win — and each written KV row is
+        scattered through the block table position-by-position with the
+        decode step's own scatter.
+
+        Bit-exactness: what gets COMMITTED is always the target's argmax,
+        and the chunk forward's per-position argmax/KV must match the
+        single-token decode step's — the same seq-chunk determinism
+        chunked prefill (PR 13) already rests on and gates with its
+        chunked-vs-monolithic bit-equality row; the speculative tier-1
+        tests and the injector's decode-spec phase hold this verify step
+        to the identical bar (bit-identity to `speculate_k=0` at every
+        bucket size, int8 and prefix sharing included)."""
+        fn = self._verify_fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        kk = self._k + 1
+
+        def step(pv, bv, pool_ts, tokens, positions, tables):
+            def seq_body(pool_ts, x):
+                toks, pos0, table = x
+                caches = self._gather(pool_ts, table)
+                (logits, new_caches), _ = self._apply(
+                    pv, bv, toks.reshape(1, kk), caches, pos0)
+                preds = jnp.argmax(
+                    logits[0].astype(jnp.float32), -1).astype(jnp.int32)
+                # the chunk wrote rows pos0..pos0+K: scatter each through
+                # the table (pos0 is NOT block-aligned, so the prefill's
+                # block-wise scatter does not apply — K+1 row scatters do)
+                for j in range(kk):
+                    pool_ts = self._scatter_row(pool_ts, new_caches,
+                                                table, pos0 + j)
+                return pool_ts, preds
+
+            pool_ts, preds = jax.lax.scan(seq_body, pool_ts,
+                                          (tokens, positions, tables))
+            return pool_ts, preds
+
+        pv, bv = self._weight_avals()
+        avals = (pv, bv, self._avals(self.pool.tensors),
+                 jax.ShapeDtypeStruct((bucket, kk), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket, self._nb), jnp.int32))
+        in_sh = out_sh = None
+        sh = self._step_shardings()
+        if sh is not None:
+            pv_sh, bv_sh, pool_sh, repl = sh
+            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl)
+            out_sh = (pool_sh, repl)
+        compiled, source = aot.compile_jit(
+            step, avals, fingerprint=self._fingerprint, cache=self._cache,
+            tag=f"decode-verify-b{bucket}",
+            extra_key=("speculate_k", self._k),
+            in_shardings=in_sh, out_shardings=out_sh,
+            audit_ctx=self._audit_ctx(pv))
+        self._note_compile(source)
+        self._verify_fns[bucket] = compiled
+        return compiled
+
+    def _propose_fn(self, bucket):
+        """Draft-side proposal step for `bucket` sequences: K
+        autoregressive draft decode steps fused into ONE dispatch — each
+        iteration feeds its own argmax back in, writing the draft's KV
+        rows through the draft block table. Draft numerics only gate the
+        ACCEPTANCE RATE, never the committed output (only target-argmax
+        tokens are ever committed), so the draft program needs no
+        bit-stability argument.
+
+        The scan runs K+1 iterations, not K: the extra step feeds the
+        LAST proposal back in (its output is discarded) purely to write
+        draft KV row `pos+K` — after a fully-accepted (bonus) round the
+        committed position advances by K+1 and every draft row behind it
+        must be valid, or the next proposal would attend a never-written
+        row and acceptance would silently erode. Rows written past the
+        committed position on a partial acceptance are garbage behind
+        the rollback line: the next round rewrites each before any query
+        can attend it (a row's own write precedes its first read)."""
+        fn = self._propose_fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        k = self._k
+
+        def step(pv, bv, pool_ts, tokens, positions, tables):
+            def seq_body(pool_ts, x):
+                tok0, pos0, table = x
+
+                def tok_body(carry, pos):
+                    pool_ts, tok = carry
+                    caches = self._gather(pool_ts, table)
+                    (logits, new_caches), _ = self._d_apply(
+                        pv, bv, tok.reshape(1, 1), caches, pos)
+                    nxt = jnp.argmax(
+                        logits[0, -1].astype(jnp.float32),
+                        -1).astype(jnp.int32)
+                    pool_ts = self._scatter_row(pool_ts, new_caches,
+                                                table, pos)
+                    return (pool_ts, nxt), nxt
+
+                poss = pos0 + jnp.arange(k + 1, dtype=jnp.int32)
+                (pool_ts, _), props = jax.lax.scan(
+                    tok_body, (pool_ts, tok0), poss)
+                return pool_ts, props[:k]
+
+            pool_ts, props = jax.lax.scan(seq_body, pool_ts,
+                                          (tokens, positions, tables))
+            return pool_ts, props
+
+        pv, bv = self._draft_weight_avals()
+        avals = (pv, bv, self._avals(self.draft_pool.tensors),
+                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket, self._nb), jnp.int32))
+        # K only shows in the OUTPUT shape: without extra_key two engines
+        # with different speculate_k would collide on identical input
+        # avals in the persistent cache
+        in_sh, out_sh = self._draft_shardings(3)
+        compiled, source = aot.compile_jit(
+            step, avals, fingerprint=self._draft_fingerprint,
+            cache=self._cache, tag=f"decode-propose-b{bucket}",
+            extra_key=("speculate_k", self._k),
+            in_shardings=in_sh, out_shardings=out_sh,
+            audit_ctx=None if not _gc.enabled() else {"mesh": self.mesh})
+        self._note_compile(source)
+        self._propose_fns[bucket] = compiled
+        return compiled
+
+    def _draft_prefill_fn(self, pbucket):
+        """Draft catch-up prefill: the draft-model twin of `_prefill_fn`
+        (chunk-aware, block-scattered, extended table) used to (re)build
+        the draft's KV over already-COMMITTED tokens — at first
+        speculation (the prompt), after a prefix-cache full hit (the
+        draft never saw the prompt), and after a plain-decode fallback
+        advanced the sequence without the draft."""
+        fn = self._draft_prefill_fns.get(pbucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from ...jit import aot
+
+        nb_table = self._nb + self._prefill_tail
+        prefill = self._make_prefill_body(pbucket, self._d_apply)
+        pv, bv = self._draft_weight_avals()
+        avals = (pv, bv, self._avals(self.draft_pool.tensors),
+                 jax.ShapeDtypeStruct((1, pbucket), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((nb_table,), jnp.int32))
+        in_sh, out_sh = self._draft_shardings(4)
+        compiled, source = aot.compile_jit(
+            prefill, avals, fingerprint=self._draft_fingerprint,
+            cache=self._cache, tag=f"decode-prefill-p{pbucket}",
+            in_shardings=in_sh, out_shardings=out_sh,
+            audit_ctx=None if not _gc.enabled() else {"mesh": self.mesh})
+        self._note_compile(source)
+        self._draft_prefill_fns[pbucket] = compiled
+        return compiled
 
     def _cow_fn(self):
         """Compiled copy-on-write block copy: ONE donated dispatch that
@@ -791,11 +1161,7 @@ class DecodeEngine:
             tag="decode-cow-copy", donate_argnums=(0,),
             in_shardings=in_sh, out_shardings=out_sh,
             audit_ctx=None if not _gc.enabled() else {"mesh": self.mesh})
-        with self._lock:
-            if source == "disk":
-                self._disk_loaded += 1
-            else:
-                self._compiled += 1
+        self._note_compile(source)
         self._cow_fn_c = compiled
         return compiled
 
@@ -811,8 +1177,19 @@ class DecodeEngine:
             self._prefill_fn(p)
         if self._prefix_on:
             self._cow_fn()
-        return {"decode": list(self.decode_buckets),
-                "prefill": list(self.prefill_buckets)}
+        out = {"decode": list(self.decode_buckets),
+               "prefill": list(self.prefill_buckets)}
+        if self._spec_on:
+            # speculation executables are part of the warm set too: a
+            # propose/verify/catch-up dispatch after mark_warm() that
+            # compiles is a retrace finding exactly like a decode one
+            for b in self.decode_buckets:
+                self._propose_fn(b)
+                self._verify_fn(b)
+            for p in self.prefill_buckets:
+                self._draft_prefill_fn(p)
+            out["speculate_k"] = self._k
+        return out
 
     def _san_sweep(self, pool_ts):
         """tpu-san non-finite guard over the freshly written KV pool: a
@@ -940,6 +1317,18 @@ class DecodeEngine:
                         and not self._evict_for(reserve + fresh,
                                                 keep=entry):
                     return      # not enough headroom yet; retry next round
+                if self._spec_on:
+                    # the draft pool has no prefix cache to evict from:
+                    # its worst case (every live sequence speculating K
+                    # tokens past its final position) must simply fit
+                    dworst = self._draft_worst(plen, seq.max_new)
+                    dreserve = sum(s.draft_outstanding
+                                   for s in self._active) \
+                        + sum(s.draft_outstanding
+                              for s in self._prefill_q)
+                    if self.draft_pool.free_count < dreserve + dworst:
+                        return  # draft headroom pending; retry next round
+                    seq.draft_outstanding = dworst
                 self._waiting.pop(0)
             try:
                 self._begin_sequence(seq, entry)
@@ -1227,6 +1616,22 @@ class DecodeEngine:
                 or seq.generated >= seq.max_new:
             self._finish(seq, "completed")
 
+    def _cow_block(self, seq, bi):
+        """Copy-on-write privatize `seq.blocks[bi]` before a write: one
+        donated dispatch (the pool buffers are aliased in place, so this
+        costs one block's traffic — `pool.copy_block`, the eager
+        fallback, would re-materialize every pool tensor)."""
+        new = self.pool.alloc(1, owner=seq.id)[0]
+        self.pool.tensors = self._cow_fn()(
+            self.pool.tensors,
+            np.asarray(seq.blocks[bi], np.int32),
+            np.asarray(new, np.int32))
+        self.pool.decref([seq.blocks[bi]], owner=seq.id)
+        seq.blocks[bi] = new
+        seq.outstanding -= 1
+        with self._lock:
+            self._cow_copies += 1
+
     def _decode_round(self):
         # step-boundary sweep: cancelled / expired sequences leave before
         # another step is spent on them
@@ -1241,6 +1646,28 @@ class DecodeEngine:
         active = list(self._active)
         if not active:
             return
+        spec = []
+        if self._spec_on:
+            # a sequence speculates when (a) it still wants at least two
+            # tokens (a 1-token remainder is exactly one plain step) and
+            # (b) all K+1 verify rows fit the normal block table — near
+            # max_length (at most the last K tokens) it falls back to
+            # plain steps, keeping the verify gather width identical to
+            # the decode step's (the bit-exactness invariant)
+            limit = self._nb * self.block_size
+            spec = [s for s in active
+                    if s.max_new - s.generated > 1
+                    and s.pos + self._k + 1 <= limit]
+            active = [s for s in active if s not in spec]
+        if spec:
+            # sequences whose draft is still catching up (one chunk per
+            # round) rejoin the plain batch — generation never stalls
+            # behind a long catch-up
+            active += self._speculate_round(spec)
+        if active:
+            self._plain_round(active)
+
+    def _plain_round(self, active):
         # lazy block growth + copy-on-write: the admission reserve
         # guarantees success of both. This step writes each sequence's
         # row at seq.pos — a write landing in a block some OTHER holder
@@ -1255,20 +1682,7 @@ class DecodeEngine:
                 else:
                     bi = seq.pos // self.block_size
                     if self.pool.refcount(seq.blocks[bi]) > 1:
-                        new = self.pool.alloc(1, owner=seq.id)[0]
-                        # one donated dispatch: the pool buffers are
-                        # aliased in place, so this costs one block's
-                        # traffic (pool.copy_block — the eager fallback
-                        # — would re-materialize every pool tensor)
-                        self.pool.tensors = self._cow_fn()(
-                            self.pool.tensors,
-                            np.asarray(seq.blocks[bi], np.int32),
-                            np.asarray(new, np.int32))
-                        self.pool.decref([seq.blocks[bi]], owner=seq.id)
-                        seq.blocks[bi] = new
-                        seq.outstanding -= 1
-                        with self._lock:
-                            self._cow_copies += 1
+                        self._cow_block(seq, bi)
             except OutOfBlocks as e:
                 active.remove(seq)
                 self._finish(seq, "failed", RequestFailed(
@@ -1293,6 +1707,52 @@ class DecodeEngine:
         for seq, tok in zip(active, nxt):
             self._deliver(seq, int(tok))
 
+    def _run_linked_step(self, name, event_name, seqs, hook_tag, info,
+                         dispatch, sweep=False):
+        """Shared scaffolding for every gathered multi-sequence dispatch
+        (plain decode step, speculative propose, speculative verify):
+        fault hook, one step-trace root span LINKING every member
+        sequence's trace id with a per-member back-link event (so a
+        sequence's record shows exactly which shared dispatches carried
+        it), lockcheck blocking region + tpu-san hot region around the
+        XLA call, optional non-finite sweep over the freshly written
+        pool, and the sanctioned host fetch — one implementation, three
+        steps. `dispatch()` runs the compiled program and returns
+        `(new_pool_tensors, host_array)`."""
+        hook = self._fault_hook
+        ids = [s.id for s in seqs]
+        traced = ([s for s in seqs
+                   if s.span.ctx is not None and s.span.ctx.sampled]
+                  if _otrace.enabled() else [])
+        member_extra = {k: v for k, v in info.items() if k != "bucket"}
+
+        def run(_member):
+            if hook is not None:
+                hook(hook_tag, ids, info)
+            step_span = _otrace.null_span() if not traced else \
+                _otrace.root_span(
+                    name,
+                    attrs={**info, "n": len(seqs),
+                           "links": [s.span.trace_id_hex
+                                     for s in traced]},
+                    sampled=True)  # inherit the members' sampling: a
+            #                        dangling back-link helps nobody
+            with step_span, _locks.blocking_region("decode.step_dispatch"):
+                with _san.hot_region("decode.step_dispatch"):
+                    new_pool, host = dispatch()
+                if sweep:
+                    self._san_sweep(new_pool)
+                with _san.allow_host_sync("decode.token_fetch"):
+                    out = new_pool, np.asarray(host)
+            for s in traced:
+                _otrace.event_in(
+                    event_name, s.span.ctx,
+                    attrs={"seq": s.id, "pos": int(s.pos), **member_extra,
+                           "step_trace": step_span.trace_id_hex})
+            return out
+
+        return self._submit_step(run)
+
     def _dispatch_decode(self, active):
         n = len(active)
         bucket = next(b for b in self.decode_buckets if b >= n)
@@ -1306,43 +1766,11 @@ class DecodeEngine:
             positions[i] = seq.pos
             tables[i] = self._padded_table(seq)
         pool_ts = self.pool.tensors
-        hook = self._fault_hook
-        ids = [s.id for s in active]
-        traced = ([s for s in active
-                   if s.span.ctx is not None and s.span.ctx.sampled]
-                  if _otrace.enabled() else [])
-
-        def run(_member):
-            if hook is not None:
-                hook("decode", ids, {"bucket": bucket})
-            # one gathered dispatch serves N sequences: the step is its
-            # own trace (like a formed batch) LINKING every member
-            # sequence's trace id; each member's trace gets a step-join
-            # event back-linking the step, so a sequence's record shows
-            # exactly which shared dispatches carried it
-            step_span = _otrace.null_span() if not traced else \
-                _otrace.root_span(
-                    "decode.step",
-                    attrs={"bucket": bucket, "n": len(active),
-                           "links": [s.span.trace_id_hex
-                                     for s in traced]},
-                    sampled=True)  # inherit the members' sampling: a
-            #                        dangling back-link helps nobody
-            with step_span, _locks.blocking_region("decode.step_dispatch"):
-                with _san.hot_region("decode.step_dispatch"):
-                    new_pool, nxt = fn(pv, bv, pool_ts, tokens, positions,
-                                       tables)
-                self._san_sweep(new_pool)
-                with _san.allow_host_sync("decode.token_fetch"):
-                    out = new_pool, np.asarray(nxt)
-            for s in traced:
-                _otrace.event_in(
-                    "decode.step_join", s.span.ctx,
-                    attrs={"seq": s.id, "pos": int(s.pos),
-                           "step_trace": step_span.trace_id_hex})
-            return out
-
-        new_pool, nxt = self._submit_step(run)
+        new_pool, nxt = self._run_linked_step(
+            "decode.step", "decode.step_join", active, "decode",
+            {"bucket": bucket},
+            lambda: fn(pv, bv, pool_ts, tokens, positions, tables),
+            sweep=True)
         self.pool.tensors = new_pool
         for seq in active:
             seq.pos += 1
@@ -1365,6 +1793,311 @@ class DecodeEngine:
                 continue
             self._deliver(seq, int(nxt[0]))
 
+    # -- speculative decoding round ----------------------------------------
+    # One round per scheduler iteration for every eligible sequence:
+    #   1. draft catch-up   — (re)build the draft's KV over committed
+    #                         tokens where it lags (first round, prefix-
+    #                         cache full hit, post-fallback)
+    #   2. propose          — ONE draft dispatch: K autoregressive tokens
+    #                         per sequence into the draft pool
+    #   3. verify           — ONE target dispatch: K+1 positions scored
+    #                         per sequence (bit-identical per-position
+    #                         program to the plain decode step)
+    #   4. commit/rollback  — greedy acceptance: longest draft prefix
+    #                         matching the target argmax + the target's
+    #                         correction/bonus token committed; rejected
+    #                         positions roll back POSITIONALLY (both
+    #                         pools' rows past the committed position are
+    #                         rewritten before they can ever be attended)
+    # A failed shared propose/verify dispatch falls back to plain
+    # isolated decode from committed state — survivors stay bit-exact and
+    # no uncommitted token is ever delivered.
+
+    def _committed_tokens(self, seq):
+        """Every committed token (prompt + delivered), index == cache
+        position; length is seq.pos + 1 with seq.last_token at the end."""
+        if not seq.stream.tokens:
+            return seq.prompt
+        return np.concatenate(
+            [seq.prompt, np.asarray(seq.stream.tokens, np.int32)])
+
+    def _draft_catchup(self, seq):
+        """Bring the draft's KV toward the committed position: prefill
+        committed tokens [draft_pos, pos) through the draft prefill
+        executables, chunked at block-aligned starts so the block-wise
+        scatter stays exact. Dispatches at most ONE chunk per call (=
+        per scheduler round — the same one-chunk-per-round scheduling
+        chunked prefill uses, so a long catch-up cannot head-of-line
+        block the running batch); returns True when the draft is fully
+        caught up. A still-lagging sequence plain-decodes this round
+        (one token) while catch-up gains a whole chunk per round, so the
+        gap closes whenever the largest block-aligned bucket exceeds
+        the block size plus one; the normal case (the prompt, a full
+        hit, a short post-fallback tail) catches up in one chunk.
+        """
+        if seq.draft_pos >= seq.pos:
+            return True
+        committed = self._committed_tokens(seq)
+        aligned = [b for b in self.prefill_buckets
+                   if b % self.block_size == 0]
+        # the prefill scatter writes block-wise from the chunk's
+        # start block at in-block offset 0, so the chunk start MUST
+        # be block-aligned. draft_pos is unaligned after a
+        # speculative fallback advanced the sequence without the
+        # draft (it froze at the last commit): round DOWN and
+        # re-feed the partial block's committed tokens — recomputing
+        # their (identical) rows is always correct, a shifted
+        # scatter would silently corrupt the draft's KV
+        start = (seq.draft_pos // self.block_size) * self.block_size
+        remaining = seq.pos - start
+        if remaining > self.prefill_buckets[-1]:
+            if not aligned:
+                raise RequestFailed(
+                    f"sequence {seq.id}: draft catch-up of "
+                    f"{remaining} tokens needs a block-aligned "
+                    f"prefill bucket (have {self.prefill_buckets})")
+            this_len = aligned[-1]
+        else:
+            this_len = remaining
+        pbucket = next(p for p in self.prefill_buckets
+                       if p >= this_len)
+        need = self.draft_pool.blocks_for(start + this_len) \
+            - len(seq.draft_blocks)
+        if need > 0:
+            try:
+                seq.draft_blocks += self.draft_pool.alloc(
+                    need, owner=seq.id)
+                seq.draft_outstanding -= need
+            except OutOfBlocks as e:
+                raise RequestFailed(
+                    f"sequence {seq.id}: draft pool exhausted at "
+                    f"catch-up (admission reserve bug)",
+                    cause=e) from e
+        fn = self._draft_prefill_fn(pbucket)
+        pv, bv = self._d_weights()
+        tokens = np.full((1, pbucket), self.pad_token_id, np.int32)
+        tokens[0, :this_len] = committed[start:start + this_len]
+        table = np.zeros(self._nb + self._prefill_tail, np.int32)
+        table[: len(seq.draft_blocks)] = seq.draft_blocks
+        pool_ts = self.draft_pool.tensors
+        hook = self._fault_hook
+        sctx = seq.span.ctx
+
+        def run(_member):
+            if hook is not None:
+                hook("draft_prefill", [seq.id],
+                     {"bucket": pbucket, "start": start,
+                      "tokens": this_len})
+            with _otrace.span_in(
+                    "decode.draft_catchup", sctx,
+                    attrs=None if sctx is None else
+                    {"seq": seq.id, "bucket": pbucket,
+                     "start": start, "tokens": this_len}), \
+                    _locks.blocking_region("decode.step_dispatch"):
+                with _san.hot_region("decode.step_dispatch"):
+                    new_pool, nxt = fn(pv, bv, pool_ts, tokens,
+                                       np.asarray(start, np.int32),
+                                       np.asarray(this_len, np.int32),
+                                       table)
+                # the argmax is discarded (the propose dispatch
+                # starts from last_token) — fetched only to fence
+                # the dispatch for the pool's hang detection
+                with _san.allow_host_sync("decode.token_fetch"):
+                    int(np.asarray(nxt))
+                return new_pool
+
+        self.draft_pool.tensors = self._submit_step(run)
+        seq.draft_pos = start + this_len
+        with self._lock:
+            self._spec_catchup_chunks += 1
+            self._spec_draft_dispatches += 1
+        return seq.draft_pos >= seq.pos
+
+    def _prepare_spec_blocks(self, seq):
+        """Block growth + COW for one speculation round. Target rows
+        `pos .. pos+K` are written this round, but only rows below
+        `plen + max_new` can ever be committed — those get real blocks
+        (within the sequence's existing worst-case reservation); rows
+        past that sink into reserved block 0 through table padding, and
+        their garbage can only influence logits at positions that are
+        themselves uncommittable. Only the block holding `pos` can be
+        shared (shared blocks never extend past the prompt), so the COW
+        rule is unchanged from the plain path."""
+        plen = len(seq.prompt)
+        cap_rows = min(seq.pos + self._k + 1, plen + seq.max_new)
+        need = self.pool.blocks_for(cap_rows) - len(seq.blocks)
+        if need > 0:
+            seq.blocks += self.pool.alloc(need, owner=seq.id)
+            seq.outstanding -= need
+        bi = seq.pos // self.block_size
+        if bi < len(seq.blocks) \
+                and self.pool.refcount(seq.blocks[bi]) > 1:
+            self._cow_block(seq, bi)
+        # the propose scan writes K+1 draft rows (pos .. pos+K — the
+        # last keeps the draft valid through a bonus round)
+        dneed = self.draft_pool.blocks_for(seq.pos + self._k + 1) \
+            - len(seq.draft_blocks)
+        if dneed > 0:
+            seq.draft_blocks += self.draft_pool.alloc(dneed, owner=seq.id)
+            seq.draft_outstanding -= dneed
+
+    def _dispatch_propose(self, seqs):
+        n = len(seqs)
+        bucket = next(b for b in self.decode_buckets if b >= n)
+        fn = self._propose_fn(bucket)
+        pv, bv = self._d_weights()
+        tokens = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, self._nb), np.int32)  # pad rows -> 0
+        for i, seq in enumerate(seqs):
+            tokens[i] = seq.last_token
+            positions[i] = seq.pos
+            tables[i, : len(seq.draft_blocks)] = seq.draft_blocks
+        pool_ts = self.draft_pool.tensors
+        new_pool, props = self._run_linked_step(
+            "decode.speculate", "decode.speculate", seqs, "speculate",
+            {"bucket": bucket, "k": self._k},
+            lambda: fn(pv, bv, pool_ts, tokens, positions, tables))
+        self.draft_pool.tensors = new_pool
+        with self._lock:
+            self._spec_draft_dispatches += 1
+        return props[:n]
+
+    def _dispatch_verify(self, seqs, props):
+        n = len(seqs)
+        bucket = next(b for b in self.decode_buckets if b >= n)
+        fn = self._verify_fn(bucket)
+        pv, bv = self._weights()
+        tokens = np.zeros((bucket, self._k + 1), np.int32)
+        positions = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, self._nb), np.int32)  # pad rows -> 0
+        for i, seq in enumerate(seqs):
+            tokens[i, 0] = seq.last_token
+            tokens[i, 1:] = props[i]
+            positions[i] = seq.pos
+            tables[i] = self._padded_table(seq)
+        pool_ts = self.pool.tensors
+        new_pool, preds = self._run_linked_step(
+            "decode.verify", "decode.verify", seqs, "verify",
+            {"bucket": bucket, "k": self._k},
+            lambda: fn(pv, bv, pool_ts, tokens, positions, tables),
+            sweep=True)
+        self.pool.tensors = new_pool
+        with self._lock:
+            self._spec_verify_dispatches += 1
+        return preds[:n]
+
+    def _speculate_round(self, seqs):
+        """One speculation round; returns the sequences DEFERRED to the
+        plain round because their draft is still catching up (at most
+        one catch-up chunk dispatches per sequence per round)."""
+        ready, deferred = [], []
+        for seq in seqs:
+            try:
+                caught_up = self._draft_catchup(seq)
+            except PoolClosed:
+                return deferred
+            except RequestFailed as e:
+                self._finish(seq, "failed", e)
+                continue
+            except Exception as exc:  # noqa: BLE001 — e.g. an XLA
+                # compile failure: fail THIS sequence, not the scheduler
+                self._finish(seq, "failed", RequestFailed(
+                    f"sequence {seq.id}: draft catch-up error: "
+                    f"{type(exc).__name__}: {exc}", cause=exc))
+                continue
+            if not caught_up:
+                deferred.append(seq)
+                continue
+            try:
+                self._prepare_spec_blocks(seq)
+            except OutOfBlocks as e:
+                self._finish(seq, "failed", RequestFailed(
+                    f"sequence {seq.id}: block pool exhausted preparing "
+                    f"a speculation round (admission reserve bug)",
+                    cause=e))
+                continue
+            ready.append(seq)
+        if not ready:
+            return deferred
+        try:
+            props = self._dispatch_propose(ready)
+            preds = self._dispatch_verify(ready, props)
+        except PoolClosed:
+            return deferred  # engine stopping; shutdown fails leftovers
+        except RequestFailed:
+            # blame is ambiguous in a shared speculative dispatch (and
+            # the fault may be speculation-specific): fall back to plain
+            # ISOLATED decode from the committed state. No uncommitted
+            # token was delivered, the draft rolls back positionally
+            # (draft_pos is untouched), and survivors stay bit-exact —
+            # a genuinely-poisoned sequence then fails alone in its own
+            # single-sequence dispatch.
+            with self._lock:
+                self._spec_fallbacks += 1
+                if len(ready) > 1:
+                    self._isolations += 1
+            self._run_isolated(ready)
+            return deferred
+        with self._lock:
+            self._spec_rounds += 1
+        self._commit_speculation(ready, props, preds)
+        return deferred
+
+    def _commit_speculation(self, seqs, props, preds):
+        """Greedy acceptance + commit: token i+1 is committed iff the
+        draft's proposal equals the target's argmax at position pos+i —
+        and what is COMMITTED is always the target's argmax, so the
+        output token sequence is exactly the plain greedy one."""
+        k = self._k
+        for i, seq in enumerate(seqs):
+            d = [int(x) for x in props[i]]
+            g = [int(x) for x in preds[i]]
+            a = 0
+            while a < k and d[a] == g[a]:
+                a += 1
+            commit = d[:a] + [g[a]]     # accepted + correction/bonus
+            pos0 = seq.pos
+            delivered = 0
+            for tok in commit:
+                self._deliver(seq, tok)
+                delivered += 1
+                if seq.state == _DONE:   # EOS or max_new: stop HERE —
+                    break                # nothing uncommittable leaks out
+            seq.pos = pos0 + delivered
+            # rollback line: rows >= draft_pos are treated invalid and
+            # rewritten before the draft can ever attend them. Valid
+            # draft rows after this round: pos0 + min(delivered, K+1)
+            # — the propose scan wrote rows pos0..pos0+K (the K+1th
+            # keeps a bonus round fully covered), each valid iff its
+            # token was committed, which delivered <= K+1 guarantees
+            seq.draft_pos = seq.pos
+            # acceptance is a DRAFT-QUALITY measure: `a` proposals agreed
+            # with the target, `k - a` disagreed (rejected). A proposal
+            # the target agreed with but EOS/max_new truncated out of
+            # delivery is NOT a rejection — counting it as one would
+            # read a perfect draft as < 1.0 acceptance on every
+            # truncated tail
+            seq.spec_proposed += k
+            seq.spec_accepted += a
+            if seq.span.ctx is not None:
+                _otrace.event_in(
+                    "decode.spec_commit", seq.span.ctx,
+                    attrs={"seq": seq.id, "accepted": a,
+                           "rejected": k - a,
+                           "committed": delivered})
+            with self._lock:
+                # proposed is counted HERE, not at propose-dispatch time:
+                # a fallback round's proposals are never judged, and
+                # counting them would break proposed == accepted +
+                # rejected and read a fault as a draft-quality dip
+                self._spec_proposed += k
+                self._spec_accepted += a
+                self._spec_rejected += k - a
+                self._spec_committed += delivered
+                if delivered == k + 1:
+                    self._spec_bonus += 1
+
     # -- lifecycle ---------------------------------------------------------
     def _finish(self, seq, status, error=None):
         with self._cv:
@@ -1382,6 +2115,9 @@ class DecodeEngine:
         # drops every reference this sequence holds: exclusive blocks
         # free, shared prefix blocks stay for their other holders
         self.pool.free_owned(seq.id)
+        if self._spec_on:
+            self.draft_pool.free_owned(seq.id)
+            seq.draft_outstanding = 0
         if status == "completed":
             self._completed += 1
         elif status == "failed":
@@ -1509,11 +2245,37 @@ class DecodeEngine:
                 "buckets": {"decode": list(self.decode_buckets),
                             "prefill": list(self.prefill_buckets),
                             "prefill_chunk": self._chunk},
+                "speculative": {
+                    "enabled": self._spec_on,
+                    "k": self._k if self._spec_on else 0,
+                    "rounds": self._spec_rounds,
+                    "proposed": self._spec_proposed,
+                    "accepted": self._spec_accepted,
+                    # proposals the TARGET disagreed with (their draft
+                    # KV rows roll back positionally; truncation-
+                    # discarded agreements are not rejections)
+                    "rejected": self._spec_rejected,
+                    "bonus": self._spec_bonus,
+                    "committed": self._spec_committed,
+                    "verify_dispatches": self._spec_verify_dispatches,
+                    "draft_dispatches": self._spec_draft_dispatches,
+                    "catchup_chunks": self._spec_catchup_chunks,
+                    "fallbacks": self._spec_fallbacks,
+                    "acceptance_rate":
+                        (self._spec_accepted / self._spec_proposed)
+                        if self._spec_proposed else 0.0,
+                    "accepted_per_dispatch":
+                        (self._spec_committed
+                         / self._spec_verify_dispatches)
+                        if self._spec_verify_dispatches else 0.0,
+                },
             }
         th = self._h_ttft.snapshot()
         snap["ttft"] = {"count": th["count"], "avg_s": th["avg"],
                         "p50_s": th["p50"], "p99_s": th["p99"]}
         snap["blocks"] = self.pool.stats()
+        if self._spec_on:
+            snap["draft_blocks"] = self.draft_pool.stats()
         snap["step_pool"] = self._steps.stats()
         if self.mesh is not None:
             from ... import sharding as _shardlib

@@ -237,7 +237,7 @@ def _aval_signature(avals):
 
 def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
                 in_shardings=None, out_shardings=None, audit_ctx=None,
-                donate_argnums=None):
+                donate_argnums=None, extra_key=None):
     """AOT-compile (or cache-load) `fn` over an aval pytree, persisting the
     executable like `compile_batched` does for bucket executables.
 
@@ -247,7 +247,13 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
     of NamedShardings matching `avals`) compiles the program partitioned
     over those placements — the decode engine's tensor-parallel path; it
     joins the cache key, so a TP executable never collides with the
-    single-device one. Returns `(compiled, source)` where
+    single-device one. `extra_key` (any str()-able value) joins both the
+    persistent-cache key and the retrace-sentinel signature: callers whose
+    traced program depends on configuration `fn` CLOSES OVER — the decode
+    engine's speculative propose/verify steps close over `speculate_k`,
+    and two K values can share identical input avals — must pass it, or a
+    stale executable for a different configuration could be resurrected
+    from disk. Returns `(compiled, source)` where
     `compiled(*args)` runs the executable and `source` is "compiled"
     (built here, persisted when a fingerprint was given) or "disk"
     (loaded from the persistent cache, zero XLA compilation).
@@ -266,7 +272,9 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
         key = CompileCache.key(tag, fingerprint, _aval_signature(avals),
                                *_versions(),
                                *(("shardings", sig) if sig != (None, None)
-                                 else ()))
+                                 else ()),
+                               *(("extra", extra_key)
+                                 if extra_key is not None else ()))
         blob = cache.get(key)
         if blob is not None:
             try:
@@ -289,7 +297,10 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
             # the "sharding:" tag routes a placement-only delta into the
             # retrace blame as a sharding-signature change
             (_san.aval_signature(avals),
-             "sharding:" + str(_sharding_sig(in_shardings))))
+             "sharding:" + str(_sharding_sig(in_shardings)),
+             # closed-over configuration (e.g. speculate_k): two programs
+             # with identical avals must not look like a duplicate compile
+             "extra:" + str(extra_key)))
     with _locks.blocking_region("aot.compile"):
         kw = {}
         if donate_argnums is not None:

@@ -434,7 +434,7 @@ class GPTForCausalLM(nn.Layer):
                 for _ in range(cfg.num_layers)]
 
     def init_block_pool(self, num_blocks, block_size, dtype=None,
-                        quant=None):
+                        quant=None, name=None):
         """Paged twin of `init_cache`: a `BlockKVCache` whose per-layer
         pool tensors use exactly this model's cache-entry order and
         dtypes — `(k, v)` blocks of the parameter dtype, or int8
@@ -442,7 +442,10 @@ class GPTForCausalLM(nn.Layer):
         [N, bs, Hkv] f32 scales). Quant precedence and error semantics
         are shared with `init_cache` (`_resolve_cache_quant`). The
         continuous-batching `DecodeEngine` calls this so cache geometry
-        is owned by the model, not the scheduler."""
+        is owned by the model, not the scheduler; with speculative
+        decoding on, the engine calls it on BOTH the target and the
+        draft model (`name` tags whose pool is whose — each model owns
+        its own layer count / head geometry)."""
         from ..inference.decode.block_pool import BlockKVCache
 
         cfg = self.cfg
@@ -456,7 +459,8 @@ class GPTForCausalLM(nn.Layer):
         else:
             layer = ((suffix, dtype), (suffix, dtype))
         return BlockKVCache(num_blocks, block_size,
-                            [layer] * cfg.num_layers, quant=quant)
+                            [layer] * cfg.num_layers, quant=quant,
+                            name=name)
 
     def decode_step(self, input_ids, caches, pos):
         """Cached decode step: logits for input_ids at global offset pos

@@ -101,11 +101,13 @@ def load_baseline(path):
     return data.get("objectives", {})
 
 
-def write_baseline(path, values, objectives, note=""):
+def write_baseline(path, values, objectives, note="", merge=None):
     """Ratchet: freeze bounds from `values` (objective name -> measured
     float) with each objective's slack applied. Returns the written
-    mapping."""
-    objs = {}
+    mapping. `merge` (a mapping from `load_baseline`) carries over
+    existing rows for objectives not being re-ratcheted — e.g. the conv
+    bench gate ratchets one platform's rows at a time."""
+    objs = dict(merge) if merge else {}
     for obj in objectives:
         if obj.name not in values:
             raise KeyError(f"no measured value for objective {obj.name!r}")

@@ -18,7 +18,7 @@ def test_cls_finetune_loss_drops():
     ids = paddle.to_tensor(rng.randint(0, 1024, (8, 32)).astype(np.int32))
     y = paddle.to_tensor(rng.randint(0, 3, 8).astype(np.int64))
     losses = []
-    for _ in range(12):
+    for _ in range(10):  # suite budget: the 0.7x drop lands before 10
         loss = m.loss(ids, y)
         loss.backward()
         opt.step()

@@ -39,7 +39,7 @@ def test_gpt_loss_decreases_with_sgd():
                                parameters=m.parameters())
     ids = paddle.to_tensor(np.random.randint(0, 64, (4, 16)).astype("int32"))
     losses = []
-    for _ in range(5):
+    for _ in range(3):   # suite budget: SGD at 0.1 separates in 3 steps
         loss = m.loss(ids)
         loss.backward()
         opt.step()
@@ -96,8 +96,8 @@ def test_conformer_ctc_trains():
     opt = paddle.optimizer.AdamW(learning_rate=1e-3,
                                  parameters=model.parameters())
     losses = []
-    for _ in range(4):   # suite-budget trim: 6 -> 4 eager steps (same
-        loss = model.loss(feats, labels)   # decreasing-loss assertion)
+    for _ in range(3):   # suite-budget trim: 6 -> 4 -> 3 eager steps
+        loss = model.loss(feats, labels)   # (same decreasing-loss bar)
         loss.backward()
         opt.step()
         opt.clear_grad()

@@ -88,13 +88,16 @@ def test_dataset_folder(tmp_path):
 
 # ---- models ---------------------------------------------------------------
 
+# suite-budget note: batch 1 + the smallest spatial size each stem
+# supports — these are eager SHAPE tests (per-op dispatch dominates),
+# so the assertions are identical at a fraction of the conv compute
 @pytest.mark.parametrize("name,ctor_kw,in_shape", [
     ("LeNet", dict(num_classes=10), (2, 1, 28, 28)),
-    ("alexnet", dict(num_classes=7), (2, 3, 224, 224)),
+    ("alexnet", dict(num_classes=7), (1, 3, 224, 224)),
     ("vgg11", dict(num_classes=5), (1, 3, 64, 64)),
-    ("mobilenet_v1", dict(num_classes=6, scale=0.25), (2, 3, 64, 64)),
-    ("mobilenet_v2", dict(num_classes=6, scale=0.25), (2, 3, 64, 64)),
-    ("squeezenet1_1", dict(num_classes=4), (2, 3, 64, 64)),
+    ("mobilenet_v1", dict(num_classes=6, scale=0.25), (1, 3, 32, 32)),
+    ("mobilenet_v2", dict(num_classes=6, scale=0.25), (1, 3, 32, 32)),
+    ("squeezenet1_1", dict(num_classes=4), (1, 3, 32, 32)),
 ])
 def test_model_forward_shapes(name, ctor_kw, in_shape):
     import paddle_tpu.vision as vision
@@ -183,9 +186,9 @@ def test_vit_forward_and_trains():
                                  parameters=model.parameters())
     y = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64) % 10)
     losses = []
-    for _ in range(8):
+    for _ in range(4):   # suite budget: 4 AdamW steps already separate
         loss = paddle.nn.functional.cross_entropy(model(x), y)
-        loss.backward()
+        loss.backward()  # a learning model from a broken one
         opt.step()
         opt.clear_grad()
         losses.append(float(loss))

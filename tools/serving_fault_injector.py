@@ -68,6 +68,14 @@ fault-free solo run):
                  feed) — typed RequestFailed for it alone;
   decode-none    fault-free control (also produces the per-prompt solo
                  reference tokens the other phases compare against);
+  decode-spec    SPECULATIVE decoding (draft-proposed, one-dispatch
+                 verified) under faults: one shared verify dispatch is
+                 poisoned mid-round (the engine falls back to plain
+                 isolated decode — no uncommitted token leaks) and one
+                 sequence is cancelled mid-generation. Survivors must be
+                 BIT-EXACT vs the non-speculative references, draft AND
+                 target block pools must conserve, and the whole phase
+                 runs with zero post-warmup retraces (tpu-san);
   decode-cow     N sequences share a cached prompt prefix (refcounted
                  blocks, one physical copy; chunked prefill); one is
                  cancelled mid-decode. Refcount conservation must hold,
@@ -188,7 +196,7 @@ def _san_mark_warm():
 PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "batch-crash", "batch-hang", "batch-poison",
           "decode-none", "decode-kill", "decode-wedge", "decode-poison",
-          "decode-cow",
+          "decode-cow", "decode-spec",
           "router-none", "router-kill", "router-wedge",
           "router-swap", "router-swap-kill")
 
@@ -833,6 +841,162 @@ def run_decode_cow_phase(phase, model, verbose=True):
     return bad
 
 
+def _decode_spec_draft(model):
+    """The speculation draft: the target's own init perturbed on one MLP
+    block — it agrees with the target often enough that acceptance
+    actually pays, but not always, so rejections/corrections (the
+    rollback path) genuinely run during the phase."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt
+
+    paddle.seed(7)
+    d = gpt("gpt_tiny", vocab_size=DECODE_VOCAB, hidden_size=48,
+            num_heads=4, num_kv_heads=2, num_layers=2, rope=True,
+            swiglu=True, rms_norm=True, max_position_embeddings=64,
+            tie_word_embeddings=False)
+    d.eval()
+    rng = np.random.RandomState(11)
+    perturbed = 0
+    for name, p in d.named_parameters():
+        if "layers.1.mlp" in name:
+            p._value = p._value + np.asarray(
+                rng.normal(0, 2e-2, p.shape), p._value.dtype)
+            perturbed += 1
+    assert perturbed, "draft perturbation filter matched no parameter"
+    return d
+
+
+def run_decode_spec_phase(phase, model, verbose=True):
+    """Speculative decoding under faults: a poisoned shared VERIFY
+    dispatch must fall back to plain isolated decode (bit-exact
+    survivors, zero uncommitted tokens delivered), a mid-generation
+    cancel must spare its round-mates, and both block pools (draft +
+    target) must conserve through every path."""
+    from paddle_tpu.inference import (DeadlineExceeded, DecodeEngine,
+                                      Overloaded, PoolClosed,
+                                      RequestFailed, ServingError)
+
+    bad = []
+    t0 = time.monotonic()
+    refs = _decode_references(model)
+    prompts = _decode_prompts()
+    draft = _decode_spec_draft(model)
+    victim_seed = DECODE_SEQS[2][0]
+    inj = {"armed": True, "injected": 0, "lock": threading.Lock()}
+
+    def hook(stage, seq_ids, meta):
+        with inj["lock"]:
+            if inj["armed"] and stage == "verify" and len(seq_ids) > 1:
+                inj["armed"] = False
+                inj["injected"] += 1
+                raise ValueError(
+                    f"injected poisoned verify dispatch for sequences "
+                    f"{seq_ids}")
+
+    # geometry shared with _decode_engine so the target-side executables
+    # disk-hit; only the draft/propose/verify programs compile here (one
+    # bucket — the harness budget; cross-bucket identity is proven by
+    # comparing against the references' solo bucket-1 decodes)
+    eng = DecodeEngine(model, max_length=32, block_size=8,
+                       decode_buckets=(8,), prefill_buckets=(8,),
+                       default_timeout=30.0, step_timeout=STEP_TIMEOUT,
+                       step_retries=2, hang_grace=0.05,
+                       supervise_interval=0.01, fault_hook=hook,
+                       draft_model=draft, speculate_k=3)
+    eng.warmup()
+    _san_mark_warm()   # speculation traffic must never compile again
+    streams = {}
+    outcomes = {}
+    try:
+        for seed, _, max_new in DECODE_SEQS:
+            streams[seed] = eng.submit(prompts[seed], max_new)
+        v = streams[victim_seed]
+        next(iter(v))                  # definitely mid-generation
+        v.cancel()
+        for seed, _, _ in DECODE_SEQS:
+            s = streams[seed]
+            try:
+                toks = s.result()
+                outcomes[seed] = "ok"
+                if toks != refs[seed]:
+                    bad.append(f"[{phase}] sequence {seed} diverged from "
+                               f"the non-speculative reference: {toks} "
+                               f"vs {refs[seed]}")
+            except PoolClosed:
+                outcomes[seed] = "cancelled"
+            except (DeadlineExceeded, Overloaded, RequestFailed) as e:
+                outcomes[seed] = type(e).__name__
+                bad.append(f"[{phase}] sequence {seed} failed "
+                           f"unexpectedly: {e}")
+            except ServingError as e:
+                outcomes[seed] = f"unexpected-typed:{e}"
+                bad.append(f"[{phase}] sequence {seed} -> unexpected "
+                           f"typed error: {e}")
+            except BaseException as e:  # noqa: BLE001 — untyped = bug
+                outcomes[seed] = f"untyped:{type(e).__name__}"
+                bad.append(f"[{phase}] sequence {seed} -> UNTYPED error: "
+                           f"{type(e).__name__}: {e}")
+        if outcomes.get(victim_seed) != "cancelled":
+            bad.append(f"[{phase}] victim outcome "
+                       f"{outcomes.get(victim_seed)} != cancelled")
+        ok = sum(1 for o in outcomes.values() if o == "ok")
+        if ok != len(DECODE_SEQS) - 1:
+            bad.append(f"[{phase}] exactly the cancelled sequence must "
+                       f"fail: {outcomes}")
+        if inj["injected"] == 0:
+            bad.append(f"[{phase}] harness error: no verify dispatch was "
+                       f"ever poisoned")
+        st = eng.stats()
+        sp = st["speculative"]
+        if not sp["enabled"] or sp["proposed"] == 0 or sp["committed"] == 0:
+            bad.append(f"[{phase}] speculation never ran: {sp}")
+        if sp["fallbacks"] < 1:
+            bad.append(f"[{phase}] the poisoned verify dispatch never "
+                       f"fell back to plain decode: {sp}")
+        if sp["accepted"] == 0:
+            bad.append(f"[{phase}] the draft never had a proposal "
+                       f"accepted — speculation was vacuous: {sp}")
+        if sp["rejected"] == 0:
+            bad.append(f"[{phase}] the perturbed draft never DISAGREED "
+                       f"with the target — the rejection/rollback path "
+                       f"ran vacuously: {sp}")
+        lhs = st["admitted"]
+        rhs = (st["completed"] + st["failed"] + st["timed_out"]
+               + st["cancelled"])
+        if lhs != rhs or st["active"] or st["waiting"]:
+            bad.append(f"[{phase}] engine conservation violated: "
+                       f"admitted={lhs} != {rhs}")
+    finally:
+        drained = eng.shutdown(drain_timeout=10.0)
+    if not drained:
+        bad.append(f"[{phase}] engine failed to drain")
+    # BOTH pools must conserve: zero leaked blocks/references — an
+    # uncommitted speculative token leaking a draft row would show here
+    final = eng.stats()
+    for key in ("blocks", "draft_blocks"):
+        bs = final[key]
+        if bs["allocated"] != 0 or bs["free"] + bs["reserved"] \
+                != bs["total"]:
+            bad.append(f"[{phase}] BLOCK LEAK in {bs['name']} pool: {bs}")
+        if bs["allocs"] != bs["frees"]:
+            bad.append(f"[{phase}] alloc/free imbalance in {bs['name']} "
+                       f"pool: {bs}")
+        if bs["shared_refs"] != 0:
+            bad.append(f"[{phase}] dangling shared references in "
+                       f"{bs['name']} pool: {bs}")
+    if verbose:
+        sp = final["speculative"]
+        tag = "FAIL" if bad else "ok"
+        print(f"  {phase:<13} -> {tag}  (rounds={sp['rounds']}, "
+              f"accepted={sp['accepted']}/{sp['proposed']}, "
+              f"rolled_back={sp['rejected']}, "
+              f"per_dispatch={sp['accepted_per_dispatch']:.2f}, "
+              f"fallbacks={sp['fallbacks']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
 # ---------------------------------------------------------------------------
 # router (distributed serving tier) phases
 # ---------------------------------------------------------------------------
@@ -1149,6 +1313,8 @@ def main(argv=None):
             for phase in decode_phases:
                 if phase == "decode-cow":
                     violations += run_decode_cow_phase(phase, dmodel)
+                elif phase == "decode-spec":
+                    violations += run_decode_spec_phase(phase, dmodel)
                 else:
                     violations += run_decode_phase(phase, dmodel)
         if router_phases:
