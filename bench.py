@@ -230,9 +230,12 @@ def bench_resnet50(on_tpu, dev):
     size = 224 if on_tpu else 64
     # channels-last is the MXU-native conv layout on TPU: it removes the
     # relayout transposes XLA wraps around NCHW convs (measured ~2x MFU on
-    # the train step); BENCH_RESNET_FORMAT=NCHW measures the parity layout
-    fmt = os.environ.get("BENCH_RESNET_FORMAT",
-                         "NHWC" if on_tpu else "NCHW")
+    # the train step). The CPU smoke now defaults NHWC too — ROADMAP
+    # item 1 lever (b): graphcheck GC003 proves the NHWC conv region
+    # transpose-free (graph_audit engine smoke + the planted-NCHW test),
+    # so the smoke exercises the layout the TPU rows ship with;
+    # BENCH_RESNET_FORMAT=NCHW measures the parity layout
+    fmt = os.environ.get("BENCH_RESNET_FORMAT", "NHWC")
     model_fn, train_flops_img = (
         (resnet50, 3 * 4.1e9) if on_tpu else (resnet18, 3 * 1.8e9))
 
@@ -353,8 +356,10 @@ def bench_ppyoloe(on_tpu, dev):
     size = 640 if on_tpu else 128
     max_boxes = 16
     # channels-last is the MXU-native conv layout (same lever as the
-    # resnet config; NCHW<->NHWC loss parity is tested in-tree)
-    fmt = os.environ.get("BENCH_YOLO_FORMAT", "NHWC" if on_tpu else "NCHW")
+    # resnet config; NCHW<->NHWC loss parity is tested in-tree). CPU
+    # smoke defaults NHWC too — ROADMAP item 1 lever (b), GC003-proven
+    # transpose-free; BENCH_YOLO_FORMAT=NCHW measures the parity layout
+    fmt = os.environ.get("BENCH_YOLO_FORMAT", "NHWC")
 
     def loss_fn(m, img, gb, gl, gm):
         return m.loss(img, gb, gl, gm)
@@ -811,6 +816,213 @@ def bench_slo(on_tpu, dev):
         "extra": {"values": {k: round(v, 6) for k, v in values.items()},
                   "results": report["results"],
                   "platform": dev.platform},
+    })
+    return payload if report["ok"] else None
+
+
+POD_BASELINE_FILENAME = "POD_BASELINE.json"
+
+
+def _pod_objectives(on_tpu):
+    """Declared objectives for the BENCH_POD gate. The CPU smoke mixes
+    two DETERMINISTIC gates (dispatch count per step, per-chip param+opt
+    state shrink — pure placement math, slack ~1) with a generous-slack
+    throughput floor; TPU rows ratchet tokens/sec on the first hardware
+    round, like the conv gate."""
+    from paddle_tpu.obs.slo import Objective
+
+    if on_tpu:
+        return [Objective(
+            "pod_smoke.tpu_fsdp_tokens_per_sec", "min",
+            description="tokens/sec/chip of the fsdp-sharded GPT train "
+                        "step on the real device mesh",
+            unit="tok/s", slack=2.0)]
+    return [
+        Objective("pod_smoke.fsdp_tokens_per_sec", "min",
+                  description="tokens/sec of the fsdp=8 GPT CPU-mesh "
+                              "smoke (8 virtual devices, multi-step "
+                              "scan path)",
+                  unit="tok/s", slack=5.0),
+        Objective("pod_smoke.dispatches_per_step", "max",
+                  description="compiled-program dispatches per optimizer "
+                              "step of the measured fsdp loop "
+                              "(train_batches k-step scan: 1/k; "
+                              "deterministic engine counter, not "
+                              "wall-clock)",
+                  unit="dispatches/step", slack=1.0),
+        Objective("pod_smoke.fsdp_state_shrink", "min",
+                  description="per-chip param+optimizer-state bytes, "
+                              "dp-replicated / fsdp-sharded — the "
+                              "fsdp-fits-where-dp-OOMs lever; "
+                              "deterministic placement math "
+                              "(graphcheck params_bytes_per_chip)",
+                  unit="x", slack=1.1),
+    ]
+
+
+def bench_pod(on_tpu, dev):
+    """BENCH_POD=1: pod-scale training defaults gate (ROADMAP item 3).
+
+    Trains the GPT flagship config (gpt_tiny CPU smoke) through
+    `MeshConfig(dp=8)` and `MeshConfig(fsdp=8)` engines on the
+    8-virtual-device mesh and gates, via the checked-in POD_BASELINE.json
+    ratchet (slo machinery, BENCH_POD_WRITE=1 re-ratchets):
+
+    * loss parity dp vs fsdp <= 1e-5 at every step (hard gate — the
+      in-graph gather/reduce-scatter must be semantically invisible);
+    * dispatches/step of the measured loop (deterministic engine
+      counter: the fsdp path must stay on the k-step scan hot path —
+      dispatch/collective overlap is bought at dispatch granularity);
+    * per-chip param+opt-state shrink dp/fsdp ~ N (deterministic
+      placement math — the memory lever that makes 7B+ fit); the run
+      also reports the "fits where dp OOMs" budget bracket;
+    * fsdp tokens/sec floor (generous slack: CPU timing).
+    """
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.analysis.graphcheck import params_bytes_per_chip
+    from paddle_tpu.distributed import topology as topo_mod
+    from paddle_tpu.models import gpt
+    from paddle_tpu.obs import slo as slo_mod
+    from paddle_tpu.sharding import MeshConfig
+
+    n_dev = len(jax.devices())
+    ways = int(os.environ.get("BENCH_POD_WAYS", "8"))
+    if n_dev < ways:
+        if on_tpu and n_dev >= 2:
+            ways = n_dev
+        else:
+            print(f"bench_pod: needs {ways} devices, have {n_dev} "
+                  f"(CPU smokes force 8 virtual devices via main()); "
+                  f"gate skipped", file=sys.stderr)
+            return {"metric": "pod gate (skipped: too few devices)",
+                    "value": 0, "unit": "objectives passed",
+                    "vs_baseline": 1.0, "extra": {"devices": n_dev}}
+
+    name = "gpt_tiny" if not on_tpu else os.environ.get(
+        "BENCH_MODEL", "gpt_base")
+    seq = int(os.environ.get("BENCH_SEQLEN", "64" if not on_tpu else "1024"))
+    batch = int(os.environ.get("BENCH_BATCH", str(ways)))
+    steps = int(os.environ.get("BENCH_POD_STEPS", "8"))
+    k = _multistep_k(steps)
+
+    rng = np.random.RandomState(0)
+    from paddle_tpu.models.gpt import CONFIGS
+
+    vocab = CONFIGS[name]["vocab_size"]
+    ids = paddle.to_tensor(
+        rng.randint(0, vocab, (batch, seq)).astype("int32"))
+
+    def make_engine(cfg):
+        topo_mod.set_hybrid_communicate_group(None)
+        paddle.seed(0)
+        model = gpt(name, max_position_embeddings=max(
+            seq, CONFIGS[name].get("max_position_embeddings", seq)))
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-4, parameters=model.parameters(),
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+        return dist.parallelize(
+            model, opt, mesh=cfg,
+            compute_dtype="bfloat16" if on_tpu else None)
+
+    def run(cfg):
+        def attempt():
+            eng = make_engine(cfg)
+            lv = eng.train_batches([(ids,)] * k)       # warmup/compile
+            float(lv.numpy()[-1])
+            d0, s0 = eng.stats["dispatches"], eng.stats["steps"]
+            losses = []
+            t0 = time.perf_counter()
+            for _ in range(steps // k):
+                lv = eng.train_batches([(ids,)] * k)
+                losses.extend(float(x) for x in np.asarray(lv.numpy()))
+            dt = time.perf_counter() - t0
+            return (eng, losses, dt,
+                    eng.stats["dispatches"] - d0, eng.stats["steps"] - s0)
+
+        return _retry_transient(attempt, label="pod bench")
+
+    def state_bytes(eng):
+        # the same declared param+opt-state set the graphcheck
+        # <site>::params watermark audits — one enumeration, one gate
+        return params_bytes_per_chip(*eng.declared_state(), eng.mesh)
+
+    fs_eng, fs_losses, fs_dt, fs_disp, fs_steps = run(MeshConfig(fsdp=ways))
+    dp_eng, dp_losses, dp_dt, _d, _s = run(MeshConfig(dp=ways))
+
+    # hard gate: the fsdp placement must be semantically invisible
+    parity = max(abs(a - b) for a, b in zip(dp_losses, fs_losses))
+    if parity > 1e-5:
+        print(f"bench_pod: dp-vs-fsdp loss parity broken "
+              f"(max |diff| {parity:.3e} > 1e-5)\n  dp   {dp_losses}\n"
+              f"  fsdp {fs_losses}", file=sys.stderr)
+        return None
+
+    dp_bytes, fs_bytes = state_bytes(dp_eng), state_bytes(fs_eng)
+    shrink = dp_bytes / max(fs_bytes, 1)
+    # the fits-where-dp-OOMs bracket: any per-chip budget between the two
+    # residencies admits the fsdp placement and rejects dp-replicated
+    budget = (dp_bytes + fs_bytes) // 2
+    tok_s = batch * seq * steps / fs_dt
+
+    values = {}
+    if on_tpu:
+        values["pod_smoke.tpu_fsdp_tokens_per_sec"] = tok_s
+    else:
+        values["pod_smoke.fsdp_tokens_per_sec"] = tok_s
+        values["pod_smoke.dispatches_per_step"] = fs_disp / max(fs_steps, 1)
+        values["pod_smoke.fsdp_state_shrink"] = shrink
+
+    objectives = _pod_objectives(on_tpu)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        POD_BASELINE_FILENAME)
+    try:
+        entries = slo_mod.load_baseline(path)
+    except FileNotFoundError:
+        entries = {}
+    if os.environ.get("BENCH_POD_WRITE") == "1":
+        entries = slo_mod.write_baseline(
+            path, values, objectives,
+            note="pod-scale fsdp training gate (ROADMAP item 3): CPU "
+                 "deterministic dispatch/memory gates + throughput "
+                 "floor; TPU rows ratchet on the first hardware round "
+                 "with BENCH_POD_WRITE=1",
+            merge=entries)
+        print(f"bench_pod: ratcheted {sorted(values)} -> {path}",
+              file=sys.stderr)
+
+    missing = [o.name for o in objectives if o.name not in entries]
+    extra = {
+        "loss_parity_max_diff": parity,
+        "dp_state_bytes_per_chip": int(dp_bytes),
+        "fsdp_state_bytes_per_chip": int(fs_bytes),
+        "fits_budget_bytes": int(budget),
+        "dp_fits": bool(dp_bytes <= budget),
+        "fsdp_fits": bool(fs_bytes <= budget),
+        "steps_per_dispatch": k,
+        "dp_tokens_per_sec": round(batch * seq * steps / dp_dt, 2),
+        "mesh_ways": ways, "model": name, "seq": seq, "batch": batch,
+        "platform": dev.platform,
+    }
+    if missing:
+        print(f"bench_pod: no ratcheted bound yet for {missing} on this "
+              f"platform — BENCH_POD_WRITE=1 ratchets; gate skipped",
+              file=sys.stderr)
+        report = {"ok": True, "results": [], "breaches": []}
+    else:
+        report = slo_mod.evaluate(values, entries, objectives)
+        print(slo_mod.format_report(report), file=sys.stderr)
+    payload = _emit({
+        "metric": f"POD gate ({len(report['results'])} objectives, "
+                  f"{name} dp vs fsdp x{ways}, {steps} steps)",
+        "value": round(tok_s, 2),
+        "unit": "tokens/sec (fsdp)",
+        "vs_baseline": 1.0 if report["ok"] else 0.0,
+        "extra": dict(extra,
+                      values={n: round(v, 6) for n, v in values.items()},
+                      results=report["results"]),
     })
     return payload if report["ok"] else None
 
@@ -1355,11 +1567,25 @@ def bench_gpt(on_tpu, dev):
 
 
 def main():
+    if os.environ.get("BENCH_POD") == "1" and \
+            "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        # the pod gate's CPU smoke needs the 8-virtual-device mesh, and
+        # the flag must land BEFORE jax initializes its backend
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
     import jax
 
     # one-chip bench (the driver runs on a single real TPU chip)
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu" or "TPU" in str(dev.device_kind)
+
+    if os.environ.get("BENCH_POD") == "1":
+        # pod-scale training defaults gate: dp-vs-fsdp on the (virtual)
+        # pod mesh against the checked-in POD_BASELINE.json ratchet
+        return 0 if bench_pod(on_tpu, dev) else 1
 
     if os.environ.get("BENCH_SLO") == "1":
         # perf-SLO regression gate: declared objectives vs the checked-in

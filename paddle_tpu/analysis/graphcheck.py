@@ -64,7 +64,8 @@ __all__ = [
     "RULES", "Finding", "enable", "disable", "enabled", "reset",
     "audit_executable", "findings", "counts_by_key", "watermarks",
     "report", "assert_clean", "load_baseline", "write_baseline",
-    "new_counts", "new_watermarks", "jaxpr_watermark", "GraphCheckError",
+    "new_counts", "new_watermarks", "jaxpr_watermark",
+    "params_bytes_per_chip", "GraphCheckError",
     "OBS_COLLECTOR",
 ]
 
@@ -504,6 +505,24 @@ def jaxpr_watermark(jaxpr):
     return peak
 
 
+def params_bytes_per_chip(param_avals, param_specs, mesh):
+    """Estimated per-chip residency (bytes) of the entrypoint's declared
+    parameter/state set: each aval's bytes scaled by its spec's shard
+    fraction on `mesh`. The jaxpr watermark above is GLOBAL logical bytes
+    — avals don't shrink when a tensor shards — so the fsdp memory story
+    ("params + optimizer state hold ~1/N per chip") needs this sibling
+    number. Deterministic given (avals, specs, mesh), which is what the
+    per-site GC006 ratchet requires; recorded under ``<site>::params``."""
+    from ..sharding import shard_fraction
+
+    total = 0.0
+    for n, aval in param_avals.items():
+        spec = param_specs.get(n)
+        frac = shard_fraction(spec, mesh) if spec is not None else 1.0
+        total += _aval_bytes(aval) * frac
+    return int(total)
+
+
 # -- GC001 / GC002 helpers ---------------------------------------------------
 
 def _spec_axes(spec):
@@ -640,6 +659,12 @@ def audit_executable(site, *, jit_obj=None, args=None, fn=None,
             rec("GC004", msg)
         watermark = jaxpr_watermark(jaxpr)
         _registry.note_watermark(site, watermark)
+        if param_avals and param_specs is not None and mesh is not None:
+            # per-chip param/state residency rides the same watermark
+            # ratchet under its own site key (see params_bytes_per_chip)
+            _registry.note_watermark(
+                site + "::params",
+                params_bytes_per_chip(param_avals, param_specs, mesh))
         budget_mb = _env_float(_ENV_MEM_MB, 0.0)
         if budget_mb and watermark > budget_mb * (1 << 20):
             rec("GC006",

@@ -82,6 +82,7 @@ from .auto_parallel.api import Placement  # noqa: F401,E402
 from .checkpoint.api import (  # noqa: F401,E402
     save_state_dict, load_state_dict,
     CheckpointError, CheckpointNotCommittedError, CheckpointCorruptError,
+    CheckpointShardMismatchError,
 )
 from .checkpoint.manager import CheckpointManager  # noqa: F401,E402
 from . import launch  # noqa: F401,E402

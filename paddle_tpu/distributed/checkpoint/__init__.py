@@ -24,6 +24,6 @@ from .api import (  # noqa: F401
     save_state_dict, load_state_dict, load_extra, is_committed,
     commit_generation, LocalTensorMetadata, Metadata, AsyncCheckpointSave,
     CheckpointError, CheckpointNotCommittedError, CheckpointCorruptError,
-    COMMITTED_SENTINEL,
+    CheckpointShardMismatchError, COMMITTED_SENTINEL,
 )
 from .manager import CheckpointManager, clean_uncommitted  # noqa: F401

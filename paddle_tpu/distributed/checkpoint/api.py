@@ -54,7 +54,7 @@ __all__ = ["save_state_dict", "load_state_dict", "load_extra",
            "is_committed", "commit_generation", "write_commit_sentinel",
            "LocalTensorMetadata", "Metadata", "CheckpointError",
            "CheckpointNotCommittedError", "CheckpointCorruptError",
-           "COMMITTED_SENTINEL"]
+           "CheckpointShardMismatchError", "COMMITTED_SENTINEL"]
 
 COMMITTED_SENTINEL = "_COMMITTED"
 MANIFEST_FORMAT = 1
@@ -72,6 +72,25 @@ class CheckpointNotCommittedError(CheckpointError):
 class CheckpointCorruptError(CheckpointError):
     """A committed checkpoint failed integrity verification (size or
     digest mismatch, unreadable payload, missing manifest entry)."""
+
+
+class CheckpointShardMismatchError(CheckpointCorruptError):
+    """The visible per-host shard files do not match the world the commit
+    sentinel records — hosts' shards are missing (per-host files on
+    storage this reader cannot see, e.g. restoring on a mesh with fewer
+    hosts than the save wrote from host-local disks) or stale extra
+    shards from an overwrite with a different topology survived. Carries
+    ``missing_processes`` / ``extra_processes`` and names them in the
+    message, instead of surfacing as a bare KeyError from the strict
+    load. A subclass of `CheckpointCorruptError`, so
+    `CheckpointManager.restore_latest` falls back past a torn shard set
+    to the previous loadable snapshot."""
+
+    def __init__(self, message, *, missing_processes=(),
+                 extra_processes=()):
+        super().__init__(message)
+        self.missing_processes = tuple(missing_processes)
+        self.extra_processes = tuple(extra_processes)
 
 
 @dataclass
@@ -492,13 +511,44 @@ def _read_manifests(path, expected_world=None):
     if not names:
         raise CheckpointCorruptError(
             f"committed checkpoint at {path!r} has no integrity manifest")
-    if expected_world is not None and len(names) != expected_world:
-        # stale per-process files from an overwritten checkpoint with a
-        # different world size would otherwise mix into the chunk map
-        raise CheckpointCorruptError(
-            f"checkpoint at {path!r} has {len(names)} manifests but its "
-            f"commit sentinel records world_size={expected_world} "
-            "(overwritten with a different topology?)")
+    if expected_world is not None:
+        present = set()
+        for f in names:
+            idx = f[len("manifest_"):-len(".json")]
+            # only canonical names count toward the world AND get merged:
+            # a non-canonical leftover (manifest_01.json from an external
+            # copy, manifest_tmp.json) must not slip stale chunks past the
+            # shard-set check below into the union
+            if not idx.isdigit() or f != f"manifest_{int(idx)}.json":
+                raise CheckpointCorruptError(
+                    f"unrecognized manifest file {f!r} in {path!r} "
+                    "(not a canonical manifest_<process>.json shard); "
+                    "refusing to load")
+            present.add(int(idx))
+        missing = sorted(set(range(expected_world)) - present)
+        extra = sorted(p for p in present if p >= expected_world)
+        if missing or extra:
+            # a partial shard set must fail TYPED, naming the hosts: a
+            # restore on fewer hosts than the save (per-host files not on
+            # this filesystem) or stale shards of an overwrite with a
+            # different topology must not surface as a bare KeyError from
+            # the strict load — and restore_latest must be able to fall
+            # back past it
+            detail = []
+            if missing:
+                detail.append(f"shards for host process(es) {missing} are "
+                              f"missing")
+            if extra:
+                detail.append(f"stale shards for process(es) {extra} "
+                              f"exceed the committed world")
+            raise CheckpointShardMismatchError(
+                f"checkpoint at {path!r} records "
+                f"world_size={expected_world} in its commit sentinel but "
+                + " and ".join(detail) +
+                " — partial/torn shard set (host-local shard files not "
+                "visible to this reader, or an overwrite with a different "
+                "topology); refusing to load",
+                missing_processes=missing, extra_processes=extra)
     chunk_map = {}
     for n in names:
         try:

@@ -27,7 +27,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+
+from .. import sharding as _shardlib
 
 __all__ = [
     "ring_attention",
@@ -117,7 +118,7 @@ def _ulysses_attention_local(q, k, v, *, axis_name, causal, scale):
 def _cp_spec(mesh, seq_axis, batch_axes, head_axis):
     batch = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
     head = head_axis if (head_axis in mesh.shape and mesh.shape[head_axis] > 1) else None
-    return P(batch if batch else None, seq_axis, head, None)
+    return _shardlib.spec(batch if batch else None, seq_axis, head, None)
 
 
 def context_parallel_attention(q, k, v, mesh, *, mode="ring", seq_axis="sep",

@@ -255,7 +255,8 @@ class ShardedTrainStep:
         """Context manager enabling context-parallel attention during trace
         (no-op when sep == 1 or context_parallel=None)."""
         import contextlib
-        if not self.context_parallel or self.mesh.shape["sep"] <= 1:
+        if not self.context_parallel or \
+                dict(self.mesh.shape).get("sep", 1) <= 1:
             return contextlib.nullcontext()
         from .context_parallel import context_parallel_guard
         return context_parallel_guard(self.mesh, mode=self.context_parallel)
@@ -465,6 +466,22 @@ class ShardedTrainStep:
     def _batch_spec_for(self, ndim):
         return batch_spec_for_ndim(self.batch_spec, ndim)
 
+    def declared_state(self):
+        """(avals, specs) of the engine's full declared state — params
+        plus optimizer slots (keyed ``opt/<param>/<slot>``, sharded like
+        their param). The one enumeration behind both the graphcheck
+        ``<site>::params`` per-chip watermark and the BENCH_POD state
+        gate (`graphcheck.params_bytes_per_chip`)."""
+        avals = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for n, v in self.param_vals.items()}
+        specs = dict(self.param_specs)
+        for n, slots in self.opt_state.items():
+            for s, v in slots.items():
+                avals[f"opt/{n}/{s}"] = jax.ShapeDtypeStruct(v.shape,
+                                                             v.dtype)
+                specs[f"opt/{n}/{s}"] = self.state_specs[n]
+        return avals, specs
+
     def _audit_graph(self, site, fn, args):
         """Graph auditor (PADDLE_TPU_GRAPHCHECK=1): statically audit the
         freshly built step program — collectives vs the declared specs,
@@ -472,13 +489,16 @@ class ShardedTrainStep:
         aliased, live-memory watermark. Costs one extra AOT
         lower+compile per cold entrypoint; free when off.
         `expect_sharded_params` stays False: fsdp-style training gathers
-        params in-graph by design (serving entrypoints pass True)."""
-        param_avals = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
-                       for n, v in self.param_vals.items()}
+        params in-graph by design (serving entrypoints pass True).
+        Optimizer slots join the declared set (`declared_state`) so the
+        `<site>::params` per-chip watermark covers param + opt-state
+        residency — the number the fsdp memory ratchet gates
+        (docs/sharding.md)."""
+        param_avals, param_specs = self.declared_state()
         _gc.audit_executable(
             site, jit_obj=fn, args=args, mesh=self.mesh,
             axes_specs=[*self.param_specs.values(), self.batch_spec],
-            param_avals=param_avals, param_specs=self.param_specs,
+            param_avals=param_avals, param_specs=param_specs,
             expect_sharded_params=False)
 
     # ---- public step APIs ----------------------------------------------
@@ -756,7 +776,17 @@ def parallelize(model, optimizer=None, loss_fn=None, *, mesh=None,
                 sharding_stage=0, rules=None, compute_dtype=None,
                 context_parallel="ring"):
     """High-level entry (≈ dist.parallelize / fleet.distributed_model +
-    distributed_optimizer in one): returns a ShardedTrainStep."""
+    distributed_optimizer in one): returns a ShardedTrainStep.
+
+    `mesh` may be a built `jax.sharding.Mesh` OR a declarative
+    `sharding.MeshConfig` — `MeshConfig(fsdp=N)` is the one-config pod
+    training story (docs/sharding.md): params and optimizer state shard
+    along the fsdp axis, gathered in-graph at use sites, with zero
+    per-model spec tables."""
+    from ..sharding import MeshConfig
+
+    if isinstance(mesh, MeshConfig):
+        mesh = mesh.build()
     hcg = None
     if mesh is not None:
         hcg = topo_mod.HybridCommunicateGroup(mesh=mesh)

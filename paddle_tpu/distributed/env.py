@@ -96,8 +96,33 @@ def init_parallel_env():
         _global_store = TCPStore(host or "127.0.0.1", int(port),
                                  world_size=world)
         _global_store.start_heartbeat(f"rank{rank}")
+    # declarative mesh from the launcher (--mesh): AFTER the
+    # jax.distributed bootstrap above, so the config resolves against the
+    # job-global device set and every host installs the identical hybrid
+    # ICI×DCN topology before any engine asks for placement
+    _apply_mesh_env()
     _initialized = True
     return ParallelEnv()
+
+
+def _apply_mesh_env():
+    """`PADDLE_TPU_MESH` (serialized by the launcher's ``--mesh``) ->
+    build the declarative mesh and install it as the global topology.
+    Returns the mesh, or None when the env is unset. Deterministic per
+    config + device set, so N hosts of a rendezvous — and the SAME hosts
+    after an elastic relaunch — always agree on placement with zero
+    per-host code (docs/sharding.md)."""
+    from ..sharding import MeshConfig
+
+    cfg = MeshConfig.from_env()
+    if cfg is None:
+        return None
+    from . import topology as topo_mod
+
+    mesh = cfg.build()
+    topo_mod.set_hybrid_communicate_group(
+        topo_mod.HybridCommunicateGroup(mesh=mesh))
+    return mesh
 
 
 def get_store():

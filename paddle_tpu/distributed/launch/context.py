@@ -38,6 +38,14 @@ def parse_args(argv=None):
                         "crash before respawning")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="elastic: maximum relaunch attempts")
+    p.add_argument("--mesh", default=os.environ.get("PADDLE_MESH"),
+                   help="declarative mesh for the whole job, e.g. "
+                        "'dp=2,fsdp=4' or 'fsdp=8,dcn_dp=2': exported to "
+                        "every worker as PADDLE_TPU_MESH so each host of "
+                        "the rendezvous builds the IDENTICAL hybrid "
+                        "ICI*DCN mesh (consumed by init_parallel_env; "
+                        "MeshConfig(fsdp=N) selects fsdp-by-default "
+                        "training, docs/sharding.md)")
     p.add_argument("--devices", default=os.environ.get("PADDLE_DEVICES"),
                    help="visible device ids for this node (comma-separated)")
     p.add_argument("-m", "--module", action="store_true",

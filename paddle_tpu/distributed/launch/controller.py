@@ -146,6 +146,15 @@ class Controller:
         })
         if getattr(args, "ckpt_dir", None):
             env["PADDLE_TPU_CKPT_DIR"] = args.ckpt_dir
+        if getattr(args, "mesh", None):
+            # canonical serialized MeshConfig: parse-validate HERE so a
+            # bad --mesh fails the launch on the controller, not worker N
+            # mid-rendezvous; the SAME payload survives elastic relaunches
+            # (spawn() re-runs this), so a restarted world rebuilds the
+            # identical mesh and auto-resume proceeds unchanged
+            from ...sharding import MeshConfig
+
+            env["PADDLE_TPU_MESH"] = MeshConfig.parse(args.mesh).to_env()
         if world > 1:
             # jax.distributed coordinator (data plane) on master host,
             # distinct port from the KV store

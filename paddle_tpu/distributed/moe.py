@@ -23,8 +23,8 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import sharding as _shardlib
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
@@ -150,14 +150,15 @@ def _moe_ffn_alltoall_impl(x, gate_w, w1, b1, w2, b2, *, top_k, capacity,
         # all of them (expert + data), not just the expert axis
         return y, jax.lax.pmean(aux, all_axes)
 
-    tok = P(all_axes, None)
-    ew = P(axis, *([None] * (w1.ndim - 1)))
-    eb = P(axis, None)
+    tok = _shardlib.spec(all_axes, None)
+    ew = _shardlib.spec(axis, *([None] * (w1.ndim - 1)))
+    eb = _shardlib.spec(axis, None)
     from ..compat import shard_map
     y, aux = shard_map(
         body, mesh=mesh,
-        in_specs=(tok, P(None, None), ew, eb, P(axis, None, None), eb),
-        out_specs=(tok, P()))(x, gate_w, w1, b1, w2, b2)
+        in_specs=(tok, _shardlib.spec(None, None), ew, eb,
+                  _shardlib.spec(axis, None, None), eb),
+        out_specs=(tok, _shardlib.spec()))(x, gate_w, w1, b1, w2, b2)
     return y, aux.astype(jnp.float32)
 
 
@@ -255,7 +256,7 @@ class MoELayer(Layer):
         # expert-parallel placement for the engine/shard_params pass
         for p in (self.w1, self.b1, self.w2, self.b2):
             spec = [expert_axis] + [None] * (p.ndim - 1)
-            p.dist_spec = P(*spec)
+            p.dist_spec = _shardlib.spec(*spec)
         self.aux_loss = None
 
     def _capacity(self, n_tokens):
@@ -269,7 +270,8 @@ class MoELayer(Layer):
         mesh = topo_mod.get_mesh()
         if mesh is None or mesh.shape.get(self.expert_axis, 1) <= 1:
             return None
-        return NamedSharding(mesh, P(self.expert_axis, None, None))
+        return _shardlib.named_sharding(
+            mesh, _shardlib.spec(self.expert_axis, None, None))
 
     def _ep_mesh(self):
         """(mesh, data_axes, total_split) when the expert axis is usable
@@ -370,13 +372,13 @@ def global_scatter(x, axis="mp", *, split_axis=0, concat_axis=0):
         return Tensor(val)
     spec = [None] * val.ndim
     spec[split_axis] = axis
-    pspec = P(*spec)
+    pspec = _shardlib.spec(*spec)
 
     def body(v):
         return dist_f.all_to_all_axis(v, axis, split_axis, concat_axis)
 
     out = shard_map(body, mesh=mesh, in_specs=pspec, out_specs=pspec)(
-        jax.device_put(val, NamedSharding(mesh, pspec)))
+        jax.device_put(val, _shardlib.named_sharding(mesh, pspec)))
     return Tensor(out)
 
 

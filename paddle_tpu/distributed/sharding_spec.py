@@ -64,6 +64,34 @@ def _filter_physical(spec, mesh):
         for e in spec])
 
 
+def _uses_axis(entries, axis):
+    return any(e == axis or (isinstance(e, tuple) and axis in e)
+               for e in entries if e is not None)
+
+
+def _shard_largest_free_dim(entries, shape, axis, n_shard):
+    """ZeRO-style fallback shared by the stage-3 ('sharding' axis) and
+    fsdp paths: shard the largest still-unsharded dim divisible by the
+    axis size; a spec already using the axis (or with no divisible free
+    dim) is returned unchanged — placement must never fail."""
+    if _uses_axis(entries, axis):
+        return entries
+    cand = sorted((i for i, e in enumerate(entries) if e is None),
+                  key=lambda i: -shape[i])
+    for i in cand:
+        if shape[i] % n_shard == 0:
+            entries[i] = axis
+            break
+    return entries
+
+
+def _fsdp_ways(mesh):
+    """The mesh's fsdp degree (1 when absent): ``MeshConfig(fsdp=N)`` is
+    the ONE switch that turns on fsdp-by-default resolution here — no
+    per-model spec tables, no engine flag."""
+    return dict(mesh.shape).get("fsdp", 1) if mesh is not None else 1
+
+
 def spec_for_param(name, param, rules=None, *, sharding_stage=0,
                    mesh=None, axis_rules=None):
     """Compute the PartitionSpec for one parameter.
@@ -74,7 +102,22 @@ def spec_for_param(name, param, rules=None, *, sharding_stage=0,
     active axis-rule table (or `axis_rules`) against `mesh`. If
     sharding_stage == 3, additionally shard the largest still-unsharded
     dim over the 'sharding' axis (ZeRO-3 param sharding ≈
-    GroupShardedStage3, group_sharded_stage3.py:85)."""
+    GroupShardedStage3, group_sharded_stage3.py:85).
+
+    A mesh carrying ``fsdp > 1`` (``MeshConfig(fsdp=N)``) selects
+    fsdp-by-default resolution: logical names resolve through the
+    `sharding.fsdp_rules` preset (embed-dim first, tp keeps its claim on
+    the tp dims) and any parameter still fully free afterwards shards its
+    largest divisible dim along ``fsdp`` — so params AND optimizer slots
+    hold ~1/N per chip, gathered in-graph at use sites by GSPMD and
+    reduce-scattered on the grad path (docs/sharding.md)."""
+    fsdp_n = _fsdp_ways(mesh)
+    if fsdp_n > 1:
+        from ..sharding.rules import fsdp_rules
+
+        # augmenting the ACTIVE table (or the caller's) keeps explicit
+        # user rules winning first-match; the preset only adds candidates
+        axis_rules = fsdp_rules(axis_rules)
     spec = None
     logical = getattr(param, "logical_axes", None)
     if logical is not None:
@@ -108,15 +151,14 @@ def spec_for_param(name, param, rules=None, *, sharding_stage=0,
             _shardlib.spec(*entries), tuple(param.shape), mesh))
     if sharding_stage >= 3 and mesh is not None and \
             dict(mesh.shape).get("sharding", 1) > 1:
-        n_shard = dict(mesh.shape)["sharding"]
-        # biggest free dim divisible by the axis size
-        cand = sorted(
-            (i for i, e in enumerate(entries) if e is None),
-            key=lambda i: -param.shape[i])
-        for i in cand:
-            if param.shape[i] % n_shard == 0:
-                entries[i] = "sharding"
-                break
+        entries = _shard_largest_free_dim(
+            entries, param.shape, "sharding",
+            dict(mesh.shape)["sharding"])
+    if fsdp_n > 1:
+        # largest-divisible-dim fallback: unannotated params (layer
+        # norms, biases, position tables) still shard 1/N
+        entries = _shard_largest_free_dim(entries, param.shape, "fsdp",
+                                          fsdp_n)
     return _shardlib.spec(*entries)
 
 
@@ -124,22 +166,21 @@ def opt_state_spec(param_spec, param_shape, mesh, *, sharding_stage=0):
     """Sharding for per-param optimizer slots (ZeRO stage >= 1 shards them
     over the sharding axis — reference DygraphShardingOptimizer
     dygraph_sharding_optimizer.py:48 / stage2 group_sharded_optimizer_stage2
-    .py:53)."""
+    .py:53). On an fsdp mesh the slots follow the param spec (already
+    fsdp-sharded) with the same largest-divisible-dim fallback, so the
+    optimizer state — 2x the params for AdamW — also holds ~1/N per
+    chip."""
     entries = list(param_spec)
     while len(entries) < len(param_shape):
         entries.append(None)
     if sharding_stage >= 1 and mesh is not None and \
             dict(mesh.shape).get("sharding", 1) > 1:
-        n_shard = dict(mesh.shape)["sharding"]
-        if not any(e == "sharding" or (isinstance(e, tuple) and "sharding" in e)
-                   for e in entries):
-            cand = sorted(
-                (i for i, e in enumerate(entries) if e is None),
-                key=lambda i: -param_shape[i])
-            for i in cand:
-                if param_shape[i] % n_shard == 0:
-                    entries[i] = "sharding"
-                    break
+        entries = _shard_largest_free_dim(
+            entries, param_shape, "sharding", dict(mesh.shape)["sharding"])
+    fsdp_n = _fsdp_ways(mesh)
+    if fsdp_n > 1:
+        entries = _shard_largest_free_dim(entries, param_shape, "fsdp",
+                                          fsdp_n)
     return _shardlib.spec(*entries)
 
 

@@ -125,9 +125,14 @@ class HybridCommunicateGroup:
                 sep=cfg.get("sep_degree", 1),
                 mp=cfg.get("mp_degree", 1),
             )
+        # a MeshConfig-built mesh carries dp/fsdp/tp (+extras) instead of
+        # the legacy hybrid axes; absent axes read as degree 1 so the HCG
+        # can wrap EITHER mesh family (the fsdp pod-training path hands
+        # the engine a MeshConfig mesh directly)
+        sizes = dict(self._mesh.shape)
         self._topo = CommunicateTopology(
             ["data", "pipe", "sharding", "sep", "model"],
-            [self._mesh.shape[a] for a in AXES])
+            [sizes.get(a, 1) for a in AXES])
         self.global_rank = 0
 
     @property
@@ -138,7 +143,7 @@ class HybridCommunicateGroup:
         return self._topo
 
     def axis_size(self, axis):
-        return self._mesh.shape[axis]
+        return dict(self._mesh.shape).get(axis, 1)
 
     # -- parity surface (topology.py:250-400) ---------------------------
     def get_parallel_mode(self):
@@ -216,6 +221,8 @@ class HybridCommunicateGroup:
             return 0
         pos = np.unravel_index(mine, devs.shape)
         axes = list(self._mesh.axis_names)
+        if axis not in axes:   # MeshConfig mesh without this legacy axis
+            return 0
         return int(pos[axes.index(axis)])
 
     def get_data_parallel_rank(self):

@@ -25,7 +25,7 @@ package is a tracelint TL011 finding (ratcheted via
 """
 from .mesh import AXES, MeshConfig, build_mesh, cpu_mesh
 from .rules import (
-    AxisRules, DEFAULT_RULES, axis_rules, get_axis_rules,
+    AxisRules, DEFAULT_RULES, axis_rules, fsdp_rules, get_axis_rules,
     logical_to_spec, logical_to_sharding, resolve_axis,
     with_logical_constraint,
 )
@@ -37,7 +37,8 @@ from .placement import (
 
 __all__ = [
     "AXES", "MeshConfig", "build_mesh", "cpu_mesh",
-    "AxisRules", "DEFAULT_RULES", "axis_rules", "get_axis_rules",
+    "AxisRules", "DEFAULT_RULES", "axis_rules", "fsdp_rules",
+    "get_axis_rules",
     "logical_to_spec", "logical_to_sharding", "resolve_axis",
     "with_logical_constraint",
     "batch_spec_for_ndim", "default_batch_spec", "mesh_stats",

@@ -30,6 +30,10 @@ import numpy as np
 #: canonical MeshConfig axis order, outermost (DCN-friendly) first
 AXES = ("dp", "fsdp", "tp")
 
+#: environment variable the launcher serializes a MeshConfig through
+#: (`--mesh` → every worker of the rendezvous builds the IDENTICAL mesh)
+ENV_VAR = "PADDLE_TPU_MESH"
+
 
 @dataclass(frozen=True)
 class MeshConfig:
@@ -98,6 +102,57 @@ class MeshConfig:
     def build(self, devices=None):
         """Instantiate the `jax.sharding.Mesh` for this config."""
         return build_mesh(self, devices=devices)
+
+    # -- launcher-env serialization (one-config multi-host mesh) ----------
+    @classmethod
+    def parse(cls, spec: str) -> "MeshConfig":
+        """Parse the compact ``"dp=2,fsdp=4,tp=1,dcn_dp=2,sep=2"`` form
+        (the launcher ``--mesh`` argument and the `PADDLE_TPU_MESH` env
+        payload). Canonical keys map to fields; any other key becomes an
+        extra axis. Validation is MeshConfig's own (`__post_init__`), so
+        a bad spec fails at launch, not on worker N mid-rendezvous."""
+        fields = {}
+        extra = {}
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep_, val = part.partition("=")
+            key = key.strip()
+            try:
+                ival = int(val.strip()) if sep_ else None
+            except ValueError:
+                ival = None
+            if not key or ival is None:
+                raise ValueError(
+                    f"bad mesh spec entry {part!r} in {spec!r} "
+                    f"(expected axis=int, e.g. 'dp=2,fsdp=4')")
+            if key in AXES or key == "dcn_dp":
+                fields[key] = ival
+            else:
+                extra[key] = ival
+        if not fields and not extra:
+            raise ValueError(f"empty mesh spec {spec!r}")
+        return cls(extra=extra, **fields)
+
+    def to_env(self) -> str:
+        """Canonical serialized form: round-trips through `parse` and is
+        byte-stable for a given config (the launcher exports it as
+        `PADDLE_TPU_MESH` so every host builds the identical mesh)."""
+        parts = [f"dp={self.dp}", f"fsdp={self.fsdp}", f"tp={self.tp}"]
+        if self.dcn_dp != 1:
+            parts.append(f"dcn_dp={self.dcn_dp}")
+        parts.extend(f"{k}={int(v)}" for k, v in sorted(self.extra.items()))
+        return ",".join(parts)
+
+    @classmethod
+    def from_env(cls, environ=None):
+        """The MeshConfig serialized in `PADDLE_TPU_MESH`, or None when
+        unset (consumed by `distributed.init_parallel_env`)."""
+        import os
+
+        spec = (environ if environ is not None else os.environ).get(ENV_VAR)
+        return cls.parse(spec) if spec else None
 
 
 def _num_slices(devices):

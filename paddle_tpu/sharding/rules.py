@@ -87,6 +87,56 @@ DEFAULT_RULES = AxisRules((
     ("embed",  None),
 ))
 
+#: weight logical axes the fsdp preset adds an "fsdp" candidate for (the
+#: fallback order in fsdp_rules(); "embed" is handled specially — it is
+#: the dim the preset shards FIRST, since the default table replicates it)
+FSDP_WEIGHT_AXES = ("heads", "kv", "mlp", "vocab", "expert")
+
+
+def fsdp_rules(base=None) -> AxisRules:
+    """The fsdp-by-default AxisRules preset (SNIPPETS [3]'s fsdp strategy
+    table, t5x/MaxText idiom): every weight logical axis gains an
+    ``"fsdp"`` candidate *after* its tp/mp entries, and ``"embed"`` —
+    explicitly replicated under the default table — shards along fsdp
+    first. One table then resolves correctly on every mesh family:
+
+    * ``MeshConfig(fsdp=8)`` — each weight shards one dim along fsdp
+      (embed preferred, else the first available weight axis), params are
+      gathered in-graph by GSPMD at their use sites and grads
+      reduce-scattered back — ZeRO-3 semantics with zero per-model specs;
+    * ``MeshConfig(fsdp=4, tp=2)`` — tp keeps first claim on the
+      heads/kv/mlp/vocab dims (those entries still match first), fsdp
+      takes embed: the standard 2D fsdp×tp layout;
+    * dp-only / legacy hybrid meshes — every fsdp entry is unavailable
+      and the table degrades to the base behavior.
+
+    Availability-with-consumption keeps activations sane: an activation's
+    "batch" dim consumes dp+fsdp before "embed" is resolved, so
+    activation constraints never steal the fsdp axis from the data
+    layout. Parameters whose every candidate dim is non-divisible (or
+    unannotated parameters) are covered by the resolver's
+    largest-divisible-dim fallback (`sharding_spec.spec_for_param`),
+    selected automatically whenever the mesh carries ``fsdp > 1``.
+
+    `base` (default: the active table) is extended, never mutated.
+    """
+    base = get_axis_rules() if base is None else AxisRules(base)
+    out = []
+    embed_inserted = False
+    for lg, phys in base:
+        if lg == "embed" and phys is None and not embed_inserted:
+            # before the terminal replicate rule, so fsdp wins when present
+            out.append(("embed", "fsdp"))
+            embed_inserted = True
+        out.append((lg, phys))
+    if not embed_inserted:
+        out.append(("embed", "fsdp"))
+    # fallback candidates scan AFTER every base entry of the same name
+    # (order between different names is irrelevant to resolution)
+    out.extend((lg, "fsdp") for lg in FSDP_WEIGHT_AXES)
+    return AxisRules(out)
+
+
 _local = threading.local()
 
 
@@ -135,8 +185,19 @@ def resolve_axis(logical, mesh=None, used=(), rules=None):
         if phys is None:
             return None
         axes = (phys,) if isinstance(phys, str) else phys
-        if sizes is not None and not all(a in sizes for a in axes):
-            continue                # axis not on this mesh: next rule
+        if sizes is not None:
+            if not all(a in sizes for a in axes):
+                continue            # other mesh family: next rule
+            # drop size-1 axes — they offer no sharding, and a rule
+            # "taken" by a trivial axis would consume it and block later
+            # candidates (e.g. the fsdp fallback entries). Dropping
+            # per-axis, not per-rule, keeps fused entries alive: on
+            # MeshConfig(fsdp=8) (dp=1) the ("batch", ("dp","fsdp")) rule
+            # must still claim fsdp for the batch dim, or a weight axis
+            # would steal the data axis
+            axes = tuple(a for a in axes if sizes[a] > 1)
+            if not axes:
+                continue            # every axis trivial on this mesh
         if any(a in used for a in axes):
             continue                # already shards another dim: next rule
         return axes[0] if len(axes) == 1 else axes

@@ -1,0 +1,242 @@
+"""Pod-scale checkpointing: save→reshard→restore round-trips across a
+mesh-size change, and torn per-host shard sets fail TYPED.
+
+Tier-1 (single process, 8 virtual CPU devices): an fsdp-sharded state
+tree saved from an ``fsdp=8`` placement restores bit-exact onto ``fsdp=4``
+and back onto ``fsdp=8`` through `CheckpointManager` — the
+save-on-8-restore-on-4 resharding story. A shard set whose visible files
+do not match the committed world raises
+`CheckpointShardMismatchError` naming the missing host processes, and
+`restore_latest` falls back past such a snapshot to the previous good
+one instead of surfacing a KeyError.
+
+Slow (gloo multi-process): two spawned hosts build the IDENTICAL mesh
+from the launcher env (`PADDLE_TPU_MESH`), each writes ONLY its owned
+shards (`manifest_<host>.json` / `data_<host>.npz`) under one
+`_COMMITTED` sentinel after the store barrier, and the union restores
+bit-exact.
+"""
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointCorruptError, CheckpointManager, CheckpointShardMismatchError,
+    load_state_dict, save_state_dict,
+)
+from paddle_tpu.distributed.checkpoint.api import write_commit_sentinel
+from paddle_tpu.distributed.sharding_spec import spec_for_param
+from paddle_tpu.sharding import MeshConfig, named_sharding, shard_fraction
+
+# the shapes cover: 2D fsdp-sharded, 1D fsdp-sharded, an opt-slot twin,
+# and a ragged tensor no fsdp way divides (stays replicated)
+_SHAPES = {
+    "model.w": (16, 64),
+    "model.b": (64,),
+    "opt.w_moment1_0": (16, 64),
+    "model.ragged": (7, 5),
+}
+
+
+def _reference(seed=0):
+    r = np.random.RandomState(seed)
+    return {n: r.randn(*s).astype(np.float32) for n, s in _SHAPES.items()}
+
+
+def _place(arrays, fsdp):
+    """Arrays -> Tensors placed with their fsdp-resolved specs on a fresh
+    MeshConfig(fsdp=N) mesh (the ONE resolver the engine uses)."""
+    import jax
+
+    mesh = MeshConfig(fsdp=fsdp).build()
+    placed = {}
+    for name, a in arrays.items():
+        t = paddle.to_tensor(a)
+        spec = spec_for_param(name, t, mesh=mesh)
+        t._value = jax.device_put(t._value, named_sharding(mesh, spec))
+        placed[name] = (t, spec)
+    return mesh, placed
+
+
+def _tree(placed):
+    out = {}
+    for name, (t, _s) in placed.items():
+        top, _, leaf = name.partition(".")
+        out.setdefault(top, {})[leaf] = t
+    return out
+
+
+def _assert_equal(placed, ref):
+    for name, (t, _s) in placed.items():
+        np.testing.assert_array_equal(t.numpy(), ref[name], err_msg=name)
+
+
+def test_save_reshard_restore_8_4_8_bit_exact(tmp_path):
+    """fsdp=8 save -> fsdp=4 restore -> fsdp=8 restore, every hop
+    bit-exact, sharded placements proven at both ends."""
+    ref = _reference()
+    mesh8, placed8 = _place(ref, fsdp=8)
+    assert shard_fraction(placed8["model.w"][1], mesh8) == 0.125
+    assert shard_fraction(placed8["model.ragged"][1], mesh8) == 1.0
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=4)
+    mgr.save(_tree(placed8), step=1)
+
+    # restore onto HALF the devices (a shrunk pod slice): the loader
+    # re-places chunks per the new mesh — no host materializes a tensor
+    # it doesn't shard
+    mesh4, placed4 = _place({n: np.zeros(s, np.float32)
+                             for n, s in _SHAPES.items()}, fsdp=4)
+    assert shard_fraction(placed4["model.w"][1], mesh4) == 0.25
+    assert mgr.restore(_tree(placed4), step=1) == 1
+    _assert_equal(placed4, ref)
+
+    # grow back to 8: save from the 4-way placement, restore on 8-way
+    mgr.save(_tree(placed4), step=2)
+    _mesh8b, placed8b = _place({n: np.zeros(s, np.float32)
+                                for n, s in _SHAPES.items()}, fsdp=8)
+    assert mgr.restore_latest(_tree(placed8b)) == 2
+    _assert_equal(placed8b, ref)
+
+
+def test_partial_shard_set_raises_typed(tmp_path):
+    """A commit sentinel recording a larger world than the visible shard
+    files names the missing hosts in a CheckpointShardMismatchError — the
+    restore-on-fewer-hosts/torn-shard-set path must never be a bare
+    KeyError."""
+    save_state_dict({"w": paddle.ones([4, 4])}, str(tmp_path))
+    # simulate a 2-host save whose host-1 files live on storage this
+    # reader cannot see (host-local disks after a pod shrink)
+    write_commit_sentinel(str(tmp_path), world_size=2)
+    with pytest.raises(CheckpointShardMismatchError) as ei:
+        load_state_dict({"w": paddle.zeros([4, 4])}, str(tmp_path))
+    assert ei.value.missing_processes == (1,)
+    assert "[1]" in str(ei.value)
+
+
+def test_stale_extra_shards_raise_typed(tmp_path):
+    """Shard files beyond the committed world (an overwrite leftover)
+    are named as extra processes instead of mixing into the union."""
+    save_state_dict({"w": paddle.ones([4, 4])}, str(tmp_path))
+    np.savez(tmp_path / "data_1.npz", **{"ghost##0": np.ones(2, "float32")})
+    with open(tmp_path / "manifest_1.json", "w") as f:
+        json.dump({"format": 1, "process": 1, "world_size": 2,
+                   "files": {}, "chunks": {}}, f)
+    with pytest.raises(CheckpointShardMismatchError) as ei:
+        load_state_dict({"w": paddle.zeros([4, 4])}, str(tmp_path))
+    assert ei.value.extra_processes == (1,)
+
+
+def test_non_canonical_manifest_name_refused(tmp_path):
+    """A manifest whose name is not canonical manifest_<int>.json (an
+    interrupted external copy: manifest_01.json, manifest_tmp.json) must
+    not slip past the shard-set accounting into the chunk union — it is
+    refused as corrupt (review-caught: isdigit() alone would count
+    '01' as process 1 and merge the stale file)."""
+    for stale in ("manifest_01.json", "manifest_tmp.json"):
+        save_state_dict({"w": paddle.ones([4, 4])}, str(tmp_path))
+        with open(tmp_path / stale, "w") as f:
+            json.dump({"format": 1, "files": {}, "chunks": {}}, f)
+        with pytest.raises(CheckpointCorruptError, match="unrecognized"):
+            load_state_dict({"w": paddle.zeros([4, 4])}, str(tmp_path))
+        os.remove(tmp_path / stale)
+
+
+def test_restore_latest_falls_back_past_shard_mismatch(tmp_path):
+    """restore_latest degrades to the previous loadable snapshot when the
+    newest one is a partial shard set (typed, so the fallback engages)."""
+    ref = _reference(seed=3)
+    _mesh, placed = _place(ref, fsdp=8)
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=4)
+    mgr.save(_tree(placed), step=1)
+    mgr.save(_tree(placed), step=2)
+    write_commit_sentinel(mgr._step_dir(2), world_size=4)
+
+    _m2, target = _place({n: np.zeros(s, np.float32)
+                          for n, s in _SHAPES.items()}, fsdp=8)
+    assert mgr.restore_latest(_tree(target)) == 1
+    _assert_equal(target, ref)
+
+
+# ---------------------------------------------------------------------------
+# gloo multi-process: per-host owned shards under one sentinel
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _multihost_worker(coord_port, ckpt_dir):
+    import os
+
+    import numpy as np
+
+    os.environ["PADDLE_TPU_COORDINATOR"] = f"127.0.0.1:{coord_port}"
+    os.environ["PADDLE_TPU_MESH"] = "fsdp=8"   # the launcher --mesh payload
+
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import topology as topo
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict)
+    from paddle_tpu.sharding import named_sharding, replicated, spec
+
+    dist.init_parallel_env()
+    assert jax.process_count() == 2
+    # every host built the IDENTICAL declarative mesh from the env
+    mesh = topo.get_mesh()
+    assert mesh is not None and dict(mesh.shape) == \
+        {"dp": 1, "fsdp": 8, "tp": 1}, dict(mesh.shape or {})
+
+    rank = jax.process_index()
+    ref = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    sh = named_sharding(mesh, spec("fsdp"))
+    arr = jax.make_array_from_callback(ref.shape, sh, lambda i: ref[i])
+    t = paddle.to_tensor(np.zeros((1,), np.float32))
+    t._value = arr
+    save_state_dict({"w": t}, ckpt_dir)
+
+    # each host wrote ONLY its owned shards under the one sentinel
+    mine = os.path.join(ckpt_dir, f"manifest_{rank}.json")
+    assert os.path.exists(mine), sorted(os.listdir(ckpt_dir))
+    assert os.path.exists(os.path.join(ckpt_dir, "_COMMITTED"))
+    import json as _json
+
+    with open(mine) as f:
+        man = _json.load(f)
+    # 8 fsdp shards dedup to their lowest-id device: 4 per host
+    assert len(man["chunks"]) == 4, man["chunks"].keys()
+
+    # the union restores bit-exact onto a DIFFERENT placement
+    tgt = paddle.to_tensor(np.zeros((1,), np.float32))
+    tgt._value = jax.make_array_from_callback(
+        ref.shape, replicated(mesh, 2), lambda i: np.zeros_like(ref[i]))
+    load_state_dict({"w": tgt}, ckpt_dir)
+    got = np.asarray(tgt._value.addressable_shards[0].data)
+    np.testing.assert_array_equal(got, ref)
+
+    store = dist.get_store()
+    store.set(f"reshard_done/{rank}", b"1")
+    store.wait(f"reshard_done/{1 - rank}", timeout=60)
+
+
+@pytest.mark.slow
+def test_multihost_owned_shards_gloo(tmp_path):
+    """Two real processes (gloo CPU collectives, 4 virtual devices each)
+    prove the multi-host path: identical env-built mesh, per-host owned
+    shard files, one commit sentinel after the store barrier, bit-exact
+    union restore."""
+    port = _free_port()
+    dist.spawn(_multihost_worker, args=(port, str(tmp_path / "ck")),
+               nprocs=2,
+               env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})

@@ -229,6 +229,30 @@ def test_gc006_watermark_estimate_and_ratchet():
     assert gc.new_watermarks({"s": 200}, {}, slack=0.25) == {}
 
 
+def test_gc006_params_per_chip_watermark():
+    """The `<site>::params` sibling watermark: per-chip param+state bytes
+    scaled by each spec's shard fraction — the number the fsdp memory
+    ratchet gates (the jaxpr watermark sees only GLOBAL aval bytes)."""
+    from jax.sharding import PartitionSpec as P  # tpu-lint: disable=TL011
+
+    from paddle_tpu.sharding import MeshConfig
+
+    mesh = MeshConfig(fsdp=8).build()
+    avals = {"w": jax.ShapeDtypeStruct((16, 64), jnp.float32),
+             "opt/w/m1": jax.ShapeDtypeStruct((16, 64), jnp.float32),
+             "ragged": jax.ShapeDtypeStruct((7, 5), jnp.float32)}
+    specs = {"w": P(None, "fsdp"), "opt/w/m1": P(None, "fsdp"),
+             "ragged": P(None, None)}
+    got = gc.params_bytes_per_chip(avals, specs, mesh)
+    assert got == 2 * (16 * 64 * 4) // 8 + 7 * 5 * 4
+    # recorded under <site>::params by audit_executable when the
+    # placement context is present
+    gc.audit_executable("t.params", fn=lambda x: x * 2,
+                        args=(jnp.ones((4,), jnp.float32),),
+                        mesh=mesh, param_avals=avals, param_specs=specs)
+    assert gc.watermarks()["t.params::params"] == got
+
+
 def test_gc006_budget_env(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_GRAPHCHECK_MEM_MB", "0.001")  # ~1 KB
     gc.audit_executable("t.budget", fn=lambda x: x * 2,

@@ -45,6 +45,37 @@ def test_launch_spawns_ranked_workers(tmp_path):
     assert sorted(os.listdir(out)) == [f"rank{i}" for i in range(4)]
 
 
+def test_launch_exports_canonical_mesh_env(tmp_path):
+    """--mesh is parse-validated on the controller and every worker gets
+    the CANONICAL serialized MeshConfig in PADDLE_TPU_MESH (so N hosts —
+    and elastic relaunches — build the identical mesh); a bad spec fails
+    at launch, not on worker N mid-rendezvous."""
+    from paddle_tpu.distributed.launch.context import Context, parse_args
+    from paddle_tpu.distributed.launch.controller import Controller
+    from paddle_tpu.sharding import MeshConfig
+
+    args = parse_args(["--mesh", "fsdp=8,dcn_dp=2", "train.py"])
+    c = Controller(Context(args))
+    c.master, c.node_rank = "127.0.0.1:1", 0
+    env = c._env_for(0)
+    assert env["PADDLE_TPU_MESH"] == "dp=1,fsdp=8,tp=1,dcn_dp=2"
+    assert MeshConfig.parse(env["PADDLE_TPU_MESH"]) == \
+        MeshConfig(fsdp=8, dcn_dp=2)
+    # unchanged across an elastic relaunch epoch
+    assert c._env_for(0, restart_epoch=2)["PADDLE_TPU_MESH"] == \
+        env["PADDLE_TPU_MESH"]
+
+    bad = Controller(Context(parse_args(["--mesh", "fsdp=x", "t.py"])))
+    bad.master, bad.node_rank = "127.0.0.1:1", 0
+    with pytest.raises(ValueError):
+        bad._env_for(0)
+    # no --mesh: the env key is absent entirely (workers fall back to
+    # their own topology setup)
+    plain = Controller(Context(parse_args(["t.py"])))
+    plain.master, plain.node_rank = "127.0.0.1:1", 0
+    assert "PADDLE_TPU_MESH" not in plain._env_for(0)
+
+
 def test_launch_fail_fast_propagates_exit_code(tmp_path):
     r = _run_launch("""
         import os, sys, time

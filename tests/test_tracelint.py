@@ -895,8 +895,9 @@ def test_tl012_suppression_and_authority_exemption():
 def test_tl012_legacy_baseline_frozen():
     """The ~15 legacy raw-lock sites are baselined (burn down, never
     grow), and the checked-in TL011 ratchet keeps shrinking: 58 at
-    introduction, 43 after the collective/misc_api migration, ≤30 after
-    the pipeline/data_parallel tranche."""
+    introduction, 43 after the collective/misc_api migration, 25 after
+    the pipeline/data_parallel tranche, ≤15 after the
+    moe/context_parallel tranche."""
     with open(BASELINE) as f:
         counts = json.load(f)["counts"]
     tl012 = {k: v for k, v in counts.items() if "::TL012::" in k}
@@ -904,13 +905,27 @@ def test_tl012_legacy_baseline_frozen():
     assert "paddle_tpu/flags.py::TL012::<module>" in tl012
     assert "paddle_tpu/core/monitor.py::TL012::<module>" in tl012
     tl011 = sum(v for k, v in counts.items() if "::TL011::" in k)
-    assert tl011 <= 30                     # ...and TL011 burned down
+    assert tl011 <= 15                     # ...and TL011 burned down
     assert not any("collective.py::TL011" in k or "misc_api.py::TL011" in k
                    for k in counts)
     # the PR-12 tranche: pipeline + data_parallel construct zero raw
     # NamedSharding/PartitionSpec now (they ask the factories)
     assert not any("pipeline.py::TL011" in k or
                    "data_parallel.py::TL011" in k for k in counts)
+    # the PR-15 tranche: moe + context_parallel rebased onto the
+    # factories (the all-to-all shard_map specs included)
+    assert not any("moe.py::TL011" in k or
+                   "context_parallel.py::TL011" in k for k in counts)
+
+
+def test_tl011_migrated_files_are_clean():
+    """Per-file clean assertions for the PR-15 TL011 tranche — not just
+    absent from the baseline, but zero findings in the live lint."""
+    for rel in ("paddle_tpu/distributed/moe.py",
+                "paddle_tpu/distributed/context_parallel.py"):
+        fs = tracelint.lint_file(os.path.join(REPO, rel), rel)
+        hits = [f for f in fs if f.rule == "TL011"]
+        assert not hits, f"{rel}: {hits}"
 
 
 # ---------------------------------------------------------------------------
