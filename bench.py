@@ -670,11 +670,16 @@ def bench_slo(on_tpu, dev):
     paddle_tpu.obs.slo (p99 request latency, throughput floor,
     queue-depth ceiling, steps/sec floor) against the checked-in
     SLO_BASELINE.json ratchet — exit nonzero on any breach, exactly how
-    .tpu_lint_baseline.json gates lint. BENCH_SLO_WRITE=1 re-measures
-    and rewrites the baseline (for an intentional, explained perf
-    change). The scrape is also verified: the pool's conservation law
-    (admitted == completed + failed + timed_out + cancelled) must hold
-    in the Prometheus text exposition itself."""
+    .tpu_lint_baseline.json gates lint. Generations are also streamed
+    through a two-replica ServingRouter over stub decode engines, so
+    the router's streaming overhead (time-to-first-token p99) rides the
+    same gate. BENCH_SLO_WRITE=1 re-measures and rewrites the whole
+    baseline (for an intentional, explained perf change);
+    BENCH_SLO_WRITE=stream re-ratchets only the router_stream.* rows,
+    merging over the existing bounds (slo.write_baseline(merge=)). The
+    scrape is also verified: the pool's conservation law (admitted ==
+    completed + failed + timed_out + cancelled) and the router's stream
+    ledger must hold in the Prometheus text exposition itself."""
     import concurrent.futures
     import itertools
     import re
@@ -685,7 +690,74 @@ def bench_slo(on_tpu, dev):
     from paddle_tpu import nn, obs
     from paddle_tpu.obs import slo as slo_mod
     from paddle_tpu.inference import (
-        BatchConfig, Config, ServingPool, create_predictor)
+        BatchConfig, Config, LocalReplica, RouterConfig, ServingPool,
+        ServingRouter, create_predictor)
+
+    class _NullPredictor:
+        """Pool-compatible stand-in: the streaming stage exercises the
+        router's decode path only, never predictor compute."""
+
+        def clone(self):
+            return _NullPredictor()
+
+        def reset_handles(self):
+            pass
+
+        def run(self, feeds):
+            return [np.asarray(f) for f in feeds]
+
+    class _StubStream:
+        """Pump-contract stream over a precomputed token list: every
+        token is available the instant the stream is placed, so the
+        measured TTFT is pure router overhead."""
+
+        def __init__(self, sid, toks):
+            self.id, self.deadline, self.status = sid, None, "active"
+            self._toks, self._i, self._end = toks, 0, None
+
+        @property
+        def tokens(self):
+            return self._toks[:self._i]
+
+        def cancel(self):
+            if self._end is None:
+                self._end = ("end", "cancelled", None)
+                self.status = "cancelled"
+
+        def poll(self, timeout=None):
+            if self._end is not None:
+                return self._end
+            if self._i < len(self._toks):
+                self._i += 1
+                return ("tok", self._toks[self._i - 1])
+            self._end = ("end", "completed", None)
+            self.status = "completed"
+            return self._end
+
+    class _StubEngine:
+        """Engine-duck-typed deterministic token recurrence — no XLA
+        anywhere in the streaming hot path."""
+
+        def __init__(self, generation):
+            self._gen = int(generation)
+            self._n = itertools.count()
+
+        def submit(self, prompt_ids, max_new_tokens, timeout=None,
+                   resume_committed=None):
+            seq = ([int(t) for t in prompt_ids]
+                   + [int(t) for t in (resume_committed or [])])
+            toks = []
+            for _ in range(int(max_new_tokens)):
+                t = (sum(seq) * 31 + len(seq) + 7 * self._gen) % 211
+                seq.append(t)
+                toks.append(t)
+            return _StubStream(f"s{next(self._n)}", toks)
+
+        def shutdown(self, drain_timeout=None):
+            pass
+
+        def stats(self):
+            return {}
 
     n_req = int(os.environ.get("BENCH_SLO_REQUESTS", "160"))
     conc = int(os.environ.get("BENCH_SLO_CONCURRENCY", "8"))
@@ -715,6 +787,7 @@ def bench_slo(on_tpu, dev):
                            default_timeout=60.0,
                            batching=BatchConfig(max_wait_ms=2.0),
                            metrics=reg, name="slo")
+        router = None
         try:
             server = pool.serve_metrics()
             pool.warmup()
@@ -744,6 +817,43 @@ def bench_slo(on_tpu, dev):
             values["serving_smoke.queue_depth_peak"] = \
                 st["queue_depth_peak"]
 
+            # streaming TTFT through the distributed tier (docs/
+            # serving.md): a two-replica ServingRouter over stub decode
+            # engines shares the SAME registry, so its stream ledger
+            # lands in the scrape below. Every token is ready the
+            # moment a stream is placed — the p99 TTFT bound gates
+            # ROUTER overhead (affinity pick, admission, first-frame
+            # pump delivery), and a stall slipped into the pump loop
+            # trips the gate even though model compute never moved.
+            n_streams = int(os.environ.get("BENCH_SLO_STREAMS", "48"))
+            router = ServingRouter(
+                lambda rid, mdir, gen: LocalReplica(
+                    rid, lambda d: _NullPredictor(), mdir, gen,
+                    decode_factory=_StubEngine,
+                    pool_kwargs=dict(default_timeout=30.0)),
+                size=2,
+                config=RouterConfig(default_timeout=30.0,
+                                    affinity_block_tokens=4,
+                                    no_capacity_wait=10.0),
+                metrics=reg, name="slo")
+
+            def stream_one(i):
+                t0 = time.perf_counter()
+                rs = router.submit_generate([i % 7, 1, 4, 1], 8,
+                                            timeout=30.0)
+                it = iter(rs)
+                next(it)                    # first token lands
+                ttft = time.perf_counter() - t0
+                for _ in it:                # drain to completion
+                    pass
+                return ttft
+
+            with concurrent.futures.ThreadPoolExecutor(4) as ex:
+                list(ex.map(stream_one, range(8)))   # warm the tier
+                ttfts = list(ex.map(stream_one, range(n_streams)))
+            values["router_stream.ttft_p99_s"] = float(
+                np.percentile(np.asarray(ttfts), 99))
+
             # the SAME registry must be scrapeable as Prometheus text
             # from the live endpoint, conservation law intact
             text = urllib.request.urlopen(
@@ -767,7 +877,31 @@ def bench_slo(on_tpu, dev):
                       f"(admitted={scraped('admitted')} vs {balance}, "
                       f"healthz={healthz})", file=sys.stderr)
                 return None
+
+            # ... and so must the router's streams ledger (admitted ==
+            # completed + failed + timed_out + cancelled + in_flight)
+            rprefix = "serving_router_slo_streams_"
+            ledger = {}
+            for ln in text.splitlines():
+                if ln.startswith(rprefix):
+                    k, _, v = ln.partition(" ")
+                    ledger[k[len(rprefix):]] = int(float(v))
+            rbal = (ledger.get("completed", 0) + ledger.get("failed", 0)
+                    + ledger.get("timed_out", 0)
+                    + ledger.get("cancelled", 0)
+                    + ledger.get("in_flight", 0))
+            if ledger.get("admitted") != rbal \
+                    or ledger.get("admitted", 0) < n_streams:
+                print(f"bench_slo: scraped stream ledger broken "
+                      f"({ledger})", file=sys.stderr)
+                return None
+            if "router_ttft_seconds" not in text:
+                print("bench_slo: router_ttft_seconds missing from the "
+                      "scraped exposition", file=sys.stderr)
+                return None
         finally:
+            if router is not None:
+                router.shutdown(drain_timeout=10.0)
             pool.shutdown(drain_timeout=10.0)
 
     # training-dispatch floor: a tiny Engine loop (compile excluded)
@@ -795,21 +929,35 @@ def bench_slo(on_tpu, dev):
     values["train_smoke.steps_per_sec"] = steps / (time.perf_counter()
                                                    - t0)
 
-    if os.environ.get("BENCH_SLO_WRITE") == "1":
+    gate_objectives = slo_mod.SERVING_SMOKE + slo_mod.ROUTER_STREAM
+    write = os.environ.get("BENCH_SLO_WRITE", "")
+    if write in ("1", "stream"):
+        # "1" re-ratchets every row; "stream" re-ratchets only the
+        # router_stream.* rows, carrying the rest of the checked-in
+        # bounds over untouched (slo.write_baseline merge semantics)
+        ratchet = gate_objectives if write == "1" \
+            else slo_mod.ROUTER_STREAM
+        try:
+            merge = slo_mod.load_baseline(baseline_path)
+        except FileNotFoundError:
+            merge = None
         written = slo_mod.write_baseline(
-            baseline_path, values, slo_mod.SERVING_SMOKE,
-            note="CPU serving+train smoke bounds; re-ratchet with "
-                 "BENCH_SLO_WRITE=1 only for an intentional perf change")
-        print(f"bench_slo: wrote {len(written)} baseline bounds -> "
-              f"{baseline_path}", file=sys.stderr)
+            baseline_path, values, ratchet,
+            note="CPU serving+stream+train smoke bounds; re-ratchet "
+                 "with BENCH_SLO_WRITE=1 (all) or =stream "
+                 "(router_stream.* only) for an intentional perf "
+                 "change", merge=merge)
+        print(f"bench_slo: wrote {len(written)} baseline bounds "
+              f"({len(ratchet)} re-ratcheted) -> {baseline_path}",
+              file=sys.stderr)
 
     baseline = slo_mod.load_baseline(baseline_path)
-    report = slo_mod.evaluate(values, baseline, slo_mod.SERVING_SMOKE)
+    report = slo_mod.evaluate(values, baseline, gate_objectives)
     print(slo_mod.format_report(report), file=sys.stderr)
     payload = _emit({
         "metric": f"SLO gate ({len(report['results'])} objectives, "
-                  f"serving c={conc} n={n_req} + {steps}-step train "
-                  f"smoke)",
+                  f"serving c={conc} n={n_req} + {n_streams} routed "
+                  f"streams + {steps}-step train smoke)",
         "value": len(report["results"]) - len(report["breaches"]),
         "unit": "objectives passed",
         "vs_baseline": 1.0 if report["ok"] else 0.0,

@@ -24,11 +24,11 @@ import threading
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
 from ..nn.layer.layers import Layer
+from ..sharding import named_sharding as _named_sharding, spec as _pspec
 from .. import nn
 
 __all__ = ["ShardedEmbedding", "DistributedLookupTable",
@@ -56,7 +56,7 @@ class ShardedEmbedding(Layer):
             default_initializer=nn.initializer.Normal(0.0, std))
         # row-sharded over the given mesh axes (tuple spec shards the row
         # dim over their product)
-        self.weight.dist_spec = P(tuple(axes), None)
+        self.weight.dist_spec = _pspec(tuple(axes), None)
 
     def forward(self, ids):
         return apply("sharded_embedding", _lookup_impl,
@@ -222,11 +222,11 @@ class HostOffloadedEmbedding(Layer):
         hcg = topo_mod.get_hybrid_communicate_group()
         if axes and hcg is not None:
             mesh = hcg.mesh
-            host = _kind(jax.sharding.NamedSharding(
-                mesh, P(tuple(axes), None)), "pinned_host")
-            dev = _kind(jax.sharding.NamedSharding(mesh, P()), "device")
-            self._acc_host_sharding = _kind(jax.sharding.NamedSharding(
-                mesh, P(tuple(axes))), "pinned_host")
+            host = _kind(_named_sharding(mesh, (tuple(axes), None)),
+                         "pinned_host")
+            dev = _kind(_named_sharding(mesh, ()), "device")
+            self._acc_host_sharding = _kind(
+                _named_sharding(mesh, (tuple(axes),)), "pinned_host")
         else:
             d = jax.devices()[0]
             host = _kind(jax.sharding.SingleDeviceSharding(d),

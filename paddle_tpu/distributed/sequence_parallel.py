@@ -53,7 +53,10 @@ def _constrain_dim(x, dim, entry):
     Dispatched through `apply` so the eager tape records it — the VJP of a
     sharding constraint is the dual constraint, handled by jax.vjp."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    # P is imported for the UNCONSTRAINED sentinel only — construction
+    # goes through the paddle_tpu.sharding factories (TL011)
+    from jax.sharding import PartitionSpec as P
+    from ..sharding import named_sharding as _named_sharding
     from . import topology as topo_mod
     from ..core.dispatch import apply
     from ..core.tensor import Tensor
@@ -64,7 +67,7 @@ def _constrain_dim(x, dim, entry):
     v = x._value if isinstance(x, Tensor) else x
     entries = [P.UNCONSTRAINED] * v.ndim
     entries[dim] = entry
-    sharding = NamedSharding(mesh, P(*entries))
+    sharding = _named_sharding(mesh, entries)
     if isinstance(v, jax.core.Tracer):
         out = jax.lax.with_sharding_constraint(v, sharding)
         return Tensor(out) if isinstance(x, Tensor) else out
@@ -139,16 +142,16 @@ class ColumnSequenceParallelLinear(nn.Layer):
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, gather_output=False, fuse_matmul_bias=False,
                  mp_group=None, name=None):
-        from jax.sharding import PartitionSpec as P
+        from ..sharding import spec as _pspec
 
         super().__init__()
         self.linear = nn.Linear(in_features, out_features,
                                 weight_attr=weight_attr,
                                 bias_attr=None if has_bias else False)
-        self.linear.weight.dist_spec = P(None, "mp")
+        self.linear.weight.dist_spec = _pspec(None, "mp")
         self.linear.weight.is_distributed = True
         if self.linear.bias is not None:
-            self.linear.bias.dist_spec = P("mp")
+            self.linear.bias.dist_spec = _pspec("mp")
             self.linear.bias.is_distributed = True
         self.gather_output = gather_output
 
@@ -179,7 +182,7 @@ class RowSequenceParallelLinear(nn.Layer):
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=True,
                  fuse_matmul_bias=False, mp_group=None, name=None):
-        from jax.sharding import PartitionSpec as P
+        from ..sharding import spec as _pspec
 
         super().__init__()
         if not input_is_parallel:
@@ -188,7 +191,7 @@ class RowSequenceParallelLinear(nn.Layer):
                 "(reference sequence_parallel_utils.py:362 asserts this)")
         self.linear = nn.Linear(in_features, out_features,
                                 weight_attr=weight_attr, bias_attr=False)
-        self.linear.weight.dist_spec = P("mp", None)
+        self.linear.weight.dist_spec = _pspec("mp", None)
         self.linear.weight.is_distributed = True
         self.bias = self.create_parameter(
             [out_features], is_bias=True) if has_bias else None

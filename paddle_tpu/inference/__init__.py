@@ -26,7 +26,8 @@ __all__ = [
     # continuous-batching LLM decode engine (decode/)
     "DecodeEngine", "SequenceStream", "BlockKVCache", "OutOfBlocks",
     # distributed serving tier (replica.py + router.py)
-    "ServingRouter", "RouterConfig", "SwapFailed", "commit_model_dir",
+    "ServingRouter", "RouterConfig", "RouterStream", "SwapFailed",
+    "commit_model_dir",
     "LocalReplica", "SubprocessReplica", "LocalHeartbeats",
     "ReplicaError", "ReplicaDead",
 ]
@@ -282,5 +283,6 @@ from .replica import (  # noqa: E402
     SubprocessReplica,
 )
 from .router import (  # noqa: E402
-    RouterConfig, ServingRouter, SwapFailed, commit_model_dir,
+    RouterConfig, RouterStream, ServingRouter, SwapFailed,
+    commit_model_dir,
 )

@@ -1,0 +1,79 @@
+"""Tiny self-contained decode-engine builder for harnesses and demos.
+
+The streaming serving tier needs a REAL continuous-batching engine in
+places where no trained checkpoint exists: the fault injector's
+router-stream phases, the slow subprocess streaming proof, and a replica
+process started with ``--decode-factory`` (replica.serve_replica). This
+module is the one shared recipe so every side of a bit-exactness
+comparison builds the SAME weights: a tiny LLaMA-style model (rope + GQA
++ swiglu) whose random init emits varied greedy tokens, seeded by
+`generation` so a weight swap is bit-visible in the token stream.
+
+    from paddle_tpu.inference.decode.demo import tiny_engine
+    eng = tiny_engine(generation=0)
+    tokens = eng.generate(prompt_ids, 8)
+
+Not a serving surface — a deterministic fixture factory.
+"""
+from __future__ import annotations
+
+VOCAB = 97          # prime, mismatched to every bucket size
+MAX_LENGTH = 32
+BLOCK_SIZE = 8
+
+
+def tiny_model(generation=0):
+    """The demo checkpoint for `generation`: deterministic per-generation
+    random init (seed varies with the generation, so two generations
+    greedy-decode DIFFERENT token sequences from the same prompt)."""
+    import paddle_tpu as paddle
+    from ...models import gpt
+
+    paddle.seed(7 + int(generation))
+    m = gpt("gpt_tiny", vocab_size=VOCAB, hidden_size=48, num_heads=4,
+            num_kv_heads=2, num_layers=2, rope=True, swiglu=True,
+            rms_norm=True, max_position_embeddings=64,
+            tie_word_embeddings=False)
+    m.eval()
+    return m
+
+
+def tiny_engine(generation=0, **over):
+    """A `DecodeEngine` over `tiny_model(generation)` with small test
+    geometry (32-token window, 8-token blocks, chunked-prefill-friendly
+    buckets). Keyword overrides pass through to the engine."""
+    from .engine import DecodeEngine
+
+    # prefill buckets reach past the base prompt length so a mid-stream
+    # failover's resume prompt (prompt + committed tokens) still admits;
+    # 8 stays the chunk, so resumes exercise chunked prefill's absolute
+    # block-aligned boundaries (the bit-exactness guarantee under test)
+    kw = dict(max_length=MAX_LENGTH, block_size=BLOCK_SIZE,
+              decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16, 24),
+              default_timeout=30.0, step_timeout=30.0, step_retries=2,
+              hang_grace=0.05, supervise_interval=0.01)
+    kw.update(over)
+    return DecodeEngine(tiny_model(generation), **kw)
+
+
+def tiny_engine_slow(generation=0, **over):
+    """`tiny_engine` throttled through the engine's fault hook (~20 ms
+    per dispatch), so a generation spans long enough wall-clock that a
+    harness can reliably SIGKILL / SIGSTOP / hot-swap a replica while
+    the stream is still mid-flight. Same weights, same tokens — the
+    bit-exactness references stay `tiny_engine(generation)`."""
+    import time
+
+    def _throttle(tag, ids, info):
+        time.sleep(0.02)
+
+    over.setdefault("fault_hook", _throttle)
+    return tiny_engine(generation, **over)
+
+
+def demo_prompt(seed, length):
+    """Deterministic prompt ids for `seed` (the injector/test idiom)."""
+    import numpy as np
+
+    return np.random.RandomState(int(seed)).randint(
+        0, VOCAB, (int(length),)).astype(np.int32)

@@ -153,6 +153,7 @@ class SequenceStream:
         self._error = None
         self._cancel = None       # engine-installed cancel callback
         self._raised = False
+        self._ended = False       # poll() consumed the _END sentinel
 
     # -- engine side -------------------------------------------------------
     def _push(self, tok):
@@ -209,6 +210,33 @@ class SequenceStream:
         for _ in self:
             pass
         return list(self.tokens)
+
+    def poll(self, timeout=None):
+        """Non-raising pump primitive (the router's streaming proxy and
+        the store-transport frame pump consume through this): wait up to
+        `timeout` seconds for the next event and return one of
+
+        * ``("tok", token)`` — the next generated token,
+        * ``("end", status, error)`` — terminal (re-returned on every
+          later call: an end is sticky),
+        * ``("empty", None)`` — nothing arrived within `timeout`.
+
+        Unlike iteration, `poll` does NOT enforce the caller-side
+        deadline — pumps own their scheduling. A stream must be consumed
+        through either the iterator or `poll`, never both."""
+        if self._ended:
+            return ("end", self._status, self._error)
+        try:
+            if timeout is None or timeout <= 0:
+                item = self._q.get_nowait()
+            else:
+                item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return ("empty", None)
+        if item is not _END:
+            return ("tok", item)
+        self._ended = True
+        return ("end", self._status, self._error)
 
 
 class _Seq:
@@ -519,6 +547,7 @@ class DecodeEngine:
         self._timed_out = 0
         self._cancelled = 0
         self._shed = 0
+        self._resumed = 0         # resume-from-committed admissions
         self._steps_run = 0
         self._prefills = 0
         self._prefill_chunks = 0
@@ -623,7 +652,8 @@ class DecodeEngine:
         return h.hexdigest()
 
     # -- admission ---------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens, timeout=None):
+    def submit(self, prompt_ids, max_new_tokens, timeout=None, *,
+               resume_committed=None):
         """Admit one generation request; returns its `SequenceStream`.
 
         Validation errors (malformed *request*: bad dtype/rank, empty or
@@ -632,8 +662,29 @@ class DecodeEngine:
         waiting queue raises `Overloaded`, a closed engine `PoolClosed`,
         a dead-on-arrival deadline `DeadlineExceeded`. The deadline
         (`timeout` seconds, None -> `default_timeout`, both None ->
-        unbounded) covers queue wait AND the whole generation."""
+        unbounded) covers queue wait AND the whole generation.
+
+        `resume_committed` is the mid-stream failover admission path
+        (docs/serving.md): tokens already committed to the client by a
+        prior attempt on another replica become a prompt extension, so
+        this sequence decodes the CONTINUATION — greedy decode over the
+        absolute-chunk-boundary prefill makes the resumed output
+        bit-identical to the uninterrupted run, and the prefix cache
+        makes the re-prefill cheap. The stream yields only the new
+        tokens (the caller owns stitching)."""
         ids = np.asarray(prompt_ids)
+        committed = 0
+        if resume_committed is not None and len(resume_committed):
+            ext = np.asarray(resume_committed)
+            if ids.ndim == 2 and ids.shape[0] == 1:
+                ids = ids[0]
+            if ext.ndim != 1 or not np.issubdtype(ext.dtype, np.integer):
+                raise ValueError(
+                    f"resume_committed must be a 1-D integer id array, "
+                    f"got shape {ext.shape} dtype {ext.dtype}")
+            committed = int(ext.shape[0])
+            ids = np.concatenate([ids.astype(np.int64),
+                                  ext.astype(np.int64)])
         if ids.ndim == 2 and ids.shape[0] == 1:
             ids = ids[0]
         if ids.ndim != 1 or not np.issubdtype(ids.dtype, np.integer):
@@ -701,10 +752,14 @@ class DecodeEngine:
                     "decode.sequence",
                     attrs={"engine": self.name, "seq": seq.id,
                            "prompt_len": int(ids.shape[0]),
-                           "max_new": max_new})
+                           "max_new": max_new,
+                           **({"resumed_from": committed}
+                              if committed else {})})
             seq.stream._cancel = lambda s=seq: self._request_cancel(s)
             self._waiting.append(seq)
             self._admitted += 1
+            if committed:
+                self._resumed += 1
             self._cv.notify()
         return seq.stream
 
@@ -2203,6 +2258,7 @@ class DecodeEngine:
                 "timed_out": self._timed_out,
                 "cancelled": self._cancelled,
                 "shed": self._shed,
+                "resumed": self._resumed,
                 "waiting": len(self._waiting),
                 "prefilling": len(self._prefill_q),
                 "active": len(self._active),

@@ -28,6 +28,14 @@ Handle contract (what router.py consumes):
     infer(feeds, timeout)   -> outputs | typed ServingError / ReplicaDead
     infer_stamped(feeds, timeout) -> (outputs, generation) — the stamp is
                             read atomically with execution (swap gate)
+    submit_generate(prompt_ids, max_new, timeout, resume_committed,
+                    admission_timeout) -> (stream, generation) — a
+                            streaming generation on the replica's decode
+                            engine; the stream speaks the pump contract
+                            (`poll(timeout)` -> ("tok", t) / ("end",
+                            status, error) / ("empty", None), plus
+                            `cancel()`), and the generation stamp is read
+                            atomically with admission (swap gate)
     queue_depth()           -> int routing load signal
     beat_age()              -> seconds since last heartbeat | None
     drained()               -> bool (no queued / in-flight work)
@@ -35,6 +43,17 @@ Handle contract (what router.py consumes):
     swap(model_dir, generation)  drain-site weight hot-swap (pool.rebase)
     restart(model_dir, generation)  rebuild after death
     kill() / close(drain_timeout)   abrupt / graceful teardown
+
+Streaming over the store transport (SubprocessReplica): the request
+payload is a `("__generate__", prompt, max_new, timeout, committed,
+wire)` tuple on the ordinary `req/<seq>` channel; the replica process
+answers `("gen-admit", generation)` on `res/<seq>` at admission, then
+writes chunked token frames `("tok", [ids...])` and one terminal
+`("end", status, kind, msg, det, spans)` frame under
+`genres/<seq>/<i>`. The client's cancel is a `gencancel/<seq>` key the
+replica-side frame pump checks every round, so an abandoned stream
+frees its KV blocks within one scheduler round instead of at deadline
+expiry. `LocalReplica` streams stay in-process (no frames).
 
 Heartbeats: `LocalHeartbeats` duck-types the slice of the store surface
 `Watchdog` reads (`keys("/hb/")` + `heartbeat_age`), so the router runs
@@ -115,6 +134,57 @@ class LocalHeartbeats:
 # in-process replica (threads-as-replicas)
 # ---------------------------------------------------------------------------
 
+class _LocalStream:
+    """Pump-contract wrapper over an in-process `SequenceStream` that
+    makes fault injection on a `LocalReplica` behave like the real
+    process faults: a wedged replica stops yielding (`poll` returns
+    ("empty", None) exactly as a SIGSTOPped process stops writing
+    frames), and a killed replica surfaces `ReplicaDead` so the router's
+    failover trigger is the same object in both topologies."""
+
+    def __init__(self, replica, inner):
+        self._rep = replica
+        self._inner = inner
+        self.id = inner.id
+        self.deadline = inner.deadline
+
+    @property
+    def tokens(self):
+        return self._inner.tokens
+
+    @property
+    def status(self):
+        return self._inner.status
+
+    def cancel(self):
+        self._inner.cancel()
+
+    def poll(self, timeout=None):
+        rep = self._rep
+        if rep._wedged and not rep._killed:
+            # frozen replica: nothing flows; wait out the slice on the
+            # resume event so an unwedge delivers promptly
+            rep._resume.wait(timeout if timeout and timeout > 0 else 0)
+            with rep._lock:
+                if rep._wedged and not rep._killed:
+                    return ("empty", None)
+        if rep._killed:
+            # process fidelity: a SIGKILLed replica's unshipped frames
+            # are LOST, even if its engine had decoded ahead of the pump
+            # (e.g. buffering through a wedge) — report replica death so
+            # the router resumes from the tokens the client actually got
+            return ("end", "failed",
+                    ReplicaDead(f"replica {rep.rid} went away "
+                                f"mid-generation"))
+        kind = self._inner.poll(timeout)
+        if kind[0] == "end" and kind[1] != "completed" and rep._killed:
+            # the engine died WITH the replica mid-poll
+            return ("end", "failed",
+                    ReplicaDead(f"replica {rep.rid} went away "
+                                f"mid-generation"))
+        return kind
+
+
 class LocalReplica:
     """One serving replica hosted in this process.
 
@@ -127,11 +197,17 @@ class LocalReplica:
 
     def __init__(self, rid, predictor_factory, model_dir=None, generation=0,
                  *, pool_size=1, pool_kwargs=None, heartbeat=None,
-                 heartbeat_interval=0.05, clock=time.monotonic):
+                 heartbeat_interval=0.05, decode_factory=None,
+                 clock=time.monotonic):
         self.rid = str(rid)
         self.model_dir = model_dir
         self.generation = int(generation)
         self._factory = predictor_factory
+        #: `decode_factory(generation) -> DecodeEngine`: when set, every
+        #: pool this replica builds (construction, restart, swap) carries
+        #: a decode engine for that weight generation, enabling
+        #: submit_generate() through this handle
+        self._decode_factory = decode_factory
         self._pool_size = int(pool_size)
         self._pool_kwargs = dict(pool_kwargs or {})
         self._clock = clock
@@ -169,9 +245,12 @@ class LocalReplica:
         t.start()
         return stop
 
-    def _make_pool(self, base):
+    def _make_pool(self, base, generation=None):
         kw = dict(self._pool_kwargs)
         kw.setdefault("max_queue_depth", 16)
+        if self._decode_factory is not None and "decode_engine" not in kw:
+            gen = self.generation if generation is None else int(generation)
+            kw["decode_engine"] = self._decode_factory(gen)
         return ServingPool(predictor=base, size=self._pool_size,
                            clock=self._clock, **kw)
 
@@ -250,6 +329,60 @@ class LocalReplica:
             with self._lock:
                 self._entering -= 1
 
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None,
+                        *, resume_committed=None, admission_timeout=None):
+        """Admit one streaming generation on this replica's decode
+        engine; returns `(stream, generation)` where the stream speaks
+        the pump contract (`poll` / `cancel`) and the stamp is EXACTLY
+        the weight generation the sequence was admitted under (same swap
+        gate as `infer_stamped`). `admission_timeout` bounds the gate
+        wait (wedge/swap hold) separately from the generation deadline —
+        the router passes its per-attempt timeout here so a frozen
+        replica sheds the ATTEMPT, not the whole stream budget."""
+        adm = Deadline(admission_timeout if admission_timeout is not None
+                       else timeout, clock=self._clock)
+        if self._wedged:
+            with self._lock:
+                wedged = self._wedged
+                if wedged:
+                    self._blocked += 1
+            if wedged:
+                try:
+                    self._resume.wait(adm.remaining())
+                finally:
+                    with self._lock:
+                        self._blocked -= 1
+                with self._lock:
+                    if self._wedged and not self._killed:
+                        raise DeadlineExceeded(
+                            f"replica {self.rid} wedged past the "
+                            f"admission deadline")
+        while True:
+            with self._lock:
+                if self._killed:
+                    raise ReplicaDead(f"replica {self.rid} is dead")
+                if not self._swapping:
+                    gen = self.generation
+                    pool = self._pool
+                    self._entering += 1
+                    break
+            if adm.expired():
+                raise DeadlineExceeded(
+                    f"replica {self.rid} held the stream at its swap "
+                    f"gate past the admission deadline")
+            time.sleep(0.002)
+        try:
+            inner = pool.submit_generate(prompt_ids, max_new_tokens,
+                                         timeout=timeout,
+                                         resume_committed=resume_committed)
+            return _LocalStream(self, inner), gen
+        except PoolClosed as e:
+            raise ReplicaDead(
+                f"replica {self.rid} went away at stream admission") from e
+        finally:
+            with self._lock:
+                self._entering -= 1
+
     def queue_depth(self):
         """Routing load signal: the pool's queued + retry-pending +
         in-flight count, plus callers a wedge is holding."""
@@ -288,10 +421,34 @@ class LocalReplica:
         first; the swap gate additionally holds out any straggler caller
         racing the drain, so no request straddles the generation cut."""
         base = self._factory(model_dir)
+        engine = None
+        if self._decode_factory is not None:
+            # build the incoming generation's engine in a helper thread:
+            # the router holds its swap mutex across this call, and an
+            # engine build blocks on compile-cache IO — the lock
+            # discipline (no blocking region entered while holding
+            # router.swap) requires the IO to happen in ANOTHER thread
+            # while this one only waits
+            box = {}
+
+            def _build():
+                try:
+                    box["engine"] = self._decode_factory(int(generation))
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    box["err"] = e
+
+            t = threading.Thread(target=_build, daemon=True,
+                                 name=f"{self.rid}-engine-build")
+            t.start()
+            t.join()
+            if "err" in box:
+                raise box["err"]
+            engine = box["engine"]
         with self._lock:
             if self._killed:
                 raise ReplicaDead(f"replica {self.rid} is dead")
             self._swapping = True
+        installed = False
         try:
             while True:           # wait out callers already past the gate
                 with self._lock:
@@ -304,6 +461,14 @@ class LocalReplica:
                 time.sleep(0.002)
             try:
                 pool.rebase(base)
+                if engine is not None:
+                    # the router drained this replica's streams first, so
+                    # the outgoing engine is quiesced; the incoming one
+                    # carries the NEW generation's weights — a stream
+                    # admitted after the gate opens is stamped and served
+                    # entirely on one side of the cut
+                    pool.swap_engine(engine)
+                installed = True
             except PoolClosed as e:
                 raise ReplicaDead(
                     f"replica {self.rid} died during weight swap") from e
@@ -315,6 +480,10 @@ class LocalReplica:
                 self.generation = int(generation)
                 self.swaps += 1
         finally:
+            if engine is not None and not installed:
+                # a swap interrupted before the engine landed must not
+                # orphan its scheduler thread / block pool
+                engine.shutdown(drain_timeout=0.5)
             with self._lock:
                 self._swapping = False
 
@@ -325,7 +494,7 @@ class LocalReplica:
         backs off (jittered) and retries."""
         model_dir = self.model_dir if model_dir is None else model_dir
         gen = self.generation if generation is None else int(generation)
-        pool = self._make_pool(self._factory(model_dir))
+        pool = self._make_pool(self._factory(model_dir), generation=gen)
         with self._lock:
             old, self._pool = self._pool, pool
             self._killed = False
@@ -415,10 +584,33 @@ def _ack_key(rid, epoch, seq):
     return f"/replica/{rid}/{epoch}/ack/{seq}"
 
 
+def _genres_key(rid, epoch, seq, frame):
+    return f"/replica/{rid}/{epoch}/genres/{seq}/{frame}"
+
+
+def _gencancel_key(rid, epoch, seq):
+    return f"/replica/{rid}/{epoch}/gencancel/{seq}"
+
+
+def _load_decode_factory(spec):
+    """Resolve a ``module:callable`` decode-factory spec (or pass a
+    callable through). The callable is invoked as `factory(generation)`
+    and must return a `DecodeEngine`."""
+    if callable(spec):
+        return spec
+    import importlib
+
+    mod, _, attr = str(spec).partition(":")
+    if not attr:
+        raise ValueError(
+            f"decode factory spec must be 'module:callable', got {spec!r}")
+    return getattr(importlib.import_module(mod), attr)
+
+
 def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                   generation=0, epoch=0, pool_size=1,
                   heartbeat_interval=0.25, poll_interval=0.005,
-                  default_timeout=None):
+                  default_timeout=None, decode_factory=None):
     """Replica process main loop: serve `/replica/<rid>/<epoch>/req/*`
     requests from the coordination store with a local `ServingPool` over
     the exported artifact at `model_prefix`, publish liveness under
@@ -426,6 +618,16 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
     `/replica/<rid>/<epoch>/depth`, and obey `swap <gen> <dir-prefix>` /
     `stop` control commands. Runs until `stop` (or the store goes away —
     the router's watchdog then declares this replica dead).
+
+    With `decode_factory` (``module:callable``, invoked as
+    `factory(generation) -> DecodeEngine`) the pool carries a decode
+    engine and the loop additionally serves streaming generations:
+    ``("__generate__", ...)`` request payloads are admitted under the
+    swap gate, answered with a `("gen-admit", generation)` stamp, and
+    pumped as chunked token frames + one terminal frame under
+    ``genres/<seq>/<i>`` (module docstring). A ``gencancel/<seq>`` key
+    from the client cancels the engine sequence within one pump round,
+    so abandoned streams free their KV blocks promptly.
 
     Every key is namespaced by the spawn `epoch` (the router bumps it per
     respawn), so a restarted replica's fresh serve loop can never be
@@ -444,9 +646,18 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
     state = {"generation": int(generation), "prefix": model_prefix,
              "entering": 0, "swapping": False}
     gate = _locks.new_lock("router.replica")
+    engine = None
+    if decode_factory is not None:
+        engine = _load_decode_factory(decode_factory)(int(generation))
     pool = ServingPool(predictor=Predictor(Config(model_prefix)),
-                       size=pool_size, default_timeout=default_timeout)
-    ex = concurrent.futures.ThreadPoolExecutor(max_workers=pool_size + 2)
+                       size=pool_size, default_timeout=default_timeout,
+                       decode_engine=engine)
+    # streams hold their executor worker for the whole generation, so
+    # give them headroom beside the one-shot infer workers
+    stream_slots = engine.max_active if engine is not None else 0
+    ex = concurrent.futures.ThreadPoolExecutor(
+        max_workers=pool_size + 2 + stream_slots)
+    streams = {"live": 0}   # plain int under the GIL: a load signal
 
     def _respond(seq, feeds, timeout, wire=None):
         dl = Deadline(timeout)
@@ -505,11 +716,132 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                 state["entering"] -= 1
         _ship(payload)
 
+    def _respond_generate(seq, prompt, max_new, timeout, committed, wire):
+        """Streaming responder: admit under the swap gate, stamp the
+        admission generation back as `("gen-admit", gen)` on the res key,
+        then pump engine tokens into chunked ``genres`` frames until the
+        stream ends. The client's cancel key is polled every pump round,
+        so an abandoned stream's KV blocks come back within one scheduler
+        round + one pump round, not at deadline expiry."""
+        dl = Deadline(timeout)
+        ctx = (_otrace.TraceContext.from_wire(wire)
+               if wire is not None and _otrace.enabled() else None)
+
+        def _ship_res(payload):
+            store.set(_res_key(rid, ep, seq), pickle.dumps(payload))
+            res_written.append((seq, time.monotonic()))
+
+        while True:  # swap gate, as for one-shot infer
+            with gate:
+                if not state["swapping"]:
+                    state["entering"] += 1
+                    gen = state["generation"]
+                    break
+            if dl.expired():
+                _ship_res(("err", "DeadlineExceeded",
+                           "held at the swap gate past the deadline",
+                           False))
+                return
+            time.sleep(0.002)
+        try:
+            try:
+                with _otrace.span_in(
+                        "replica.generate", ctx,
+                        attrs=None if ctx is None else
+                        {"rid": rid, "generation": gen,
+                         "resume_committed":
+                             0 if committed is None else len(committed)}):
+                    stream = pool.submit_generate(
+                        prompt, max_new, timeout=dl.remaining(),
+                        resume_committed=committed)
+            except ServingError as e:
+                det = isinstance(getattr(e, "cause", None),
+                                 DETERMINISTIC_ERRORS)
+                payload = ("err", type(e).__name__, str(e), det)
+                if ctx is not None and ctx.sampled:
+                    payload = payload + ([s.to_dict() for s in
+                                          _flight.recorder().spans_for(
+                                              ctx.trace_id)],)
+                _ship_res(payload)
+                return
+            except Exception as e:  # tpu-lint: disable=TL007 — typed
+                # RequestFailed on the client side, never swallowed
+                _ship_res(("err", "RequestFailed",
+                           f"{type(e).__name__}: {e}", False))
+                return
+        finally:
+            with gate:
+                state["entering"] -= 1
+
+        streams["live"] += 1
+        frame = 0
+        buf: "list[int]" = []
+        last_flush = time.monotonic()
+
+        def _flush(terminal=None):
+            nonlocal frame, buf, last_flush
+            if buf:
+                store.set(_genres_key(rid, ep, seq, frame),
+                          pickle.dumps(("tok", buf)))
+                frames_written.append(
+                    (_genres_key(rid, ep, seq, frame), time.monotonic()))
+                frame += 1
+                buf = []
+            if terminal is not None:
+                store.set(_genres_key(rid, ep, seq, frame),
+                          pickle.dumps(terminal))
+                frames_written.append(
+                    (_genres_key(rid, ep, seq, frame), time.monotonic()))
+                frame += 1
+            last_flush = time.monotonic()
+
+        cancelled = False
+        try:
+            _ship_res(("gen-admit", gen))
+            while True:
+                polled = stream.poll(0.01)
+                if polled[0] == "tok":
+                    buf.append(int(polled[1]))
+                    if len(buf) >= 16:
+                        _flush()
+                elif polled[0] == "end":
+                    _, status, err = polled
+                    if status == "completed":
+                        payload = ("end", "completed", None, None, False)
+                    else:
+                        det = isinstance(getattr(err, "cause", None),
+                                         DETERMINISTIC_ERRORS)
+                        payload = ("end", status,
+                                   type(err).__name__ if err is not None
+                                   else "RequestFailed",
+                                   str(err) if err is not None else "",
+                                   det)
+                    if ctx is not None and ctx.sampled:
+                        payload = payload + (
+                            [s.to_dict() for s in
+                             _flight.recorder().spans_for(ctx.trace_id)],)
+                    _flush(terminal=payload)
+                    return
+                elif buf and time.monotonic() - last_flush > 0.02:
+                    _flush()
+                if not cancelled and store.get_nowait(
+                        _gencancel_key(rid, ep, seq)) is not None:
+                    cancelled = True
+                    stream.cancel()  # engine evicts at the next step
+                    # boundary and frees the blocks; the pump keeps
+                    # draining until the typed "cancelled" terminal
+        finally:
+            streams["live"] -= 1
+            store.delete_key(_gencancel_key(rid, ep, seq))
+
     # response keys a timed-out caller abandoned (it deletes the key on
     # every path it actually reads) are reaped after RES_TTL so sustained
-    # wedge/failover traffic cannot grow the store without bound
+    # wedge/failover traffic cannot grow the store without bound; token
+    # frames the client consumed are deleted by the client, so the same
+    # TTL reap covers only abandoned-stream leftovers
     RES_TTL = 120.0
     res_written: "list[tuple[int, float]]" = []
+    frames_written: "list[tuple[str, float]]" = []
     served = ctl_seen = 0
     last_depth = None
     try:
@@ -522,6 +854,10 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                 payload = pickle.loads(raw)
                 if payload is None:
                     pass  # client-side tombstone: seq consumed, no work
+                elif payload[0] == "__generate__":
+                    _, prompt, max_new, timeout, committed, wire = payload
+                    ex.submit(_respond_generate, seq, prompt, max_new,
+                              timeout, committed, wire)
                 else:
                     feeds, timeout = payload[0], payload[1]
                     wire = payload[2] if len(payload) > 2 else None
@@ -548,6 +884,16 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                                         break
                                 time.sleep(0.002)
                             pool.rebase(base)
+                            if engine is not None:
+                                # the router drained this replica's
+                                # streams before commanding the swap, so
+                                # the outgoing engine is quiesced; any
+                                # straggler a client abandoned is failed
+                                # typed by the old engine's shutdown and
+                                # its pump ships the terminal frame
+                                engine = _load_decode_factory(
+                                    decode_factory)(gen)
+                                pool.swap_engine(engine)
                             with gate:
                                 state["generation"] = gen
                                 state["prefix"] = prefix
@@ -563,7 +909,10 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                 else:
                     store.set(_ack_key(rid, ep, seq), b"err unknown-command")
                 progressed = True
-            depth = pool.load()
+            # live streams count toward the published load signal: a
+            # replica saturated with generations should not look idle to
+            # the router's least-loaded pick
+            depth = pool.load() + streams["live"]
             if depth != last_depth:
                 store.set(f"/replica/{rid}/{ep}/depth", str(depth).encode())
                 last_depth = depth
@@ -571,6 +920,10 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                     time.monotonic() - res_written[0][1] > RES_TTL:
                 old_seq, _ = res_written.pop(0)
                 store.delete_key(_res_key(rid, ep, old_seq))  # no-op if read
+            while frames_written and \
+                    time.monotonic() - frames_written[0][1] > RES_TTL:
+                key, _ = frames_written.pop(0)
+                store.delete_key(key)  # no-op if the client consumed it
             if not progressed:
                 time.sleep(poll_interval)
     finally:
@@ -578,6 +931,111 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
         pool.shutdown(drain_timeout=1.0)
         store.stop_heartbeat()
         store.close()
+
+
+class _RemoteStream:
+    """Client half of the store stream transport: reads the replica
+    process's chunked token frames (``genres/<seq>/<frame>``) strictly in
+    order, deleting each consumed key, and goes sticky on the terminal
+    frame. A replica process that dies mid-stream surfaces as
+    `("end", "failed", ReplicaDead)` — the router's pump reads that as
+    "fail over", never as "stream failed". Same pump contract as
+    `SequenceStream.poll` / `_LocalStream`."""
+
+    def __init__(self, rep, seq):
+        self._rep = rep
+        self._epoch = rep._epoch
+        self._seq = seq
+        self._frame = 0
+        self._pending = []   # frame tokens not yet handed to the pump
+        self.tokens = []     # every token handed out, in order
+        self._ended = False
+        self._status = None
+        self._error = None
+        self._cancelled = False
+
+    @property
+    def status(self):
+        return self._status
+
+    def cancel(self):
+        """Ask the replica process to evict the sequence: one small key
+        write; the serve loop's pump sees it within one round and the
+        engine frees the KV blocks at the next step boundary."""
+        if self._cancelled or self._ended:
+            return
+        self._cancelled = True
+        try:
+            self._rep._store.set(
+                _gencancel_key(self._rep.rid, self._epoch, self._seq), b"1")
+        except Exception:  # tpu-lint: disable=TL007 — store down: the
+            pass           # watchdog story owns this replica now
+
+    def _key(self):
+        return _genres_key(self._rep.rid, self._epoch, self._seq,
+                           self._frame)
+
+    def poll(self, timeout=None):
+        import pickle
+
+        if self._pending:
+            tok = self._pending.pop(0)
+            self.tokens.append(tok)
+            return ("tok", tok)
+        if self._ended:
+            return ("end", self._status, self._error)
+        dl = Deadline(timeout, clock=self._rep._clock) \
+            if timeout is not None and timeout > 0 else None
+        while True:
+            try:
+                raw = self._rep._store.get_nowait(self._key())
+            except Exception as e:  # tpu-lint: disable=TL007 — a store
+                # hiccup mid-stream reads as replica death: fail over
+                self._ended = True
+                self._status = "failed"
+                self._error = ReplicaDead(
+                    f"replica {self._rep.rid}: stream transport lost "
+                    f"({type(e).__name__}: {e})")
+                return ("end", self._status, self._error)
+            if raw is not None:
+                self._rep._store.delete_key(self._key())
+                self._frame += 1
+                payload = pickle.loads(raw)
+                if payload[0] == "tok":
+                    self._pending.extend(payload[1])
+                    tok = self._pending.pop(0)
+                    self.tokens.append(tok)
+                    return ("tok", tok)
+                # terminal frame: ("end", status, kind, msg, det[, spans])
+                _, status, kind, msg, det = payload[:5]
+                if len(payload) > 5 and payload[5]:
+                    _flight.recorder().ingest(payload[5])
+                self._ended = True
+                self._status = status
+                self._error = None if status == "completed" else \
+                    _typed_error(kind or "RequestFailed",
+                                 f"replica {self._rep.rid}: {msg}",
+                                 deterministic=bool(det))
+                return ("end", self._status, self._error)
+            if self._rep._proc is None or \
+                    self._rep._proc.poll() is not None:
+                # one last look below would race frames that landed just
+                # before death; the next loop pass covers it, so only
+                # declare death when the frame key is truly absent
+                try:
+                    raw = self._rep._store.get_nowait(self._key())
+                except Exception:  # tpu-lint: disable=TL007 — as above
+                    raw = None
+                if raw is None:
+                    self._ended = True
+                    self._status = "failed"
+                    self._error = ReplicaDead(
+                        f"replica {self._rep.rid} died mid-stream")
+                    return ("end", self._status, self._error)
+                continue
+            if dl is None or dl.expired():
+                return ("empty", None)
+            time.sleep(0.003)
 
 
 class SubprocessReplica:
@@ -589,7 +1047,7 @@ class SubprocessReplica:
 
     def __init__(self, rid, store, model_dir=None, generation=0, *,
                  pool_size=1, artifact_name=None, start_timeout=60.0,
-                 clock=time.monotonic):
+                 decode_factory=None, clock=time.monotonic):
         self.rid = str(rid)
         self.model_dir = model_dir
         self.generation = int(generation)
@@ -599,6 +1057,9 @@ class SubprocessReplica:
         self._artifact_name = artifact_name
         self._store = store
         self._pool_size = int(pool_size)
+        #: ``module:callable`` spec forwarded to the replica process so
+        #: its pool carries a decode engine (streaming generations)
+        self._decode_factory = decode_factory
         self._start_timeout = float(start_timeout)
         self._clock = clock
         self._proc = None
@@ -621,14 +1082,16 @@ class SubprocessReplica:
         # never be stranded behind a previous life's consumed sequence
         # counters (the rpc.py stale-counter hazard)
         self._epoch = self._store.add(f"/replica/{self.rid}/epoch", 1)
-        self._proc = subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.inference.replica",
-             "--rid", self.rid, "--host", str(self._store.host),
-             "--port", str(self._store.port),
-             "--model", self._prefix_for(self.model_dir),
-             "--generation", str(self.generation),
-             "--epoch", str(self._epoch),
-             "--pool-size", str(self._pool_size)])
+        argv = [sys.executable, "-m", "paddle_tpu.inference.replica",
+                "--rid", self.rid, "--host", str(self._store.host),
+                "--port", str(self._store.port),
+                "--model", self._prefix_for(self.model_dir),
+                "--generation", str(self.generation),
+                "--epoch", str(self._epoch),
+                "--pool-size", str(self._pool_size)]
+        if self._decode_factory is not None:
+            argv += ["--decode-factory", str(self._decode_factory)]
+        self._proc = subprocess.Popen(argv)
         dl = Deadline(self._start_timeout, clock=self._clock)
         while True:
             age = self._store.heartbeat_age(self.rid)
@@ -718,6 +1181,81 @@ class SubprocessReplica:
                 raise DeadlineExceeded(
                     f"replica {self.rid} gave no answer within the "
                     f"attempt deadline (wedged process?)")
+            time.sleep(0.003)
+
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None, *,
+                        resume_committed=None, admission_timeout=None):
+        """`(stream, generation)`: ship the prompt to the replica process
+        and wait out its swap-gate admission; the stamp comes back as the
+        `("gen-admit", gen)` reply, after which tokens flow as chunked
+        frames through the returned `_RemoteStream`. `admission_timeout`
+        bounds ONLY the wait for the stamp (the router's per-attempt
+        knob); `timeout` rides the wire as the engine-side deadline."""
+        import pickle
+
+        import numpy as np
+
+        if self._proc is None or self._proc.poll() is not None:
+            raise ReplicaDead(f"replica {self.rid} process is gone")
+        # pickle BEFORE allocating the sequence number (see infer_stamped)
+        committed = None if resume_committed is None else \
+            [int(t) for t in resume_committed]
+        blob = pickle.dumps((
+            "__generate__", np.asarray(prompt_ids), int(max_new_tokens),
+            timeout, committed, _otrace.current_wire()))
+        try:
+            seq = self._store.add(f"/replica/{self.rid}/{self._epoch}/seq",
+                                  1) - 1
+        except Exception as e:
+            raise ReplicaError(
+                f"replica {self.rid}: sequence allocation failed "
+                f"({type(e).__name__}: {e})") from e
+        try:
+            self._store.set(_req_key(self.rid, self._epoch, seq), blob)
+        except Exception as e:
+            try:
+                self._store.set(_req_key(self.rid, self._epoch, seq),
+                                pickle.dumps(None))
+            except Exception:  # tpu-lint: disable=TL007 — store down:
+                pass           # the watchdog story owns this replica now
+            raise ReplicaError(
+                f"replica {self.rid}: stream submit failed "
+                f"({type(e).__name__}: {e})") from e
+        adm = Deadline(admission_timeout if admission_timeout is not None
+                       else timeout, clock=self._clock)
+        while True:
+            raw = self._store.get_nowait(
+                _res_key(self.rid, self._epoch, seq))
+            if raw is not None:
+                self._store.delete_key(_res_key(self.rid, self._epoch, seq))
+                payload = pickle.loads(raw)
+                if payload[0] == "gen-admit":
+                    return _RemoteStream(self, seq), int(payload[1])
+                kind, msg = payload[1], payload[2]
+                deterministic = bool(payload[3]) if len(payload) > 3 \
+                    else False
+                if len(payload) > 4 and payload[4]:
+                    _flight.recorder().ingest(payload[4])
+                raise _typed_error(kind, f"replica {self.rid}: {msg}",
+                                   deterministic=deterministic)
+            if self._proc.poll() is not None:
+                raise ReplicaDead(
+                    f"replica {self.rid} died before admitting the "
+                    f"stream (exit {self._proc.returncode})")
+            if adm.expired():
+                # abandoned at admission: leave the cancel key so a
+                # late-admitting engine evicts the sequence (and frees
+                # its blocks) instead of generating for nobody
+                try:
+                    self._store.set(
+                        _gencancel_key(self.rid, self._epoch, seq), b"1")
+                except Exception:  # tpu-lint: disable=TL007 — as above
+                    pass
+                self._store.delete_key(
+                    _res_key(self.rid, self._epoch, seq))
+                raise DeadlineExceeded(
+                    f"replica {self.rid} did not admit the stream "
+                    f"within the attempt deadline (wedged process?)")
             time.sleep(0.003)
 
     def queue_depth(self):
@@ -856,10 +1394,15 @@ def _main(argv=None):
     ap.add_argument("--generation", type=int, default=0)
     ap.add_argument("--epoch", type=int, default=0)
     ap.add_argument("--pool-size", type=int, default=1)
+    ap.add_argument("--decode-factory", default=None,
+                    help="module:callable building the decode engine "
+                         "(factory(generation) -> DecodeEngine); enables "
+                         "streaming generations on this replica")
     args = ap.parse_args(argv)
     serve_replica(args.rid, args.port, args.model, host=args.host,
                   generation=args.generation, epoch=args.epoch,
-                  pool_size=args.pool_size)
+                  pool_size=args.pool_size,
+                  decode_factory=args.decode_factory)
 
 
 if __name__ == "__main__":

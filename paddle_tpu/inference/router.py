@@ -41,16 +41,36 @@ one model redeploy can no longer take the tier down.
   already-swapped replicas back so the tier converges to a consistent
   generation, and `SwapFailed` names the cause.
 
+* **Streaming through the tier** — `submit_generate()` routes decode
+  streams (decode/engine.py) with the same HA story: prefix-affinity
+  placement (the replica whose engine already holds the prompt's
+  block-aligned prefix blocks — PR 13's COW prefix cache makes the
+  re-prefill nearly free), and **mid-stream failover**: a replica that
+  dies or wedges mid-generation is replaced by re-submitting
+  `prompt + committed_tokens` on a healthy replica — absolute-boundary
+  chunked prefill makes the resumed tokens bit-identical to an
+  uninterrupted greedy run, so the client iterator sees ONE unbroken
+  sequence (no duplicates, no gaps) and typed failure only once the
+  retry budget/deadline is spent. Generation purity holds across
+  failover and hot-swap: a stream never mixes tokens from two weight
+  generations. With `autoscale_slo` the band controller stops watching
+  raw queue depth and evaluates windowed p99 latency + TTFT against
+  declared `slo` objectives instead (scrapeable as `router.*` series).
+
 Proof: tools/serving_fault_injector.py `router-*` phases (tier-1) kill
 and wedge replicas under load and kill a replica mid-hot-swap, asserting
 zero lost idempotent requests, bit-correct generation-stamped outputs,
-capacity convergence, and the stats conservation law below.
+capacity convergence, and the stats conservation law below; the
+`router-stream-*` phases do the same under live streams (bit-exact
+resumes, zero leaked KV blocks, the streams ledger law).
 """
 from __future__ import annotations
 
 import itertools
+import queue
 import threading
 import time
+from collections import OrderedDict
 
 from ..analysis import locks as _locks
 from ..obs import trace as _otrace
@@ -60,7 +80,7 @@ from .serving import (
     Overloaded, PoolClosed, RequestFailed, RetryPolicy, ServingError,
 )
 
-__all__ = ["SwapFailed", "RouterConfig", "ServingRouter",
+__all__ = ["SwapFailed", "RouterConfig", "RouterStream", "ServingRouter",
            "commit_model_dir"]
 
 
@@ -114,7 +134,12 @@ class RouterConfig:
                  max_replicas=8,
                  scale_up_depth=4.0,
                  scale_down_depth=0.5,
-                 autoscale_patience=3):
+                 autoscale_patience=3,
+                 autoscale_slo=None,
+                 slo_scale_down_ratio=0.5,
+                 slo_min_samples=8,
+                 affinity_block_tokens=16,
+                 affinity_max_entries=512):
         self.default_timeout = default_timeout
         #: per-dispatch cap (< the request deadline), so a wedged replica
         #: costs one attempt, not the whole deadline — the failover lever
@@ -140,6 +165,23 @@ class RouterConfig:
         self.scale_up_depth = float(scale_up_depth)
         self.scale_down_depth = float(scale_down_depth)
         self.autoscale_patience = int(autoscale_patience)
+        #: SLO-driven band controller: `{"p99_latency_s": ceiling_s,
+        #: "ttft_p99_s": ceiling_s}` — when set (and autoscale=True) the
+        #: controller evaluates windowed p99s from the router's own
+        #: request/TTFT histograms against these declared objectives via
+        #: `obs.slo.evaluate` instead of watching raw queue depth
+        self.autoscale_slo = dict(autoscale_slo) if autoscale_slo else None
+        #: scale DOWN only when every measured objective sits below
+        #: ratio x its ceiling (the comfort band), patience-gated
+        self.slo_scale_down_ratio = float(slo_scale_down_ratio)
+        #: fewer new observations than this per sweep window reads as an
+        #: idle tier (a scale-down signal), not as an SLO evaluation
+        self.slo_min_samples = int(slo_min_samples)
+        #: streams hash this many leading prompt tokens (block-aligned;
+        #: 0 disables affinity) to prefer the replica whose decode
+        #: engine already holds the prefix's KV blocks
+        self.affinity_block_tokens = int(affinity_block_tokens)
+        self.affinity_max_entries = int(affinity_max_entries)
 
 
 _READY, _DRAINING, _DEAD, _RETIRED = "ready", "draining", "dead", "retired"
@@ -148,7 +190,7 @@ _READY, _DRAINING, _DEAD, _RETIRED = "ready", "draining", "dead", "retired"
 class _ReplicaRecord:
     __slots__ = ("rid", "replica", "state", "breaker", "restart_attempts",
                  "next_restart_at", "started_at", "dispatched", "completed",
-                 "deaths", "retiring", "restarting")
+                 "deaths", "retiring", "restarting", "streams", "evacuate")
 
     def __init__(self, rid, replica, breaker, started_at):
         self.rid = rid
@@ -163,6 +205,96 @@ class _ReplicaRecord:
         self.deaths = 0
         self.retiring = False
         self.restarting = False
+        self.streams = 0        # live stream attempts pinned here
+        self.evacuate = False   # rolling/retiring: streams must migrate
+
+
+_STREAM_END = object()
+
+
+class RouterStream:
+    """Client handle for a generation routed through the tier: one
+    uninterrupted token sequence regardless of how many replicas served
+    it. Iterate for tokens (the idiom of the engine's `SequenceStream`),
+    or `result()` for the full list; `cancel()` releases the replica-side
+    KV blocks within one scheduler round. `generation` is the weight
+    generation EVERY delivered token was computed under (generation
+    purity — the pump refuses a resume on mismatched weights), and
+    `failovers` counts the mid-stream replica changes the client never
+    had to see."""
+
+    def __init__(self, router, timeout):
+        self._router = router
+        self._q = queue.Queue()
+        self._tokens = []
+        self._status = None
+        self._error = None
+        self._done = threading.Event()
+        self._cancel_requested = False
+        self._deadline = Deadline(timeout, clock=router._clock)
+        self._t0 = router._clock()
+        self._ttft_observed = False
+        self.generation = None
+        self.failovers = 0
+
+    @property
+    def tokens(self):
+        """Tokens delivered so far (snapshot, in order)."""
+        return list(self._tokens)
+
+    @property
+    def status(self):
+        """None while live; "completed" / "failed" / "timed_out" /
+        "cancelled" once terminal."""
+        return self._status
+
+    def cancel(self):
+        """Stop the generation. The pump cancels the live replica
+        attempt (for process replicas: one cancel frame on the store),
+        so the engine evicts the sequence and frees its blocks at the
+        next step boundary — not at deadline expiry."""
+        self._cancel_requested = True
+
+    def _push(self, tok):
+        self._tokens.append(tok)
+        self._q.put(tok)
+
+    def _finish(self, status, error=None):
+        self._status = status
+        self._error = error
+        self._done.set()
+        self._q.put(_STREAM_END)
+
+    def __iter__(self):
+        while True:
+            rem = self._deadline.remaining()
+            try:
+                item = self._q.get(timeout=rem)
+            except queue.Empty:
+                self.cancel()
+                raise DeadlineExceeded(
+                    "stream deadline elapsed while iterating")
+            if item is _STREAM_END:
+                if self._status == "completed":
+                    return
+                raise self._error if self._error is not None else \
+                    RequestFailed(f"stream ended {self._status}")
+            yield item
+
+    def result(self, timeout=None):
+        """Block until the stream ends; return every token on
+        "completed", raise the stream's typed error otherwise."""
+        rem = self._deadline.remaining()
+        wait = rem if timeout is None else (
+            timeout if rem is None else min(timeout, rem))
+        if not self._done.wait(wait):
+            self.cancel()
+            raise DeadlineExceeded(
+                "stream did not finish within the deadline")
+        if self._status == "completed":
+            return list(self._tokens)
+        raise self._error if self._error is not None else \
+            RequestFailed(f"stream ended {self._status}")
 
 
 class ServingRouter:
@@ -230,6 +362,29 @@ class ServingRouter:
         self._gen_sweep_running = False
         self._spawning = False
 
+        # streams ledger (guarded by self._lock). Conservation law:
+        #   admitted == completed + failed + timed_out + cancelled
+        #               + in_flight
+        # where in_flight includes streams mid-failover (the ISSUE's
+        # failed_over_in_flight term: admitted, currently unserved, not
+        # yet terminal). `shed` sits outside the law (refused AT
+        # admission), as for one-shot requests.
+        self._streams = {"admitted": 0, "completed": 0, "failed": 0,
+                         "timed_out": 0, "cancelled": 0, "in_flight": 0,
+                         "failovers": 0, "resumed": 0, "shed": 0,
+                         "affinity_hits": 0}
+        #: prefix-affinity map: sha1(block-aligned prompt prefix) -> rid
+        #: (LRU-capped; guarded by self._lock)
+        self._affinity = OrderedDict()
+        # dual-histogram idiom (decode engine's): the PRIVATE pair feeds
+        # the SLO autoscale controller's windowed quantiles even when
+        # registry label-cardinality collapse folds the shared series
+        from ..obs.metrics import Histogram as _Histogram
+
+        self._h_request = _Histogram("router.request_seconds")
+        self._h_ttft = _Histogram("router.ttft_seconds")
+        self._slo_window = {}   # histogram counts at the last SLO sweep
+
         self._records = []
         self._hb = heartbeats if heartbeats is not None else LocalHeartbeats(
             clock=clock)
@@ -264,6 +419,7 @@ class ServingRouter:
         self._metrics_server = None
         if metrics is False:
             self._metrics = None
+            self._m_request = None
         else:
             from ..obs.metrics import registry as _obs_registry
 
@@ -271,6 +427,13 @@ class ServingRouter:
                 else _obs_registry()
             self._metrics.register_collector(
                 f"serving.router.{self.name}", self.stats)
+            # registry-shared twin of the private request histogram;
+            # the per-replica `router.ttft_seconds` twins materialize
+            # lazily at first token (replica ids are dynamic)
+            self._m_request = self._metrics.histogram(
+                "router.request_seconds",
+                "end-to-end routed request/stream latency",
+                labels={"router": self.name})
 
     # -- construction helpers ---------------------------------------------
     def _new_record(self):
@@ -553,6 +716,409 @@ class ServingRouter:
             return None
         return best
 
+    # -- streaming ---------------------------------------------------------
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None):
+        """Route one streaming generation through the tier; returns a
+        `RouterStream` immediately (admission errors raise typed). The
+        stream's pump thread owns placement (prefix-affinity first),
+        mid-stream failover (resume with `prompt + committed` on a fresh
+        replica — bit-identical to an uninterrupted run), drain-or-
+        migrate under a weight swap, and the deadline. The client
+        iterator sees one unbroken token sequence; typed `RequestFailed`
+        only when the failover budget or deadline is exhausted."""
+        import numpy as np
+
+        cfg = self.config
+        eff = cfg.default_timeout if timeout is None else timeout
+        with self._lock:
+            if self._closed:
+                self._streams["shed"] += 1
+                raise PoolClosed("router is shut down — admission refused")
+            healthy = sum(1 for r in self._records if r.state == _READY)
+            if healthy < max(1, cfg.min_healthy):
+                self._streams["shed"] += 1
+                raise Overloaded(
+                    f"serving tier degraded below its floor: {healthy} "
+                    f"ready replicas < min_healthy={cfg.min_healthy} — "
+                    f"shedding while supervised restarts restore capacity")
+            self._streams["admitted"] += 1
+            self._streams["in_flight"] += 1
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        rs = RouterStream(self, eff)
+        threading.Thread(
+            target=self._stream_pump,
+            args=(rs, prompt, int(max_new_tokens)),
+            name=f"ServingRouter-stream-{self.name}",
+            daemon=True).start()
+        return rs
+
+    def _stream_pump(self, rs, prompt, max_new):
+        # the stream's ROOT span wraps the pump's whole life: every
+        # failover attempt is a sibling `router.attempt` under it and
+        # the replica processes' spans ride the terminal frames home, so
+        # a failed-over stream reads as ONE merged causal record
+        if not _otrace.enabled():
+            self._stream_pump_impl(rs, prompt, max_new)
+            return
+        with _otrace.root_span("router.generate",
+                               attrs={"router": self.name}) as root:
+            self._stream_pump_impl(rs, prompt, max_new)
+            root.set_attr("status", rs._status)
+            root.set_attr("failovers", rs.failovers)
+            if rs.generation is not None:
+                root.set_attr("generation", rs.generation)
+            if rs._status == "completed" and root.parent_id is None \
+                    and root.ctx is not None:
+                # recovered (possibly after pinned typed errors): release
+                # the postmortem retention, as _route does
+                from ..obs import flight as _oflight
+
+                _oflight.recorder().unpin(root.ctx.trace_id)
+
+    def _stream_pump_impl(self, rs, prompt, max_new):
+        cfg = self.config
+        dl = rs._deadline
+        committed = []   # every token delivered to the client, in order
+        start = self._clock()
+        attempts = 0
+        tried = set()
+        last_exc = None
+        no_capacity_since = None
+        akey = self._affinity_key(prompt)
+        while True:
+            with self._lock:
+                closed = self._closed
+            if closed:
+                self._finish_stream(rs, "cancelled", PoolClosed(
+                    "router shut down mid-stream"))
+                return
+            if rs._cancel_requested:
+                self._finish_stream(rs, "cancelled", RequestFailed(
+                    "stream cancelled by the client"))
+                return
+            if dl.expired():
+                self._finish_stream(rs, "timed_out", DeadlineExceeded(
+                    "stream deadline elapsed while failing over"
+                    if attempts else
+                    "stream deadline elapsed before any dispatch"))
+                return
+            if len(committed) >= max_new:
+                # the replica died between its last token and its
+                # terminal frame: everything requested was delivered
+                self._finish_stream(rs, "completed")
+                return
+            rec = self._pick_stream(akey, tried)
+            if rec is None and tried:
+                tried.clear()
+                rec = self._pick_stream(akey, tried)
+            if rec is None:
+                now = self._clock()
+                if no_capacity_since is None:
+                    no_capacity_since = now
+                if now - no_capacity_since > cfg.no_capacity_wait:
+                    msg = (f"no routable replica (dead/draining/tripped) "
+                           f"for {cfg.no_capacity_wait}s")
+                    err = Overloaded(msg) if not committed else \
+                        RequestFailed(
+                            f"{msg} to resume the stream "
+                            f"({len(committed)} tokens committed)",
+                            cause=last_exc, attempts=attempts)
+                    self._finish_stream(rs, "failed", err)
+                    return
+                time.sleep(min(0.005, cfg.supervise_interval))
+                continue
+            no_capacity_since = None
+            attempts += 1
+            exc = self._stream_attempt(rs, rec, prompt, max_new,
+                                       committed, dl, attempts)
+            if exc is None:
+                return   # terminal: the attempt finished the stream
+            last_exc = exc
+            # ---- mid-stream failover tail --------------------------------
+            tried.add(rec.rid)
+            elapsed = self._clock() - start
+            if not cfg.failover.should_retry(attempts, elapsed):
+                self._finish_stream(rs, "failed", RequestFailed(
+                    f"stream failed over {attempts} attempt(s) across "
+                    f"replicas without success "
+                    f"(last: {type(last_exc).__name__}: {last_exc})",
+                    cause=last_exc, attempts=attempts))
+                return
+            with self._lock:
+                self._streams["failovers"] += 1
+                if committed:
+                    self._streams["resumed"] += 1
+            rs.failovers += 1
+            delay = cfg.failover.delay(attempts)
+            rem = dl.remaining()
+            if rem is not None:
+                delay = min(delay, max(0.0, rem))
+            time.sleep(delay)
+
+    def _stream_attempt(self, rs, rec, prompt, max_new, committed, dl,
+                        attempts):
+        """One replica attempt: admit (resuming from `committed`), check
+        generation purity, pump tokens. Returns None when the attempt
+        reached a terminal outcome for the STREAM (rs finished inside),
+        or the exception that makes the pump fail over."""
+        cfg = self.config
+        rep = rec.replica
+        with self._lock:
+            rec.dispatched += 1
+        att_tmo = dl.remaining()
+        if cfg.attempt_timeout is not None:
+            att_tmo = (cfg.attempt_timeout if att_tmo is None
+                       else min(att_tmo, cfg.attempt_timeout))
+        att_span = _otrace.null_span() if not _otrace.enabled() \
+            else _otrace.span("router.attempt",
+                              attrs={"rid": rec.rid, "attempt": attempts,
+                                     "resumed_from": len(committed)})
+        with att_span:
+            try:
+                with _locks.blocking_region("router.dispatch"):
+                    stream, gen = rep.submit_generate(
+                        prompt, max_new - len(committed),
+                        timeout=dl.remaining(),
+                        resume_committed=committed if committed else None,
+                        admission_timeout=att_tmo)
+            except Overloaded as e:
+                # never admitted there: reroute, no health penalty (the
+                # outer loop's no-capacity window bounds how long a
+                # fully-shedding tier is retried)
+                rec.breaker.cancel_probe()
+                return e
+            except DeadlineExceeded as e:
+                if dl.expired():
+                    self._note_dispatch_failure(rec)
+                    self._finish_stream(rs, "timed_out", e)
+                    return None
+                # wedged at admission under a live stream deadline
+                self._note_dispatch_failure(rec)
+                return e
+            except ReplicaDead as e:
+                self._mark_dead(rec, f"died under stream dispatch: {e}")
+                return e
+            except RequestFailed as e:
+                if isinstance(e.cause, DETERMINISTIC_ERRORS):
+                    # malformed request: identical on any replica
+                    rec.breaker.record_success()
+                    self._finish_stream(rs, "failed", e)
+                    return None
+                self._note_dispatch_failure(rec)
+                return e
+            except DETERMINISTIC_ERRORS as e:
+                # engine admission validation (prompt too long, bad
+                # dtype, ...): the request is the problem — no failover
+                rec.breaker.record_success()
+                err = RequestFailed(
+                    f"stream admission rejected deterministically "
+                    f"({type(e).__name__}: {e})", cause=e,
+                    attempts=attempts)
+                err.__cause__ = e
+                self._finish_stream(rs, "failed", err)
+                return None
+            except Exception as e:  # noqa: BLE001 — untyped transport
+                # escape: charge the attempt, fail over like a transient
+                self._note_dispatch_failure(rec)
+                return e
+            rec.breaker.record_success()
+            if committed and rs.generation is not None \
+                    and gen != rs.generation:
+                # generation purity: the committed prefix was computed
+                # under rs.generation — a resume on different weights
+                # would splice two generations into one stream
+                try:
+                    stream.cancel()
+                except Exception:  # tpu-lint: disable=TL007 — best
+                    pass           # effort: the engine's deadline reaps
+                return RequestFailed(
+                    f"replica {rec.rid} admitted the resume under "
+                    f"generation {gen}; stream is generation "
+                    f"{rs.generation} — refusing a mixed-weights splice")
+            rs.generation = gen
+            with self._lock:
+                rec.streams += 1
+            try:
+                return self._pump_attempt(rs, rec, stream, dl, committed,
+                                          max_new)
+            finally:
+                with self._lock:
+                    rec.streams -= 1
+
+    def _pump_attempt(self, rs, rec, stream, dl, committed, max_new):
+        """Forward tokens from one replica attempt into the client
+        stream until a terminal frame, a fault, or a migration signal.
+        Same return contract as `_stream_attempt`."""
+        cfg = self.config
+        stall = cfg.attempt_timeout
+        last_progress = self._clock()
+        while True:
+            try:
+                polled = stream.poll(0.01)
+            except Exception as e:  # noqa: BLE001 — a transport escape
+                return e            # mid-pump reads as replica trouble
+            if polled[0] == "tok":
+                self._deliver(rs, rec, committed, int(polled[1]))
+                last_progress = self._clock()
+                continue   # drain the burst before re-checking health
+            if polled[0] == "end":
+                _, status, err = polled
+                if status == "completed":
+                    self._finish_stream(rs, "completed")
+                    return None
+                if rs._cancel_requested:
+                    self._finish_stream(rs, "cancelled", RequestFailed(
+                        "stream cancelled by the client"))
+                    return None
+                if isinstance(err, ReplicaDead):
+                    self._mark_dead(rec, f"died mid-stream: {err}")
+                    return err
+                if status == "cancelled":
+                    # replica-side eviction the client never asked for
+                    # (engine teardown under swap/retire): migrate
+                    return err if err is not None else ReplicaError(
+                        f"replica {rec.rid} evicted the stream")
+                if isinstance(err, DeadlineExceeded):
+                    self._note_dispatch_failure(rec)
+                    self._finish_stream(rs, "timed_out", err)
+                    return None
+                if err is not None and isinstance(
+                        getattr(err, "cause", None), DETERMINISTIC_ERRORS):
+                    self._finish_stream(rs, "failed", err)
+                    return None
+                self._note_dispatch_failure(rec)
+                return err if err is not None else ReplicaError(
+                    f"replica {rec.rid} ended the stream without status")
+            # ("empty", None): a scheduling gap — run the round checks
+            if rs._cancel_requested:
+                try:
+                    stream.cancel()
+                except Exception:  # tpu-lint: disable=TL007 — the engine
+                    pass           # deadline reaps an uncancellable seq
+                self._finish_stream(rs, "cancelled", RequestFailed(
+                    "stream cancelled by the client"))
+                return None
+            with self._lock:
+                closed = self._closed
+                state = rec.state
+                evacuate = rec.evacuate
+            if closed:
+                try:
+                    stream.cancel()
+                except Exception:  # tpu-lint: disable=TL007 — as above
+                    pass
+                self._finish_stream(rs, "cancelled", PoolClosed(
+                    "router shut down mid-stream"))
+                return None
+            if dl.expired():
+                try:
+                    stream.cancel()
+                except Exception:  # tpu-lint: disable=TL007 — as above
+                    pass
+                self._finish_stream(rs, "timed_out", DeadlineExceeded(
+                    "stream deadline elapsed mid-generation"))
+                return None
+            if state == _DEAD:
+                return ReplicaDead(
+                    f"replica {rec.rid} marked dead mid-stream")
+            if evacuate or state == _DRAINING:
+                # drain-or-migrate under a rolling swap / retire: hand
+                # back whatever already arrived, then move the stream —
+                # no breaker charge, the replica is healthy
+                while True:
+                    p = stream.poll(None)
+                    if p[0] == "tok":
+                        self._deliver(rs, rec, committed, int(p[1]))
+                        continue
+                    if p[0] == "end" and p[1] == "completed":
+                        self._finish_stream(rs, "completed")
+                        return None
+                    break
+                try:
+                    stream.cancel()
+                except Exception:  # tpu-lint: disable=TL007 — as above
+                    pass
+                return ReplicaError(
+                    f"replica {rec.rid} is rolling — stream migrates")
+            if stall is not None \
+                    and self._clock() - last_progress > stall:
+                # tokens stopped flowing (wedged replica): charge its
+                # breaker and move the stream
+                self._note_dispatch_failure(rec)
+                try:
+                    stream.cancel()
+                except Exception:  # tpu-lint: disable=TL007 — as above
+                    pass
+                return DeadlineExceeded(
+                    f"replica {rec.rid} stalled mid-stream "
+                    f"(> {stall}s without a token)")
+
+    def _deliver(self, rs, rec, committed, tok):
+        committed.append(tok)
+        rs._push(tok)
+        if not rs._ttft_observed:
+            rs._ttft_observed = True
+            ttft = self._clock() - rs._t0
+            self._h_ttft.observe(ttft)
+            if self._metrics is not None:
+                self._metrics.histogram(
+                    "router.ttft_seconds",
+                    "time to first streamed token, per serving replica",
+                    labels={"router": self.name,
+                            "replica": rec.rid}).observe(ttft)
+
+    def _finish_stream(self, rs, status, error=None):
+        with self._lock:
+            self._streams["in_flight"] -= 1
+            self._streams[status] += 1
+        dur = self._clock() - rs._t0
+        self._h_request.observe(dur)
+        if self._m_request is not None:
+            self._m_request.observe(dur)
+        rs._finish(status, error)
+
+    def _affinity_key(self, prompt):
+        blk = self.config.affinity_block_tokens
+        n = 0 if blk <= 0 else (len(prompt) // blk) * blk
+        if n <= 0:
+            return None
+        import hashlib
+
+        import numpy as np
+
+        return hashlib.sha1(np.ascontiguousarray(
+            np.asarray(prompt[:n], dtype=np.int64)).tobytes()).hexdigest()
+
+    def _pick_stream(self, akey, exclude):
+        """Affinity-first replica pick: the replica that last served
+        this block-aligned prompt prefix holds its KV blocks in the
+        engine's COW prefix cache, so landing there skips most of the
+        prefill. Falls back to the least-loaded pick, then remembers
+        the placement for the next stream sharing the prefix."""
+        if akey is not None:
+            with self._lock:
+                rid = self._affinity.get(akey)
+                rec = None
+                if rid is not None and rid not in exclude:
+                    rec = next((r for r in self._records
+                                if r.rid == rid and r.state == _READY
+                                and not r.evacuate), None)
+                if rec is not None and rec.breaker.allow():
+                    self._affinity.move_to_end(akey)
+                    self._streams["affinity_hits"] += 1
+                    return rec
+        rec = self._pick(exclude)
+        if rec is not None and akey is not None:
+            with self._lock:
+                self._affinity[akey] = rec.rid
+                self._affinity.move_to_end(akey)
+                while len(self._affinity) > \
+                        self.config.affinity_max_entries:
+                    self._affinity.popitem(last=False)
+        return rec
+
     # -- failure handling --------------------------------------------------
     def _note_dispatch_failure(self, rec):
         rec.breaker.record_failure()
@@ -572,6 +1138,7 @@ class ServingRouter:
                 return
             rec.state = _DEAD
             rec.deaths += 1
+            rec.evacuate = False  # pumps key off _DEAD from here
             self._deaths += 1
             rec.restart_attempts = 0
             rec.next_restart_at = (self._clock()
@@ -730,23 +1297,17 @@ class ServingRouter:
             active = [r for r in self._records if r.state != _RETIRED]
         if not ready:
             return
-        # depth polls outside the lock (store round-trips for process
-        # replicas)
+        if cfg.autoscale_slo:
+            self._autoscale_slo_sweep(active)
+            return
+        # legacy band: raw queue depth. Depth polls outside the lock
+        # (store round-trips for process replicas)
         depth = sum(r.replica.queue_depth() for r in ready) / len(ready)
         if depth > cfg.scale_up_depth and len(active) < cfg.max_replicas:
             self._scale_streak = max(0, self._scale_streak) + 1
             if self._scale_streak >= cfg.autoscale_patience \
                     and not self._spawning:
-                self._scale_streak = 0
-                with self._lock:
-                    if self._spawning:
-                        return
-                    self._spawning = True
-                # artifact load + probe take seconds: never inside the
-                # supervisor tick (fault detection must keep its cadence)
-                threading.Thread(target=self._spawn_replica,
-                                 name="ServingRouter-spawn",
-                                 daemon=True).start()
+                self._kick_spawn()
         elif depth < cfg.scale_down_depth and len(active) > cfg.min_replicas:
             self._scale_streak = min(0, self._scale_streak) - 1
             if -self._scale_streak >= cfg.autoscale_patience:
@@ -754,6 +1315,79 @@ class ServingRouter:
                 self._retire_one(active)
         else:
             self._scale_streak = 0
+
+    def _autoscale_slo_sweep(self, active):
+        """SLO-driven band controller: windowed p99s off the router's
+        own obs histograms (request latency, TTFT) evaluated against the
+        declared ceilings through `obs.slo.evaluate` — the autoscaler
+        and the release gate share ONE notion of "meeting the SLO".
+        Any breached objective (patience-gated) spawns; every objective
+        comfortably inside `slo_scale_down_ratio` x ceiling — or an idle
+        window with nothing to measure — retires. Raw queue depth is
+        never consulted."""
+        from ..obs import slo as _slo
+
+        cfg = self.config
+        values = {}
+        total_new = 0
+        for name, hist in (("p99_latency_s", self._h_request),
+                           ("ttft_p99_s", self._h_ttft)):
+            if name not in cfg.autoscale_slo:
+                continue
+            counts = hist.counts()
+            prev = self._slo_window.get(name)
+            self._slo_window[name] = counts
+            delta = counts if prev is None else \
+                [c - p for c, p in zip(counts, prev)]
+            n = sum(delta)
+            total_new += n
+            if n:
+                values[name] = hist.quantile(0.99, delta)
+        if total_new < cfg.slo_min_samples:
+            # idle tier: no evaluation to run — idle IS the scale-down
+            # signal (patience-gated, floored at min_replicas)
+            if len(active) > cfg.min_replicas:
+                self._scale_streak = min(0, self._scale_streak) - 1
+                if -self._scale_streak >= cfg.autoscale_patience:
+                    self._scale_streak = 0
+                    self._retire_one(active)
+            else:
+                self._scale_streak = 0
+            return
+        objectives = [_slo.Objective(n, "max", unit="s", slack=1.0)
+                      for n in values]
+        baseline = {n: {"kind": "max",
+                        "bound": float(cfg.autoscale_slo[n])}
+                    for n in values}
+        report = _slo.evaluate(values, baseline, objectives)
+        if not report["ok"]:
+            if len(active) < cfg.max_replicas:
+                self._scale_streak = max(0, self._scale_streak) + 1
+                if self._scale_streak >= cfg.autoscale_patience \
+                        and not self._spawning:
+                    self._kick_spawn()
+            return
+        comfy = all(values[n] < float(cfg.autoscale_slo[n])
+                    * cfg.slo_scale_down_ratio for n in values)
+        if comfy and len(active) > cfg.min_replicas:
+            self._scale_streak = min(0, self._scale_streak) - 1
+            if -self._scale_streak >= cfg.autoscale_patience:
+                self._scale_streak = 0
+                self._retire_one(active)
+        else:
+            self._scale_streak = 0
+
+    def _kick_spawn(self):
+        self._scale_streak = 0
+        with self._lock:
+            if self._spawning:
+                return
+            self._spawning = True
+        # artifact load + probe take seconds: never inside the
+        # supervisor tick (fault detection must keep its cadence)
+        threading.Thread(target=self._spawn_replica,
+                         name="ServingRouter-spawn",
+                         daemon=True).start()
 
     def _spawn_replica(self):
         try:
@@ -788,13 +1422,15 @@ class ServingRouter:
                 return
             rec.state = _DRAINING
             rec.retiring = True
+            rec.evacuate = True  # live streams migrate, not die
         threading.Thread(
             target=self._do_retire, args=(rec,),
             name=f"ServingRouter-retire-{rec.rid}", daemon=True).start()
 
     def _do_retire(self, rec):
         dl = Deadline(self.config.probe_timeout, clock=self._clock)
-        while not rec.replica.drained() and not dl.expired():
+        while not (rec.replica.drained() and rec.streams == 0) \
+                and not dl.expired():
             time.sleep(0.005)
         try:
             rec.replica.close(drain_timeout=1.0)
@@ -913,10 +1549,15 @@ class ServingRouter:
                 raise SwapFailed(
                     f"replica {rec.rid} is {rec.state}, not ready")
             rec.state = _DRAINING
+            # live streams must leave before the weights change: their
+            # pumps see the flag, drain what already arrived, and fail
+            # over (resume elsewhere on the SAME generation — purity)
+            rec.evacuate = True
         dl = Deadline(drain_timeout, clock=self._clock)
-        while not rec.replica.drained():
+        while not (rec.replica.drained() and rec.streams == 0):
             if dl.expired():
                 with self._lock:
+                    rec.evacuate = False
                     if rec.state == _DRAINING:
                         rec.state = _READY  # healthy, just busy
                 raise SwapFailed(
@@ -936,6 +1577,7 @@ class ServingRouter:
             err.__cause__ = e
             raise err
         with self._lock:
+            rec.evacuate = False
             if rec.state == _DRAINING:
                 rec.state = _READY
         rec.breaker.record_success()
@@ -1020,9 +1662,11 @@ class ServingRouter:
             return self._generation
 
     def stats(self):
-        """Counter snapshot + per-replica health. Conservation law
+        """Counter snapshot + per-replica health. Conservation laws
         (quiesced): admitted == completed + failed + timed_out +
-        overloaded + cancelled."""
+        overloaded + cancelled, and for the streams ledger
+        streams.admitted == completed + failed + timed_out + cancelled
+        + in_flight (in_flight covers streams mid-failover)."""
         with self._lock:
             replicas = []
             for rec in self._records:
@@ -1035,6 +1679,7 @@ class ServingRouter:
                     "dispatched": rec.dispatched,
                     "completed": rec.completed,
                     "deaths": rec.deaths,
+                    "streams": rec.streams,
                 })
             ready = sum(1 for r in replicas if r["state"] == _READY)
             snap = {
@@ -1059,6 +1704,7 @@ class ServingRouter:
                 "swap_rollbacks": self._swap_rollbacks,
                 "scale_ups": self._scale_ups,
                 "scale_downs": self._scale_downs,
+                "streams": dict(self._streams),
                 "members": replicas,
             }
         # depth/beat polls and the watchdog snapshot run OUTSIDE the

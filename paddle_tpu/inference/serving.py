@@ -735,8 +735,24 @@ class ServingPool:
                 slot.reclones += 1
                 slot.generation += 1
 
+    def swap_engine(self, engine, drain_timeout=5.0):
+        """Replace the attached decode engine (the streaming analog of
+        `rebase`): install `engine` and shut the previous one down. The
+        caller owns the drain contract — the router drains every live
+        stream off a replica before swapping it, so the old engine is
+        quiesced here and its block pool returns to allocated == 0 on
+        shutdown (leftovers would fail typed, never hang)."""
+        with self._lock:
+            if self._stopping:
+                raise PoolClosed("cannot swap the engine of a shut-down "
+                                 "pool")
+            old, self._engine = self._engine, engine
+        if old is not None:
+            old.shutdown(drain_timeout=drain_timeout)
+
     # -- streaming generation (continuous-batching decode engine) ----------
-    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None):
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None,
+                        *, resume_committed=None):
         """Admit one LLM generation request on the attached
         `DecodeEngine` (construct the pool with `decode_engine=`);
         returns a `decode.SequenceStream` whose iterator yields tokens as
@@ -747,13 +763,16 @@ class ServingPool:
         generation. Sequence failures are isolated: one failing sequence
         never disturbs the others decoding beside it (its KV blocks
         return to the pool), and a wedged decode step trips the same
-        hang detection that guards regular requests."""
+        hang detection that guards regular requests. `resume_committed`
+        is the mid-stream failover resume path (see
+        `DecodeEngine.submit`)."""
         if self._engine is None:
             raise RuntimeError(
                 "submit_generate() needs a decode engine: construct the "
                 "pool with decode_engine=DecodeEngine(model, ...)")
         eff = self.default_timeout if timeout is None else timeout
-        return self._engine.submit(prompt_ids, max_new_tokens, timeout=eff)
+        return self._engine.submit(prompt_ids, max_new_tokens, timeout=eff,
+                                   resume_committed=resume_committed)
 
     def generate(self, prompt_ids, max_new_tokens, timeout=None):
         """Synchronous generation convenience: submit + drain; returns

@@ -26,9 +26,9 @@ from functools import partial
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from .. import nn
+from ..sharding import spec as _pspec
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
 from ..distributed import topology as topo_mod
@@ -113,7 +113,7 @@ class GPTForCausalLMPipe(nn.Layer):
             p = self.create_parameter(
                 list(shape),
                 default_initializer=nn.initializer.Normal(0.0, scale))
-            p.dist_spec = P(*spec)
+            p.dist_spec = _pspec(*spec)
             return p
 
         self.wte = mk((V, H), std, ("mp", None))

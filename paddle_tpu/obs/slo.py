@@ -27,8 +27,9 @@ from __future__ import annotations
 import json
 import os
 
-__all__ = ["Objective", "SERVING_SMOKE", "evaluate", "load_baseline",
-           "write_baseline", "format_report", "BASELINE_FILENAME"]
+__all__ = ["Objective", "SERVING_SMOKE", "ROUTER_STREAM", "evaluate",
+           "load_baseline", "write_baseline", "format_report",
+           "BASELINE_FILENAME"]
 
 BASELINE_FILENAME = "SLO_BASELINE.json"
 
@@ -86,6 +87,19 @@ SERVING_SMOKE = [
                           "loop through Engine.train_batch (dispatch "
                           "overhead floor)",
               unit="steps/s", slack=5.0),
+]
+
+#: Streaming-through-the-HA-tier objectives: bench.py's BENCH_SLO=1
+#: section also drives generations through a ServingRouter over stub
+#: decode replicas (no XLA in the loop), so this bound gates the
+#: ROUTER's streaming overhead — affinity placement, admission, pump
+#: delivery of the first frame — not model compute.
+ROUTER_STREAM = [
+    Objective("router_stream.ttft_p99_s", "max",
+              description="p99 time-to-first-token of streams routed "
+                          "through a ServingRouter over stub decode "
+                          "replicas (fed to router.ttft_seconds)",
+              unit="s", slack=4.0),
 ]
 
 

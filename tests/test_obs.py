@@ -497,7 +497,7 @@ def test_checked_in_baseline_covers_declared_objectives():
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), slo.BASELINE_FILENAME)
     baseline = slo.load_baseline(path)
-    for obj in slo.SERVING_SMOKE:
+    for obj in slo.SERVING_SMOKE + slo.ROUTER_STREAM:
         assert obj.name in baseline, (
             f"declared objective {obj.name} has no checked-in bound — "
             f"run BENCH_SLO_WRITE=1 python bench.py and commit")
@@ -526,6 +526,13 @@ def test_metrics_dump_cli_scrape_modes(capsys):
                         "--format", "json"]) == 0
         assert json.loads(capsys.readouterr().out)[
             "metrics"]["cli.hits"][0]["value"] == 5
+        # --grep keeps only matching lines (shell-free series filter)
+        r.counter("cli.misses").inc(1)
+        assert md.main(["--url", s.url, "--grep", "cli_hits"]) == 0
+        filtered = capsys.readouterr().out
+        assert "cli_hits 5" in filtered and "cli_misses" not in filtered
+        assert md.main(["--url", s.url, "--grep", "(unbalanced"]) == 2
+        capsys.readouterr()
     assert md.main(["--url", "http://127.0.0.1:1/metrics"]) == 1
 
 

@@ -4,12 +4,23 @@ Fast tier-1 coverage over threads-as-replicas with stub predictors (no
 model export, no XLA): health-checked least-loaded routing, typed
 failover on replica death/wedge, the non-idempotent refusal, the
 capacity floor, supervised restart convergence, rolling weight hot-swap
-with generation stamping + ordering refusal + rollback, autoscale band,
-and the router stats conservation law. The real-model / real-process
-variants live in tools/serving_fault_injector.py (router-* phases,
-tier-1) and the slow-marked subprocess test at the bottom.
+with generation stamping + ordering refusal + rollback, the SLO-driven
+autoscale band, and the router stats conservation law. Streaming rides
+the same stubs: `StubEngine` "decodes" a pure recurrence over the full
+token prefix, so mid-stream failover resumption is bit-exact by
+construction and a weight generation is bit-visible — the tier-1
+equivalent of the real-engine streaming proofs in
+tools/serving_fault_injector.py (router-stream-* phases) and the
+slow-marked subprocess tests at the bottom.
+
+Cost control (suite-budget idiom from the batching/decode modules):
+the healthy streaming topology is ONE module-scoped router
+(`stream_router`) shared by every test that doesn't fault it, with
+delta-based stats assertions; only fault tests (kill/wedge/swap/
+autoscale) build their own tier.
 """
 import concurrent.futures
+import itertools
 import threading
 import time
 
@@ -18,8 +29,9 @@ import pytest
 
 from paddle_tpu.distributed.store import Watchdog
 from paddle_tpu.inference import (
-    LocalHeartbeats, LocalReplica, Overloaded, ReplicaDead, RequestFailed,
-    RouterConfig, ServingRouter, SwapFailed, commit_model_dir,
+    DeadlineExceeded, LocalHeartbeats, LocalReplica, Overloaded, PoolClosed,
+    ReplicaDead, RequestFailed, RouterConfig, ServingRouter, SwapFailed,
+    commit_model_dir,
 )
 from paddle_tpu.inference.serving import RetryPolicy
 
@@ -48,18 +60,137 @@ class StubPredictor:
         return [np.asarray(f, np.float64) * self.scale for f in feeds]
 
 
+STUB_VOCAB = 211
+
+
+def stub_ref(prompt_ids, max_new, generation=0):
+    """The stub "greedy decode" as a pure function: each next token is a
+    recurrence over the FULL prefix (prompt + everything generated), so
+    a resume from `prompt + committed` is bit-identical to the
+    uninterrupted run by construction, and the generation term makes a
+    weight swap bit-visible — the stub analog of the demo checkpoint's
+    seeded weights."""
+    seq = [int(t) for t in prompt_ids]
+    out = []
+    for _ in range(int(max_new)):
+        t = (sum(seq) * 31 + len(seq) + 7 * int(generation)) % STUB_VOCAB
+        seq.append(t)
+        out.append(t)
+    return out
+
+
+class _StubStream:
+    """Pump-contract stream (`poll`/`cancel`/`tokens`/`status`) whose
+    tokens drip on a wall clock (`delay` per token) so a test can kill,
+    wedge, cancel, or swap mid-generation deterministically."""
+
+    def __init__(self, engine, sid, toks, delay):
+        self.id = sid
+        self.deadline = None
+        self.status = "active"
+        self._engine = engine
+        self._toks = toks
+        self._delay = float(delay)
+        self._i = 0
+        self._t0 = time.monotonic()
+        self._end = None
+
+    @property
+    def tokens(self):
+        return self._toks[:self._i]
+
+    def cancel(self):
+        self._finish("cancelled")
+
+    def _finish(self, status):
+        if self._end is None:
+            self._end = ("end", status, None)
+            self.status = status
+            self._engine._release(self.id)
+
+    def poll(self, timeout=None):
+        if self._end is not None:
+            return self._end
+        if not self._delay:
+            avail = len(self._toks)
+        else:
+            avail = min(len(self._toks),
+                        int((time.monotonic() - self._t0) / self._delay))
+        if self._i < avail:
+            tok = self._toks[self._i]
+            self._i += 1
+            return ("tok", tok)
+        if self._i >= len(self._toks):
+            self._finish("completed")
+            return self._end
+        if timeout and timeout > 0:
+            time.sleep(min(timeout, self._delay))
+        return ("empty", None)
+
+
+class StubEngine:
+    """Duck-typed decode engine for streaming tests (no XLA, no model):
+    the ServingPool surface is `submit` / `shutdown` / `stats`, and
+    "decoding" is the `stub_ref` recurrence. `live` tracks admitted
+    sequences so tests can assert a cancelled / failed-over stream
+    released its (stub) KV hold."""
+
+    def __init__(self, generation=0, delay=0.0):
+        self.generation = int(generation)
+        self.delay = float(delay)
+        self.closed = False
+        self.live = {}
+        self.submitted = 0
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+
+    def submit(self, prompt_ids, max_new_tokens, timeout=None,
+               resume_committed=None):
+        with self._lock:
+            if self.closed:
+                raise PoolClosed("stub engine is shut down")
+            seq = [int(t) for t in prompt_ids] + [
+                int(t) for t in (resume_committed or [])]
+            toks = stub_ref(seq, max_new_tokens, self.generation)
+            s = _StubStream(self, f"stub-{next(self._ids)}", toks,
+                            self.delay)
+            self.live[s.id] = s
+            self.submitted += 1
+            return s
+
+    def _release(self, sid):
+        with self._lock:
+            self.live.pop(sid, None)
+
+    def shutdown(self, drain_timeout=None):
+        with self._lock:
+            self.closed = True
+            streams = list(self.live.values())
+        for s in streams:
+            s.cancel()
+
+    def stats(self):
+        with self._lock:
+            return {"active": len(self.live), "submitted": self.submitted}
+
+
 class Tier:
     """One test topology: shared heartbeat sink + replica registry so
-    tests can reach into specific replicas to kill/wedge them."""
+    tests can reach into specific replicas to kill/wedge them. With
+    `stream_delay` set, every replica carries a `StubEngine` for its
+    weight generation (`decode_factory`), enabling submit_generate()
+    through the tier; engines are recorded for leak assertions."""
 
     def __init__(self, scales=None, delay=0.0, fail_value=None,
-                 factory_hook=None):
+                 factory_hook=None, stream_delay=None):
         self.hb = LocalHeartbeats()
         self.scales = scales if scales is not None else {None: 1.0}
         self.delay = delay
         self.fail_value = fail_value
         self.replicas = {}
         self.factory_hook = factory_hook  # (rid, dir) -> maybe raise
+        self.stream_delay = stream_delay
+        self.engines = []                 # every StubEngine ever built
 
     def predictor(self, model_dir):
         key = model_dir if model_dir in self.scales else None
@@ -74,13 +205,24 @@ class Tier:
                 self.factory_hook(rid, d)
             return self.predictor(d)
 
+        deco = None
+        if self.stream_delay is not None:
+            def deco(gen):
+                eng = StubEngine(gen, self.stream_delay)
+                self.engines.append(eng)
+                return eng
+
         rep = LocalReplica(rid, make, model_dir, generation,
                            heartbeat=self.hb, heartbeat_interval=0.01,
+                           decode_factory=deco,
                            pool_kwargs=dict(default_timeout=5.0,
                                             supervise_interval=0.01,
                                             hang_grace=0.05))
         self.replicas[rid] = rep
         return rep
+
+    def engines_idle(self):
+        return all(e.stats()["active"] == 0 for e in self.engines)
 
 
 def fast_config(**over):
@@ -363,26 +505,200 @@ def test_failed_swap_rolls_back_to_consistent_generation(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# autoscale band
+# streaming through the tier (stub engines: bit-exact by recurrence)
 # ---------------------------------------------------------------------------
 
-def test_autoscale_spawns_under_load_and_retires_idle():
-    tier = Tier(delay=0.08)
+@pytest.fixture(scope="module")
+def stream_router():
+    """ONE healthy 2-replica streaming topology shared by every test
+    that never faults it (suite-budget idiom): tests assert on stats
+    DELTAS, never absolutes, and use distinct prompts so affinity
+    entries don't cross-talk."""
+    tier = Tier(stream_delay=0.02)
+    cfg = fast_config(affinity_block_tokens=4, attempt_timeout=1.0)
+    r = ServingRouter(tier.factory, size=2, config=cfg)
+    yield tier, r
+    r.shutdown(drain_timeout=5.0)
+
+
+def test_stream_routes_conserves_and_prefers_prefix_affinity(stream_router):
+    tier, r = stream_router
+    before = r.stats()["streams"]
+    prompt = [3, 1, 4, 1, 5]
+    want = stub_ref(prompt, 6)
+    for _ in range(5):
+        rs = r.submit_generate(prompt, 6, timeout=10.0)
+        assert rs.result() == want
+        assert rs.generation == 0 and rs.failovers == 0
+    # the iterator idiom yields the same uninterrupted sequence
+    assert list(r.submit_generate(prompt, 6, timeout=10.0)) == want
+    s = r.stats()
+    st = s["streams"]
+    assert st["admitted"] - before["admitted"] == 6
+    assert st["completed"] - before["completed"] == 6
+    # conservation ledger (quiesced: nothing of ours is in flight)
+    assert st["admitted"] == (st["completed"] + st["failed"]
+                              + st["timed_out"] + st["cancelled"]
+                              + st["in_flight"])
+    # a repeated prefix sticks to the replica holding its KV blocks
+    assert st["affinity_hits"] - before["affinity_hits"] >= 5
+    assert all(m["streams"] == 0 for m in s["members"])
+    assert wait_until(tier.engines_idle)
+
+
+def test_stream_cancel_releases_engine_sequence(stream_router):
+    tier, r = stream_router
+    before = r.stats()["streams"]
+    rs = r.submit_generate([2, 7, 1, 8], 40, timeout=10.0)
+    it = iter(rs)
+    next(it)                      # mid-generation, tokens flowing
+    rs.cancel()
+    with pytest.raises(RequestFailed, match="cancelled"):
+        rs.result(timeout=5.0)
+    assert rs.status == "cancelled"
+    # the stub sequence is evicted within a round, not at deadline
+    assert wait_until(tier.engines_idle, timeout=2.0)
+    st = r.stats()["streams"]
+    assert st["cancelled"] - before["cancelled"] == 1
+
+
+def test_stream_deadline_expires_typed_and_releases(stream_router):
+    tier, r = stream_router
+    before = r.stats()["streams"]
+    # 40 tokens at ~20ms each can't fit a 0.25s budget
+    rs = r.submit_generate([6, 6, 6, 6], 40, timeout=0.25)
+    with pytest.raises(DeadlineExceeded):
+        rs.result()
+    # the client raise races the pump's own deadline check by design:
+    # the caller sees DeadlineExceeded immediately and cancels; the pump
+    # lands the stream terminal as timed_out OR cancelled — exactly one
+    assert wait_until(lambda: rs.status is not None, timeout=2.0)
+    assert rs.status in ("timed_out", "cancelled")
+    assert 0 < len(rs.tokens) < 40    # it was genuinely mid-generation
+    st = r.stats()["streams"]
+    assert (st["timed_out"] + st["cancelled"]
+            - before["timed_out"] - before["cancelled"]) == 1
+    assert wait_until(tier.engines_idle, timeout=2.0)
+
+
+def test_stream_failover_on_kill_is_bit_exact():
+    tier = Tier(stream_delay=0.03)
+    cfg = fast_config(affinity_block_tokens=4, attempt_timeout=1.0)
+    with ServingRouter(tier.factory, size=2, config=cfg) as r:
+        prompt = [5, 4, 3, 2]
+        want = stub_ref(prompt, 12)
+        rs = r.submit_generate(prompt, 12, timeout=20.0)
+        it = iter(rs)
+        got = [next(it), next(it)]
+        victim = next(m["rid"] for m in r.stats()["members"]
+                      if m["streams"] > 0)
+        tier.replicas[victim].kill()
+        got += list(it)
+        # ONE uninterrupted sequence: no duplicates, no gaps, no splice
+        assert got == want
+        assert rs.failovers >= 1 and rs.status == "completed"
+        st = r.stats()["streams"]
+        assert st["failovers"] >= 1 and st["resumed"] >= 1
+        assert st["admitted"] == (st["completed"] + st["failed"]
+                                  + st["timed_out"] + st["cancelled"]
+                                  + st["in_flight"])
+        assert wait_until(lambda: r.stats()["ready"] == 2)
+        assert wait_until(tier.engines_idle)
+
+
+def test_stream_failover_on_wedge_stalls_then_resumes_bit_exact():
+    tier = Tier(stream_delay=0.03)
+    cfg = fast_config(affinity_block_tokens=4, attempt_timeout=0.25)
+    with ServingRouter(tier.factory, size=2, config=cfg) as r:
+        prompt = [8, 6, 4, 2]
+        want = stub_ref(prompt, 12)
+        rs = r.submit_generate(prompt, 12, timeout=20.0)
+        it = iter(rs)
+        got = [next(it)]
+        victim = next(m["rid"] for m in r.stats()["members"]
+                      if m["streams"] > 0)
+        tier.replicas[victim].wedge()
+        # tokens stop flowing; the stall detector moves the stream
+        got += list(it)
+        assert got == want
+        assert rs.failovers >= 1
+        # the watchdog reaps the wedged replica and restarts it
+        assert wait_until(lambda: r.stats()["deaths"] >= 1)
+        assert wait_until(lambda: r.stats()["ready"] == 2)
+
+
+def test_stream_swap_preserves_generation_purity(tmp_path):
+    tier = Tier(scales={None: 1.0}, stream_delay=0.01)
+    dirs = _dirs(tmp_path, tier, {"g0": (1.0, 0), "g2": (2.0, 2)})
+    cfg = fast_config(affinity_block_tokens=4, attempt_timeout=1.0)
+    prompt = [2, 3, 5, 7]
+    refs = {g: stub_ref(prompt, 8, g) for g in (0, 2)}
+    with ServingRouter(tier.factory, size=2, model_dir=dirs["g0"],
+                       generation=0, config=cfg) as r:
+        stop = threading.Event()
+        results, bad = [], []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    rs = r.submit_generate(prompt, 8, timeout=10.0)
+                    toks = rs.result()
+                except (RequestFailed, DeadlineExceeded):
+                    # purity over availability: a stream caught between
+                    # generations may typed-fail, never splice
+                    continue
+                if toks != refs.get(rs.generation):
+                    bad.append((rs.generation, toks))
+                results.append(rs.generation)
+
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        assert r.swap_weights(dirs["g2"], drain_timeout=10.0) == 2
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not bad, bad[:3]   # every token sequence is ONE generation's
+        assert 0 in results       # traffic flowed on both sides of the roll
+        rs = r.submit_generate(prompt, 8, timeout=10.0)
+        assert rs.result() == refs[2] and rs.generation == 2
+        s = r.stats()
+        assert s["generation"] == 2 and s["swaps"] == 1
+
+
+# ---------------------------------------------------------------------------
+# autoscale band (SLO-driven: p99 off the router's own histograms)
+# ---------------------------------------------------------------------------
+
+def test_autoscale_slo_spawns_on_breach_and_retires_idle():
+    """The band controller consumes obs-registry SLO evaluation, not raw
+    queue depth: streams whose latency p99 breaches the declared ceiling
+    spawn a replica (patience-gated); an idle measurement window IS the
+    scale-down signal back to the floor."""
+    tier = Tier(stream_delay=0.02)
     cfg = fast_config(autoscale=True, min_replicas=1, max_replicas=3,
-                      scale_up_depth=1.0, scale_down_depth=0.2,
-                      autoscale_patience=2, supervise_interval=0.03)
+                      autoscale_slo={"p99_latency_s": 0.05},
+                      slo_min_samples=1, autoscale_patience=2,
+                      affinity_block_tokens=0, supervise_interval=0.1)
     with ServingRouter(tier.factory, size=1, config=cfg) as r:
-        with concurrent.futures.ThreadPoolExecutor(8) as ex:
-            futs = [ex.submit(r.infer, [np.ones(2)], 10.0)
-                    for _ in range(40)]
-            grew = wait_until(lambda: len(r) > 1, timeout=8.0)
+        def one(i):
+            # ~8 tokens x 20ms = 0.16s per stream >> the 50ms ceiling
+            return r.submit_generate([i % 13, 2, 4], 8,
+                                     timeout=20.0).result()
+
+        with concurrent.futures.ThreadPoolExecutor(6) as ex:
+            futs = [ex.submit(one, i) for i in range(30)]
+            grew = wait_until(lambda: len(r) > 1, timeout=10.0)
             for f in futs:
                 f.result()
         assert grew and r.stats()["scale_ups"] >= 1
-        # idle: the tier shrinks back into the band floor
-        assert wait_until(lambda: len(r) == 1, timeout=8.0)
+        # idle: no new samples to evaluate — shrink into the band floor
+        assert wait_until(lambda: len(r) == 1, timeout=10.0)
         assert r.stats()["scale_downs"] >= 1
-        r.infer([np.ones(2)], timeout=2.0)  # survivors still serve
+        assert r.submit_generate([1, 2, 3], 4, timeout=10.0).result() \
+            == stub_ref([1, 2, 3], 4)  # survivors still serve
 
 
 # ---------------------------------------------------------------------------
@@ -492,6 +808,90 @@ def test_subprocess_replicas_failover_and_swap(tmp_path):
         s = r.stats()
         assert s["admitted"] == s["completed"]
         assert victims  # silence the unused-var lint
+    finally:
+        r.shutdown(drain_timeout=30.0)
+        store.close()
+
+
+@pytest.mark.slow
+def test_subprocess_stream_failover_resumes_bit_exact(tmp_path):
+    """Mid-stream failover over REAL replica processes: a subprocess
+    replica frozen then SIGKILLed mid-generation fails its stream over
+    the store transport to the surviving process, and the client
+    iterator reads a token sequence bit-identical to an uninterrupted
+    single-process greedy run on the committed generation. (The fast
+    stub-engine equivalents above cover the same invariants tier-1.)"""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.store import create_master_store
+    from paddle_tpu.inference import SubprocessReplica
+    from paddle_tpu.inference.decode.demo import demo_prompt, tiny_engine
+
+    d0 = tmp_path / "g0"
+    d0.mkdir()
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    m.eval()
+    paddle.jit.save(m, str(d0 / "model"), input_spec=[
+        paddle.to_tensor(np.zeros((1, 4), np.float32))])
+    commit_model_dir(str(d0), 1)
+
+    prompt = demo_prompt(5, 8)
+    ref_eng = tiny_engine(1)          # the tier serves generation 1
+    try:
+        ref = list(ref_eng.generate(prompt, 12))
+    finally:
+        ref_eng.shutdown()
+
+    store = create_master_store()
+    reps = {}
+
+    def factory(rid, model_dir, generation):
+        rep = SubprocessReplica(
+            rid, store, model_dir=model_dir, generation=generation,
+            artifact_name="model", start_timeout=120.0,
+            decode_factory="paddle_tpu.inference.decode.demo:"
+                           "tiny_engine_slow")
+        reps[rid] = rep
+        return rep
+
+    cfg = fast_config(heartbeat_ttl=2.0, start_grace=120.0,
+                      attempt_timeout=15.0, probe_timeout=60.0,
+                      no_capacity_wait=5.0, affinity_block_tokens=8,
+                      restart_backoff=RetryPolicy(base_delay=0.2,
+                                                  max_delay=1.0),
+                      failover=RetryPolicy(max_retries=4, base_delay=0.002,
+                                           max_delay=0.01,
+                                           max_elapsed=60.0))
+    r = ServingRouter(factory, size=2, model_dir=str(d0), generation=1,
+                      config=cfg, heartbeats=store)
+    try:
+        rs = r.submit_generate(prompt, 12, timeout=120.0)
+        it = iter(rs)
+        got = [next(it) for _ in range(4)]
+        victim = next(m["rid"] for m in r.stats()["members"]
+                      if m["streams"] > 0)
+        # freeze first so the engine can't sprint ahead, then SIGKILL:
+        # the stream is provably mid-flight when the process dies
+        reps[victim].wedge()
+        time.sleep(0.2)
+        reps[victim].kill()
+        got += list(it)
+        assert got == ref             # no duplicates, no gaps, no splice
+        st = r.stats()["streams"]
+        assert st["failovers"] >= 1 and st["resumed"] >= 1
+        assert st["admitted"] == (st["completed"] + st["failed"]
+                                  + st["timed_out"] + st["cancelled"]
+                                  + st["in_flight"])
+        # cancel over the store transport frees the replica promptly
+        rs2 = r.submit_generate(prompt, 12, timeout=120.0)
+        next(iter(rs2))
+        rs2.cancel()
+        with pytest.raises(RequestFailed, match="cancelled"):
+            rs2.result(timeout=30.0)
+        assert wait_until(
+            lambda: all(mem["streams"] == 0
+                        for mem in r.stats()["members"]), timeout=30.0)
     finally:
         r.shutdown(drain_timeout=30.0)
         store.close()

@@ -12,9 +12,16 @@ requests and capacity converges back to N via supervised restart; a
 rolling weight hot-swap under sustained traffic drops nothing, stamps
 every response with exactly one generation whose single-process outputs
 it bit-matches, and a swap interrupted by a replica kill rolls back to a
-consistent generation. Running it in the suite makes resilience
-regressions fail CI, mirroring tests/test_ckpt_fault_injection.py for
-checkpoints."""
+consistent generation. The router-stream-* phases stream token
+generations through the same tier over real continuous-batching decode
+engines: a replica killed or wedged mid-generation fails its streams
+over to fresh replicas that resume from the committed tokens, the
+client iterator reading one bit-exact sequence; a hot-swap under live
+streams preserves generation purity; a cancelled stream frees its KV
+blocks within a scheduler round; and the streams conservation ledger
+holds in the live Prometheus exposition. Running it in the suite makes
+resilience regressions fail CI, mirroring
+tests/test_ckpt_fault_injection.py for checkpoints."""
 import os
 import subprocess
 import sys

@@ -905,7 +905,7 @@ def test_tl012_legacy_baseline_frozen():
     assert "paddle_tpu/flags.py::TL012::<module>" in tl012
     assert "paddle_tpu/core/monitor.py::TL012::<module>" in tl012
     tl011 = sum(v for k, v in counts.items() if "::TL011::" in k)
-    assert tl011 <= 15                     # ...and TL011 burned down
+    assert tl011 == 0                      # ...and TL011 burned down
     assert not any("collective.py::TL011" in k or "misc_api.py::TL011" in k
                    for k in counts)
     # the PR-12 tranche: pipeline + data_parallel construct zero raw
@@ -916,13 +916,21 @@ def test_tl012_legacy_baseline_frozen():
     # factories (the all-to-all shard_map specs included)
     assert not any("moe.py::TL011" in k or
                    "context_parallel.py::TL011" in k for k in counts)
+    # the PR-16 tranche retired the rule from the baseline outright:
+    # ps + sequence_parallel + gpt_pipe were the last raw sites
+    assert not any("::TL011::" in k for k in counts)
 
 
 def test_tl011_migrated_files_are_clean():
-    """Per-file clean assertions for the PR-15 TL011 tranche — not just
-    absent from the baseline, but zero findings in the live lint."""
+    """Per-file clean assertions for the PR-15 (moe/context_parallel)
+    and PR-16 (ps/sequence_parallel/gpt_pipe — the final tranche) TL011
+    migrations — not just absent from the baseline, but zero findings in
+    the live lint."""
     for rel in ("paddle_tpu/distributed/moe.py",
-                "paddle_tpu/distributed/context_parallel.py"):
+                "paddle_tpu/distributed/context_parallel.py",
+                "paddle_tpu/distributed/ps.py",
+                "paddle_tpu/distributed/sequence_parallel.py",
+                "paddle_tpu/models/gpt_pipe.py"):
         fs = tracelint.lint_file(os.path.join(REPO, rel), rel)
         hits = [f for f in fs if f.rule == "TL011"]
         assert not hits, f"{rel}: {hits}"

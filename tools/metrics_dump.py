@@ -14,16 +14,23 @@ Two modes (docs/observability.md):
   the process default registry as a side effect — e.g. a module that
   builds a pool), then dump that registry in the requested format.
 
+``--grep PATTERN`` filters the output lines by a Python regex before
+printing (shell-free equivalent of piping through grep — one Prometheus
+series per line, so a family name or label value selects its series;
+JSON output is filtered line-wise the same way).
+
 Exit codes: 0 on success, 1 on scrape/import failure, 2 on usage error.
 
     python tools/metrics_dump.py --url http://127.0.0.1:9090
     python tools/metrics_dump.py --url http://127.0.0.1:9090 --format json
+    python tools/metrics_dump.py --url 127.0.0.1:9090 --grep streams_
     python tools/metrics_dump.py --import myapp.serving --format prom
 """
 from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 import urllib.request
 
@@ -63,11 +70,31 @@ def main(argv=None):
                          "(their side effects populate the registry)")
     ap.add_argument("--timeout", type=float, default=5.0,
                     help="scrape timeout in seconds (default: 5)")
+    ap.add_argument("--grep", default=None, metavar="PATTERN",
+                    help="print only output lines matching this Python "
+                         "regex (e.g. a metric family name, a label "
+                         "value, 'streams_')")
     args = ap.parse_args(argv)
+
+    if args.grep is not None:
+        try:
+            pattern = re.compile(args.grep)
+        except re.error as e:
+            print(f"metrics_dump: bad --grep pattern {args.grep!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        pattern = None
+
+    def emit(text):
+        if pattern is not None:
+            text = "".join(ln for ln in text.splitlines(keepends=True)
+                           if pattern.search(ln))
+        sys.stdout.write(text)
 
     if args.url:
         try:
-            sys.stdout.write(_scrape(args.url, args.fmt, args.timeout))
+            emit(_scrape(args.url, args.fmt, args.timeout))
         except Exception as e:  # noqa: BLE001 — CLI boundary
             print(f"metrics_dump: scrape of {args.url!r} failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
@@ -87,9 +114,9 @@ def main(argv=None):
 
     snap = registry().snapshot()
     if args.fmt == "json":
-        sys.stdout.write(render_json(snap, indent=1) + "\n")
+        emit(render_json(snap, indent=1) + "\n")
     else:
-        sys.stdout.write(render_prometheus(snap))
+        emit(render_prometheus(snap))
     return 0
 
 

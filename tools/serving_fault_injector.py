@@ -110,6 +110,34 @@ conservation law admitted == completed + failed + timed_out + overloaded
                    (the dead replica restarts onto it), then a clean
                    re-swap completes.
 
+Router STREAMING phases (`router-stream-*`) run client token streams
+through the same tier over REAL continuous-batching decode engines
+(decode.demo.tiny_engine_slow per replica, seeded by the weight
+generation) and prove the mid-stream robustness contract: a stream
+interrupted by replica death resumes on a fresh replica from
+`prompt + committed tokens` and the client iterator reads ONE token
+sequence bit-identical to an uninterrupted solo-engine run; the streams
+ledger conservation law streams.admitted == completed + failed +
+timed_out + cancelled + in_flight holds both in `stats()` and in the
+live Prometheus exposition; a cancelled stream frees its replica-side
+KV blocks within a scheduler round (zero leaks); and every failed-over
+stream resolves to one merged causal trace (root `router.generate` +
+sibling `router.attempt` spans, the resumed attempt carrying
+`resumed_from`):
+
+  router-stream-kill   kill the replica carrying live streams
+                       mid-generation: every stream fails over and
+                       completes bit-exact, zero tokens lost or
+                       duplicated, capacity converges back to N;
+  router-stream-wedge  SIGSTOP-shaped wedge (tokens stop, beats stop):
+                       the watchdog flags the replica and the pumps
+                       migrate mid-stream, same bit-exactness bar;
+  router-stream-swap   weight hot-swap under live streams: in-flight
+                       streams drain or migrate with generation purity
+                       (no stream ever mixes tokens from two
+                       generations), post-swap streams serve only the
+                       new generation's weights.
+
 The real multi-process replica topology (SubprocessReplica over the
 coordination store) is exercised by the slow-marked test in
 tests/test_router.py.
@@ -198,7 +226,9 @@ PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "decode-none", "decode-kill", "decode-wedge", "decode-poison",
           "decode-cow", "decode-spec",
           "router-none", "router-kill", "router-wedge",
-          "router-swap", "router-swap-kill")
+          "router-swap", "router-swap-kill",
+          "router-stream-kill", "router-stream-wedge",
+          "router-stream-swap")
 
 POOL_SIZE = 3
 N_REQUESTS = 48
@@ -1251,6 +1281,332 @@ def run_router_phase(phase, ctx, verbose=True):
     return bad
 
 
+# ---------------------------------------------------------------------------
+# router streaming (HA decode tier) phases
+# ---------------------------------------------------------------------------
+
+STREAM_TIER = 3
+STREAM_COUNT = 6            # concurrent client streams per phase
+STREAM_MAX_NEW = 12
+STREAM_GEN_A, STREAM_GEN_B = 1, 2
+
+
+def _export_stream_ctx(workdir):
+    """Commit-stamped (artifact-free) model dirs for the streaming
+    phases — the decode weights come from the demo engine factory,
+    seeded by the dir's generation stamp — plus SOLO-engine reference
+    token sequences, the bit-match yardstick for every streamed
+    generation (the decode phases already prove multi-sequence batching
+    matches solo runs; here the same bar spans replica failover)."""
+    from paddle_tpu.inference import commit_model_dir
+    from paddle_tpu.inference.decode.demo import demo_prompt, tiny_engine
+
+    prompts = [demo_prompt(40 + i, 8) for i in range(STREAM_COUNT)]
+    ctx = {"prompts": prompts, "dirs": {}, "refs": {}}
+    for gen in (STREAM_GEN_A, STREAM_GEN_B):
+        d = os.path.join(workdir, f"stream-gen{gen}")
+        os.makedirs(d)
+        commit_model_dir(d, gen)
+        ctx["dirs"][gen] = d
+        eng = tiny_engine(gen)
+        ctx["refs"][gen] = [list(eng.generate(p, STREAM_MAX_NEW))
+                            for p in prompts]
+        eng.shutdown()
+    return ctx
+
+
+def run_router_stream_phase(phase, ctx, mserver_url, verbose=True):
+    import urllib.request
+
+    from paddle_tpu.inference import (
+        LocalHeartbeats, LocalReplica, RouterConfig, ServingError,
+        ServingRouter)
+    from paddle_tpu.inference.decode.demo import tiny_engine_slow
+    from paddle_tpu.inference.serving import RetryPolicy, _NullPredictor
+
+    bad = []
+    prompts, dirs, refs = ctx["prompts"], ctx["dirs"], ctx["refs"]
+    kind = phase.rsplit("-", 1)[1]
+    hb = LocalHeartbeats()
+    registry = {}
+
+    def engine_factory(gen):
+        # throttled (~50ms/dispatch — wider than the demo default) so a
+        # generation spans enough wall-clock that the fault below lands
+        # mid-stream deterministically; warmup compiles/disk-hits every
+        # bucket up front so faulted traffic never traces
+        eng = tiny_engine_slow(
+            int(gen), fault_hook=lambda tag, ids, info: time.sleep(0.05))
+        eng.warmup()
+        return eng
+
+    def factory(rid, model_dir, generation):
+        rep = LocalReplica(
+            rid, lambda d: _NullPredictor(), model_dir=model_dir,
+            generation=generation, heartbeat=hb,
+            heartbeat_interval=0.02, decode_factory=engine_factory,
+            pool_kwargs=dict(default_timeout=30.0,
+                             supervise_interval=0.01, hang_grace=0.05))
+        registry[rid] = rep
+        return rep
+
+    cfg = RouterConfig(
+        # ttl is looser than the infer phases': engine builds compile
+        # under instrumented harnesses, and a starved beat thread must
+        # not read as a death mid-swap
+        heartbeat_ttl=1.0, supervise_interval=0.02, start_grace=30.0,
+        attempt_timeout=2.0, probe_timeout=10.0, no_capacity_wait=5.0,
+        breaker_reset_timeout=0.2, affinity_block_tokens=8,
+        restart_backoff=RetryPolicy(base_delay=0.05, max_delay=0.3),
+        failover=RetryPolicy(max_retries=5, base_delay=0.002,
+                             max_delay=0.01, max_elapsed=40.0))
+    t0 = time.monotonic()
+    name = f"stream_{kind}"
+    router = ServingRouter(factory, size=STREAM_TIER,
+                           model_dir=dirs[STREAM_GEN_A],
+                           generation=STREAM_GEN_A, config=cfg,
+                           heartbeats=hb, name=name)
+    olock = threading.Lock()
+
+    def run_stream(i, want_gen=None):
+        """Submit prompt i, consume the stream to completion, bit-check
+        the ONE token sequence the client iterator saw against the
+        stamped generation's solo reference."""
+        try:
+            rs = router.submit_generate(prompts[i], STREAM_MAX_NEW,
+                                        timeout=30.0)
+            toks = list(rs.result())
+        except ServingError as e:
+            return ("typed", type(e).__name__, None)
+        except BaseException as e:  # noqa: BLE001 — untyped = violation
+            with olock:
+                bad.append(f"[{phase}] stream {i} -> UNTYPED "
+                           f"{type(e).__name__}: {e}")
+            return ("untyped", type(e).__name__, None)
+        gen = rs.generation
+        with olock:
+            if gen not in refs:
+                bad.append(f"[{phase}] stream {i} stamped unknown "
+                           f"generation {gen}")
+            elif toks != refs[gen][i]:
+                # the ONE-sequence guarantee: resumed output must be
+                # bit-identical to an uninterrupted solo run — a lost,
+                # duplicated, or mixed-weights token can never hide
+                bad.append(f"[{phase}] stream {i} diverged from its "
+                           f"stamped generation {gen}'s solo reference: "
+                           f"{toks} vs {refs[gen][i]}")
+            elif want_gen is not None and gen != want_gen:
+                bad.append(f"[{phase}] stream {i} stamped generation "
+                           f"{gen}, wanted {want_gen}")
+        return ("ok", gen, rs)
+
+    def _live_victim(timeout=15.0):
+        deadline_at = time.monotonic() + timeout
+        while time.monotonic() < deadline_at:
+            carrying = [m for m in router.stats()["members"]
+                        if m["streams"] > 0 and m["state"] == "ready"]
+            if carrying:
+                return max(carrying, key=lambda m: m["streams"])["rid"]
+            time.sleep(0.01)
+        return None
+
+    try:
+        # warm control stream: proves the fault-free path and flushes
+        # the first-dispatch compiles before the retrace sentinel arms
+        if run_stream(0)[0] != "ok":
+            bad.append(f"[{phase}] warm control stream failed")
+        _san_mark_warm()   # replica restarts / swaps build FRESH engines
+        # (cold entrypoints) — those may compile; these must not
+
+        results = []
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=STREAM_COUNT) as ex:
+            futs = [ex.submit(run_stream, i) for i in range(STREAM_COUNT)]
+            victim = _live_victim()
+            if victim is None:
+                bad.append(f"[{phase}] no replica ever carried a live "
+                           f"stream — the fault was never landed")
+            elif kind == "kill":
+                time.sleep(0.1)          # definitely mid-generation
+                registry[victim].kill()
+            elif kind == "wedge":
+                time.sleep(0.1)
+                registry[victim].wedge()
+            else:                        # swap under live streams
+                new_gen = router.swap_weights(dirs[STREAM_GEN_B],
+                                             drain_timeout=20.0)
+                if new_gen != STREAM_GEN_B:
+                    bad.append(f"[{phase}] swap returned generation "
+                               f"{new_gen}, wanted {STREAM_GEN_B}")
+            done, pending = concurrent.futures.wait(futs, timeout=120)
+            if pending:
+                bad.append(f"[{phase}] {len(pending)} streams HUNG past "
+                           f"every deadline")
+            results = [f.result() for f in done]
+
+        ok = sum(1 for r in results if r[0] == "ok")
+        if kind in ("kill", "wedge"):
+            # failover is lossless for streams: every client iterator
+            # completes (resumed mid-stream on a fresh replica)
+            if ok != STREAM_COUNT:
+                bad.append(f"[{phase}] lost streams across the fault: "
+                           f"{ok}/{STREAM_COUNT} completed "
+                           f"({[r[:2] for r in results]})")
+            st = router.stats()["streams"]
+            if st["failovers"] < 1 or st["resumed"] < 1:
+                bad.append(f"[{phase}] no stream ever failed over / "
+                           f"resumed mid-generation: {st}")
+        else:
+            # the roll may typed-fail a stream caught between
+            # generations (purity > availability) but never silently
+            # splice; completed streams are bit-checked by run_stream
+            for r in results:
+                if r[0] == "typed" and r[1] not in (
+                        "RequestFailed", "DeadlineExceeded"):
+                    bad.append(f"[{phase}] stream failed with unexpected "
+                               f"typed error {r[1]}")
+            gens = {r[1] for r in results if r[0] == "ok"}
+            if not gens <= {STREAM_GEN_A, STREAM_GEN_B}:
+                bad.append(f"[{phase}] streams stamped unknown "
+                           f"generations {sorted(gens)}")
+
+        # --- convergence: full healthy capacity on ONE generation ------
+        want_gen = STREAM_GEN_B if kind == "swap" else STREAM_GEN_A
+        deadline_at = time.monotonic() + CONVERGE_TIMEOUT
+        stats = router.stats()
+        while time.monotonic() < deadline_at:
+            stats = router.stats()
+            if stats["ready"] == STREAM_TIER and all(
+                    m["generation"] == want_gen
+                    for m in stats["members"]
+                    if m["state"] not in ("retired",)):
+                break
+            time.sleep(0.05)
+        else:
+            bad.append(f"[{phase}] tier did NOT converge to "
+                       f"{STREAM_TIER} ready replicas on generation "
+                       f"{want_gen}: {stats['members']}")
+
+        # post-fault streams on the converged generation
+        for i in (0, 1):
+            r = run_stream(i, want_gen=want_gen)
+            if r[0] != "ok":
+                bad.append(f"[{phase}] post-fault stream {i} failed: "
+                           f"{r[1]}")
+
+        # --- cancelled stream frees replica-side KV blocks -------------
+        rs = router.submit_generate(prompts[0], STREAM_MAX_NEW,
+                                    timeout=30.0)
+        it = iter(rs)
+        next(it)                        # mid-generation, blocks held
+        rs.cancel()
+        try:
+            rs.result(timeout=10.0)
+            bad.append(f"[{phase}] cancelled stream completed anyway")
+        except ServingError:
+            pass
+        deadline_at = time.monotonic() + 5.0
+        leaks = ["unchecked"]
+        while time.monotonic() < deadline_at:
+            leaks = []
+            for m in router.stats()["members"]:
+                rep = registry.get(m["rid"])
+                if rep is None or m["state"] != "ready":
+                    continue
+                d = (rep.stats().get("pool") or {}).get("decode")
+                if not d:
+                    continue
+                # blocks pinned by the prefix cache are deliberate
+                # retention, not a leak
+                held = (d["blocks"]["allocated"]
+                        - d["prefix_cache"]["physical_blocks"])
+                if d["active"] or d["waiting"] or d["prefilling"] or held:
+                    leaks.append((m["rid"], d["active"], d["waiting"],
+                                  held))
+            if not leaks:
+                break
+            time.sleep(0.05)
+        if leaks:
+            bad.append(f"[{phase}] KV blocks leaked after stream "
+                       f"cancel: {leaks}")
+
+        # --- streams ledger: stats() AND the live Prometheus text ------
+        st = router.stats()["streams"]
+        lhs = st["admitted"]
+        rhs = (st["completed"] + st["failed"] + st["timed_out"]
+               + st["cancelled"] + st["in_flight"])
+        if lhs != rhs:
+            bad.append(f"[{phase}] STREAMS conservation violated: "
+                       f"admitted={lhs} != completed+failed+timed_out+"
+                       f"cancelled+in_flight={rhs} ({st})")
+        try:
+            text = urllib.request.urlopen(
+                mserver_url + "/metrics", timeout=5).read().decode()
+        except Exception as e:  # noqa: BLE001 — verdict-reported
+            bad.append(f"[{phase}] live metrics scrape failed: "
+                       f"{type(e).__name__}: {e}")
+        else:
+            prefix = f"serving_router_{name}_streams_"
+            scraped = {}
+            for ln in text.splitlines():
+                if ln.startswith(prefix):
+                    k, _, v = ln.partition(" ")
+                    scraped[k[len(prefix):]] = int(float(v))
+            need = ("admitted", "completed", "failed", "timed_out",
+                    "cancelled", "in_flight")
+            if not all(k in scraped for k in need):
+                bad.append(f"[{phase}] streams ledger missing from the "
+                           f"scraped exposition: {sorted(scraped)}")
+            elif scraped["admitted"] != sum(scraped[k]
+                                            for k in need[1:]):
+                bad.append(f"[{phase}] scraped streams ledger violates "
+                           f"conservation: {scraped}")
+            if 'router_ttft_seconds_count{' not in text \
+                    or 'replica="' not in text:
+                bad.append(f"[{phase}] per-replica router.ttft_seconds "
+                           f"histogram missing from the exposition")
+
+        # --- failed-over streams read as ONE merged causal record ------
+        if kind in ("kill", "wedge") and _trace_on():
+            from paddle_tpu.obs import flight
+            rec = flight.recorder()
+            merged = 0
+            for tr in rec.traces(limit=200):
+                spans = rec.spans_for(tr["trace_id"])
+                root = next(
+                    (s for s in spans if s.name == "router.generate"
+                     and s.parent_id is None
+                     and (s.attrs or {}).get("router") == name), None)
+                if root is None \
+                        or int((root.attrs or {}).get("failovers", 0)) < 1:
+                    continue
+                attempts = [s for s in spans
+                            if s.name == "router.attempt"]
+                if len(attempts) >= 2 and any(
+                        (s.attrs or {}).get("resumed_from")
+                        for s in attempts):
+                    merged += 1
+            if merged < 1:
+                bad.append(f"[{phase}] no failed-over stream resolved "
+                           f"to one merged causal record (root "
+                           f"router.generate + resumed router.attempt)")
+    finally:
+        drained = router.shutdown(drain_timeout=15.0)
+    if not drained:
+        bad.append(f"[{phase}] router failed to drain on shutdown")
+    final = router.stats()
+    if verbose:
+        st = final["streams"]
+        tag = "FAIL" if bad else "ok"
+        print(f"  {phase:<20} -> {tag}  (streams={st['admitted']} "
+              f"admitted/{st['completed']} completed, "
+              f"failovers={st['failovers']}, resumed={st['resumed']}, "
+              f"affinity_hits={st['affinity_hits']}, "
+              f"deaths={final['deaths']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--phases", default=",".join(PHASES),
@@ -1298,7 +1654,10 @@ def main(argv=None):
         serving_phases = [p for p in phases
                           if not p.startswith(("decode-", "router-"))]
         decode_phases = [p for p in phases if p.startswith("decode-")]
-        router_phases = [p for p in phases if p.startswith("router-")]
+        stream_phases = [p for p in phases
+                         if p.startswith("router-stream-")]
+        router_phases = [p for p in phases if p.startswith("router-")
+                         and not p.startswith("router-stream-")]
         model = _export_model(path) if serving_phases else None
         print("serving fault injection (hook-at-execution):")
         for phase in serving_phases:
@@ -1325,6 +1684,15 @@ def main(argv=None):
             print("router (distributed serving tier) phases:")
             for phase in router_phases:
                 violations += run_router_phase(phase, rctx)
+        if stream_phases:
+            # streaming through the tier: LocalReplica over real
+            # continuous-batching decode engines (the multi-process
+            # topology runs slow-marked in tests/test_router.py)
+            sctx = _export_stream_ctx(workdir)
+            print("router streaming (HA decode tier) phases:")
+            for phase in stream_phases:
+                violations += run_router_stream_phase(
+                    phase, sctx, mserver.url)
 
         # telemetry verdict: the concurrent scraper must have succeeded
         # throughout, and a final scrape must expose the serving metric
@@ -1349,6 +1717,11 @@ def main(argv=None):
                     "final scrape is missing the serving_request_seconds "
                     "histogram — pool instrumentation never reached the "
                     "registry")
+            if stream_phases and "router_request_seconds" not in final:
+                violations.append(
+                    "final scrape is missing the router_request_seconds "
+                    "histogram — router stream instrumentation never "
+                    "reached the registry")
             print(f"obs: {scrapes[0]} concurrent scrapes ok; final "
                   f"exposition {len(final)} bytes")
         mserver.stop()
@@ -1477,10 +1850,13 @@ def main(argv=None):
             # recorder's registry/postmortem lock are on every traced
             # request path — same 0-cycles / 0-held-across-dispatch bar
             expected_locks |= {"obs.trace", "obs.flight"}
-        if any(p.startswith("decode-") for p in phases):
+        if any(p.startswith(("decode-", "router-stream-"))
+               for p in phases):
             # the decode engine's own named locks must have been observed
             # (and the 0-cycles / 0-held-across-dispatch assertions below
-            # now cover the decode-step dispatch path too)
+            # now cover the decode-step dispatch path too); the streaming
+            # router phases run real decode engines inside each replica,
+            # so they put the same locks on the live path
             expected_locks |= {"decode.engine", "decode.block_pool"}
         if any(p.startswith("router-") for p in phases):
             # the distributed tier's named locks: the same 0-cycles /
