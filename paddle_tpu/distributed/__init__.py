@@ -85,5 +85,12 @@ from .checkpoint.api import (  # noqa: F401,E402
     CheckpointShardMismatchError,
 )
 from .checkpoint.manager import CheckpointManager  # noqa: F401,E402
+from .preemption import (  # noqa: F401,E402
+    PreemptionHandler, PREEMPT_EXIT_CODE, is_clean_preempt,
+)
+from .train_guard import (  # noqa: F401,E402
+    TrainGuard, TrainWatchdog, BadStepError, TrainingStalledError,
+    recovery_counters,
+)
 from . import launch  # noqa: F401,E402
 from . import io  # noqa: F401,E402

@@ -234,6 +234,30 @@ class CheckpointManager:
         if h is not None:
             h.join()
 
+    def preempt_save(self, state_dict, step, extra=None, generation=None):
+        """Synchronous save for the preemption-shutdown path
+        (distributed/preemption.py): an in-flight async save is WAITED out
+        first — superseded, never abandoned as an uncommitted staging dir
+        for the next boot's GC sweep — and its failure is demoted to a
+        stderr note (the preemption save that follows replaces whatever
+        the failed one was writing). The save itself runs synchronously
+        regardless of `async_save`, because the process exits right
+        after."""
+        import sys
+
+        try:
+            self.wait()
+        except Exception as e:  # noqa: BLE001 — superseded by this save
+            print(f"checkpoint: pending async save failed during "
+                  f"preemption ({e}); superseding with a synchronous "
+                  f"save of step {step}", file=sys.stderr)
+        prev, self.async_save = self.async_save, False
+        try:
+            return self.save(state_dict, step, extra=extra,
+                             generation=generation)
+        finally:
+            self.async_save = prev
+
     # -- restore -----------------------------------------------------------
     def restore(self, state_dict, step, strict=True):
         """Load checkpoint `step` into `state_dict` (tensors in place,

@@ -29,6 +29,7 @@ batch), so nothing host-side executes between micro-steps.
 from __future__ import annotations
 
 import itertools
+import time
 
 import numpy as np
 import jax
@@ -213,6 +214,11 @@ class ShardedTrainStep:
         # explicit host->device transfers, for perf smoke tests that must
         # not depend on wall-clock
         self.stats = {"dispatches": 0, "device_puts": 0, "steps": 0}
+        # in-flight dispatch marker (site, monotonic start), set around
+        # every compiled-step dispatch: the training watchdog
+        # (train_guard.TrainWatchdog) flags a dispatch that exceeds its
+        # timeout as a wedged collective/device hang
+        self._inflight = None
         # telemetry (paddle_tpu.obs): the SAME stats dict registered as a
         # weakly-held collector (the registry prunes it when the engine is
         # garbage-collected), plus a dispatch-latency histogram fed by the
@@ -534,13 +540,18 @@ class ShardedTrainStep:
                    key, step_no) if san and self.donate else None
         # the hot-sync probe arms only on WARM dispatches: the cold call
         # traces user loss code (compile time, not the hot path)
-        with _span("engine::dispatch", histogram=self._h_dispatch), \
-                (_san.allow_host_sync("engine.compile") if cold
-                 else _san.hot_region("engine.dispatch")):
-            (loss, gnorm, self.param_vals, self.opt_state, self.buffer_vals,
-             self._key_dev, self._step_dev) = self._step_fn(
-                self.param_vals, self.opt_state, self.buffer_vals, placed,
-                lr, key, step_no)
+        self._inflight = ("engine.dispatch", time.monotonic())
+        try:
+            with _span("engine::dispatch", histogram=self._h_dispatch), \
+                    (_san.allow_host_sync("engine.compile") if cold
+                     else _san.hot_region("engine.dispatch")):
+                (loss, gnorm, self.param_vals, self.opt_state,
+                 self.buffer_vals, self._key_dev, self._step_dev) = \
+                    self._step_fn(
+                        self.param_vals, self.opt_state, self.buffer_vals,
+                        placed, lr, key, step_no)
+        finally:
+            self._inflight = None
         if donated is not None:
             _san.note_donation("engine.dispatch", donated,
                                tag=f"step {self._step_count}")
@@ -651,13 +662,17 @@ class ShardedTrainStep:
                                self.buffer_vals, placed, lrs, key, step0))
         donated = (self.param_vals, self.opt_state, self.buffer_vals,
                    key, step0) if san and self.donate else None
-        with _span("engine::dispatch", histogram=self._h_dispatch), \
-                (_san.allow_host_sync("engine.compile") if cold
-                 else _san.hot_region("engine.dispatch")):
-            (losses, gnorms, self.param_vals, self.opt_state,
-             self.buffer_vals, self._key_dev, self._step_dev) = fn(
-                self.param_vals, self.opt_state, self.buffer_vals, placed,
-                lrs, key, step0)
+        self._inflight = ("engine.dispatch", time.monotonic())
+        try:
+            with _span("engine::dispatch", histogram=self._h_dispatch), \
+                    (_san.allow_host_sync("engine.compile") if cold
+                     else _san.hot_region("engine.dispatch")):
+                (losses, gnorms, self.param_vals, self.opt_state,
+                 self.buffer_vals, self._key_dev, self._step_dev) = fn(
+                    self.param_vals, self.opt_state, self.buffer_vals,
+                    placed, lrs, key, step0)
+        finally:
+            self._inflight = None
         if donated is not None:
             _san.note_donation("engine.dispatch", donated,
                                tag=f"steps {self._step_count + 1}.."
@@ -770,6 +785,86 @@ class ShardedTrainStep:
         for n, p in self._params.items():
             self.optimizer._accumulators[id(p)] = dict(self.opt_state[n])
         self.optimizer._step_count = self._step_count
+
+    # ---- fault tolerance: snapshots + checkpoint state -----------------
+    def _copy_tree(self, d):
+        # jnp.copy dispatches a device-side copy that preserves sharding;
+        # plain references would be invalidated by the NEXT dispatch (the
+        # engine donates params/slots/buffers/key/step to XLA every step)
+        return {k: jnp.copy(v) for k, v in d.items()}
+
+    def snapshot(self):
+        """Donation-safe deep copy of the engine's carried train state
+        (params, optimizer slots, buffers, step count, RNG key) — the unit
+        of `train_guard.TrainGuard`'s rollback ring. The RNG key is
+        materialized first so a restore replays the EXACT key sequence
+        (bit-identical skip-and-continue) instead of redrawing."""
+        if self.optimizer is not None:
+            self._key_scalar()
+        return {
+            "step_count": self._step_count,
+            "params": self._copy_tree(self.param_vals),
+            "opt": {n: self._copy_tree(s)
+                    for n, s in self.opt_state.items()},
+            "buffers": self._copy_tree(self.buffer_vals),
+            "key": None if self._key_dev is None else jnp.copy(
+                self._key_dev),
+            "key_epoch": self._key_epoch,
+        }
+
+    def restore(self, snap):
+        """Rewind the engine to `snap` (from `snapshot()`). The snapshot
+        itself is copied on the way in, so the SAME snapshot can absorb a
+        second rollback. External Parameter writes since the snapshot are
+        dropped (the refs are re-armed) — a rollback rewinds everything."""
+        self.param_vals = self._copy_tree(snap["params"])
+        self.opt_state = {n: self._copy_tree(s)
+                          for n, s in snap["opt"].items()}
+        self.buffer_vals = self._copy_tree(snap["buffers"])
+        self._step_count = int(snap["step_count"])
+        self._step_dev = None     # rebuilt from _step_count on next step
+        self._key_epoch = snap["key_epoch"]
+        self._key_dev = None if snap["key"] is None else jnp.copy(
+            snap["key"])
+        self._write_back_buffers()
+        for _n, p, ref in self._param_refs:
+            p._v_ = ref
+
+    def state_dict(self):
+        """Checkpointable state tree (Tensor leaves + the step scalar) for
+        `CheckpointManager` round-trips: restore_latest() into this tree,
+        then `load_state_dict` it back — the engine-level resume path the
+        fault-tolerance layer (preemption saves, elastic relaunch) uses."""
+        tree = {
+            "model": {n: Tensor(v) for n, v in self.param_vals.items()},
+            "buffers": {n: Tensor(v) for n, v in self.buffer_vals.items()},
+            "step": self._step_count,
+        }
+        if self.opt_state:
+            tree["opt"] = {n: {s: Tensor(v) for s, v in slots.items()}
+                           for n, slots in self.opt_state.items()}
+        return tree
+
+    def load_state_dict(self, tree):
+        """Adopt a `state_dict()`-shaped tree (fresh from a checkpoint
+        restore) as the engine's carried state, re-placed per the CURRENT
+        mesh shardings."""
+        for n in self.param_vals:
+            self.param_vals[n] = jax.device_put(
+                tree["model"][n]._value, self._param_sh[n])
+        for n in self.buffer_vals:
+            if n in tree.get("buffers", {}):
+                self.buffer_vals[n] = jax.device_put(
+                    tree["buffers"][n]._value, self._buf_sh[n])
+        for n, slots in (tree.get("opt") or {}).items():
+            sh = self._state_sh[n]
+            for s, v in slots.items():
+                self.opt_state[n][s] = jax.device_put(v._value, sh)
+        self._step_count = int(tree.get("step", 0))
+        self._step_dev = None
+        self._write_back_buffers()
+        for _n, p, ref in self._param_refs:
+            p._v_ = ref
 
 
 def parallelize(model, optimizer=None, loss_fn=None, *, mesh=None,

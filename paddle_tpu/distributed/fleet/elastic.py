@@ -14,6 +14,10 @@ import threading
 import time
 
 from ..store import TCPStore, Watchdog
+# clean-preempt contract shared with the launcher: a worker that exits
+# PREEMPT_EXIT_CODE checkpointed on purpose inside its grace window, and
+# the elastic relaunch does NOT spend a retry on it (controller.run)
+from ..preemption import PREEMPT_EXIT_CODE, is_clean_preempt  # noqa: F401
 
 
 class ElasticStatus:
@@ -22,6 +26,7 @@ class ElasticStatus:
     HOLD = "hold"
     RESTART = "restart"
     EXIT = "exit"
+    PREEMPT = "preempt"  # clean preemption — relaunch without burning a retry
 
 
 class ElasticManager:
@@ -133,6 +138,19 @@ class ElasticManager:
             raise RuntimeError(
                 "ElasticManager has no checkpoint_root configured")
         return self.checkpoint.save(state_dict, step, extra=extra)
+
+    def preempt_save(self, state_dict, step, extra=None):
+        """Grace-window save for a preemption notice: synchronous, and an
+        in-flight async save is waited out first so no uncommitted staging
+        dir is abandoned (CheckpointManager.preempt_save). Pair with
+        `sys.exit(PREEMPT_EXIT_CODE)` so the launcher relaunches without
+        spending an elastic retry."""
+        if self.checkpoint is None:
+            raise RuntimeError(
+                "ElasticManager has no checkpoint_root configured")
+        with self._lock:
+            self._status = ElasticStatus.PREEMPT
+        return self.checkpoint.preempt_save(state_dict, step, extra=extra)
 
     def exit(self):
         self._stop.set()

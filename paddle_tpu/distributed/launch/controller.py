@@ -219,8 +219,14 @@ class Controller:
                 if rc is None:
                     alive += 1
                 elif rc != 0:
-                    print(f"worker rank {pr.rank} failed with code {rc}",
-                          file=sys.stderr)
+                    from ..preemption import is_clean_preempt
+
+                    if is_clean_preempt(rc):
+                        print(f"worker rank {pr.rank} exited on clean "
+                              f"preemption (code {rc})", file=sys.stderr)
+                    else:
+                        print(f"worker rank {pr.rank} failed with code {rc}",
+                              file=sys.stderr)
                     self.terminate()
                     return rc
             if alive == 0:
@@ -228,17 +234,35 @@ class Controller:
             time.sleep(0.2)
 
     def run(self):
+        from ..preemption import is_clean_preempt
+
         self.rendezvous()
         args = self.args
-        restarts = 0
+        restarts = 0   # FAILURE relaunches — the budget args.max_restarts caps
+        spawns = 0     # all incarnations, incl. free clean-preempt relaunches
         while True:
-            self.spawn(restart_epoch=restarts)
+            self.spawn(restart_epoch=spawns)
+            spawns += 1
             rc = self.watch()
             if rc == 0:
                 return 0
-            if not args.elastic or restarts >= args.max_restarts:
+            if not args.elastic:
                 return rc
-            restarts += 1
+            preempted = is_clean_preempt(rc)
+            if preempted:
+                # the worker checkpointed inside its grace window and
+                # exited PREEMPT_EXIT_CODE on purpose — relaunching costs
+                # nothing from the retry budget (a preemption storm must
+                # not exhaust the failure allowance)
+                print("elastic: clean preemption (workers checkpointed "
+                      "and exited within the grace window); relaunching "
+                      f"without spending a retry "
+                      f"({restarts}/{args.max_restarts} used)",
+                      file=sys.stderr)
+            elif restarts >= args.max_restarts:
+                return rc
+            else:
+                restarts += 1
             # all workers are dead here (watch() tears down on first
             # failure), so sweeping torn checkpoints is race-free; the
             # relaunched workers then auto-resume from the newest
@@ -255,9 +279,10 @@ class Controller:
                     if removed:
                         print("elastic: swept torn checkpoints "
                               f"{sorted(removed)}", file=sys.stderr)
-            print(f"elastic: relaunching workers "
-                  f"(attempt {restarts}/{args.max_restarts})",
-                  file=sys.stderr)
+            if not preempted:
+                print(f"elastic: relaunching workers after failure "
+                      f"(attempt {restarts}/{args.max_restarts})",
+                      file=sys.stderr)
 
     def close(self):
         if self._store is not None:

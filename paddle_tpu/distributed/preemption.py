@@ -1,0 +1,193 @@
+"""Preemption-safe training shutdown.
+
+Reference analog: the fleet elastic stack's graceful-exit path
+(fleet/elastic/manager.py's SIGTERM hooks + trainer relaunch). On TPU the
+scenario is sharper: maintenance preemptions deliver SIGTERM with a bounded
+grace window, and a pod-scale run must get EVERY host to checkpoint the
+SAME step inside that window or the sharded save is torn by construction
+(each host writes only the shards it owns — manifest_<host>.json under one
+sentinel, docs/checkpointing.md).
+
+Design: the signal handler itself does nothing heavy — it records a flag
+plus a monotonic deadline and returns (async-signal safety; the training
+loop may be inside a compiled dispatch). The training loop polls
+`preempted()` at step boundaries and then runs the coordinated shutdown:
+
+  1. `agree_step(step)` — every host publishes its current step to the
+     coordination store; the last arrival publishes ``max`` of all of them
+     as the agreed checkpoint step (a counting barrier bounded by the
+     remaining grace window, so a dead peer degrades to a timeout instead
+     of a hang). Hosts behind the agreed step run their remaining batches
+     first; hosts at it checkpoint immediately.
+  2. `save_and_exit(manager, state, step)` — flushes any in-flight async
+     save (superseding it instead of abandoning an uncommitted staging
+     dir), saves synchronously through the crash-atomic commit protocol,
+     and exits with `PREEMPT_EXIT_CODE` — a code the launcher's elastic
+     loop recognizes as a clean preemption and relaunches WITHOUT burning
+     an elastic retry (launch/controller.py).
+
+`PADDLE_TPU_PREEMPT_GRACE_S` sets the default grace window (seconds).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+
+__all__ = ["PreemptionHandler", "PREEMPT_EXIT_CODE", "is_clean_preempt"]
+
+#: Exit code of a clean coordinated preemption shutdown. Distinct from
+#: crash codes (1, -signal, 137) so the launcher/ElasticManager can
+#: relaunch without decrementing the elastic retry budget.
+PREEMPT_EXIT_CODE = 77
+
+_ENV_GRACE = "PADDLE_TPU_PREEMPT_GRACE_S"
+
+
+def is_clean_preempt(rc) -> bool:
+    """True when a worker exit code means 'coordinated preemption save
+    completed' rather than a crash."""
+    return rc == PREEMPT_EXIT_CODE
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT-driven coordinated checkpoint-and-exit.
+
+    Usage in a training loop::
+
+        pre = PreemptionHandler(store=get_store(), rank=r, world_size=w)
+        pre.install()
+        for step, batch in ...:
+            engine.train_batch(*batch)
+            if pre.preempted():
+                target = pre.agree_step(step)
+                ...run batches until step == target...
+                pre.save_and_exit(manager, state, step=target)
+
+    With `store=None` (or world_size == 1) `agree_step` is a trivial
+    passthrough — the single-host path needs no coordination.
+    """
+
+    def __init__(self, store=None, rank=0, world_size=1, grace_s=None,
+                 job_id="train"):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        if grace_s is None:
+            grace_s = float(os.environ.get(_ENV_GRACE, "30"))
+        self.grace_s = float(grace_s)
+        self.job_id = str(job_id)
+        self._flag = threading.Event()
+        self._deadline = None       # monotonic seconds; set by the handler
+        self._prev = {}
+        self._installed = False
+
+    # -- signal plumbing ---------------------------------------------------
+    def _on_signal(self, signum, frame):
+        # handler body stays trivial: flag + deadline only. The heavy
+        # coordinated save runs from the training loop at the next step
+        # boundary, never from inside the interrupted frame.
+        if not self._flag.is_set():
+            self._deadline = time.monotonic() + self.grace_s
+            self._flag.set()
+
+    def install(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        """Install the handlers (main thread only — CPython restriction).
+        Returns self for chaining."""
+        for s in signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def trigger(self):
+        """Mark the run preempted as if the signal had arrived (tests,
+        and launchers that learn of preemption out-of-band)."""
+        self._on_signal(signal.SIGTERM, None)
+
+    # -- state -------------------------------------------------------------
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def deadline_remaining(self):
+        """Seconds left in the grace window (None before preemption)."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    # -- coordination ------------------------------------------------------
+    def _base(self):
+        return f"/preempt/{self.job_id}"
+
+    def agree_step(self, current_step, timeout=None):
+        """Store-coordinated choice of the ONE step every host checkpoints
+        at: each host publishes its step; the last arrival of the counting
+        barrier publishes ``max(steps)`` as the agreed target. Bounded by
+        the remaining grace window (monotonic deadline), so a host that
+        died before signaling fails this with TimeoutError instead of
+        hanging the pod past its preemption."""
+        step = int(current_step)
+        if self.store is None or self.world_size <= 1:
+            return step
+        base = self._base()
+        self.store.set(f"{base}/step/{self.rank}", str(step))
+        n = self.store.add(f"{base}/count", 1)
+        epoch = (n - 1) // self.world_size
+        release = f"{base}/release/{epoch}"
+        if n % self.world_size == 0:
+            steps = [step]
+            for r in range(self.world_size):
+                v = self.store.get_nowait(f"{base}/step/{r}")
+                if v is not None:
+                    steps.append(int(v))
+            self.store.set(release, str(max(steps)))
+        if timeout is None:
+            left = self.deadline_remaining()
+            timeout = max(1.0, left) if left is not None else 30.0
+        return int(self.store.wait(release, timeout=timeout))
+
+    def _cleanup_keys(self, timeout=5.0):
+        """Post-save key sweep. Every host bumps a done-counter; rank 0
+        polls it (never a deletable key — a waiter blocked on a key a peer
+        just deleted would hang) and then deletes the handler's namespace
+        so a completed preemption leaks nothing into the next incarnation
+        of the job."""
+        base = self._base()
+        n = self.store.add(f"{base}/done", 1)
+        if self.rank != 0:
+            return
+        deadline = time.monotonic() + timeout
+        while n < self.world_size and time.monotonic() < deadline:
+            time.sleep(0.05)
+            n = self.store.add(f"{base}/done", 0)
+        for k in self.store.keys(base):
+            self.store.delete_key(k)
+
+    # -- the shutdown ------------------------------------------------------
+    def save_and_exit(self, manager, state_dict, step, extra=None,
+                      _exit=None):
+        """Flush + synchronous preemption save + exit(PREEMPT_EXIT_CODE).
+
+        `manager.preempt_save` waits out any in-flight async save first
+        (superseding it — never an abandoned uncommitted staging dir), then
+        commits synchronously. `_exit` is injectable for tests; the default
+        is `sys.exit` so context managers/atexit still run under the
+        launcher's process supervision."""
+        from .train_guard import recovery_counters
+
+        manager.preempt_save(state_dict, int(step), extra=extra)
+        recovery_counters()["preemption_saves"] += 1
+        if self.store is not None and self.world_size > 1:
+            try:
+                self._cleanup_keys()
+            except Exception as e:  # noqa: BLE001 — best effort on the way out
+                print(f"preemption: store cleanup failed: {e}",
+                      file=sys.stderr)
+        (sys.exit if _exit is None else _exit)(PREEMPT_EXIT_CODE)

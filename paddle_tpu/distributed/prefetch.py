@@ -90,6 +90,14 @@ class DevicePrefetcher:
         self.stats["batches"] += 1
         return item
 
+    @property
+    def consumed(self):
+        """Batches handed to the consumer — NOT batches pulled from the
+        source (the worker runs `size` ahead). This is the position a
+        bit-exact data-resume checkpoint must record: feed it to
+        `DataLoader.state_dict(consumed=...)`."""
+        return self.stats["batches"]
+
     def close(self):
         """Stop the worker and join it; safe to call more than once. In-
         flight prefetched batches are dropped."""

@@ -1,0 +1,333 @@
+"""Bad-step rollback + training watchdog: recovery, not just detection.
+
+Two failure classes a long run must ride through without dying:
+
+- **Bad steps** — a poisoned batch or numeric blow-up NaNs the loss or
+  spikes the gradient norm. `TrainGuard` keeps a bounded ring of
+  donation-safe engine snapshots (the engine donates its carried state to
+  XLA every dispatch, so the ring holds device COPIES — engine.snapshot());
+  when the tpu-san non-finite sweep or the windowed-median grad-spike
+  detector fires, the run rewinds to the last good snapshot, the offending
+  batch is quarantined, and the blame (first offending leaf path, batch
+  id) rides a typed `BadStepError` — or, in skip mode, the step is dropped
+  silently and training continues bit-identically to a run that never saw
+  the batch.
+
+- **Wedged dispatches / dead hosts** — a hung collective leaves a pod
+  silently stuck. `TrainWatchdog` stamps per-host step-boundary heartbeats
+  through the coordination store (`/hb/train-<host>`, server-side receipt
+  ages via the existing `store.Watchdog`) and watches the engine's
+  in-flight dispatch marker; a dispatch exceeding `timeout` raises a typed
+  `TrainingStalledError` naming the stalled host to `on_stall` instead of
+  a silent pod-wide hang.
+
+Recovery counters (`train.recoveries`: skipped_steps / rollbacks /
+preemption_saves / stalled_detections) and the `train.last_good_step`
+gauge ride the obs registry — docs/observability.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..analysis import runtime_san as _san
+
+__all__ = [
+    "TrainGuard", "TrainWatchdog", "BadStepError", "TrainingStalledError",
+    "recovery_counters",
+]
+
+
+class BadStepError(RuntimeError):
+    """A training step produced non-finite values or a gradient-norm spike.
+    Carries the forensic fields recovery tooling needs: the bad step
+    number, the blamed leaf/path, the quarantined batch id, and the step
+    the engine was rolled back to."""
+
+    def __init__(self, message, *, step=None, blame=None, batch_id=None,
+                 rolled_back_to=None):
+        super().__init__(message)
+        self.step = step
+        self.blame = blame
+        self.batch_id = batch_id
+        self.rolled_back_to = rolled_back_to
+
+
+class TrainingStalledError(RuntimeError):
+    """A dispatch (or a peer host) exceeded the training watchdog timeout.
+    Names the stalled host so the controller can act on it."""
+
+    def __init__(self, message, *, host=None, phase=None, elapsed=None):
+        super().__init__(message)
+        self.host = host
+        self.phase = phase
+        self.elapsed = elapsed
+
+
+# one shared per-process counter dict: TrainGuard, TrainWatchdog and
+# PreemptionHandler all bump it, and it rides the obs registry under the
+# ONE collector key `train.recoveries` (a plain function, held strongly)
+_COUNTERS = {"skipped_steps": 0, "rollbacks": 0, "preemption_saves": 0,
+             "stalled_detections": 0}
+_counters_registered = False
+
+
+def _collect_recoveries():
+    return dict(_COUNTERS)
+
+
+def recovery_counters():
+    """The process-wide `train.recoveries` counter dict, registered as an
+    obs collector on first use (zero overhead for runs that never touch
+    the fault-tolerance layer)."""
+    global _counters_registered
+    if not _counters_registered:
+        from ..obs.metrics import registry as _registry
+
+        _registry().register_collector("train.recoveries",
+                                       _collect_recoveries)
+        _counters_registered = True
+    return _COUNTERS
+
+
+class TrainGuard:
+    """Snapshot-ring rollback around `engine.train_batch`.
+
+    Every `rollback_every` steps the guard captures a donation-safe
+    snapshot of the engine's carried state (`engine.snapshot()` — device
+    copies; the originals are donated to XLA on the next dispatch). After
+    each step it checks loss/grad-norm finiteness (and catches the
+    tpu-san `NonFiniteError` when the sanitizer is live) plus a windowed
+    grad-norm spike detector (norm > `spike_factor` x rolling median over
+    `window` good steps, armed after `min_history` of them). A bad step
+    restores the most recent snapshot and quarantines the batch.
+
+    on_bad_step:
+      - "skip"  — restore + return None from step(); training continues
+                  as if the batch never existed (bit-identical when the
+                  snapshot is from immediately before the bad step).
+      - "raise" — restore + raise the typed `BadStepError`.
+    """
+
+    def __init__(self, engine, rollback_every=1, ring_size=2, window=16,
+                 spike_factor=8.0, min_history=5, on_bad_step="skip"):
+        if on_bad_step not in ("skip", "raise"):
+            raise ValueError("on_bad_step must be 'skip' or 'raise'")
+        if rollback_every < 1:
+            raise ValueError("rollback_every must be >= 1")
+        self.engine = engine
+        self.rollback_every = int(rollback_every)
+        self.window = int(window)
+        self.spike_factor = float(spike_factor)
+        self.min_history = int(min_history)
+        self.on_bad_step = on_bad_step
+        self._ring = deque(maxlen=max(1, int(ring_size)))
+        self._norms = deque(maxlen=self.window)
+        self.quarantined = []        # (batch_id, blame) forensic log
+        self.last_good_step = engine._step_count
+        self.watchdog = None         # optional TrainWatchdog attachment
+        from ..obs.metrics import registry as _registry
+
+        recovery_counters()
+        self._g_last_good = _registry().gauge(
+            "train.last_good_step",
+            help="newest engine step that passed the TrainGuard checks")
+        self._g_last_good.set(self.last_good_step)
+
+    # -- snapshots ---------------------------------------------------------
+    def _maybe_snapshot(self):
+        eng = self.engine
+        if not self._ring or \
+                eng._step_count - self._ring[-1][0] >= self.rollback_every:
+            self._ring.append((eng._step_count, eng.snapshot()))
+
+    def snapshot_now(self):
+        """Force a ring snapshot at the current step (e.g. right after a
+        checkpoint restore)."""
+        self._ring.append((self.engine._step_count, self.engine.snapshot()))
+
+    # -- the guarded step --------------------------------------------------
+    def step(self, *batch, batch_id=None):
+        """`engine.train_batch(*batch)` under the guard. Returns the loss
+        Tensor for a good step, None for a skipped bad one (skip mode).
+        The finiteness check is a deliberate host sync — the guard is the
+        stability layer, and it reads one scalar per step."""
+        eng = self.engine
+        self._maybe_snapshot()
+        blame = None
+        loss_t = None
+        try:
+            loss_t = eng.train_batch(*batch)
+            with _san.allow_host_sync("train_guard.check"):
+                loss = float(loss_t._value)
+                gnorm = float(eng.last_grad_norm) \
+                    if eng.last_grad_norm is not None else 0.0
+        except _san.NonFiniteError as e:
+            blame = f"non-finite ({e})"
+            gnorm = float("nan")
+        if blame is None:
+            if not np.isfinite(loss):
+                blame = "loss is non-finite"
+            elif not np.isfinite(gnorm):
+                blame = "grad_norm is non-finite"
+            elif len(self._norms) >= self.min_history:
+                med = float(np.median(self._norms))
+                if med > 0 and gnorm > self.spike_factor * med:
+                    blame = (f"grad_norm spike ({gnorm:.3g} > "
+                             f"{self.spike_factor:g} x median {med:.3g})")
+        if blame is not None:
+            return self._bad_step(blame, batch_id)
+        self._norms.append(gnorm)
+        self.last_good_step = eng._step_count
+        self._g_last_good.set(self.last_good_step)
+        if self.watchdog is not None:
+            self.watchdog.beat(self.last_good_step)
+        return loss_t
+
+    def _bad_step(self, blame, batch_id):
+        eng = self.engine
+        bad_step = eng._step_count
+        good_step, snap = self._ring[-1]
+        eng.restore(snap)
+        self.quarantined.append((batch_id, blame))
+        c = recovery_counters()
+        # a snapshot taken immediately before the bad step makes this a
+        # pure skip (no good work rewound); an older one is a rollback
+        rolled = good_step < bad_step - 1
+        c["rollbacks" if rolled else "skipped_steps"] += 1
+        err = BadStepError(
+            f"bad step {bad_step}: {blame} — batch {batch_id!r} "
+            f"quarantined, engine rolled back to step {good_step}",
+            step=bad_step, blame=blame, batch_id=batch_id,
+            rolled_back_to=good_step)
+        if self.on_bad_step == "raise":
+            raise err
+        return None
+
+
+class TrainWatchdog:
+    """Step-boundary heartbeats + wedged-dispatch detection.
+
+    - `beat(step)` stamps `/hb/train-<host>` in the coordination store at
+      each step boundary; the existing `store.Watchdog` then reports any
+      host whose stamp goes stale (`peer_ttl`) — a host wedged inside a
+      dispatch stops beating and is named by its peers.
+    - a background thread watches the engine's in-flight dispatch marker
+      (`engine._inflight`, set around every compiled-step dispatch); a
+      dispatch older than `timeout` raises `TrainingStalledError` into
+      `on_stall` (default: record on `self.stalled` for the training loop
+      to collect via `raise_if_stalled()` at the next step boundary —
+      which a truly wedged dispatch never reaches, hence `on_stall` for
+      processes that must exit and let the elastic relaunch take over).
+    """
+
+    def __init__(self, engine=None, timeout=30.0, interval=None, store=None,
+                 host=None, on_stall=None, peer_ttl=None):
+        self.engine = engine
+        self.timeout = float(timeout)
+        self.interval = float(interval) if interval is not None \
+            else max(0.05, min(1.0, self.timeout / 4))
+        self.store = store
+        if host is None:
+            from .env import get_rank
+            host = f"rank{get_rank()}"
+        self.host = str(host)
+        self.on_stall = on_stall
+        self.peer_ttl = float(peer_ttl) if peer_ttl is not None \
+            else self.timeout
+        self.stalled = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._peer_dog = None
+        if store is not None:
+            from .store import Watchdog
+
+            self._peer_dog = Watchdog(store, ttl=self.peer_ttl,
+                                      interval=self.interval,
+                                      on_failure=self._peers_dead)
+
+    # -- heartbeats --------------------------------------------------------
+    def _hb_key(self):
+        return f"/hb/train-{self.host}"
+
+    def beat(self, step=None):
+        """Stamp this host's step-boundary heartbeat (server-side receipt
+        age is what peers watch — the value is informational)."""
+        if self.store is not None:
+            self.store.set(self._hb_key(), str(-1 if step is None else step))
+
+    # -- detection ---------------------------------------------------------
+    def _peers_dead(self, names):
+        train_peers = [n[len("train-"):] for n in names
+                       if n.startswith("train-") and
+                       n != f"train-{self.host}"]
+        for peer in train_peers:
+            self._stall(TrainingStalledError(
+                f"training host {peer!r} stopped heartbeating "
+                f"(> {self.peer_ttl:g}s since its last step boundary)",
+                host=peer, phase="heartbeat", elapsed=self.peer_ttl))
+
+    def check(self):
+        """One local sweep of the engine's in-flight dispatch marker."""
+        eng = self.engine
+        inflight = getattr(eng, "_inflight", None) if eng is not None \
+            else None
+        if inflight is not None:
+            site, t0 = inflight
+            elapsed = time.monotonic() - t0
+            if elapsed > self.timeout:
+                self._stall(TrainingStalledError(
+                    f"dispatch {site!r} on host {self.host!r} has been "
+                    f"in flight {elapsed:.1f}s (> watchdog timeout "
+                    f"{self.timeout:g}s) — wedged collective or device "
+                    f"hang", host=self.host, phase=site, elapsed=elapsed))
+                return True
+        return False
+
+    def _stall(self, err):
+        if self.stalled is not None:
+            return  # first detection wins; one error per stall
+        recovery_counters()["stalled_detections"] += 1
+        self.stalled = err
+        if self.on_stall is not None:
+            self.on_stall(err)
+
+    def raise_if_stalled(self):
+        """Surface a recorded stall at a step boundary (peer-death case —
+        the local loop is still running)."""
+        if self.stalled is not None:
+            raise self.stalled
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        if self._peer_dog is not None:
+            self._peer_dog.start()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.check()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="paddle-tpu-train-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the threads and retire this host's heartbeat key so a
+        clean shutdown leaks nothing into the store."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._peer_dog is not None:
+            self._peer_dog.stop()
+        if self.store is not None:
+            try:
+                self.store.delete_key(self._hb_key())
+            except Exception:  # tpu-lint: disable=TL007 — teardown path
+                pass

@@ -118,9 +118,13 @@ class ModelCheckpoint(Callback):
     env feeds only the manager; legacy per-epoch saves still require an
     explicit save_dir) so a restarted worker resumes instead of starting
     cold. The restored step
-    is exposed as `self.resumed_step` and `model._resume_step` (weights +
-    optimizer are restored; the fit loop replays the epoch's remaining
-    batches)."""
+    is exposed as `self.resumed_step` and `model._resume_step`; the
+    data-pipeline cursor (epoch, consumed-batch position, shuffle state —
+    saved in the extra sidecar when `fit` hands the callback its loader)
+    comes back as `self.resumed_data` / `model._resume_data`, which the
+    fit loop feeds into `DataLoader.load_state_dict` so the relaunched
+    run consumes the IDENTICAL remaining batch sequence
+    (docs/checkpointing.md, "Self-healing training")."""
 
     def __init__(self, save_freq=1, save_dir=None, every_n_steps=None,
                  keep_last_k=3, auto_resume=False, async_save=False):
@@ -142,7 +146,10 @@ class ModelCheckpoint(Callback):
         self.async_save = bool(async_save)
         self._manager = None
         self._global_step = 0
+        self._cur_epoch = 0
+        self._epoch_step = 0     # CONSUMED batches this epoch (see _data_state)
         self.resumed_step = None
+        self.resumed_data = None
 
     def _mgr(self):
         if self._manager is None:
@@ -166,9 +173,25 @@ class ModelCheckpoint(Callback):
             state["opt"] = opt.state_dict()
         return state
 
+    def _data_state(self):
+        """Data-pipeline resume cursor for the checkpoint sidecar. Counts
+        the batch position the TRAINING LOOP has consumed (`_epoch_step`),
+        not the loader's produced cursor — with `fit(prefetch=)` the
+        device queue runs ahead, and resuming at the produced position
+        would silently drop the queued-but-unseen batches."""
+        loader = self.params.get("loader")
+        if loader is None or not hasattr(loader, "state_dict"):
+            return None
+        state = loader.state_dict(consumed=self._epoch_step)
+        state["epoch"] = self._cur_epoch
+        return state
+
     def _snapshot(self):
-        self._mgr().save(self._state(), step=self._global_step,
-                         extra={"global_step": self._global_step})
+        extra = {"global_step": self._global_step}
+        data = self._data_state()
+        if data is not None:
+            extra["data"] = data
+        self._mgr().save(self._state(), step=self._global_step, extra=extra)
 
     def on_train_begin(self, logs=None):
         self._global_step = 0
@@ -189,8 +212,18 @@ class ModelCheckpoint(Callback):
         self.resumed_step = step
         self.model._resume_step = step
         self._global_step = step  # keep step numbering monotonic
+        # the data-pipeline cursor rides the extra sidecar; the fit loop
+        # feeds it back into the loader for a bit-exact resume
+        extra = self._mgr().last_extra or {}
+        self.resumed_data = extra.get("data")
+        self.model._resume_data = self.resumed_data
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._cur_epoch = epoch
+        self._epoch_step = 0
 
     def on_train_batch_end(self, step, logs=None):
+        self._epoch_step = step + 1
         self._global_step += 1
         if self.every_n_steps and self._ckpt_root and self.model and \
                 self._global_step % int(self.every_n_steps) == 0:

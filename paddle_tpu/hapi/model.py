@@ -178,22 +178,46 @@ class Model:
         steps = len(loader) if hasattr(loader, "__len__") else None
         cblist = CallbackList(cbs, model=self,
                               params={"epochs": epochs, "steps": steps,
-                                      "verbose": verbose})
+                                      "verbose": verbose,
+                                      "loader": loader})
         self.stop_training = False
         cblist.call("on_train_begin", {})
+        # bit-exact data resume: ModelCheckpoint(auto_resume=True) leaves
+        # the snapshotted data cursor on `_resume_data`; feeding it back
+        # into the loader fast-forwards to the exact consumed position of
+        # the interrupted run (same shuffle seed, same remaining batches)
+        resume = getattr(self, "_resume_data", None)
+        start_epoch = start_step = 0
+        if resume is not None and hasattr(loader, "load_state_dict"):
+            self._resume_data = None
+            loader.load_state_dict(resume)
+            start_epoch = int(resume.get("epoch", 0))
+            start_step = int(resume.get("cursor", 0))
+            if steps is not None and start_step >= steps:
+                # checkpoint landed exactly on an epoch boundary — resume
+                # at the top of the next epoch (same restored base_seed)
+                start_epoch, start_step = start_epoch + 1, 0
+                loader.load_state_dict(dict(resume, epoch=start_epoch,
+                                            cursor=0))
         history = cbs[0]
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cblist.call("on_epoch_begin", epoch, {})
             for m in self._metrics:
                 m.reset()
             logs = {}
+            if hasattr(loader, "set_epoch"):
+                # epoch-pure shuffle order: f(base_seed, epoch) — the
+                # anchor that makes mid-epoch resume bit-exact
+                loader.set_epoch(epoch)
             batch_iter = loader
             if prefetch:
                 from ..distributed.prefetch import prefetch_to_device
 
                 batch_iter = prefetch_to_device(iter(loader), size=prefetch)
             try:
-                for step, batch in enumerate(batch_iter):
+                for step, batch in enumerate(
+                        batch_iter,
+                        start=start_step if epoch == start_epoch else 0):
                     cblist.call("on_train_batch_begin", step, {})
                     ins, lbs = self._split_batch(batch)
                     res = self.train_batch(ins, lbs or None)

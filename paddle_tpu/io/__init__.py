@@ -129,12 +129,52 @@ class SequenceSampler(Sampler):
         return len(self.data_source)
 
 
-class RandomSampler(Sampler):
+class _ResumableShuffle:
+    """Shared epoch-seeded RNG plumbing for the shuffling samplers.
+
+    Each sampler draws ONE base seed from the ambient numpy RNG at
+    construction (so default behavior stays random, and `np.random.seed()`
+    before construction still pins it), then derives every epoch's order as
+    a pure function of ``base_seed + epoch``. That property — no sequential
+    RNG dependence across epochs — is what makes `state_dict()` resume
+    bit-exact: a relaunched run that restores ``{base_seed, epoch}`` and
+    re-iterates replays the IDENTICAL index order. Without `set_epoch()`
+    the epoch counter auto-advances per iteration, preserving the classic
+    different-shuffle-every-epoch behavior."""
+
+    def _init_shuffle_state(self):
+        self._base_seed = int(np.random.randint(0, 2**31 - 1))
+        self._epoch = 0
+        self._last_epoch = None
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    def _epoch_rng(self):
+        epoch = self._epoch
+        self._last_epoch = epoch
+        self._epoch = epoch + 1   # auto-advance for set_epoch-less loops
+        return np.random.RandomState((self._base_seed + epoch) % (2**32))
+
+    def state_dict(self):
+        """State replaying the CURRENT (most recently started) epoch's
+        order — load it and re-iterate to get the identical sequence."""
+        epoch = self._epoch if self._last_epoch is None else self._last_epoch
+        return {"base_seed": self._base_seed, "epoch": epoch}
+
+    def load_state_dict(self, state):
+        self._base_seed = int(state["base_seed"])
+        self._epoch = int(state.get("epoch", 0))
+        self._last_epoch = None
+
+
+class RandomSampler(_ResumableShuffle, Sampler):
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self._init_shuffle_state()
 
     @property
     def num_samples(self):
@@ -142,40 +182,42 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = self._epoch_rng()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
 
 
-class SubsetRandomSampler(Sampler):
+class SubsetRandomSampler(_ResumableShuffle, Sampler):
     """Sample the given indices in random order (reference:
     io/sampler.py SubsetRandomSampler)."""
 
     def __init__(self, indices):
         self.indices = list(indices)
+        self._init_shuffle_state()
 
     def __iter__(self):
-        import numpy as _np
-        order = _np.random.permutation(len(self.indices))
+        order = self._epoch_rng().permutation(len(self.indices))
         return iter([self.indices[i] for i in order])
 
     def __len__(self):
         return len(self.indices)
 
 
-class WeightedRandomSampler(Sampler):
+class WeightedRandomSampler(_ResumableShuffle, Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, dtype=np.float64)
         self.num_samples = num_samples
         self.replacement = replacement
+        self._init_shuffle_state()
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        idx = np.random.choice(len(self.weights), self.num_samples,
-                               replace=self.replacement, p=p)
+        idx = self._epoch_rng().choice(len(self.weights), self.num_samples,
+                                       replace=self.replacement, p=p)
         return iter(idx.tolist())
 
     def __len__(self):
@@ -210,6 +252,21 @@ class BatchSampler(Sampler):
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    # -- resume ------------------------------------------------------------
+    def set_epoch(self, epoch):
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
+
+    def state_dict(self):
+        if hasattr(self.sampler, "state_dict"):
+            return {"sampler": self.sampler.state_dict()}
+        return {}
+
+    def load_state_dict(self, state):
+        sub = (state or {}).get("sampler")
+        if sub is not None and hasattr(self.sampler, "load_state_dict"):
+            self.sampler.load_state_dict(sub)
+
 
 class DistributedBatchSampler(BatchSampler):
     """Reference: io/dataloader/batch_sampler.py DistributedBatchSampler —
@@ -233,6 +290,15 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    def state_dict(self):
+        """The shuffle order is already a pure function of the epoch
+        (`np.random.RandomState(self.epoch)` below), so the epoch IS the
+        resumable state."""
+        return {"epoch": int(self.epoch)}
+
+    def load_state_dict(self, state):
+        self.epoch = int((state or {}).get("epoch", 0))
 
     def __iter__(self):
         n = len(self.dataset)
@@ -355,19 +421,40 @@ class PrefetchThread:
 
 
 class _PrefetchIter:
+    """Prefetch wrapper tracking the CONSUMED position: the producer
+    thread runs `depth` batches ahead, so resume state must count batches
+    handed to the consumer, not batches pulled from the source —
+    `state_dict()["consumed"]` is the cursor a checkpoint should record
+    (feed it to `DataLoader.state_dict(consumed=...)`)."""
+
     def __init__(self, gen, depth=2):
         self._impl = PrefetchThread(gen, depth=depth,
                                     name="paddle-tpu-loader-prefetch")
         self._t = self._impl._t
+        self._consumed = 0
 
     def __iter__(self):
         return self
 
     def __next__(self):
         item = self._impl.get()
+        self._consumed += 1
         from ..core import monitor
         monitor.increment("dataloader_batches_total")
         return item
+
+    @property
+    def consumed(self):
+        return self._consumed
+
+    def state_dict(self):
+        return {"consumed": self._consumed}
+
+    def load_state_dict(self, state):
+        """Rebase the consumed counter (a resumed iterator reports its
+        absolute epoch position; the fast-forward itself is the source
+        loader's job — `DataLoader.load_state_dict`)."""
+        self._consumed = int((state or {}).get("consumed", 0))
 
     def close(self):
         self._impl.close()
@@ -426,19 +513,45 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
+        # bit-exact resume state (docs/checkpointing.md "Self-healing
+        # training"): epoch ordinal, batch cursor within the epoch, and a
+        # pending fast-forward count applied at the next __iter__
+        self._epoch = 0
+        self._cursor = 0
+        self._resume_skip = 0
 
     def _gen(self):
+        # index-level fast-forward: the first `_resume_skip` batches are
+        # stepped over WITHOUT touching the dataset (map-style) or
+        # collating (iterable) — resuming epoch e at cursor c costs no
+        # wasted __getitem__ work
+        skip, self._resume_skip = self._resume_skip, 0
+        self._cursor = skip
         if self._iterable_mode:
             it = iter(self.dataset)
             while True:
-                batch = list(itertools.islice(it, self.batch_size))
+                n_items = self.batch_size if not skip \
+                    else self.batch_size * skip
+                batch = list(itertools.islice(it, n_items))
+                if skip:
+                    if len(batch) < n_items:
+                        return
+                    skip = 0
+                    continue
                 if not batch:
                     return
                 if len(batch) < self.batch_size and self.drop_last:
                     return
+                # count BEFORE yielding: a checkpoint taken right after
+                # the consumer received batch k must read cursor == k
+                self._cursor += 1
                 yield self.collate_fn(batch)
         else:
             for idx_batch in self.batch_sampler:
+                if skip:
+                    skip -= 1
+                    continue
+                self._cursor += 1
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def _autotune_num_workers(self):
@@ -479,6 +592,11 @@ class DataLoader:
                 and not getattr(self, "_autotuned", False)):
             self._autotuned = True
             self.num_workers = self._autotune_num_workers()
+        if self._resume_skip:
+            # resumed epoch: the index-level fast-forward lives in _gen();
+            # run this ONE epoch in-process (correctness over throughput —
+            # the next epoch re-enters the worker pool path)
+            return self._gen()
         if self.num_workers and self.num_workers > 0:
             from .worker import MultiprocessIter
             if self.persistent_workers and not self._iterable_mode:
@@ -497,6 +615,51 @@ class DataLoader:
         if self.batch_sampler is None:
             raise TypeError("len() undefined for IterableDataset loader")
         return len(self.batch_sampler)
+
+    # -- bit-exact resume ----------------------------------------------------
+    def set_epoch(self, epoch):
+        """Pin the shuffle epoch (delegates to the sampler stack). Call
+        once per epoch — e.g. `Model.fit` does — so every epoch's order is
+        a pure function of the epoch number, independent of how often the
+        loader was iterated before (the property checkpoint resume relies
+        on)."""
+        self._epoch = int(epoch)
+        bs = self.batch_sampler
+        if bs is not None and hasattr(bs, "set_epoch"):
+            bs.set_epoch(epoch)
+
+    def state_dict(self, consumed=None):
+        """Resume cursor: ``{epoch, cursor, sampler}``. `cursor` counts
+        batches this loader has PRODUCED in the current epoch; pass
+        `consumed=` to override it with a consumer-side count — required
+        when the loader feeds a prefetch queue (`prefetch_to_device` /
+        `_PrefetchIter`), where produced runs ahead of consumed and
+        resuming at the produced position would skip the queued-but-unseen
+        batches."""
+        state = {"epoch": self._epoch,
+                 "cursor": self._cursor if consumed is None
+                 else int(consumed)}
+        bs = self.batch_sampler
+        if bs is not None and hasattr(bs, "state_dict"):
+            state["sampler"] = bs.state_dict()
+        return state
+
+    def load_state_dict(self, state):
+        """Arm the loader to resume: the next `__iter__` replays the
+        snapshotted epoch's order (sampler state) and fast-forwards
+        `cursor` batches at the index level — the relaunched run consumes
+        the IDENTICAL remaining batch sequence, no duplicated or skipped
+        batch."""
+        state = state or {}
+        self._epoch = int(state.get("epoch", 0))
+        cur = int(state.get("cursor", 0))
+        self._cursor = cur
+        self._resume_skip = cur
+        bs = self.batch_sampler
+        sampler_state = state.get("sampler")
+        if bs is not None and sampler_state is not None and \
+                hasattr(bs, "load_state_dict"):
+            bs.load_state_dict(sampler_state)
 
 
 from .worker import get_worker_info, WorkerInfo, WorkerException  # noqa: F401,E402

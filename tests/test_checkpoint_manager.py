@@ -386,3 +386,65 @@ def test_commit_generation_absent_on_legacy_commits(tmp_path):
     assert commit_generation(path) is None
     with open(os.path.join(path, COMMITTED_SENTINEL)) as f:
         assert "generation" not in json.load(f)
+
+
+def test_preempt_save_flushes_inflight_async_save(tmp_path, monkeypatch):
+    """Regression: SIGTERM arriving while an async save is in flight must
+    WAIT that save out (supersede, never abandon an uncommitted staging
+    dir) and then run its own save synchronously."""
+    import threading
+    import time as _time
+
+    real = manager_mod.save_state_dict
+    release = threading.Event()
+
+    def slow(tensors, path, **kw):
+        write = real(tensors, path, **kw)
+
+        def delayed():
+            release.wait(10)
+            return write()
+        return delayed
+
+    monkeypatch.setattr(manager_mod, "save_state_dict", slow)
+    mgr = CheckpointManager(tmp_path, async_save=True)
+    mgr.save(_state(0), step=0)          # async, parked on the event
+    t = threading.Thread(
+        target=lambda: (_time.sleep(0.1), release.set()), daemon=True)
+    t.start()
+    mgr.preempt_save(_state(1), step=1)  # must join step 0 first
+    t.join()
+    assert mgr.async_save is True        # mode restored after the preempt
+    assert mgr.all_steps() == [0, 1]     # BOTH landed committed
+    assert not [e for e in os.listdir(tmp_path) if ".tmp." in e]
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 1
+
+
+def test_preempt_save_supersedes_failed_async_save(tmp_path, monkeypatch,
+                                                   capsys):
+    """A pending async save that FAILS must not abort the preemption
+    checkpoint: the failure is demoted to a stderr note and the grace-
+    window save still commits."""
+    real = manager_mod.save_state_dict
+    fail_once = {"armed": True}
+
+    def flaky(tensors, path, **kw):
+        write = real(tensors, path, **kw)
+
+        def w():
+            if fail_once["armed"]:
+                fail_once["armed"] = False
+                raise OSError("disk went away")
+            return write()
+        return w
+
+    monkeypatch.setattr(manager_mod, "save_state_dict", flaky)
+    mgr = CheckpointManager(tmp_path, async_save=True, max_retries=0)
+    mgr.save(_state(0), step=0)          # the async write dies
+    mgr.preempt_save(_state(1), step=1)
+    assert "superseding" in capsys.readouterr().err
+    assert mgr.all_steps() == [1]
+    assert not [e for e in os.listdir(tmp_path) if ".tmp." in e]
+    tgt = _zeros_state()
+    assert mgr.restore_latest(tgt) == 1

@@ -1,0 +1,264 @@
+"""Bit-exact data-pipeline resume: sampler/loader `state_dict` round
+trips under shuffle, prefetch consumed-position cursors, and hapi
+auto-resume batch-sequence identity — a relaunched run must consume the
+IDENTICAL remaining batch sequence, no duplicated or skipped batch
+(docs/checkpointing.md, "Self-healing training")."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, DistributedBatchSampler, RandomSampler,
+    TensorDataset,
+)
+
+
+def _dataset(n=24, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.arange(n, dtype=np.float32).reshape(n, 1)  # row id rides y
+    return TensorDataset([x, y])
+
+
+def _ids(batches):
+    """Row-id fingerprint of a batch sequence (the y column)."""
+    return [tuple(int(v) for v in np.asarray(b[1]).ravel())
+            for b in batches]
+
+
+class TestResumableSamplers:
+    def test_epoch_purity_same_epoch_same_order(self):
+        ds = _dataset()
+        s = RandomSampler(ds)
+        s.set_epoch(2)
+        a = list(s)
+        s.set_epoch(2)
+        b = list(s)
+        assert a == b
+        s.set_epoch(3)
+        assert list(s) != a  # different epoch, different order
+
+    def test_auto_advance_without_set_epoch(self):
+        ds = _dataset()
+        s = RandomSampler(ds)
+        a, b = list(s), list(s)
+        assert a != b  # epochs advance on their own
+        t = RandomSampler(ds)
+        t.load_state_dict(s.state_dict())
+        # the restored sampler replays s's LAST epoch
+        assert list(t) == b
+
+    def test_state_dict_round_trip_to_fresh_sampler(self):
+        ds = _dataset()
+        np.random.seed(101)
+        s = RandomSampler(ds)
+        s.set_epoch(5)
+        order = list(s)
+        np.random.seed(999)  # fresh process draws a different base seed
+        t = RandomSampler(ds)
+        t.load_state_dict(s.state_dict())
+        assert list(t) == order
+
+    def test_batch_sampler_delegates(self):
+        ds = _dataset()
+        bs = BatchSampler(dataset=ds, shuffle=True, batch_size=4)
+        bs.set_epoch(1)
+        order = list(bs)
+        fresh = BatchSampler(dataset=ds, shuffle=True, batch_size=4)
+        fresh.load_state_dict(bs.state_dict())
+        assert list(fresh) == order
+
+    def test_distributed_batch_sampler_round_trip(self):
+        ds = _dataset()
+        bs = DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
+                                     rank=0, shuffle=True)
+        bs.set_epoch(3)
+        order = list(bs)
+        fresh = DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
+                                        rank=0, shuffle=True)
+        fresh.load_state_dict(bs.state_dict())
+        assert list(fresh) == order
+        assert bs.state_dict()["epoch"] == 3
+
+
+class TestLoaderResume:
+    def test_mid_epoch_resume_yields_identical_remainder(self):
+        np.random.seed(11)
+        ref = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        ref.set_epoch(1)
+        full = _ids(ref)
+
+        np.random.seed(11)
+        run1 = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        run1.set_epoch(1)
+        it = iter(run1)
+        for _ in range(2):
+            next(it)
+        state = run1.state_dict()
+        assert state["cursor"] == 2
+
+        np.random.seed(77)  # relaunched process: different ambient RNG
+        run2 = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        run2.load_state_dict(state)
+        run2.set_epoch(1)
+        assert _ids(run2) == full[2:]
+
+    def test_resume_consumes_each_sample_exactly_once(self):
+        np.random.seed(5)
+        run1 = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        run1.set_epoch(0)
+        it = iter(run1)
+        seen = _ids([next(it), next(it), next(it)])
+        state = run1.state_dict()
+        np.random.seed(123)
+        run2 = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        run2.load_state_dict(state)
+        run2.set_epoch(0)
+        rest = _ids(run2)
+        flat = [i for b in seen + rest for i in b]
+        assert sorted(flat) == list(range(24))  # a perfect partition
+
+    def test_next_epoch_after_resume_runs_fresh(self):
+        np.random.seed(9)
+        ld = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        ld.load_state_dict({"epoch": 0, "cursor": 3,
+                            "sampler": ld.state_dict()["sampler"]})
+        ld.set_epoch(0)
+        assert len(list(ld)) == 3   # fast-forwarded remainder
+        ld.set_epoch(1)
+        assert len(list(ld)) == 6   # the next epoch is complete again
+
+
+class TestPrefetchCursor:
+    def test_prefetch_iter_counts_consumed_not_produced(self):
+        from paddle_tpu.io import _PrefetchIter
+
+        it = _PrefetchIter(iter(range(10)), depth=4)
+        try:
+            for _ in range(3):
+                next(it)
+            # the producer thread ran ahead, but the resume cursor is
+            # the CONSUMED count
+            assert it.consumed == 3
+            assert it.state_dict() == {"consumed": 3}
+            it.load_state_dict({"consumed": 7})
+            assert it.consumed == 7
+        finally:
+            it.close()
+
+    def test_device_prefetcher_consumed_drives_loader_cursor(self):
+        from paddle_tpu.distributed.prefetch import prefetch_to_device
+
+        np.random.seed(21)
+        run1 = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        run1.set_epoch(0)
+        pf = prefetch_to_device(iter(run1), size=3)
+        seen = _ids([next(pf), next(pf)])
+        assert pf.consumed == 2
+        # checkpoint at the CONSUMED position, not the produced one
+        state = run1.state_dict(consumed=pf.consumed)
+        assert state["cursor"] == 2
+        pf.close()
+
+        np.random.seed(900)
+        run2 = DataLoader(_dataset(), batch_size=4, shuffle=True)
+        run2.load_state_dict(state)
+        run2.set_epoch(0)
+        rest = _ids(run2)
+        flat = [i for b in seen + rest for i in b]
+        assert sorted(flat) == list(range(24))
+
+
+class TestHapiAutoResume:
+    def _model(self):
+        paddle.seed(13)
+        net = paddle.nn.Linear(4, 1)
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters()),
+                  paddle.nn.MSELoss())
+        return m
+
+    def _loader(self):
+        np.random.seed(31)
+        ds = _dataset(seed=8)
+        return DataLoader(ds, batch_size=4, shuffle=True)
+
+    def test_kill_and_relaunch_is_bit_identical(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import Callback, ModelCheckpoint
+
+        ref = self._model()
+        ref.fit(self._loader(), epochs=2, verbose=0)
+        ref_w = ref.network.state_dict()["weight"].numpy().copy()
+
+        class Kill(Exception):
+            pass
+
+        class KillAt(Callback):
+            def __init__(self, n):
+                super().__init__()
+                self.left = n
+
+            def on_train_batch_end(self, step, logs=None):
+                self.left -= 1
+                if self.left <= 0:
+                    raise Kill()
+
+        root = str(tmp_path)
+        m1 = self._model()
+        ck1 = ModelCheckpoint(save_dir=root, every_n_steps=3,
+                              auto_resume=True)
+        with pytest.raises(Kill):
+            # dies mid-epoch-1, after the step-9 checkpoint
+            m1.fit(self._loader(), epochs=2, verbose=0,
+                   callbacks=[ck1, KillAt(10)])
+
+        m2 = self._model()
+        ck2 = ModelCheckpoint(save_dir=root, every_n_steps=3,
+                              auto_resume=True)
+        m2.fit(self._loader(), epochs=2, verbose=0, callbacks=[ck2])
+        assert ck2.resumed_step == 9
+        assert ck2.resumed_data is not None
+        assert ck2.resumed_data["epoch"] == 1
+        assert ck2.resumed_data["cursor"] == 3  # 9 global = epoch1 step 3
+        w2 = m2.network.state_dict()["weight"].numpy()
+        assert np.array_equal(ref_w, w2)
+
+    def test_epoch_boundary_checkpoint_rolls_to_next_epoch(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import Callback, ModelCheckpoint
+
+        ref = self._model()
+        ref.fit(self._loader(), epochs=2, verbose=0)
+        ref_w = ref.network.state_dict()["weight"].numpy().copy()
+
+        class Kill(Exception):
+            pass
+
+        class KillAt(Callback):
+            def __init__(self, n):
+                super().__init__()
+                self.left = n
+
+            def on_train_batch_end(self, step, logs=None):
+                self.left -= 1
+                if self.left <= 0:
+                    raise Kill()
+
+        root = str(tmp_path)
+        m1 = self._model()
+        # 24 samples / batch 4 = 6 steps per epoch: the step-6 checkpoint
+        # lands exactly on the epoch-0/1 boundary
+        ck1 = ModelCheckpoint(save_dir=root, every_n_steps=6,
+                              auto_resume=True)
+        with pytest.raises(Kill):
+            m1.fit(self._loader(), epochs=2, verbose=0,
+                   callbacks=[ck1, KillAt(8)])
+
+        m2 = self._model()
+        ck2 = ModelCheckpoint(save_dir=root, every_n_steps=6,
+                              auto_resume=True)
+        m2.fit(self._loader(), epochs=2, verbose=0, callbacks=[ck2])
+        assert ck2.resumed_step == 6
+        assert ck2.resumed_data["cursor"] == 6  # == steps/epoch -> rollover
+        w2 = m2.network.state_dict()["weight"].numpy()
+        assert np.array_equal(ref_w, w2)

@@ -104,6 +104,29 @@ def test_launch_elastic_relaunches(tmp_path):
         == ["ok0", "ok1"]
 
 
+def test_launch_clean_preempt_does_not_burn_retry_budget(tmp_path):
+    """Workers exiting PREEMPT_EXIT_CODE (checkpointed inside the grace
+    window) are relaunched WITHOUT spending an elastic retry: two
+    consecutive preemptions converge even with --max_restarts 1, and the
+    relaunch log names the clean preemption instead of a failure."""
+    from paddle_tpu.distributed.preemption import PREEMPT_EXIT_CODE
+
+    r = _run_launch(f"""
+        import os, sys
+        epoch = int(os.environ["PADDLE_RESTART_EPOCH"])
+        rank = os.environ["PADDLE_TPU_PROCESS_ID"]
+        if epoch < 2:
+            sys.exit({PREEMPT_EXIT_CODE})  # clean preemption, twice
+        open(r"{tmp_path}" + f"/done{{rank}}", "w").write(str(epoch))
+    """, tmp_path, "--elastic", "--max_restarts", "1", procs=2, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "clean preemption" in r.stderr
+    assert "without spending a retry" in r.stderr
+    assert "failed" not in r.stderr
+    assert sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("done")) == ["done0", "done1"]
+
+
 def test_launch_log_dir(tmp_path):
     logs = tmp_path / "logs"
     r = _run_launch("""

@@ -1,0 +1,24 @@
+"""Tier-1 registration of the self-healing-training fault-injection
+harness (tools/train_fault_injector.py): a deterministic engine training
+job is driven through SIGTERM preemption, SIGKILL, a poisoned NaN batch,
+and a wedged dispatch — and every faulted run must converge to the SAME
+bit-exact loss trajectory and final parameters as the uninterrupted
+reference, leaving zero uncommitted checkpoint dirs and zero leaked
+store keys. Running it in the suite makes self-healing regressions
+(preemption saves, bad-step rollback, watchdog, data-pipeline resume)
+fail CI."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARNESS = os.path.join(REPO, "tools", "train_fault_injector.py")
+
+
+def test_every_fault_converges_bit_exact_to_reference():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_SAN="1")
+    r = subprocess.run([sys.executable, HARNESS], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    assert "RESULT: PASS" in r.stdout

@@ -1,0 +1,287 @@
+"""TrainGuard / TrainWatchdog / PreemptionHandler units: bad-step skip
+and rollback semantics, typed blame errors, wedged-dispatch and dead-peer
+detection, the preemption step-agreement barrier, and the recovery
+counters/gauge riding the obs registry. The end-to-end bit-exactness of
+the whole stack is proven by tools/train_fault_injector.py (registered
+via test_train_fault_injection.py); these are the cheap per-contract
+units."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.engine import parallelize
+from paddle_tpu.distributed.preemption import (
+    PREEMPT_EXIT_CODE, PreemptionHandler, is_clean_preempt,
+)
+from paddle_tpu.distributed.store import create_master_store, TCPStore
+from paddle_tpu.distributed.train_guard import (
+    BadStepError, TrainGuard, TrainingStalledError, TrainWatchdog,
+    recovery_counters,
+)
+
+
+def _batch(i, scale=1.0):
+    rng = np.random.RandomState(1000 + i)
+    return (scale * rng.randn(8, 4).astype(np.float32),
+            rng.randn(8, 2).astype(np.float32))
+
+
+def _poisoned(i):
+    x, y = _batch(i)
+    x[0, 0] = np.nan
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def engine():
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sgd = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    return parallelize(net, sgd, loss_fn=loss_fn)
+
+
+class TestTrainGuard:
+    def test_good_steps_pass_through_and_stamp_gauge(self, engine):
+        guard = TrainGuard(engine)
+        before = dict(recovery_counters())
+        for i in range(3):
+            assert guard.step(*_batch(i), batch_id=i) is not None
+        assert guard.last_good_step == engine._step_count
+        assert guard.quarantined == []
+        after = recovery_counters()
+        assert after["skipped_steps"] == before["skipped_steps"]
+        from paddle_tpu.obs.metrics import registry
+
+        snap = registry().snapshot()
+        assert snap["metrics"]["train.last_good_step"][0]["value"] == \
+            engine._step_count
+        assert "train.recoveries" in snap["collectors"]
+
+    def test_nan_batch_is_skipped_bit_exactly(self, engine):
+        guard = TrainGuard(engine, on_bad_step="skip")
+        guard.step(*_batch(0))
+        want = {n: np.asarray(v) for n, v in engine.param_vals.items()}
+        step_before = engine._step_count
+        before = recovery_counters()["skipped_steps"]
+        assert guard.step(*_poisoned(1), batch_id="bad-1") is None
+        assert engine._step_count == step_before
+        for n, v in want.items():
+            assert np.array_equal(v, np.asarray(engine.param_vals[n])), n
+        assert recovery_counters()["skipped_steps"] == before + 1
+        assert guard.quarantined[-1][0] == "bad-1"
+        assert "non-finite" in guard.quarantined[-1][1] or \
+            "loss is non-finite" in guard.quarantined[-1][1]
+
+    def test_raise_mode_carries_typed_blame(self, engine):
+        guard = TrainGuard(engine, on_bad_step="raise")
+        guard.step(*_batch(0))
+        good = engine._step_count
+        with pytest.raises(BadStepError) as ei:
+            guard.step(*_poisoned(2), batch_id="bad-2")
+        assert ei.value.step == good + 1   # the step that was executed
+        assert ei.value.batch_id == "bad-2"
+        assert ei.value.rolled_back_to == good
+        assert engine._step_count == good
+
+    def test_stale_snapshot_counts_as_rollback(self, engine):
+        # rollback_every=4: the ring snapshot is 3 steps stale when the
+        # bad step hits (a bad step at the refresh boundary would grab a
+        # fresh snapshot and degrade to a pure skip), so good work is
+        # rewound -> "rollbacks", and the engine rewinds to the snapshot
+        guard = TrainGuard(engine, rollback_every=4, on_bad_step="raise")
+        guard.step(*_batch(0))          # snapshot taken here
+        snap_step = guard._ring[-1][0]
+        guard.step(*_batch(1))
+        guard.step(*_batch(2))
+        before = recovery_counters()["rollbacks"]
+        with pytest.raises(BadStepError) as ei:
+            guard.step(*_poisoned(3), batch_id="bad-3")
+        assert recovery_counters()["rollbacks"] == before + 1
+        assert ei.value.rolled_back_to == snap_step
+        assert engine._step_count == snap_step
+
+    def test_grad_spike_detector_blames_spike(self, engine):
+        guard = TrainGuard(engine, min_history=3, on_bad_step="raise")
+        for i in range(3):
+            guard.step(*_batch(i))
+        guard.spike_factor = 1e-9  # arm: any finite norm now "spikes"
+        with pytest.raises(BadStepError) as ei:
+            guard.step(*_batch(4), batch_id="spike")
+        assert "spike" in str(ei.value)
+
+    def test_validates_config(self, engine):
+        with pytest.raises(ValueError):
+            TrainGuard(engine, on_bad_step="explode")
+        with pytest.raises(ValueError):
+            TrainGuard(engine, rollback_every=0)
+
+
+class _FakeEngine:
+    def __init__(self):
+        self._inflight = None
+
+
+class TestTrainWatchdog:
+    def test_wedged_dispatch_detected_once(self):
+        eng = _FakeEngine()
+        hits = []
+        wd = TrainWatchdog(eng, timeout=0.2, host="h0",
+                           on_stall=hits.append)
+        assert wd.check() is False          # nothing in flight
+        eng._inflight = ("engine.dispatch", time.monotonic())
+        assert wd.check() is False          # young dispatch
+        eng._inflight = ("engine.dispatch", time.monotonic() - 5.0)
+        before = recovery_counters()["stalled_detections"]
+        assert wd.check() is True
+        assert wd.check() is True           # still wedged, but counted once
+        assert recovery_counters()["stalled_detections"] == before + 1
+        assert len(hits) == 1
+        err = hits[0]
+        assert isinstance(err, TrainingStalledError)
+        assert err.host == "h0" and err.phase == "engine.dispatch"
+        with pytest.raises(TrainingStalledError):
+            wd.raise_if_stalled()
+
+    def test_background_thread_detects_and_stops_clean(self):
+        eng = _FakeEngine()
+        eng._inflight = ("engine.dispatch", time.monotonic() - 5.0)
+        wd = TrainWatchdog(eng, timeout=0.2, interval=0.05, host="h1")
+        wd.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while wd.stalled is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert wd.stalled is not None
+        finally:
+            wd.stop()
+
+    def test_dead_peer_named_and_heartbeats_retired(self):
+        store = create_master_store(port=0)
+        try:
+            a = TrainWatchdog(timeout=0.3, interval=0.05, store=store,
+                              host="hostA")
+            b = TrainWatchdog(timeout=0.3, interval=0.05, store=store,
+                              host="hostB")
+            a.beat(1)
+            b.beat(1)
+            a._peer_dog.start()
+            try:
+                # only A keeps beating; B goes silent and must be blamed
+                deadline = time.monotonic() + 3.0
+                while a.stalled is None and time.monotonic() < deadline:
+                    a.beat(2)
+                    time.sleep(0.05)
+                assert a.stalled is not None
+                assert a.stalled.host == "hostB"
+                assert a.stalled.phase == "heartbeat"
+            finally:
+                a.stop()
+                b.stop()
+            assert store.keys("/hb/") == []  # clean stop leaks nothing
+        finally:
+            store.close()
+
+
+class TestPreemption:
+    def test_exit_code_contract(self):
+        assert is_clean_preempt(PREEMPT_EXIT_CODE)
+        assert not is_clean_preempt(0)
+        assert not is_clean_preempt(1)
+        assert not is_clean_preempt(-9)
+
+    def test_trigger_and_grace_deadline(self):
+        h = PreemptionHandler(grace_s=30)
+        assert not h.preempted()
+        h.trigger()
+        assert h.preempted()
+        assert 0 < h.deadline_remaining() <= 30
+
+    def test_grace_from_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PREEMPT_GRACE_S", "7.5")
+        assert PreemptionHandler().grace_s == 7.5
+
+    def test_signal_handler_install_uninstall(self):
+        import signal as _sig
+
+        h = PreemptionHandler(grace_s=5)
+        h.install()
+        try:
+            os.kill(os.getpid(), _sig.SIGTERM)
+            deadline = time.monotonic() + 2.0
+            while not h.preempted() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert h.preempted()
+        finally:
+            h.uninstall()
+
+    def test_agree_step_single_process_passthrough(self):
+        assert PreemptionHandler().agree_step(41) == 41
+
+    def test_agree_step_converges_on_max_across_ranks(self):
+        store = create_master_store(port=0, world_size=3)
+        try:
+            steps = {0: 5, 1: 7, 2: 6}
+            agreed = {}
+
+            def rank(r):
+                peer = TCPStore("127.0.0.1", store.port)
+                try:
+                    h = PreemptionHandler(store=peer, rank=r, world_size=3,
+                                          grace_s=20, job_id="t")
+                    h.trigger()
+                    agreed[r] = h.agree_step(steps[r])
+                finally:
+                    peer.close()
+
+            ts = [threading.Thread(target=rank, args=(r,)) for r in steps]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert agreed == {0: 7, 1: 7, 2: 7}
+            # every host checkpoints the SAME (max) step, and the barrier
+            # keys are garbage-collected afterwards
+            def cleanup(r):
+                h = PreemptionHandler(store=TCPStore("127.0.0.1",
+                                                     store.port),
+                                      rank=r, world_size=3, job_id="t")
+                h._cleanup_keys(timeout=10)
+
+            ts = [threading.Thread(target=cleanup, args=(r,))
+                  for r in steps]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert store.keys("/preempt/") == []
+        finally:
+            store.close()
+
+    def test_save_and_exit_commits_and_exits_preempt_code(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
+        codes = []
+        before = recovery_counters()["preemption_saves"]
+        h = PreemptionHandler(grace_s=30)
+        h.trigger()
+        state = {"model": {"w": paddle.to_tensor(
+            np.arange(6, dtype=np.float32))}, "step": 3}
+        h.save_and_exit(mgr, state, step=3, _exit=codes.append)
+        assert codes == [PREEMPT_EXIT_CODE]
+        assert recovery_counters()["preemption_saves"] == before + 1
+        tgt = {"model": {"w": paddle.to_tensor(
+            np.zeros(6, np.float32))}, "step": -1}
+        assert mgr.restore_latest(tgt) == 3
+        assert np.array_equal(tgt["model"]["w"].numpy(),
+                              np.arange(6, dtype=np.float32))

@@ -1,0 +1,394 @@
+"""Fault-injection harness for the self-healing training stack.
+
+Each phase runs a small deterministic engine training job (fixed seeds:
+model init, shuffle order, batch payloads) to completion — through a
+different injected fault — and the parent then proves the self-healing
+invariant: every phase's per-step loss trajectory and final parameters
+are BIT-IDENTICAL to the uninterrupted reference run, with zero
+uncommitted checkpoint directories and zero leaked store keys left
+behind.
+
+Phases (tentpole legs, docs/checkpointing.md "Self-healing training"):
+
+  none    — uninterrupted reference run.
+  sigterm — the parent delivers a real SIGTERM mid-run; the child's
+            `PreemptionHandler` finishes the in-flight step, saves a
+            synchronous checkpoint inside the grace window (flushing the
+            pending async save first) and exits `PREEMPT_EXIT_CODE`; the
+            relaunched child auto-resumes bit-exactly.
+  kill9   — the child SIGKILLs itself mid-run (no grace, no handler);
+            the relaunch resumes from the last COMMITTED checkpoint and
+            replays the overlap — replayed steps must reproduce the
+            first incarnation's losses bit-for-bit.
+  nan     — a poisoned (NaN) extra batch is injected; `TrainGuard` (with
+            the tpu-san non-finite sweep live) skips it, quarantines the
+            batch, and the run converges as if the batch never existed.
+  wedge   — a dispatch wedges (never completes); `TrainWatchdog` detects
+            the stall, names the host, and exits; the relaunch resumes.
+
+Run as a script (exits nonzero on any violation — registered as a tier-1
+test via tests/test_train_fault_injection.py):
+
+    python tools/train_fault_injector.py [--phases none,sigterm,...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PHASES = ("sigterm", "kill9", "nan", "wedge")
+KILL_EXIT = (-signal.SIGKILL, 137)  # Popen reports -9; shells report 137
+WEDGE_EXIT = 86                     # child's on_stall exit code
+TOTAL_STEPS = 12                    # 2 epochs x 6 steps
+SIGTERM_AFTER = 5                   # parent preempts once this many steps ran
+
+# One deterministic training job, parameterized by the fault phase. All
+# randomness is pinned (paddle.seed for init, np.random.seed for data +
+# the sampler's shuffle base seed), so every phase must reproduce the
+# reference trajectory bit-for-bit. PYTHONPATH carries the repo.
+_CHILD = r'''
+import json, os, signal, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_TPU_SAN", "1")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.analysis import runtime_san as san
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.engine import parallelize
+from paddle_tpu.distributed.preemption import PreemptionHandler
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.train_guard import (
+    TrainGuard, TrainWatchdog, recovery_counters,
+)
+from paddle_tpu.io import DataLoader, TensorDataset
+
+root, phase, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
+EPOCHS, SPE, CKPT_EVERY = 2, 6, 4
+KILL_AT, NAN_AT, WEDGE_AT, WEDGE_EXIT = 7, 5, 9, 86
+
+marker = os.path.join(root, "incarnation")
+inc = int(open(marker).read()) + 1 if os.path.exists(marker) else 0
+open(marker, "w").write(str(inc))
+losses_path = os.path.join(root, "losses.jsonl")
+log_f = open(losses_path, "a", buffering=1)
+
+paddle.seed(7)
+net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+sgd = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                   parameters=net.parameters())
+
+def loss_fn(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+eng = parallelize(net, sgd, loss_fn=loss_fn)
+guard = TrainGuard(eng, rollback_every=1, on_bad_step="skip")
+
+np.random.seed(4242)  # pins the data AND the sampler's shuffle base seed
+data_x = np.random.randn(SPE * 4, 8).astype(np.float32)
+data_y = np.random.randn(SPE * 4, 1).astype(np.float32)
+loader = DataLoader(TensorDataset([data_x, data_y]), batch_size=4,
+                    shuffle=True)
+
+store = TCPStore("127.0.0.1", port)
+
+def on_stall(err):
+    with open(os.path.join(root, "stall.json"), "w") as f:
+        json.dump({"host": err.host, "phase": err.phase,
+                   "elapsed": err.elapsed,
+                   "counters": dict(recovery_counters())}, f)
+    os._exit(WEDGE_EXIT)
+
+wd = TrainWatchdog(eng, timeout=8.0, store=store, host=phase,
+                   on_stall=on_stall)
+guard.watchdog = wd
+pre = PreemptionHandler(rank=0, world_size=1, grace_s=30, job_id=phase)
+pre.install()
+
+mgr = CheckpointManager(os.path.join(root, "ckpt"), keep_last_k=3,
+                        async_save=True)
+
+def data_state(epoch, gstep):
+    st = loader.state_dict(consumed=gstep - epoch * SPE)
+    st["epoch"] = epoch
+    return st
+
+tmpl = eng.state_dict()
+resumed = mgr.restore_latest(tmpl, strict=False)
+gstep = 0
+if resumed is not None:
+    eng.load_state_dict(tmpl)
+    guard.last_good_step = eng._step_count
+    d = (mgr.last_extra or {}).get("data") or {}
+    if int(d.get("cursor", 0)) >= SPE:
+        # checkpoint landed exactly on the epoch boundary: resume at the
+        # top of the next epoch, not SPE batches into it
+        d = dict(d, epoch=int(d.get("epoch", 0)) + 1, cursor=0)
+    loader.load_state_dict(d)
+    gstep = int(resumed)
+
+poison_done = gstep > NAN_AT  # replays past NAN_AT re-inject (determinism)
+for epoch in range(gstep // SPE, EPOCHS):
+    loader.set_epoch(epoch)
+    for bx, by in loader:
+        if phase == "nan" and gstep == NAN_AT and not poison_done:
+            px = np.asarray(bx.numpy() if hasattr(bx, "numpy") else bx,
+                            dtype=np.float32).copy()
+            px[0, 0] = np.nan
+            out = guard.step(px, by, batch_id=f"poison-{NAN_AT}")
+            assert out is None, "poisoned batch must be skipped"
+            poison_done = True
+        loss = guard.step(bx, by, batch_id=gstep)
+        gstep += 1
+        log_f.write(json.dumps({"inc": inc, "gstep": gstep,
+                                "loss": repr(float(loss._value))}) + "\n")
+        wd.beat(gstep)
+        if gstep == 1:
+            wd.start()  # arm after the first (compile-heavy) dispatch
+        if gstep % CKPT_EVERY == 0:
+            mgr.save(eng.state_dict(), step=gstep,
+                     extra={"data": data_state(epoch, gstep)})
+        if pre.preempted():
+            def dump_exit(code):
+                with open(os.path.join(root, "preempt.json"), "w") as f:
+                    json.dump({"gstep": gstep,
+                               "counters": dict(recovery_counters())}, f)
+                os._exit(code)
+            pre.save_and_exit(mgr, eng.state_dict(), step=gstep,
+                              extra={"data": data_state(epoch, gstep)},
+                              _exit=dump_exit)
+        if phase == "kill9" and inc == 0 and gstep == KILL_AT:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if phase == "wedge" and inc == 0 and gstep == WEDGE_AT:
+            # simulate a wedged collective: an in-flight dispatch marker
+            # that never clears — the watchdog must detect and exit
+            eng._inflight = ("engine.dispatch", time.monotonic())
+            time.sleep(600)
+
+mgr.wait()
+params = {n: np.asarray(v) for n, v in sorted(eng.param_vals.items())}
+h = __import__("hashlib").sha256()
+for n, v in params.items():
+    h.update(n.encode())
+    h.update(np.ascontiguousarray(v).tobytes())
+report = {"params_sha256": h.hexdigest(), "gstep": gstep, "inc": inc,
+          "counters": dict(recovery_counters()),
+          "quarantined": [[str(b), why] for b, why in guard.quarantined],
+          "san_findings": [f.to_dict() for f in san.registry().findings()]}
+with open(os.path.join(root, "final.json"), "w") as f:
+    json.dump(report, f)
+wd.stop()
+pre.uninstall()
+store.close()
+sys.exit(0)
+'''
+
+
+def spawn_child(phase, root, port):
+    child = os.path.join(root, "child.py")
+    if not os.path.exists(child):
+        with open(child, "w") as f:
+            f.write(_CHILD)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_SAN="1")
+    return subprocess.Popen(
+        [sys.executable, child, root, phase, str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _wait_for_lines(path, n, timeout=240.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                if sum(1 for _ in f) >= n:
+                    return True
+        except FileNotFoundError:
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def drive_phase(phase, workdir, store):
+    """Run one phase to convergence (spawning relaunches as the launcher
+    would) and return (violations, trajectory, final_report)."""
+    root = os.path.join(workdir, phase)
+    os.makedirs(root, exist_ok=True)
+    from paddle_tpu.distributed.preemption import is_clean_preempt
+
+    expect_mid = {"sigterm": lambda rc: is_clean_preempt(rc),
+                  "kill9": lambda rc: rc in KILL_EXIT,
+                  "wedge": lambda rc: rc == WEDGE_EXIT}
+    bad = []
+    rcs = []
+    for inc in range(3):  # fault incarnation(s) + the clean finisher
+        proc = spawn_child(phase, root, store.port)
+        if phase == "sigterm" and inc == 0:
+            if not _wait_for_lines(os.path.join(root, "losses.jsonl"),
+                                   SIGTERM_AFTER):
+                proc.kill()
+                return [f"[{phase}] child produced no steps to preempt"], \
+                    {}, {}
+            proc.send_signal(signal.SIGTERM)
+        try:
+            _, stderr = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return [f"[{phase}] incarnation {inc} hung"], {}, {}
+        rcs.append(proc.returncode)
+        if proc.returncode == 0:
+            break
+        if phase == "none" or inc > 0 or \
+                not expect_mid[phase](proc.returncode):
+            return [f"[{phase}] incarnation {inc} exited "
+                    f"{proc.returncode} (rcs={rcs}): {stderr[-2000:]}"], \
+                {}, {}
+    else:
+        return [f"[{phase}] never converged (rcs={rcs})"], {}, {}
+
+    # expected incarnation count: faults need exactly one relaunch
+    want_incs = 1 if phase in ("none", "nan") else 2
+    if len(rcs) != want_incs:
+        bad.append(f"[{phase}] took {len(rcs)} incarnations "
+                   f"(rcs={rcs}), wanted {want_incs}")
+
+    # per-step trajectory: replayed steps must agree bit-for-bit
+    traj = {}
+    with open(os.path.join(root, "losses.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            g, lo = rec["gstep"], rec["loss"]
+            if g in traj and traj[g] != lo:
+                bad.append(f"[{phase}] replayed step {g} diverged: "
+                           f"{traj[g]} vs {lo}")
+            traj[g] = lo
+    if sorted(traj) != list(range(1, TOTAL_STEPS + 1)):
+        bad.append(f"[{phase}] incomplete trajectory: {sorted(traj)}")
+
+    with open(os.path.join(root, "final.json")) as f:
+        final = json.load(f)
+
+    # zero uncommitted checkpoint dirs after convergence
+    from paddle_tpu.distributed.checkpoint import is_committed
+
+    ckpt_root = os.path.join(root, "ckpt")
+    for e in sorted(os.listdir(ckpt_root)):
+        p = os.path.join(ckpt_root, e)
+        if ".tmp." in e or (os.path.isdir(p) and not is_committed(p)):
+            bad.append(f"[{phase}] uncommitted checkpoint left: {e}")
+
+    # zero leaked store keys (heartbeats retired, no preempt litter)
+    for prefix in ("/hb/", "/preempt/"):
+        leaked = store.keys(prefix)
+        leaked = [k for k in leaked if phase in k]
+        if leaked:
+            bad.append(f"[{phase}] leaked store keys: {leaked}")
+
+    # phase-specific recovery evidence
+    c = final.get("counters", {})
+    if phase == "sigterm":
+        with open(os.path.join(root, "preempt.json")) as f:
+            pdump = json.load(f)
+        if pdump["counters"].get("preemption_saves") != 1:
+            bad.append(f"[{phase}] preemption_saves != 1: {pdump}")
+    if phase == "nan":
+        if c.get("skipped_steps") != 1:
+            bad.append(f"[{phase}] skipped_steps != 1: {c}")
+        if not any("poison" in q[0] for q in final.get("quarantined", [])):
+            bad.append(f"[{phase}] poisoned batch not quarantined: "
+                       f"{final.get('quarantined')}")
+        finite = [x for x in final.get("san_findings", [])
+                  if "finite" in x.get("detector", "")]
+        if len(finite) != 1:
+            bad.append(f"[{phase}] expected exactly the poisoned-batch "
+                       f"non-finite finding, got {finite}")
+    else:
+        if final.get("san_findings"):
+            bad.append(f"[{phase}] unexpected sanitizer findings: "
+                       f"{final['san_findings']}")
+    if phase == "wedge":
+        with open(os.path.join(root, "stall.json")) as f:
+            stall = json.load(f)
+        if stall.get("host") != phase or \
+                stall.get("phase") != "engine.dispatch":
+            bad.append(f"[{phase}] stall blame wrong: {stall}")
+        if stall["counters"].get("stalled_detections") != 1:
+            bad.append(f"[{phase}] stalled_detections != 1: {stall}")
+    return bad, traj, final
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phases", default=",".join(("none",) + PHASES),
+                    help="comma-separated fault phases (default: the "
+                         "no-fault reference + all faults)")
+    args = ap.parse_args(argv)
+    phases = [p.strip() for p in args.phases.split(",")]
+    if "none" not in phases:
+        phases.insert(0, "none")  # every comparison needs the reference
+
+    from paddle_tpu.analysis.locks import new_lock
+    from paddle_tpu.distributed.store import create_master_store
+
+    violations = []
+    results = {}
+    with tempfile.TemporaryDirectory(prefix="train-fault-") as workdir:
+        store = create_master_store(port=0)
+        print("training fault injection (self-healing invariant):")
+        lock = new_lock("tools.train_fault_injector.results")
+
+        def run(phase):
+            out = drive_phase(phase, workdir, store)
+            with lock:
+                results[phase] = out
+                print(f"  {phase:<8} -> "
+                      + ("FAIL" if out[0] else "ok"))
+
+        threads = [threading.Thread(target=run, args=(p,), daemon=True)
+                   for p in phases]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        store.close()
+
+    ref_bad, ref_traj, ref_final = results["none"]
+    violations += ref_bad
+    for phase in phases:
+        if phase == "none":
+            continue
+        bad, traj, final = results[phase]
+        violations += bad
+        if bad or ref_bad:
+            continue
+        if traj != ref_traj:
+            diff = [g for g in sorted(set(ref_traj) | set(traj))
+                    if ref_traj.get(g) != traj.get(g)][:4]
+            violations.append(
+                f"[{phase}] loss trajectory differs from the reference "
+                f"at steps {diff}")
+        if final.get("params_sha256") != ref_final.get("params_sha256"):
+            violations.append(
+                f"[{phase}] final params differ from the reference "
+                f"({final.get('params_sha256')} vs "
+                f"{ref_final.get('params_sha256')})")
+    for v in violations:
+        print("VIOLATION:", v, file=sys.stderr)
+    print("RESULT:", "FAIL" if violations else "PASS")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
