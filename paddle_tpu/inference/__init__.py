@@ -25,6 +25,9 @@ __all__ = [
     "BatchConfig", "DynamicBatcher",
     # continuous-batching LLM decode engine (decode/)
     "DecodeEngine", "SequenceStream", "BlockKVCache", "OutOfBlocks",
+    # multi-tenant decode: batched LoRA adapters + per-request sampling
+    "AdapterPool", "OutOfAdapterSlots", "AdapterNotLoaded",
+    "SamplingParams",
     # distributed serving tier (replica.py + router.py)
     "ServingRouter", "RouterConfig", "RouterStream", "SwapFailed",
     "commit_model_dir",
@@ -273,10 +276,12 @@ class PredictorPool:
 from .batching import BatchConfig, DynamicBatcher  # noqa: E402
 from .serving import (  # noqa: E402
     ServingPool, ServingError, DeadlineExceeded, Overloaded, PoolClosed,
-    RequestFailed, CircuitBreaker, RetryPolicy, Deadline,
+    RequestFailed, CircuitBreaker, RetryPolicy, Deadline, AdapterNotLoaded,
 )
+from .sampling import SamplingParams  # noqa: E402
 from .decode import (  # noqa: E402
-    BlockKVCache, DecodeEngine, OutOfBlocks, SequenceStream,
+    AdapterPool, BlockKVCache, DecodeEngine, OutOfAdapterSlots,
+    OutOfBlocks, SequenceStream,
 )
 from .replica import (  # noqa: E402
     LocalHeartbeats, LocalReplica, ReplicaDead, ReplicaError,

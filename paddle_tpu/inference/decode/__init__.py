@@ -10,7 +10,9 @@ read-through-the-block-table attention kernel.
 """
 from __future__ import annotations
 
+from .adapter_pool import AdapterPool, OutOfAdapterSlots
 from .block_pool import BlockKVCache, OutOfBlocks
 from .engine import DecodeEngine, SequenceStream
 
-__all__ = ["BlockKVCache", "OutOfBlocks", "DecodeEngine", "SequenceStream"]
+__all__ = ["AdapterPool", "OutOfAdapterSlots", "BlockKVCache",
+           "OutOfBlocks", "DecodeEngine", "SequenceStream"]

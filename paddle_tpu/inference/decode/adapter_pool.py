@@ -1,0 +1,402 @@
+"""paddle_tpu.inference.decode.adapter_pool — paged LoRA adapter serving.
+
+Multi-tenant decode (S-LoRA / Punica): thousands of fine-tunes share ONE
+resident base model, so the per-tenant state — low-rank A/B adapter
+weights — is paged exactly like KV blocks.  `AdapterPool` keeps one
+device-resident SLOT-STACKED tensor pair per target projection,
+
+    A_stack: [slots, in_features, rank]
+    B_stack: [slots, rank, out_features]      (pre-scaled by alpha/rank)
+
+with slot 0 RESERVED all-zero ("no adapter": a padded or adapter-less
+sequence rides slot 0 and the engine's hook selects the base output back
+bitwise).  Per-sequence slot ids ride the decode batch as values, and
+`ops/pallas/bgmv.lora_delta` gathers each sequence's slots inside the
+one compiled dispatch — an arbitrary tenant mix never retraces.
+
+Host-side the pool is the refcounted block-pool idiom transplanted:
+
+* `acquire(name, owner)` pins the adapter's slot for a sequence and
+  returns ``(slot, generation)`` — the generation-stamped signature the
+  engine's prefix cache keys by (KV computed under one adapter version
+  must never be reused under another).
+* `load()` on a NAME whose slot is still referenced writes the new
+  weights into a FRESH slot and repoints the name — in-flight sequences
+  keep their pinned (now anonymous) slot untouched, the generation-
+  purity rule the router's weight hot-swap machinery established.
+* Unreferenced named slots are LRU-evicted under pressure; refcount
+  misuse (releasing a reference that was never taken, unloading a
+  referenced adapter) is LOUD — ``ValueError`` — exactly like
+  `BlockKVCache`.
+
+`AdapterNotLoaded` (a ``ValueError``) is the typed admission error: the
+serving tier fails the request fast with no failover and no health
+penalty.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ...analysis import locks as _locks
+from ..serving import AdapterNotLoaded
+
+__all__ = ["AdapterPool", "AdapterNotLoaded", "OutOfAdapterSlots",
+           "adapter_context", "current_context", "DEFAULT_TARGETS"]
+
+#: slot ids below this are never handed out (slot 0 = no-adapter lane)
+RESERVED_SLOTS = 1
+
+#: attention projections — the S-LoRA default target set for `gpt`
+DEFAULT_TARGETS = ("qkv_proj", "out_proj")
+
+
+class OutOfAdapterSlots(RuntimeError):
+    """`load()` found no free slot and nothing evictable: every slot is
+    pinned by live sequences. Admission-level callers should treat this
+    as backpressure (retry after traffic drains), not a request error."""
+
+
+# ---------------------------------------------------------------------------
+# traced adapter context (set by the engine around each model call)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+class _AdapterContext:
+    __slots__ = ("stacks", "ids")
+
+    def __init__(self, stacks, ids):
+        self.stacks = stacks      # {target name: (A_stack, B_stack)}
+        self.ids = ids            # traced i32 scalar or [batch] slot ids
+
+
+class adapter_context:
+    """Context manager the engine enters while TRACING a step: the layer
+    post-hooks read the traced stacks/ids from here, so the adapter
+    gather is embedded into the compiled executable without touching the
+    model's parameter tree (names, checkpoints and `swap_weights` stay
+    byte-compatible)."""
+
+    def __init__(self, stacks, ids):
+        self._ctx = _AdapterContext(stacks, ids)
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "active", None)
+        _tls.active = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tls.active = self._prev
+        return False
+
+
+def current_context():
+    return getattr(_tls, "active", None)
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class AdapterPool:
+    """Slot-stacked LoRA adapter registry for one base model.
+
+    Args:
+        model: the base model (`gpt(...)`); matching sublayers get a
+            forward post-hook that adds the gathered adapter delta.
+        rank: LoRA rank (every adapter in the pool shares it — the slot
+            stack is one tensor, S-LoRA's unified memory rule).
+        slots: total device slots INCLUDING reserved slot 0.
+        targets: leaf-name fragments selecting the projections adapters
+            apply to (the `apply_lora` matching idiom).
+        alpha: default LoRA alpha when `load()` does not override it
+            (scaling = alpha / rank is folded into B at load time).
+    """
+
+    def __init__(self, model, *, rank, slots=8, targets=DEFAULT_TARGETS,
+                 alpha=None, name=None):
+        import jax.numpy as jnp
+
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        if slots < RESERVED_SLOTS + 1:
+            raise ValueError(
+                f"slots must be > {RESERVED_SLOTS} (slot 0 is the "
+                f"reserved no-adapter lane), got {slots}")
+        self.rank = int(rank)
+        self.slots = int(slots)
+        self.targets = tuple(targets)
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self.name = name
+        self._lock = _locks.new_lock("decode.adapter_pool")
+
+        #: matched sublayers: full name -> (in_features, out_features)
+        self._layers = {}
+        self._hooks = []
+        for lname, sub in model.named_sublayers():
+            leaf = lname.split(".")[-1]
+            if not any(t in leaf for t in self.targets):
+                continue
+            in_f = getattr(sub, "in_features", None)
+            out_f = getattr(sub, "out_features", None)
+            if not isinstance(in_f, int) or not isinstance(out_f, int):
+                continue  # not a projection (e.g. a container hit)
+            self._layers[lname] = (in_f, out_f)
+            self._hooks.append(
+                sub.register_forward_post_hook(self._make_hook(lname)))
+        if not self._layers:
+            raise ValueError(
+                f"no sublayer matched targets {self.targets!r} — nothing "
+                "for adapters to apply to")
+
+        #: device stacks: full layer name -> (A [S,in,r], B [S,r,out]);
+        #: replaced wholesale on load (values, never signatures)
+        self._stacks = {
+            lname: (jnp.zeros((self.slots, in_f, self.rank), jnp.float32),
+                    jnp.zeros((self.slots, self.rank, out_f), jnp.float32))
+            for lname, (in_f, out_f) in self._layers.items()}
+
+        self._by_name = {}                  # adapter name -> slot
+        self._info = {}                     # slot -> bookkeeping dict
+        self._free = list(range(self.slots - 1, RESERVED_SLOTS - 1, -1))
+        self._tick = 0                      # LRU clock
+        self._generation = 0                # monotonic load stamp
+        self.hits = 0
+        self.misses = 0
+        self.loads = 0
+        self.evictions = 0
+        self.swaps = 0
+        self.releases = 0
+
+    # -- hook ---------------------------------------------------------------
+
+    def _make_hook(self, key):
+        def hook(layer, inputs, outputs):
+            ctx = current_context()
+            if ctx is None:
+                return None               # adapter-free call: untouched
+            ab = ctx.stacks.get(key)
+            if ab is None:
+                return None
+            import jax.numpy as jnp
+
+            from ...core.tensor import Tensor
+            from ...ops.pallas.bgmv import lora_delta
+
+            x = inputs[0]
+            y = outputs
+            yv = y._value if isinstance(y, Tensor) else y
+            xv = x._value if isinstance(x, Tensor) else x
+            ids = jnp.asarray(ctx.ids, jnp.int32)
+            delta = lora_delta(xv, ab[0], ab[1], ids)
+            mask = ids == 0
+            if ids.ndim:
+                mask = mask[:, None, None]
+            # slot-0 rows select the base output BITWISE: an adapter-less
+            # sequence in a mixed batch is the base model, exactly
+            new = jnp.where(mask, yv, yv + delta.astype(yv.dtype))
+            return Tensor(new) if isinstance(y, Tensor) else new
+        return hook
+
+    def detach(self):
+        """Remove the forward hooks (engine shutdown)."""
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+    # -- dispatch surface ---------------------------------------------------
+
+    def stacks(self):
+        """Current device stacks (fetched by the engine per dispatch so
+        hot-loads ride the next step without recompiling)."""
+        with self._lock:
+            return dict(self._stacks)
+
+    def stack_avals(self):
+        import jax
+
+        with self._lock:
+            return {k: tuple(jax.ShapeDtypeStruct(t.shape, t.dtype)
+                             for t in ab)
+                    for k, ab in self._stacks.items()}
+
+    def geometry(self):
+        """Hashable shape signature for the engine fingerprint."""
+        return (self.rank, self.slots,
+                tuple(sorted((k, v) for k, v in self._layers.items())))
+
+    # -- load / evict / swap ------------------------------------------------
+
+    def load(self, name, weights, alpha=None):
+        """Load (or hot-reload) adapter `name` from `weights`:
+        ``{layer name: (A [in, rank], B [rank, out])}`` covering every
+        matched target layer. Returns the slot it landed in."""
+        import jax.numpy as jnp
+
+        scale = (float(alpha) if alpha is not None else self.alpha) \
+            / self.rank
+        missing = set(self._layers) - set(weights)
+        if missing:
+            raise ValueError(
+                f"adapter {name!r} is missing weights for matched "
+                f"layers {sorted(missing)}")
+        staged = {}
+        for lname, (in_f, out_f) in self._layers.items():
+            a, b = weights[lname]
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            if a.shape != (in_f, self.rank) \
+                    or b.shape != (self.rank, out_f):
+                raise ValueError(
+                    f"adapter {name!r} layer {lname!r}: expected A "
+                    f"{(in_f, self.rank)} / B {(self.rank, out_f)}, got "
+                    f"{a.shape} / {b.shape}")
+            staged[lname] = (a, b * scale)
+
+        with self._lock:
+            old = self._by_name.get(name)
+            if old is not None and not self._info[old]["refs"]:
+                slot = old                 # idle: reload in place
+            else:
+                slot = self._take_slot_locked()
+                if old is not None:
+                    # referenced: generation-stamped swap — the old slot
+                    # stays pinned (anonymous) until its holders finish
+                    self._info[old]["name"] = None
+                    self.swaps += 1
+            self._generation += 1
+            self._tick += 1
+            self._by_name[name] = slot
+            self._info[slot] = {"name": name, "refs": {},
+                                "generation": self._generation,
+                                "stamp": self._tick}
+            new_stacks = {}
+            for lname, ab in self._stacks.items():
+                a, b = staged[lname]
+                new_stacks[lname] = (ab[0].at[slot].set(jnp.asarray(a)),
+                                     ab[1].at[slot].set(jnp.asarray(b)))
+            self._stacks = new_stacks
+            self.loads += 1
+            return slot
+
+    def _take_slot_locked(self):
+        if self._free:
+            return self._free.pop()
+        # LRU-evict the least recently used NAMED, UNREFERENCED slot
+        victims = [s for s, info in self._info.items()
+                   if info["name"] is not None and not info["refs"]]
+        if not victims:
+            raise OutOfAdapterSlots(
+                f"all {self.slots - RESERVED_SLOTS} adapter slots are "
+                "pinned by live sequences — retry after traffic drains")
+        victim = min(victims, key=lambda s: self._info[s]["stamp"])
+        del self._by_name[self._info[victim]["name"]]
+        del self._info[victim]
+        self.evictions += 1
+        return victim
+
+    def unload(self, name):
+        """Explicitly evict an idle adapter. LOUD on a referenced one."""
+        with self._lock:
+            slot = self._by_name.get(name)
+            if slot is None:
+                raise AdapterNotLoaded(f"adapter {name!r} is not loaded")
+            refs = self._info[slot]["refs"]
+            if refs:
+                raise ValueError(
+                    f"adapter {name!r} (slot {slot}) is referenced by "
+                    f"{sorted(refs)} — release before unloading")
+            del self._by_name[name]
+            del self._info[slot]
+            self._free.append(slot)
+            self.evictions += 1
+
+    # -- refcounts ----------------------------------------------------------
+
+    def acquire(self, name, owner):
+        """Pin `name`'s slot for `owner`; returns (slot, generation) —
+        the adapter signature the prefix cache keys by."""
+        with self._lock:
+            slot = self._by_name.get(name)
+            if slot is None:
+                self.misses += 1
+                raise AdapterNotLoaded(
+                    f"adapter {name!r} is not loaded (load() it, then "
+                    "resubmit)")
+            info = self._info[slot]
+            info["refs"][owner] = info["refs"].get(owner, 0) + 1
+            self._tick += 1
+            info["stamp"] = self._tick
+            self.hits += 1
+            return slot, info["generation"]
+
+    def release(self, slot, owner):
+        """Drop one of `owner`'s references on `slot`. LOUD misuse: a
+        reference that was never taken raises."""
+        with self._lock:
+            info = self._info.get(slot)
+            if info is None or owner not in info["refs"]:
+                raise ValueError(
+                    f"owner {owner!r} holds no reference on adapter slot "
+                    f"{slot}")
+            self._release_one_locked(slot, info, owner, all_refs=False)
+
+    def release_owned(self, owner):
+        """Drop every reference `owner` holds (sequence teardown — safe
+        on every fault path, idempotent like `free_owned`)."""
+        n = 0
+        with self._lock:
+            for slot, info in list(self._info.items()):
+                if owner in info["refs"]:
+                    n += info["refs"][owner]
+                    self._release_one_locked(slot, info, owner,
+                                             all_refs=True)
+        return n
+
+    def _release_one_locked(self, slot, info, owner, *, all_refs):
+        if all_refs or info["refs"][owner] <= 1:
+            del info["refs"][owner]
+        else:
+            info["refs"][owner] -= 1
+        self.releases += 1
+        if info["name"] is None and not info["refs"]:
+            # anonymous (swapped-out) slot lost its last holder
+            del self._info[slot]
+            self._free.append(slot)
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            per = {}
+            for nm, slot in self._by_name.items():
+                info = self._info[slot]
+                per[nm] = {"slot": slot,
+                           "generation": info["generation"],
+                           "refs": sum(info["refs"].values()),
+                           "holders": len(info["refs"]),
+                           "stamp": info["stamp"]}
+            usable = self.slots - RESERVED_SLOTS
+            used = usable - len(self._free)
+            return {
+                "slots": usable,
+                "used": used,
+                "loaded": len(self._by_name),
+                "pinned_anonymous": used - len(self._by_name),
+                "occupancy": used / usable if usable else 0.0,
+                "refs": sum(sum(i["refs"].values())
+                            for i in self._info.values()),
+                "hits": self.hits, "misses": self.misses,
+                "loads": self.loads, "evictions": self.evictions,
+                "swaps": self.swaps, "releases": self.releases,
+                "rank": self.rank, "targets": len(self._layers),
+                "adapters": per,
+            }
+
+    def __repr__(self):
+        s = self.stats()
+        return (f"AdapterPool(rank={self.rank}, slots={s['slots']}, "
+                f"loaded={s['loaded']}, refs={s['refs']})")

@@ -39,6 +39,12 @@ step carry an all-zeros block table, so their (garbage) KV writes land in
 block 0 and can never corrupt a live sequence — the allocator simply
 never hands block 0 out.
 
+The same paging idiom serves LoRA adapters: `adapter_pool.AdapterPool`
+pages per-tenant A/B weights through slot-stacked device tensors with
+the identical refcount/reserved-slot-0/LRU-eviction contract (slots
+instead of blocks, `release_owned` instead of `free_owned`), so
+multi-tenant decode shares one allocator mental model end to end.
+
 Invariant (asserted by the decode fault-injection harness):
 ``allocated + free + reserved == total`` at all times (a block is
 "allocated" while it has >= 1 reference, however many holders share it),

@@ -116,9 +116,9 @@ from ...analysis import locks as _locks
 from ...analysis import graphcheck as _gc
 from ...analysis import runtime_san as _san
 from ...obs import trace as _otrace
-from ..serving import (Deadline, DeadlineExceeded, Overloaded, PoolClosed,
-                       RequestFailed, RetryPolicy, ServingPool,
-                       _NullPredictor)
+from ..serving import (AdapterNotLoaded, Deadline, DeadlineExceeded,
+                       Overloaded, PoolClosed, RequestFailed, RetryPolicy,
+                       ServingPool, _NullPredictor)
 from .block_pool import BlockKVCache, OutOfBlocks, RESERVED_BLOCKS
 
 __all__ = ["DecodeEngine", "SequenceStream"]
@@ -245,7 +245,8 @@ class _Seq:
                  "prefill_pos", "matched_tokens", "last_token", "generated",
                  "cancelled", "submitted_at", "span", "draft_blocks",
                  "draft_pos", "draft_outstanding", "spec_proposed",
-                 "spec_accepted")
+                 "spec_accepted", "sampling", "adapter", "adapter_slot",
+                 "adapter_sig", "sample_base", "out_tokens", "held")
 
     def __init__(self, sid, prompt, max_new, deadline):
         self.id = sid
@@ -271,6 +272,15 @@ class _Seq:
         self.draft_outstanding = 0     # draft fresh allocations to come
         self.spec_proposed = 0         # draft tokens proposed for this seq
         self.spec_accepted = 0         # proposals the target agreed with
+        # multi-tenant / sampled decode
+        self.sampling = None           # SamplingParams or None (greedy)
+        self.adapter = None            # adapter name or None (base model)
+        self.adapter_slot = 0          # slot 0 = reserved no-adapter lane
+        self.adapter_sig = (0, 0)      # (slot, generation) cache signature
+        self.sample_base = 0           # committed tokens before this run
+        self.out_tokens = []           # every committed token (incl. held)
+        self.held = []                 # committed, not yet streamed (stop
+        #                                hold-back: a possible stop prefix)
 
 
 #: registry collector keys need a distinct name per engine instance
@@ -292,7 +302,7 @@ class DecodeEngine:
                  mesh=None, sharding_rules=None, clock=time.monotonic,
                  prefix_cache=True, prefix_cache_blocks=None,
                  prefill_chunk=None, draft_model=None, speculate_k=0,
-                 draft_num_blocks=None):
+                 draft_num_blocks=None, adapters=None):
         from ...distributed.functional import functionalize
         from ...core.tensor import Tensor
 
@@ -448,12 +458,37 @@ class DecodeEngine:
         self._prefill_tail = math.ceil(self.prefill_buckets[-1]
                                        / self.block_size)
 
+        # multi-tenant LoRA serving (S-LoRA/Punica): an AdapterPool over
+        # THIS model adds the per-sequence gathered adapter delta through
+        # layer post-hooks; the engine threads the slot stacks + per-
+        # sequence slot ids through every target dispatch as VALUES, so
+        # any tenant mix shares the one compiled executable per bucket
+        self._adapters = adapters
+        if adapters is not None:
+            from .adapter_pool import AdapterPool
+
+            if not isinstance(adapters, AdapterPool):
+                raise ValueError(
+                    f"adapters must be an AdapterPool, got "
+                    f"{type(adapters).__name__}")
+
         # functional decode step (the generation.py idiom: swap values
-        # into the live layers, trace the python forward once)
-        def wrapped(tokens, cache_vals, pos):
+        # into the live layers, trace the python forward once). `ats`
+        # (adapter stacks) / `aid` (slot ids) enter through the traced
+        # adapter context so the pool's post-hooks see them; an empty
+        # stacks dict (no adapter pool, or the spec verify path) traces
+        # the bare base model — static emptiness, never a retrace.
+        def wrapped(tokens, cache_vals, pos, ats, aid):
+            from .adapter_pool import adapter_context
+
             cts = [tuple(Tensor(a) for a in entry) for entry in cache_vals]
-            logits, new_caches = model.decode_step(Tensor(tokens), cts,
-                                                   Tensor(pos))
+            if ats:
+                with adapter_context(ats, aid):
+                    logits, new_caches = model.decode_step(
+                        Tensor(tokens), cts, Tensor(pos))
+            else:
+                logits, new_caches = model.decode_step(Tensor(tokens), cts,
+                                                       Tensor(pos))
             return (logits._value,
                     [tuple(t._value for t in nc) for nc in new_caches])
 
@@ -563,6 +598,8 @@ class DecodeEngine:
         self._prefix_tokens_reused = 0
         self._prefix_evictions = 0
         self._cow_copies = 0
+        self._sampled = 0         # admissions with sampling params
+        self._stop_hits = 0       # sequences completed by a stop sequence
         # speculative decoding counters (guarded by _lock like the other
         # dispatch-side counters)
         self._spec_rounds = 0
@@ -620,9 +657,14 @@ class DecodeEngine:
         for n in sorted(self._buffers):
             b = self._buffers[n]
             h.update(f"{n}:{tuple(b.shape)}:{b.dtype}".encode())
-        h.update(f"paged-scan-greedy-v2:{self.pool.quant}:"
-                 f"{self.block_size}:{self._nb}:{self._prefill_tail}"
-                 .encode())
+        h.update(f"paged-scan-mt-v3:{self.pool.quant}:"
+                 f"{self.block_size}:{self._nb}:{self._prefill_tail}:"
+                 f"{self.max_length}".encode())
+        if self._adapters is not None:
+            # the adapter stacks are step-executable INPUTS: their
+            # geometry (rank/slots/target layers) is part of the
+            # program's identity exactly like the weight avals above
+            h.update(f"adapters:{self._adapters.geometry()}".encode())
         if self.mesh is not None:
             # a TP engine compiles different programs — its disk-cache
             # entries must never collide with the single-device ones
@@ -653,7 +695,7 @@ class DecodeEngine:
 
     # -- admission ---------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens, timeout=None, *,
-               resume_committed=None):
+               resume_committed=None, sampling=None, adapter=None):
         """Admit one generation request; returns its `SequenceStream`.
 
         Validation errors (malformed *request*: bad dtype/rank, empty or
@@ -664,14 +706,32 @@ class DecodeEngine:
         (`timeout` seconds, None -> `default_timeout`, both None ->
         unbounded) covers queue wait AND the whole generation.
 
+        `sampling` (a `SamplingParams`, or its `to_dict()` wire form)
+        turns on per-request in-graph sampling; `None` is the greedy
+        path, bit-identical at every bucket to the engine before
+        sampling existed. `adapter` names a LoRA adapter in the engine's
+        `AdapterPool`; an unknown name raises the typed
+        `AdapterNotLoaded` (a deterministic request error — the serving
+        tier fails fast, no failover, no health penalty). Both ride the
+        batch as per-sequence VALUES, so arbitrary mixes share the
+        compiled executables — zero post-warmup retraces.
+
         `resume_committed` is the mid-stream failover admission path
         (docs/serving.md): tokens already committed to the client by a
         prior attempt on another replica become a prompt extension, so
         this sequence decodes the CONTINUATION — greedy decode over the
         absolute-chunk-boundary prefill makes the resumed output
         bit-identical to the uninterrupted run, and the prefix cache
-        makes the re-prefill cheap. The stream yields only the new
-        tokens (the caller owns stitching)."""
+        makes the re-prefill cheap. Sampled sequences resume
+        bit-identically too: the per-token RNG key is a counter folded
+        into the request seed, and the counter restarts at the committed
+        length. The stream yields only the new tokens (the caller owns
+        stitching)."""
+        from ..sampling import SamplingParams
+
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            sampling = SamplingParams.from_dict(dict(sampling))
         ids = np.asarray(prompt_ids)
         committed = 0
         if resume_committed is not None and len(resume_committed):
@@ -742,6 +802,22 @@ class DecodeEngine:
                     f"— request shed; retry with backoff")
             self._ids += 1
             seq = _Seq(self._ids, ids.astype(np.int32), max_new, dl)
+            seq.sampling = sampling
+            seq.sample_base = committed
+            if adapter is not None:
+                if self._adapters is None:
+                    raise AdapterNotLoaded(
+                        f"adapter {adapter!r} requested but this engine "
+                        f"has no adapter pool (pass adapters= to "
+                        f"DecodeEngine)")
+                # pin the adapter's slot for this sequence's lifetime: a
+                # hot-reload of the same NAME lands in a fresh slot and
+                # this sequence keeps decoding under the weights it was
+                # admitted with (generation purity)
+                slot, gen = self._adapters.acquire(adapter, owner=seq.id)
+                seq.adapter = adapter
+                seq.adapter_slot = slot
+                seq.adapter_sig = (slot, gen)
             seq.submitted_at = self._clock()
             # per-sequence root span: lives across scheduler rounds
             # (detached from any thread stack), closed by _finish with
@@ -754,20 +830,26 @@ class DecodeEngine:
                            "prompt_len": int(ids.shape[0]),
                            "max_new": max_new,
                            **({"resumed_from": committed}
-                              if committed else {})})
+                              if committed else {}),
+                           **({"adapter": adapter} if adapter else {}),
+                           **({"sampled": True}
+                              if sampling is not None else {})})
             seq.stream._cancel = lambda s=seq: self._request_cancel(s)
             self._waiting.append(seq)
             self._admitted += 1
+            if sampling is not None:
+                self._sampled += 1
             if committed:
                 self._resumed += 1
             self._cv.notify()
         return seq.stream
 
-    def generate(self, prompt_ids, max_new_tokens, timeout=None):
+    def generate(self, prompt_ids, max_new_tokens, timeout=None, *,
+                 sampling=None, adapter=None):
         """Synchronous convenience: submit + drain; returns the generated
         token list or raises the typed serving error."""
-        return self.submit(prompt_ids, max_new_tokens,
-                           timeout=timeout).result()
+        return self.submit(prompt_ids, max_new_tokens, timeout=timeout,
+                           sampling=sampling, adapter=adapter).result()
 
     def _request_cancel(self, seq):
         with self._cv:
@@ -844,6 +926,19 @@ class DecodeEngine:
             out.append(tuple(entry))
         return out
 
+    def _adapter_avals(self):
+        """Abstract values of the adapter slot stacks riding every
+        target dispatch ({} without an adapter pool — static emptiness,
+        one signature either way)."""
+        return self._adapters.stack_avals() \
+            if self._adapters is not None else {}
+
+    def _adapter_stacks(self):
+        """Current stack VALUES, fetched per dispatch so a hot-load
+        rides the very next step without recompiling anything."""
+        return self._adapters.stacks() \
+            if self._adapters is not None else {}
+
     def _decode_fn(self, bucket):
         fn = self._decode_fns.get(bucket)
         if fn is not None:
@@ -851,36 +946,55 @@ class DecodeEngine:
         import jax
         import jax.numpy as jnp
         from ...jit import aot
+        from ..sampling import sample_token, samp_pack_avals
 
-        def step(pv, bv, pool_ts, tokens, positions, tables):
+        def step(pv, bv, ats, pool_ts, tokens, positions, tables,
+                 aids, hist, samp):
             def body(pool_ts, x):
-                tok, pos, table = x
+                tok, pos, table, aid, hrow, srow = x
                 caches = self._gather(pool_ts, table)
                 (logits, new_caches), _ = self._apply(
-                    pv, bv, tok.reshape(1, 1), caches, pos)
-                nxt = jnp.argmax(
-                    logits[0, -1].astype(jnp.float32), -1).astype(jnp.int32)
+                    pv, bv, tok.reshape(1, 1), caches, pos, ats, aid)
+                # greedy rows (`srow["greedy"] == 1`) select the raw-
+                # logits argmax behind a where — bit-identical to the
+                # pre-sampling engine; sampled rows draw from the
+                # counter-keyed per-sequence RNG
+                nxt = sample_token(
+                    logits[0, -1].astype(jnp.float32), srow, hrow)
                 pool_ts = self._scatter_row(pool_ts, new_caches, table, pos)
                 return pool_ts, nxt
             # scan over the batch: each sequence runs the IDENTICAL
             # per-sequence program at every bucket size (bit-identical to
             # running alone — compile_batched's lax.map argument), writes
             # land in its own blocks (padded rows in reserved block 0),
-            # and the whole bucket is ONE gathered XLA dispatch
-            pool_ts, nxt = jax.lax.scan(body, pool_ts,
-                                        (tokens, positions, tables))
+            # and the whole bucket is ONE gathered XLA dispatch. The
+            # adapter delta gathers each sequence's own slot (slot 0 =
+            # base model, selected back bitwise), so a mixed-tenant
+            # mixed-sampling batch is still this one executable.
+            pool_ts, nxt = jax.lax.scan(
+                body, pool_ts,
+                (tokens, positions, tables, aids, hist, samp))
             return pool_ts, nxt
 
         pv, bv = self._weight_avals()
-        avals = (pv, bv, self._avals(self.pool.tensors),
+        ats_avals = self._adapter_avals()
+        samp_avals = samp_pack_avals(bucket)
+        avals = (pv, bv, ats_avals, self._avals(self.pool.tensors),
                  jax.ShapeDtypeStruct((bucket,), jnp.int32),
                  jax.ShapeDtypeStruct((bucket,), jnp.int32),
-                 jax.ShapeDtypeStruct((bucket, self._nb), jnp.int32))
+                 jax.ShapeDtypeStruct((bucket, self._nb), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                 jax.ShapeDtypeStruct((bucket, self.max_length),
+                                      jnp.int32),
+                 samp_avals)
         in_sh = out_sh = None
         sh = self._step_shardings()
         if sh is not None:
             pv_sh, bv_sh, pool_sh, repl = sh
-            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl)
+            ats_sh = jax.tree_util.tree_map(lambda _: repl, ats_avals)
+            samp_sh = jax.tree_util.tree_map(lambda _: repl, samp_avals)
+            in_sh = (pv_sh, bv_sh, ats_sh, pool_sh, repl, repl, repl,
+                     repl, repl, samp_sh)
             out_sh = (pool_sh, repl)
         compiled, source = aot.compile_jit(
             step, avals, fingerprint=self._fingerprint, cache=self._cache,
@@ -890,28 +1004,26 @@ class DecodeEngine:
         self._decode_fns[bucket] = compiled
         return compiled
 
-    def _make_prefill_body(self, pbucket, apply):
+    def _make_prefill_body(self, pbucket, apply, multiplex=False):
         """The traced chunk-prefill program, shared by the target
         prefill and the draft catch-up prefill (`apply` selects whose
         weights run the forward). The block-wise scatter below is the
         bit-exactness-critical core both chunked prefill and draft
-        catch-up rest on — one implementation, two compilers."""
+        catch-up rest on — one implementation, two compilers.
+
+        `multiplex=True` (the target) threads the adapter stacks / slot
+        id through the forward (the adapter delta changes the PROMPT KV
+        too, not just decode) and samples the next token through the
+        samp pack — the final chunk of a sampled sequence draws its
+        first generated token here. The draft keeps the plain greedy
+        signature (speculation is greedy-only)."""
         import jax
         import jax.numpy as jnp
 
         nb_written = math.ceil(pbucket / self.block_size)
         nb_table = self._nb + self._prefill_tail
 
-        def prefill(pv, bv, pool_ts, tokens, start, valid_len, table):
-            # chunk-aware prefill: tokens [1, pbucket] hold prompt
-            # positions [start, start + valid_len); `start` is always
-            # block-aligned (0 for a monolithic prefill). Attention over
-            # already-written earlier chunks rides the same gathered view.
-            caches = self._gather(pool_ts, table, nb=nb_table)
-            (logits, new_caches), _ = apply(pv, bv, tokens, caches, start)
-            last = jax.lax.dynamic_index_in_dim(logits[0], valid_len - 1,
-                                                axis=0, keepdims=False)
-            nxt = jnp.argmax(last.astype(jnp.float32), -1).astype(jnp.int32)
+        def scatter(pool_ts, new_caches, table, start):
             # scatter the written rows block-by-block from the chunk's
             # start block; rows past the real tokens are garbage that
             # decode overwrites position-by-position before it can ever
@@ -932,7 +1044,35 @@ class DecodeEngine:
                         new_t = new_t.at[table[sb + j], : hi - lo].set(rows)
                     entry.append(new_t)
                 out.append(tuple(entry))
-            return out, nxt
+            return out
+
+        if multiplex:
+            from ..sampling import sample_token
+
+            def prefill(pv, bv, ats, pool_ts, tokens, start, valid_len,
+                        table, aid, hist, samp):
+                # chunk-aware prefill: tokens [1, pbucket] hold prompt
+                # positions [start, start + valid_len); `start` is
+                # always block-aligned (0 for a monolithic prefill).
+                # Attention over already-written earlier chunks rides
+                # the same gathered view.
+                caches = self._gather(pool_ts, table, nb=nb_table)
+                (logits, new_caches), _ = apply(pv, bv, tokens, caches,
+                                                start, ats, aid)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], valid_len - 1, axis=0, keepdims=False)
+                nxt = sample_token(last.astype(jnp.float32), samp, hist)
+                return scatter(pool_ts, new_caches, table, start), nxt
+        else:
+            def prefill(pv, bv, pool_ts, tokens, start, valid_len, table):
+                caches = self._gather(pool_ts, table, nb=nb_table)
+                (logits, new_caches), _ = apply(pv, bv, tokens, caches,
+                                                start)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], valid_len - 1, axis=0, keepdims=False)
+                nxt = jnp.argmax(last.astype(jnp.float32),
+                                 -1).astype(jnp.int32)
+                return scatter(pool_ts, new_caches, table, start), nxt
 
         return prefill
 
@@ -944,19 +1084,30 @@ class DecodeEngine:
         import jax.numpy as jnp
         from ...jit import aot
 
+        from ..sampling import samp_pack_avals
+
         nb_table = self._nb + self._prefill_tail
-        prefill = self._make_prefill_body(pbucket, self._apply)
+        prefill = self._make_prefill_body(pbucket, self._apply,
+                                          multiplex=True)
         pv, bv = self._weight_avals()
-        avals = (pv, bv, self._avals(self.pool.tensors),
+        ats_avals = self._adapter_avals()
+        samp_avals = samp_pack_avals(None)   # one sequence: scalar rows
+        avals = (pv, bv, ats_avals, self._avals(self.pool.tensors),
                  jax.ShapeDtypeStruct((1, pbucket), jnp.int32),
                  jax.ShapeDtypeStruct((), jnp.int32),
                  jax.ShapeDtypeStruct((), jnp.int32),
-                 jax.ShapeDtypeStruct((nb_table,), jnp.int32))
+                 jax.ShapeDtypeStruct((nb_table,), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((self.max_length,), jnp.int32),
+                 samp_avals)
         in_sh = out_sh = None
         sh = self._step_shardings()
         if sh is not None:
             pv_sh, bv_sh, pool_sh, repl = sh
-            in_sh = (pv_sh, bv_sh, pool_sh, repl, repl, repl, repl)
+            ats_sh = jax.tree_util.tree_map(lambda _: repl, ats_avals)
+            samp_sh = jax.tree_util.tree_map(lambda _: repl, samp_avals)
+            in_sh = (pv_sh, bv_sh, ats_sh, pool_sh, repl, repl, repl,
+                     repl, repl, repl, samp_sh)
             out_sh = (pool_sh, repl)
         compiled, source = aot.compile_jit(
             prefill, avals, fingerprint=self._fingerprint,
@@ -1045,8 +1196,12 @@ class DecodeEngine:
             def seq_body(pool_ts, x):
                 toks, pos0, table = x
                 caches = self._gather(pool_ts, table)
+                # speculation is greedy-only and adapter-free (submit
+                # eligibility excludes both): the bare base model traces
+                # — empty stacks are a static no-op in `wrapped`
                 (logits, new_caches), _ = self._apply(
-                    pv, bv, toks.reshape(1, kk), caches, pos0)
+                    pv, bv, toks.reshape(1, kk), caches, pos0,
+                    {}, jnp.int32(0))
                 preds = jnp.argmax(
                     logits[0].astype(jnp.float32), -1).astype(jnp.int32)
                 # the chunk wrote rows pos0..pos0+K: scatter each through
@@ -1268,6 +1423,79 @@ class DecodeEngine:
         bv = {n: b._value for n, b in self._buffers.items()}
         return pv, bv
 
+    #: samp-pack values for a padded (or greedy) batch row — the raw-
+    #: argmax lane, so padding never perturbs anything
+    _PACK_DEFAULTS = {"ctr": 0, "greedy": 1, "rep": 1.0, "seed": 0,
+                      "temp": 1.0, "top_k": 0, "top_p": 1.0}
+
+    def _pack_values(self, seq):
+        """This sequence's samp-pack scalars for the NEXT token. The RNG
+        counter is the token's absolute output index (committed tokens
+        from a prior attempt included), so a restarted or failed-over
+        sequence redraws the identical stream."""
+        sp = seq.sampling
+        if sp is None or sp.is_greedy():
+            # greedy: the raw-argmax lane, every other knob inert (the
+            # SamplingParams contract: temperature <= 0 means argmax)
+            return dict(self._PACK_DEFAULTS,
+                        ctr=seq.sample_base + seq.generated)
+        return {"ctr": seq.sample_base + seq.generated, "greedy": 0,
+                "rep": sp.repetition_penalty, "seed": sp.seed,
+                "temp": sp.temperature, "top_k": sp.top_k,
+                "top_p": sp.top_p}
+
+    def _samp_row(self, seq):
+        """Scalar samp pack (the single-sequence prefill dispatch)."""
+        from ..sampling import PACK_FIELDS
+
+        vals = self._pack_values(seq)
+        return {name: np.asarray(vals[name], np.dtype(dt))
+                for name, dt in PACK_FIELDS}
+
+    def _samp_pack(self, seqs, bucket):
+        """Batched `(bucket,)` samp pack for one decode dispatch —
+        param mixes land here as VALUES; the layout never changes."""
+        from ..sampling import PACK_FIELDS
+
+        rows = [self._pack_values(s) for s in seqs]
+        pack = {}
+        for name, dt in PACK_FIELDS:
+            arr = np.full(bucket, self._PACK_DEFAULTS[name],
+                          np.dtype(dt))
+            for i, r in enumerate(rows):
+                arr[i] = r[name]
+            pack[name] = arr
+        return pack
+
+    @staticmethod
+    def _is_greedy(seq):
+        """True when this sequence's next token is the raw-logits argmax
+        (no params, or temperature <= 0): the full-prompt prefix-cache
+        fast path — delivering the PUBLISHER's cached next token — is
+        exact for these and only these."""
+        return seq.sampling is None or seq.sampling.is_greedy()
+
+    def _hist_fill(self, row, seq):
+        sp = seq.sampling
+        if sp is not None and not sp.is_greedy() \
+                and sp.repetition_penalty != 1.0:
+            toks = self._committed_tokens(seq)
+            row[: len(toks)] = toks
+
+    def _hist_row(self, seq):
+        """Token history `(max_length,)` (-1 padded) for the repetition
+        penalty — filled only when the sequence actually penalizes
+        (values, not signatures; an all-(-1) row is the identity)."""
+        row = np.full(self.max_length, -1, np.int32)
+        self._hist_fill(row, seq)
+        return row
+
+    def _hist_pack(self, seqs, bucket):
+        rows = np.full((bucket, self.max_length), -1, np.int32)
+        for i, s in enumerate(seqs):
+            self._hist_fill(rows[i], s)
+        return rows
+
     def _padded_table(self, seq, length=None):
         # 0 = reserved padding sink
         table = np.zeros(self._nb if length is None else length, np.int32)
@@ -1362,7 +1590,9 @@ class DecodeEngine:
                             and plen % self.block_size) else 0
                 seq.reserved_total = self.pool.blocks_for(
                     plen + seq.max_new) + cow
-                entry = self._match_prefix(seq.prompt) \
+                entry = self._match_prefix(
+                    seq.prompt, seq.adapter_sig,
+                    full_ok=self._is_greedy(seq)) \
                     if self._prefix_on else None
                 matched = len(entry["blocks"]) if entry else 0
                 reserve = sum(s.outstanding for s in self._active) \
@@ -1489,6 +1719,10 @@ class DecodeEngine:
                     cause=e) from e
         fn = self._prefill_fn(pbucket)
         pv, bv = self._weights()
+        ats = self._adapter_stacks()
+        aid = np.asarray(seq.adapter_slot, np.int32)
+        hist = self._hist_row(seq)
+        samp = self._samp_row(seq)
         tokens = np.full((1, pbucket), self.pad_token_id, np.int32)
         tokens[0, :this_len] = seq.prompt[start:start + this_len]
         table = self._padded_table(seq, self._nb + self._prefill_tail)
@@ -1518,10 +1752,10 @@ class DecodeEngine:
                 # sanctioned inside the step pool's serving.execute
                 # region
                 with _san.hot_region("decode.step_dispatch"):
-                    new_pool, nxt = fn(pv, bv, pool_ts, tokens,
+                    new_pool, nxt = fn(pv, bv, ats, pool_ts, tokens,
                                        np.asarray(start, np.int32),
                                        np.asarray(this_len, np.int32),
-                                       table)
+                                       table, aid, hist, samp)
                 self._san_sweep(new_pool)
                 with _san.allow_host_sync("decode.token_fetch"):
                     return new_pool, int(np.asarray(nxt))
@@ -1531,25 +1765,34 @@ class DecodeEngine:
         seq.prefill_pos = done = start + this_len
         with self._lock:
             self._prefill_chunks += 1
-        if self._prefix_on and self._chunk and done % self._chunk == 0:
+        if self._prefix_on and self._chunk and done % self._chunk == 0 \
+                and (self._is_greedy(seq) or done < plen):
             # a full chunk boundary: publish tokens[0:done] for reuse —
             # chunk boundaries are absolute multiples of the chunk size,
             # so any later prompt sharing these tokens computes (or now
-            # skips) the IDENTICAL dispatches, keeping reuse bit-exact
+            # skips) the IDENTICAL dispatches, keeping reuse bit-exact.
+            # A SAMPLED sequence's final chunk is not published: its
+            # stored next_token is a draw from this request's RNG, and a
+            # full-prompt hit would deliver it to someone else.
             with self._cv:
                 self._prefix_insert(
                     "chunk", seq.prompt[:done],
-                    seq.blocks[:done // self.block_size], tok)
+                    seq.blocks[:done // self.block_size], tok,
+                    seq.adapter_sig)
         if done < plen:
             return
         # prompt complete: publish the full-prompt entry (identical
         # resubmissions skip prefill entirely; a mid-block tail is shared
         # too — the writer COW-copies it before its first private token),
-        # then join the running batch and stream the first token
-        if self._prefix_on and not (self._chunk
-                                    and plen % self._chunk == 0):
+        # then join the running batch and stream the first token. Only
+        # greedy sequences publish full entries (same RNG argument as
+        # above); cache keys carry the adapter signature, so KV computed
+        # under one adapter version is never reused under another.
+        if self._prefix_on and self._is_greedy(seq) \
+                and not (self._chunk and plen % self._chunk == 0):
             with self._cv:
-                self._prefix_insert("full", seq.prompt, seq.blocks, tok)
+                self._prefix_insert("full", seq.prompt, seq.blocks, tok,
+                                    seq.adapter_sig)
         with self._lock:
             self._prefills += 1
         seq.state = _ACTIVE
@@ -1572,33 +1815,42 @@ class DecodeEngine:
         return hashlib.sha1(
             np.ascontiguousarray(ids[:t]).tobytes()).hexdigest()
 
-    def _match_prefix(self, ids):
-        """Longest cached prefix of `ids`: the full-prompt entry first
-        (total reuse — prefill skipped entirely), then chunk boundaries
-        descending. Token contents are verified, never just hashes."""
+    def _match_prefix(self, ids, sig=(0, 0), full_ok=True):
+        """Longest cached prefix of `ids` UNDER adapter signature `sig`:
+        the full-prompt entry first (total reuse — prefill skipped
+        entirely), then chunk boundaries descending. Token contents are
+        verified, never just hashes. `full_ok=False` (a sampled
+        request) skips any entry covering the WHOLE prompt: such a hit
+        would deliver the publisher's next token, but a sampled request
+        must draw its own first token from the final chunk's logits."""
         plen = len(ids)
-        e = self._prefix_cache.get(
-            ("full", plen, self._digest(ids, plen)))
-        if e is not None and np.array_equal(e["tokens"], ids):
-            e["stamp"] = next(self._lru)
-            return e
+        if full_ok:
+            e = self._prefix_cache.get(
+                ("full", plen, self._digest(ids, plen), sig))
+            if e is not None and np.array_equal(e["tokens"], ids):
+                e["stamp"] = next(self._lru)
+                return e
         if self._chunk:
             t = (plen // self._chunk) * self._chunk
+            if not full_ok and t == plen:
+                t -= self._chunk
             while t >= self._chunk:
                 e = self._prefix_cache.get(
-                    ("chunk", t, self._digest(ids, t)))
+                    ("chunk", t, self._digest(ids, t), sig))
                 if e is not None and np.array_equal(e["tokens"], ids[:t]):
                     e["stamp"] = next(self._lru)
                     return e
                 t -= self._chunk
         return None
 
-    def _prefix_insert(self, kind, toks, blocks, next_token):
+    def _prefix_insert(self, kind, toks, blocks, next_token, sig=(0, 0)):
         """Publish `blocks` (holding the KV of `toks`) for reuse; the
         cache takes its own reference on every block. Bounded by the
         block cap (LRU evictions make room; an oversized entry is simply
-        not cached)."""
-        key = (kind, len(toks), self._digest(toks, len(toks)))
+        not cached). `sig` is the publisher's `(slot, generation)`
+        adapter signature: KV computed under one adapter version can
+        only ever be matched under the same one."""
+        key = (kind, len(toks), self._digest(toks, len(toks)), sig)
         e = self._prefix_cache.get(key)
         if e is not None:
             e["stamp"] = next(self._lru)
@@ -1649,11 +1901,30 @@ class DecodeEngine:
             self.pool.decref(e["blocks"], owner=_CACHE_OWNER)
         self._prefix_cache.clear()
 
+    def _push_tokens(self, seq, toks):
+        """Release tokens to the sequence's stream (stop-sequence
+        hold-back happens upstream in `_deliver`)."""
+        for t in toks:
+            seq.stream._push(int(t))
+        if toks:
+            with self._lock:
+                self._tokens_out += len(toks)
+
     def _deliver(self, seq, tok):
         """Commit one decoded token: stream it out and retire the
-        sequence if it just finished."""
+        sequence if it just finished.
+
+        Stop sequences are enforced here, scheduler-side: a token is
+        held back while it could still be the prefix of a stop match,
+        and released only once it provably is not.  The invariant —
+        released tokens never end with a proper prefix of any stop
+        sequence — is what makes router failover correct: the resume
+        `committed` prefix regenerates the held tail bit-identically
+        (counter RNG), so the stop still truncates at the same point.
+        """
         seq.last_token = tok
         seq.generated += 1
+        seq.out_tokens.append(int(tok))
         if seq.generated == 1 and seq.submitted_at is not None:
             ttft = self._clock() - seq.submitted_at
             self._h_ttft.observe(ttft, ctx=seq.span.ctx)
@@ -1664,9 +1935,40 @@ class DecodeEngine:
             if seq.span.ctx is not None:
                 _otrace.event_in("decode.first_token", seq.span.ctx,
                                  attrs={"seq": seq.id, "ttft_s": ttft})
-        seq.stream._push(tok)
-        with self._lock:
-            self._tokens_out += 1
+        sps = (seq.sampling.stop_sequences
+               if seq.sampling is not None else ())
+        if not sps:
+            self._push_tokens(seq, [tok])
+        else:
+            seq.held.append(int(tok))
+            out = seq.out_tokens
+            hit = None
+            for stop in sps:
+                ls = len(stop)
+                if len(out) >= ls and tuple(out[-ls:]) == stop:
+                    hit = stop
+                    break
+            if hit is not None:
+                # the stop's tokens themselves are swallowed; everything
+                # held before them is released
+                flush = seq.held[:len(seq.held) - len(hit)]
+                seq.held = []
+                self._push_tokens(seq, flush)
+                with self._lock:
+                    self._stop_hits += 1
+                self._finish(seq, "completed")
+                return
+            keep = 0
+            for stop in sps:
+                top = min(len(stop) - 1, len(seq.held))
+                for l in range(top, keep, -1):
+                    if tuple(out[-l:]) == stop[:l]:
+                        keep = l
+                        break
+            if len(seq.held) > keep:
+                flush = seq.held[:len(seq.held) - keep]
+                seq.held = seq.held[len(seq.held) - keep:]
+                self._push_tokens(seq, flush)
         if (self.eos_token_id is not None and tok == self.eos_token_id) \
                 or seq.generated >= seq.max_new:
             self._finish(seq, "completed")
@@ -1712,7 +2014,8 @@ class DecodeEngine:
             limit = self._nb * self.block_size
             spec = [s for s in active
                     if s.max_new - s.generated > 1
-                    and s.pos + self._k + 1 <= limit]
+                    and s.pos + self._k + 1 <= limit
+                    and s.sampling is None and s.adapter is None]
             active = [s for s in active if s not in spec]
         if spec:
             # sequences whose draft is still catching up (one chunk per
@@ -1813,18 +2116,24 @@ class DecodeEngine:
         bucket = next(b for b in self.decode_buckets if b >= n)
         fn = self._decode_fn(bucket)
         pv, bv = self._weights()
+        ats = self._adapter_stacks()
         tokens = np.zeros(bucket, np.int32)
         positions = np.zeros(bucket, np.int32)
         tables = np.zeros((bucket, self._nb), np.int32)  # pad rows -> 0
+        aids = np.zeros(bucket, np.int32)  # pad rows -> slot 0 (no-op)
         for i, seq in enumerate(active):
             tokens[i] = seq.last_token
             positions[i] = seq.pos
             tables[i] = self._padded_table(seq)
+            aids[i] = seq.adapter_slot
+        hist = self._hist_pack(active, bucket)
+        samp = self._samp_pack(active, bucket)
         pool_ts = self.pool.tensors
         new_pool, nxt = self._run_linked_step(
             "decode.step", "decode.step_join", active, "decode",
             {"bucket": bucket},
-            lambda: fn(pv, bv, pool_ts, tokens, positions, tables),
+            lambda: fn(pv, bv, ats, pool_ts, tokens, positions, tables,
+                       aids, hist, samp),
             sweep=True)
         self.pool.tensors = new_pool
         for seq in active:
@@ -1869,12 +2178,15 @@ class DecodeEngine:
     # no uncommitted token is ever delivered.
 
     def _committed_tokens(self, seq):
-        """Every committed token (prompt + delivered), index == cache
-        position; length is seq.pos + 1 with seq.last_token at the end."""
-        if not seq.stream.tokens:
+        """Every committed token (prompt + generated), index == cache
+        position; length is seq.pos + 1 with seq.last_token at the end.
+        Uses `out_tokens`, not the stream: tokens held back by a pending
+        stop-sequence match are committed (they occupy cache positions)
+        even though they have not been released to the caller."""
+        if not seq.out_tokens:
             return seq.prompt
         return np.concatenate(
-            [seq.prompt, np.asarray(seq.stream.tokens, np.int32)])
+            [seq.prompt, np.asarray(seq.out_tokens, np.int32)])
 
     def _draft_catchup(self, seq):
         """Bring the draft's KV toward the committed position: prefill
@@ -2167,9 +2479,24 @@ class DecodeEngine:
             self._active.remove(seq)
         if seq in self._prefill_q:
             self._prefill_q.remove(seq)
+        if seq.held and status == "completed":
+            # eos/max_new ended the stream mid-hold: no stop match is
+            # coming, so the held tail is plain output — release it.
+            # Non-completed finishes deliberately DROP the held tail:
+            # a failover resume regenerates it bit-identically (counter
+            # RNG), and the released prefix keeps the no-stop-prefix
+            # invariant the resume-side stop scan depends on.
+            # inline push: we already hold `_lock` (via `_cv`) here and
+            # `_push_tokens` would re-take the non-reentrant lock
+            for t in seq.held:
+                seq.stream._push(int(t))
+            self._tokens_out += len(seq.held)
+        seq.held = []
         # drops every reference this sequence holds: exclusive blocks
         # free, shared prefix blocks stay for their other holders
         self.pool.free_owned(seq.id)
+        if self._adapters is not None:
+            self._adapters.release_owned(seq.id)
         if self._spec_on:
             self.draft_pool.free_owned(seq.id)
             seq.draft_outstanding = 0
@@ -2278,6 +2605,8 @@ class DecodeEngine:
                 "prefix_hit_rate": (self._prefix_hits / lookups)
                 if lookups else 0.0,
                 "cow_copies": self._cow_copies,
+                "sampled": self._sampled,
+                "stop_hits": self._stop_hits,
                 "prefix_cache": {
                     "enabled": self._prefix_on,
                     "entries": len(self._prefix_cache),
@@ -2330,6 +2659,8 @@ class DecodeEngine:
         snap["ttft"] = {"count": th["count"], "avg_s": th["avg"],
                         "p50_s": th["p50"], "p99_s": th["p99"]}
         snap["blocks"] = self.pool.stats()
+        if self._adapters is not None:
+            snap["adapters"] = self._adapters.stats()
         if self._spec_on:
             snap["draft_blocks"] = self.draft_pool.stats()
         snap["step_pool"] = self._steps.stats()

@@ -29,7 +29,8 @@ Handle contract (what router.py consumes):
     infer_stamped(feeds, timeout) -> (outputs, generation) — the stamp is
                             read atomically with execution (swap gate)
     submit_generate(prompt_ids, max_new, timeout, resume_committed,
-                    admission_timeout) -> (stream, generation) — a
+                    sampling, adapter, admission_timeout)
+                            -> (stream, generation) — a
                             streaming generation on the replica's decode
                             engine; the stream speaks the pump contract
                             (`poll(timeout)` -> ("tok", t) / ("end",
@@ -330,7 +331,8 @@ class LocalReplica:
                 self._entering -= 1
 
     def submit_generate(self, prompt_ids, max_new_tokens, timeout=None,
-                        *, resume_committed=None, admission_timeout=None):
+                        *, resume_committed=None, sampling=None,
+                        adapter=None, admission_timeout=None):
         """Admit one streaming generation on this replica's decode
         engine; returns `(stream, generation)` where the stream speaks
         the pump contract (`poll` / `cancel`) and the stamp is EXACTLY
@@ -338,7 +340,10 @@ class LocalReplica:
         gate as `infer_stamped`). `admission_timeout` bounds the gate
         wait (wedge/swap hold) separately from the generation deadline —
         the router passes its per-attempt timeout here so a frozen
-        replica sheds the ATTEMPT, not the whole stream budget."""
+        replica sheds the ATTEMPT, not the whole stream budget.
+        `sampling` / `adapter` ride through to the engine verbatim (a
+        failover retry re-submits the SAME values, so the counter-based
+        RNG regenerates the identical continuation)."""
         adm = Deadline(admission_timeout if admission_timeout is not None
                        else timeout, clock=self._clock)
         if self._wedged:
@@ -374,7 +379,8 @@ class LocalReplica:
         try:
             inner = pool.submit_generate(prompt_ids, max_new_tokens,
                                          timeout=timeout,
-                                         resume_committed=resume_committed)
+                                         resume_committed=resume_committed,
+                                         sampling=sampling, adapter=adapter)
             return _LocalStream(self, inner), gen
         except PoolClosed as e:
             raise ReplicaDead(
@@ -716,7 +722,8 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                 state["entering"] -= 1
         _ship(payload)
 
-    def _respond_generate(seq, prompt, max_new, timeout, committed, wire):
+    def _respond_generate(seq, prompt, max_new, timeout, committed, wire,
+                          samp=None, adapter=None):
         """Streaming responder: admit under the swap gate, stamp the
         admission generation back as `("gen-admit", gen)` on the res key,
         then pump engine tokens into chunked ``genres`` frames until the
@@ -753,7 +760,8 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                              0 if committed is None else len(committed)}):
                     stream = pool.submit_generate(
                         prompt, max_new, timeout=dl.remaining(),
-                        resume_committed=committed)
+                        resume_committed=committed, sampling=samp,
+                        adapter=adapter)
             except ServingError as e:
                 det = isinstance(getattr(e, "cause", None),
                                  DETERMINISTIC_ERRORS)
@@ -855,9 +863,12 @@ def serve_replica(rid, port, model_prefix, *, host="127.0.0.1",
                 if payload is None:
                     pass  # client-side tombstone: seq consumed, no work
                 elif payload[0] == "__generate__":
-                    _, prompt, max_new, timeout, committed, wire = payload
+                    (_, prompt, max_new, timeout, committed,
+                     wire) = payload[:6]
+                    samp = payload[6] if len(payload) > 6 else None
+                    adapter = payload[7] if len(payload) > 7 else None
                     ex.submit(_respond_generate, seq, prompt, max_new,
-                              timeout, committed, wire)
+                              timeout, committed, wire, samp, adapter)
                 else:
                     feeds, timeout = payload[0], payload[1]
                     wire = payload[2] if len(payload) > 2 else None
@@ -1184,13 +1195,16 @@ class SubprocessReplica:
             time.sleep(0.003)
 
     def submit_generate(self, prompt_ids, max_new_tokens, timeout=None, *,
-                        resume_committed=None, admission_timeout=None):
+                        resume_committed=None, sampling=None, adapter=None,
+                        admission_timeout=None):
         """`(stream, generation)`: ship the prompt to the replica process
         and wait out its swap-gate admission; the stamp comes back as the
         `("gen-admit", gen)` reply, after which tokens flow as chunked
         frames through the returned `_RemoteStream`. `admission_timeout`
         bounds ONLY the wait for the stamp (the router's per-attempt
-        knob); `timeout` rides the wire as the engine-side deadline."""
+        knob); `timeout` rides the wire as the engine-side deadline.
+        `sampling` crosses the wire in its dict form (the engine side
+        rebuilds the `SamplingParams`); `adapter` as the plain name."""
         import pickle
 
         import numpy as np
@@ -1200,9 +1214,12 @@ class SubprocessReplica:
         # pickle BEFORE allocating the sequence number (see infer_stamped)
         committed = None if resume_committed is None else \
             [int(t) for t in resume_committed]
+        samp_wire = sampling.to_dict() if hasattr(sampling, "to_dict") \
+            else sampling
         blob = pickle.dumps((
             "__generate__", np.asarray(prompt_ids), int(max_new_tokens),
-            timeout, committed, _otrace.current_wire()))
+            timeout, committed, _otrace.current_wire(), samp_wire,
+            adapter))
         try:
             seq = self._store.add(f"/replica/{self.rid}/{self._epoch}/seq",
                                   1) - 1

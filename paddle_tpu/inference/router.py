@@ -717,7 +717,8 @@ class ServingRouter:
         return best
 
     # -- streaming ---------------------------------------------------------
-    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None):
+    def submit_generate(self, prompt_ids, max_new_tokens, timeout=None, *,
+                        sampling=None, adapter=None):
         """Route one streaming generation through the tier; returns a
         `RouterStream` immediately (admission errors raise typed). The
         stream's pump thread owns placement (prefix-affinity first),
@@ -725,7 +726,12 @@ class ServingRouter:
         replica — bit-identical to an uninterrupted run), drain-or-
         migrate under a weight swap, and the deadline. The client
         iterator sees one unbroken token sequence; typed `RequestFailed`
-        only when the failover budget or deadline is exhausted."""
+        only when the failover budget or deadline is exhausted.
+        `sampling` / `adapter` ride every attempt verbatim: the engine's
+        counter-based RNG makes a resumed sampled stream regenerate the
+        identical continuation, and a replica without the adapter
+        rejects deterministically (`AdapterNotLoaded` is a `ValueError`
+        — no failover, the request is the problem)."""
         import numpy as np
 
         cfg = self.config
@@ -749,22 +755,23 @@ class ServingRouter:
         rs = RouterStream(self, eff)
         threading.Thread(
             target=self._stream_pump,
-            args=(rs, prompt, int(max_new_tokens)),
+            args=(rs, prompt, int(max_new_tokens), sampling, adapter),
             name=f"ServingRouter-stream-{self.name}",
             daemon=True).start()
         return rs
 
-    def _stream_pump(self, rs, prompt, max_new):
+    def _stream_pump(self, rs, prompt, max_new, sampling=None,
+                     adapter=None):
         # the stream's ROOT span wraps the pump's whole life: every
         # failover attempt is a sibling `router.attempt` under it and
         # the replica processes' spans ride the terminal frames home, so
         # a failed-over stream reads as ONE merged causal record
         if not _otrace.enabled():
-            self._stream_pump_impl(rs, prompt, max_new)
+            self._stream_pump_impl(rs, prompt, max_new, sampling, adapter)
             return
         with _otrace.root_span("router.generate",
                                attrs={"router": self.name}) as root:
-            self._stream_pump_impl(rs, prompt, max_new)
+            self._stream_pump_impl(rs, prompt, max_new, sampling, adapter)
             root.set_attr("status", rs._status)
             root.set_attr("failovers", rs.failovers)
             if rs.generation is not None:
@@ -777,7 +784,8 @@ class ServingRouter:
 
                 _oflight.recorder().unpin(root.ctx.trace_id)
 
-    def _stream_pump_impl(self, rs, prompt, max_new):
+    def _stream_pump_impl(self, rs, prompt, max_new, sampling=None,
+                          adapter=None):
         cfg = self.config
         dl = rs._deadline
         committed = []   # every token delivered to the client, in order
@@ -832,7 +840,8 @@ class ServingRouter:
             no_capacity_since = None
             attempts += 1
             exc = self._stream_attempt(rs, rec, prompt, max_new,
-                                       committed, dl, attempts)
+                                       committed, dl, attempts,
+                                       sampling, adapter)
             if exc is None:
                 return   # terminal: the attempt finished the stream
             last_exc = exc
@@ -858,7 +867,7 @@ class ServingRouter:
             time.sleep(delay)
 
     def _stream_attempt(self, rs, rec, prompt, max_new, committed, dl,
-                        attempts):
+                        attempts, sampling=None, adapter=None):
         """One replica attempt: admit (resuming from `committed`), check
         generation purity, pump tokens. Returns None when the attempt
         reached a terminal outcome for the STREAM (rs finished inside),
@@ -882,6 +891,7 @@ class ServingRouter:
                         prompt, max_new - len(committed),
                         timeout=dl.remaining(),
                         resume_committed=committed if committed else None,
+                        sampling=sampling, adapter=adapter,
                         admission_timeout=att_tmo)
             except Overloaded as e:
                 # never admitted there: reroute, no health penalty (the

@@ -1,0 +1,175 @@
+"""Per-request sampling for the decode engine — in-graph, value-driven.
+
+`SamplingParams` is the request-side contract (temperature / top-k /
+top-p / repetition penalty / seed / stop sequences); the module-level
+helpers are the IN-GRAPH math the engine's compiled step executables
+call.  Two properties anchor the design:
+
+* **Values, never signatures.**  Every knob rides the batch as a
+  per-sequence scalar (f32/i32/u32 rows in a fixed "samp pack" dict), so
+  an arbitrary mix of sampling params across the running batch — or a
+  mid-stream change of mix — reuses the one compiled executable per
+  bucket.  Zero post-warmup retraces, tpu-san-enforced.
+* **Counter-based randomness.**  The per-token key is
+  ``fold_in(PRNGKey(seed), sample_base + tokens_already_generated)`` — a
+  pure function of (seed, absolute output position).  An engine restart
+  or a router failover that resumes from the committed tokens reproduces
+  the remaining stream bit-identically; no RNG state to checkpoint.
+
+Greedy requests (``sampling=None`` or ``temperature <= 0``) take the
+same executable with ``greedy=1`` in the pack: the token is selected
+from the RAW logits with the identical ``argmax`` the greedy engine has
+always used, behind a ``jnp.where`` — bit-identical by construction.
+
+`models/generation.py` (the offline `generate()` loop) calls the same
+helpers, so online and offline sampling share one set of semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SamplingParams", "apply_top_k", "apply_top_p",
+    "apply_repetition_penalty", "sample_token", "samp_pack_avals",
+]
+
+
+class SamplingParams:
+    """Per-request sampling contract for `DecodeEngine.submit`.
+
+    ``temperature <= 0`` means greedy (argmax) — the engine then takes
+    the bit-identical raw-argmax path regardless of the other knobs.
+    ``stop_sequences`` are token-id tuples handled scheduler-side (the
+    stream never emits a stop sequence or any part of one).
+    """
+
+    __slots__ = ("temperature", "top_k", "top_p", "repetition_penalty",
+                 "seed", "stop_sequences")
+
+    def __init__(self, temperature=1.0, top_k=0, top_p=1.0,
+                 repetition_penalty=1.0, seed=0, stop_sequences=()):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.repetition_penalty = float(repetition_penalty)
+        self.seed = int(seed)
+        stops = []
+        for s in stop_sequences or ():
+            toks = tuple(int(t) for t in s)
+            if not toks:
+                raise ValueError("empty stop sequence")
+            stops.append(toks)
+        self.stop_sequences = tuple(stops)
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.repetition_penalty <= 0.0:
+            raise ValueError("repetition_penalty must be > 0, got "
+                             f"{self.repetition_penalty}")
+        if not 0 <= self.seed < 2 ** 32:
+            raise ValueError(f"seed must be a u32, got {self.seed}")
+
+    def is_greedy(self):
+        return self.temperature <= 0.0
+
+    def to_dict(self):
+        """Wire form (process-replica transport)."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p,
+                "repetition_penalty": self.repetition_penalty,
+                "seed": self.seed,
+                "stop_sequences": self.stop_sequences}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+    def __repr__(self):
+        return (f"SamplingParams(temperature={self.temperature}, "
+                f"top_k={self.top_k}, top_p={self.top_p}, "
+                f"repetition_penalty={self.repetition_penalty}, "
+                f"seed={self.seed}, "
+                f"stop_sequences={self.stop_sequences})")
+
+
+# ---------------------------------------------------------------------------
+# in-graph helpers (shared by the engine's compiled step and generate())
+# ---------------------------------------------------------------------------
+
+def apply_top_k(logits, k):
+    """Mask everything below the k-th largest logit. `k` may be a traced
+    i32 scalar; ``k <= 0`` disables the filter (identity)."""
+    v = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, -1)[..., ::-1]
+    idx = jnp.clip(k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.broadcast_to(idx, logits.shape[:-1])[..., None],
+        -1)
+    return jnp.where((k > 0) & (logits < kth), -jnp.inf, logits)
+
+
+def apply_top_p(logits, p):
+    """Nucleus filter: keep the smallest set of tokens whose cumulative
+    probability reaches `p`. `p` may be a traced f32 scalar; ``p >= 1``
+    disables the filter (identity)."""
+    v = logits.shape[-1]
+    sorted_l = jnp.sort(logits, -1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_l, -1)
+    cum = jnp.cumsum(probs, -1)
+    cutoff_idx = jnp.sum(cum < p, -1, keepdims=True)
+    cutoff = jnp.take_along_axis(
+        sorted_l, jnp.clip(cutoff_idx, 0, v - 1), -1)
+    return jnp.where((p < 1.0) & (logits < cutoff), -jnp.inf, logits)
+
+
+def apply_repetition_penalty(logits, history, penalty):
+    """CTRL-style repetition penalty over `history` (token ids, -1 for
+    padding): seen tokens' logits are divided by `penalty` when positive
+    and multiplied when negative. ``penalty == 1`` is the identity."""
+    v = logits.shape[-1]
+    hist = jnp.where(history >= 0, history, 0)
+    counts = jnp.zeros((v,), jnp.int32).at[hist].add(
+        (history >= 0).astype(jnp.int32))
+    seen = counts > 0
+    pen = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen & (penalty != 1.0), pen, logits)
+
+
+#: samp-pack field order — one per-sequence scalar row each; the engine
+#: builds `(bucket,)` arrays in this layout so param mixes change VALUES
+#: only, never the compiled signature.
+PACK_FIELDS = (("ctr", jnp.int32), ("greedy", jnp.int32),
+               ("rep", jnp.float32), ("seed", jnp.uint32),
+               ("temp", jnp.float32), ("top_k", jnp.int32),
+               ("top_p", jnp.float32))
+
+
+def samp_pack_avals(bucket=None):
+    """Abstract values for one bucket's samp pack (AOT compilation).
+    ``bucket=None`` means scalar rows — the single-sequence prefill
+    dispatch's shape."""
+    shape = () if bucket is None else (bucket,)
+    return {name: jax.ShapeDtypeStruct(shape, dt)
+            for name, dt in PACK_FIELDS}
+
+
+def sample_token(logits, sp, history):
+    """Select one token from a `(vocab,)` f32 logits row.
+
+    `sp` holds this sequence's scalars (one element per PACK_FIELDS
+    entry, already indexed out of the batch pack); `history` is the
+    sequence's `(max_length,)` token-id row (-1 padded) for the
+    repetition penalty.  The greedy branch is the raw-logits argmax the
+    greedy engine has always computed — selected by `jnp.where`, so
+    ``greedy=1`` rows are bit-identical to the pre-sampling engine.
+    """
+    greedy_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    l = apply_repetition_penalty(logits, history, sp["rep"])
+    l = l / jnp.maximum(sp["temp"], 1e-6)
+    l = apply_top_k(l, sp["top_k"])
+    l = apply_top_p(l, sp["top_p"])
+    key = jax.random.fold_in(jax.random.PRNGKey(sp["seed"]), sp["ctr"])
+    sampled = jax.random.categorical(key, l).astype(jnp.int32)
+    return jnp.where(sp["greedy"] > 0, greedy_tok, sampled)

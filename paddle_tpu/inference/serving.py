@@ -80,8 +80,8 @@ from ..obs import trace as _otrace
 
 __all__ = [
     "ServingError", "DeadlineExceeded", "Overloaded", "PoolClosed",
-    "RequestFailed", "Deadline", "CircuitBreaker", "RetryPolicy",
-    "ServingPool",
+    "RequestFailed", "AdapterNotLoaded", "Deadline", "CircuitBreaker",
+    "RetryPolicy", "ServingPool",
 ]
 
 
@@ -133,6 +133,15 @@ class RequestFailed(ServingError):
         super().__init__(msg)
         self.cause = cause
         self.attempts = attempts
+
+
+class AdapterNotLoaded(ValueError):
+    """The request named a LoRA adapter the serving `AdapterPool` does
+    not currently hold.  Subclasses ValueError so every layer of the
+    stack already treats it as a DETERMINISTIC request error: fail fast,
+    no failover, no health penalty — resubmit after `AdapterPool.load`.
+    Defined here (not in decode/) so the router/replica tier can type it
+    without importing the engine."""
 
 
 #: deterministic request errors: the request itself is malformed, so a
@@ -752,7 +761,8 @@ class ServingPool:
 
     # -- streaming generation (continuous-batching decode engine) ----------
     def submit_generate(self, prompt_ids, max_new_tokens, timeout=None,
-                        *, resume_committed=None):
+                        *, resume_committed=None, sampling=None,
+                        adapter=None):
         """Admit one LLM generation request on the attached
         `DecodeEngine` (construct the pool with `decode_engine=`);
         returns a `decode.SequenceStream` whose iterator yields tokens as
@@ -764,7 +774,9 @@ class ServingPool:
         never disturbs the others decoding beside it (its KV blocks
         return to the pool), and a wedged decode step trips the same
         hang detection that guards regular requests. `resume_committed`
-        is the mid-stream failover resume path (see
+        is the mid-stream failover resume path, `sampling` a
+        `SamplingParams` (or its dict wire form), `adapter` the name of
+        a LoRA adapter loaded in the engine's `AdapterPool` (see
         `DecodeEngine.submit`)."""
         if self._engine is None:
             raise RuntimeError(
@@ -772,13 +784,16 @@ class ServingPool:
                 "pool with decode_engine=DecodeEngine(model, ...)")
         eff = self.default_timeout if timeout is None else timeout
         return self._engine.submit(prompt_ids, max_new_tokens, timeout=eff,
-                                   resume_committed=resume_committed)
+                                   resume_committed=resume_committed,
+                                   sampling=sampling, adapter=adapter)
 
-    def generate(self, prompt_ids, max_new_tokens, timeout=None):
+    def generate(self, prompt_ids, max_new_tokens, timeout=None, *,
+                 sampling=None, adapter=None):
         """Synchronous generation convenience: submit + drain; returns
         the generated token list or raises the typed serving error."""
         return self.submit_generate(prompt_ids, max_new_tokens,
-                                    timeout=timeout).result()
+                                    timeout=timeout, sampling=sampling,
+                                    adapter=adapter).result()
 
     def _on_caller_timeout(self, req):
         with self._lock:

@@ -41,19 +41,19 @@ class GenerationConfig:
 
 
 def _sample_logits(logits, key, cfg: GenerationConfig):
+    # shared in-graph helpers with the serving engine's per-request
+    # sampling (inference/sampling.py) — one set of top-k/top-p
+    # semantics, online and offline (lazy import: models must not pull
+    # the serving stack at import time)
+    from ..inference import sampling as _samp
+
     if not cfg.do_sample:
         return jnp.argmax(logits, -1).astype(jnp.int32)
     logits = logits / jnp.maximum(cfg.temperature, 1e-6)
     if cfg.top_k > 0:
-        kth = jnp.sort(logits, -1)[..., -cfg.top_k][..., None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        logits = _samp.apply_top_k(logits, cfg.top_k)
     if cfg.top_p < 1.0:
-        sorted_l = jnp.sort(logits, -1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_l, -1)
-        cum = jnp.cumsum(probs, -1)
-        cutoff_idx = jnp.sum(cum < cfg.top_p, -1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, -1)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        logits = _samp.apply_top_p(logits, cfg.top_p)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 

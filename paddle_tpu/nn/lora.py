@@ -20,7 +20,8 @@ from . import initializer as I
 from . import functional as F
 
 __all__ = ["LoRAConfig", "LoRALinear", "apply_lora", "merge_lora",
-           "lora_parameters", "mark_only_lora_as_trainable"]
+           "lora_parameters", "mark_only_lora_as_trainable",
+           "export_lora_weights"]
 
 
 class LoRAConfig:
@@ -120,3 +121,20 @@ def merge_lora(model: Layer):
         if isinstance(sub, LoRALinear):
             sub.merge()
     return model
+
+
+def export_lora_weights(model: Layer):
+    """Extract a trained model's adapters as the raw (unscaled) A/B
+    arrays keyed by the wrapped layer's full name — the format
+    `inference.decode.AdapterPool.load` consumes for multi-tenant
+    serving (pass the training `lora_alpha` to `load(alpha=...)`; the
+    pool folds alpha/r into B itself)."""
+    out = {}
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, LoRALinear):
+            out[name] = (np.asarray(sub.lora_A._value, np.float32),
+                         np.asarray(sub.lora_B._value, np.float32))
+    if not out:
+        raise ValueError("model has no LoRALinear sublayers "
+                         "(apply_lora first, or load a LoRA checkpoint)")
+    return out

@@ -5,4 +5,5 @@ XLA already fuses the elementwise long tail; Pallas is reserved for the ops
 where schedule control wins: flash attention (forward + FlashAttention-2
 backward), and (future) MoE dispatch / quantized matmul.
 """
+from .bgmv import lora_delta  # noqa: F401
 from .flash_attention import flash_attention, flash_attention_supported  # noqa: F401

@@ -60,9 +60,11 @@ def pytest_configure(config):
 _WALL_CLOCK_TAIL = (
     "test_decode_engine.py",      # ~30s / 17 tests (AOT decode buckets)
     "test_engine_pipeline.py",    # ~13s / 18 tests (multi-step dispatch)
+    "test_vision_zoo_r3.py",      # ~110s / 9 tests (zoo fwd+grad sweeps)
     "test_launch.py",             # ~50s /  9 tests (elastic relaunch)
     "test_examples.py",           # ~67s / 11 example subprocesses
-    "test_train_fault_injection.py",  # ~25s / 1 test (5 faulted runs)
+    "test_serving_fault_injection.py",  # ~90s / 1 test (22 fault phases)
+    "test_train_fault_injection.py",  # ~35s / 1 test (5 faulted runs)
     "test_multiprocess_dist.py",  # ~10s /  1 test  (spawned world)
     "test_multiprocess_hybrid.py",  # all 3 hybrid jobs slow-marked (PR 17)
 )

@@ -265,9 +265,10 @@ def test_admission_headroom_under_sharing(model):
     admission at scale."""
     p = _prompt(70, 24)                        # 3 full blocks of prompt
     peaks = {}
+    # GEO geometry exactly (same num_blocks => same pool signature), so
+    # both throwaway engines disk-hit the module engines' executables
     for mode, on in (("shared", True), ("private", False)):
         with DecodeEngine(model, **{**GEO, "decode_buckets": (4,),
-                                    "num_blocks": 25,
                                     "prefix_cache": on}) as e:
             e.generate(p, 8)                   # canary seeds the cache
             streams = [e.submit(p, 8) for _ in range(4)]

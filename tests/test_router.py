@@ -145,7 +145,7 @@ class StubEngine:
         self._ids = itertools.count()
 
     def submit(self, prompt_ids, max_new_tokens, timeout=None,
-               resume_committed=None):
+               resume_committed=None, sampling=None, adapter=None):
         with self._lock:
             if self.closed:
                 raise PoolClosed("stub engine is shut down")

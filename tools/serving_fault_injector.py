@@ -83,6 +83,17 @@ fault-free solo run):
                  (prefix_cache=False) solo references, copy-on-write must
                  have fired for every mid-block tail writer, and zero
                  blocks or references may leak.
+  decode-adapter MULTI-TENANT decode (paged LoRA `AdapterPool` + mixed
+                 per-request sampling) under adapter-pool churn: while a
+                 mixed-adapter batch decodes live, an adapter is hot-
+                 reloaded in place (generation-stamped — in-flight
+                 holders keep the OLD weights), a fresh tenant load
+                 LRU-evicts an idle adapter, a request for the evicted
+                 adapter fails typed (`AdapterNotLoaded`), and an unload
+                 of a referenced adapter is refused loud. Survivors must
+                 be BIT-EXACT vs solo same-adapter references, adapter
+                 AND KV refcounts must conserve (zero pinned slots or
+                 blocks after drain), with zero post-warmup retraces.
 
 Router phases (`router-*`) run the DISTRIBUTED SERVING TIER
 (paddle_tpu/inference/router.py over replica.py, threads-as-replicas over
@@ -224,7 +235,7 @@ def _san_mark_warm():
 PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "batch-crash", "batch-hang", "batch-poison",
           "decode-none", "decode-kill", "decode-wedge", "decode-poison",
-          "decode-cow", "decode-spec",
+          "decode-cow", "decode-spec", "decode-adapter",
           "router-none", "router-kill", "router-wedge",
           "router-swap", "router-swap-kill",
           "router-stream-kill", "router-stream-wedge",
@@ -866,6 +877,159 @@ def run_decode_cow_phase(phase, model, verbose=True):
               f"full={st['prefix_cache']['full_hits']}, "
               f"reused={st['prefix_cache']['tokens_reused']}, "
               f"cow={st['cow_copies']}, chunks={st['prefill_chunks']}, "
+              f"peak_blocks={bs['peak_allocated']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
+def _adapter_weights(pool, seed):
+    """Random LoRA A/B arrays matching the pool's per-layer geometry."""
+    import numpy as np
+
+    r = np.random.RandomState(seed)
+    return {lname: (r.normal(0, 0.05, a.shape[1:]).astype(np.float32),
+                    r.normal(0, 0.05, b.shape[1:]).astype(np.float32))
+            for lname, (a, b) in pool.stacks().items()}
+
+
+def run_decode_adapter_phase(phase, model, verbose=True):
+    """Multi-tenant decode under adapter-pool churn: hot reload, LRU
+    eviction, and refused unloads race a LIVE mixed-adapter (and
+    mixed-sampling) batch. Survivors must stay bit-exact vs solo
+    same-adapter references through the SAME warm engine, the evicted
+    tenant must fail typed (`AdapterNotLoaded`), a referenced unload
+    must be refused loud, and both the adapter pool and the KV block
+    pool must conserve (zero pinned slots, zero leaked blocks)."""
+    import numpy as np
+    from paddle_tpu.inference import (AdapterNotLoaded, AdapterPool,
+                                      DecodeEngine, SamplingParams)
+
+    bad = []
+    t0 = time.monotonic()
+    prompts = _decode_prompts()
+    # 4 usable slots (slot 0 is the reserved no-adapter lane), 3 tenants
+    # resident: the mid-race reload takes the last free slot and the
+    # fresh tenant load must LRU-evict the idle one
+    pool = AdapterPool(model, rank=4, slots=5)
+    for i in range(3):
+        pool.load(f"t{i}", _adapter_weights(pool, 200 + i))
+    eng = DecodeEngine(model, max_length=32, block_size=8,
+                       decode_buckets=(1, 2, 4, 8), prefill_buckets=(8,),
+                       default_timeout=30.0, step_timeout=STEP_TIMEOUT,
+                       step_retries=2, hang_grace=0.05,
+                       supervise_interval=0.01, adapters=pool)
+    eng.warmup()
+    _san_mark_warm()   # adapter churn + param mixes must never retrace
+    sampled_sp = dict(temperature=0.8, top_k=12, seed=77)
+    # (seed, adapter, sampling) per live sequence: tenants t0/t1 mixed
+    # with the base model and one seeded sampled request in ONE batch
+    live = [(1, None, None), (2, "t0", None), (3, "t1", None),
+            (4, "t0", None), (5, "t1", SamplingParams(**sampled_sp))]
+    try:
+        # solo references through the SAME warm engine — the bit-identity
+        # yardstick (t2 serves one solo request so it is resident-idle,
+        # the LRU eviction target, when the race begins)
+        refs = {}
+        for seed, adapter, sp in live:
+            refs[seed] = eng.generate(
+                prompts[seed], 12, adapter=adapter,
+                sampling=None if sp is None else
+                SamplingParams(**sampled_sp))
+        t2_ref = eng.generate(prompts[6], 8, adapter="t2")
+        t0_old_ref = eng.generate(prompts[6], 8, adapter="t0")
+        streams = {seed: eng.submit(prompts[seed], 12, adapter=adapter,
+                                    sampling=sp)
+                   for seed, adapter, sp in live}
+        for seed, s in streams.items():
+            first = next(iter(s))
+            if first != refs[seed][0]:
+                bad.append(f"[{phase}] sequence {seed} first token "
+                           f"{first} != solo ref {refs[seed][0]}")
+        # -- the race: pool churn against the live mixed batch ----------
+        # (1) hot reload t0 in place: referenced -> fresh slot, old slot
+        # anonymized; in-flight t0 holders keep the OLD generation
+        new_t0 = _adapter_weights(pool, 300)
+        pool.load("t0", new_t0)
+        # (2) fresh tenant: no free slot left -> LRU-evicts idle t2
+        pool.load("t3", _adapter_weights(pool, 301))
+        # (3) the evicted tenant fails typed at admission
+        try:
+            eng.submit(prompts[6], 4, adapter="t2")
+            bad.append(f"[{phase}] submit for the evicted adapter t2 "
+                       f"did not raise AdapterNotLoaded")
+        except AdapterNotLoaded:
+            pass
+        # (4) unloading a referenced adapter is refused loud
+        try:
+            pool.unload("t1")
+            bad.append(f"[{phase}] unload of the referenced adapter t1 "
+                       f"was not refused")
+        except ValueError as e:
+            if "referenced" not in str(e):
+                bad.append(f"[{phase}] referenced-unload refusal lost "
+                           f"its diagnosis: {e}")
+        # (5) a NEW t0 request decodes under the reloaded weights while
+        # the old-generation holders are still live
+        post_swap = eng.generate(prompts[6], 8, adapter="t0")
+        for seed, s in streams.items():
+            try:
+                toks = s.result()
+            except BaseException as e:  # noqa: BLE001 — any failure =
+                bad.append(f"[{phase}] sequence {seed} failed under "
+                           f"adapter churn: {type(e).__name__}: {e}")
+                continue
+            if toks != refs[seed]:
+                bad.append(f"[{phase}] survivor {seed} diverged from its "
+                           f"solo reference under churn: {toks} vs "
+                           f"{refs[seed]}")
+        # the post-swap t0 output must reproduce solo-under-new-weights
+        # (deterministic) and must actually reflect the NEW generation
+        if post_swap != eng.generate(prompts[6], 8, adapter="t0"):
+            bad.append(f"[{phase}] post-swap t0 decode is not "
+                       f"deterministic")
+        if post_swap == t0_old_ref:
+            bad.append(f"[{phase}] reloaded t0 weights never took "
+                       f"effect (old-generation == new-generation "
+                       f"outputs: {post_swap})")
+        # evict -> hot-load round-trip: re-loading the evicted tenant's
+        # weights must reproduce its pre-eviction output bit-exactly
+        pool.load("t2", _adapter_weights(pool, 202))
+        if eng.generate(prompts[6], 8, adapter="t2") != t2_ref:
+            bad.append(f"[{phase}] re-loaded t2 diverged from its "
+                       f"pre-eviction output")
+        st = eng.stats()
+        ast = st["adapters"]
+        if ast["evictions"] < 1:
+            bad.append(f"[{phase}] LRU eviction never fired: {ast}")
+        if ast["swaps"] < 1:
+            bad.append(f"[{phase}] generation-stamped reload never "
+                       f"swapped: {ast}")
+        if ast["refs"] != 0 or ast["pinned_anonymous"] != 0:
+            bad.append(f"[{phase}] ADAPTER REFCOUNT LEAK after drain: "
+                       f"{ast}")
+        if st["sampled"] < 1:
+            bad.append(f"[{phase}] the sampled lane never ran: {st}")
+        lhs = st["admitted"]
+        rhs = (st["completed"] + st["failed"] + st["timed_out"]
+               + st["cancelled"])
+        if lhs != rhs or st["active"] or st["waiting"]:
+            bad.append(f"[{phase}] engine conservation violated: "
+                       f"admitted={lhs} != {rhs}")
+    finally:
+        drained = eng.shutdown(drain_timeout=10.0)
+    if not drained:
+        bad.append(f"[{phase}] engine failed to drain")
+    bs = eng.stats()["blocks"]
+    if bs["allocated"] != 0 or bs["free"] + bs["reserved"] != bs["total"]:
+        bad.append(f"[{phase}] BLOCK LEAK: {bs}")
+    if bs["allocs"] != bs["frees"]:
+        bad.append(f"[{phase}] alloc/free imbalance: {bs}")
+    if verbose:
+        tag = "FAIL" if bad else "ok"
+        ast = eng.stats()["adapters"]
+        print(f"  {phase:<13} -> {tag}  (loads={ast['loads']}, "
+              f"evictions={ast['evictions']}, swaps={ast['swaps']}, "
+              f"hits={ast['hits']}, occupancy={ast['occupancy']:.2f}, "
               f"peak_blocks={bs['peak_allocated']}, "
               f"{time.monotonic() - t0:.1f}s)")
     return bad
@@ -1667,13 +1831,16 @@ def main(argv=None):
             # reference engine compiles each bucket once, later phases
             # disk-hit (warm-start reuse is ALSO under test here)
             dmodel = _decode_model()
-            if [p for p in decode_phases if p != "decode-cow"]:
+            if [p for p in decode_phases
+                    if p not in ("decode-cow", "decode-adapter")]:
                 _decode_references(dmodel)
             for phase in decode_phases:
                 if phase == "decode-cow":
                     violations += run_decode_cow_phase(phase, dmodel)
                 elif phase == "decode-spec":
                     violations += run_decode_spec_phase(phase, dmodel)
+                elif phase == "decode-adapter":
+                    violations += run_decode_adapter_phase(phase, dmodel)
                 else:
                     violations += run_decode_phase(phase, dmodel)
         if router_phases:
@@ -1858,6 +2025,10 @@ def main(argv=None):
             # router phases run real decode engines inside each replica,
             # so they put the same locks on the live path
             expected_locks |= {"decode.engine", "decode.block_pool"}
+        if "decode-adapter" in phases:
+            # the adapter pool's named lock joins the decode dispatch
+            # path: same 0-cycles / 0-held-across-dispatch bar
+            expected_locks |= {"decode.adapter_pool"}
         if any(p.startswith("router-") for p in phases):
             # the distributed tier's named locks: the same 0-cycles /
             # 0-held-across-dispatch assertions cover the router's

@@ -48,7 +48,7 @@ if REPO not in sys.path:
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 DEFAULT_BASELINE = os.path.join(REPO, ".tpu_san_baseline.json")
-SMOKES = ("engine", "serving")
+SMOKES = ("engine", "serving", "decode")
 
 USAGE_ERROR, NEW_FINDINGS, CLEAN = 2, 1, 0
 
@@ -121,6 +121,64 @@ def _smoke_serving():
         pool.shutdown(drain_timeout=5.0)
 
 
+def _smoke_decode():
+    """Multi-tenant decode hot path: warm every bucket, arm the retrace
+    sentinel, then sweep a MIXED-adapter + MIXED-sampling warm batch
+    through the one set of compiled step executables — adapter ids and
+    sampling params are per-sequence VALUES, so no mix may ever trace
+    again (the zero-post-warmup-retraces contract of
+    docs/llm_serving.md)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import runtime_san
+    from paddle_tpu.inference import (AdapterPool, DecodeEngine,
+                                      SamplingParams)
+    from paddle_tpu.models import gpt
+
+    paddle.seed(7)
+    model = gpt("gpt_tiny", vocab_size=97, hidden_size=32, num_heads=4,
+                num_kv_heads=2, num_layers=1, rope=True, swiglu=True,
+                rms_norm=True, max_position_embeddings=64,
+                tie_word_embeddings=False)
+    model.eval()
+    pool = AdapterPool(model, rank=2, slots=3)
+    rng = np.random.RandomState(0)
+    for nm in ("a", "b"):
+        pool.load(nm, {ln: (rng.normal(0, 0.05, a.shape[1:])
+                            .astype(np.float32),
+                            rng.normal(0, 0.05, b.shape[1:])
+                            .astype(np.float32))
+                       for ln, (a, b) in pool.stacks().items()})
+    eng = DecodeEngine(model, max_length=24, block_size=8,
+                       decode_buckets=(1, 2, 4), prefill_buckets=(8,),
+                       prefix_cache=False, default_timeout=30.0,
+                       adapters=pool)
+    try:
+        eng.warmup()
+        runtime_san.mark_warm()
+        prompts = [rng.randint(0, 97, (5,)).astype(np.int32)
+                   for _ in range(4)]
+        mixes = [(None, None),
+                 ("a", None),
+                 ("b", SamplingParams(temperature=0.8, top_k=8, seed=1)),
+                 ("a", SamplingParams(temperature=1.1, top_p=0.9,
+                                      repetition_penalty=1.2, seed=2))]
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(4) as ex:
+            list(ex.map(
+                lambda i: eng.generate(prompts[i], 6,
+                                       adapter=mixes[i][0],
+                                       sampling=mixes[i][1]),
+                range(4)))
+        # a CHANGED mix over the same buckets: values only, no retrace
+        for i in range(4):
+            eng.generate(prompts[i], 4, adapter=mixes[3 - i][0],
+                         sampling=mixes[3 - i][1])
+    finally:
+        eng.shutdown(drain_timeout=5.0)
+
+
 def run_smokes(names):
     """Run the selected workloads with the sanitizer live; returns the
     (counts, report) pair recorded across them."""
@@ -129,7 +187,8 @@ def run_smokes(names):
     runtime_san.enable()
     runtime_san.reset()
     for name in names:
-        {"engine": _smoke_engine, "serving": _smoke_serving}[name]()
+        {"engine": _smoke_engine, "serving": _smoke_serving,
+         "decode": _smoke_decode}[name]()
     return runtime_san.counts_by_key(), runtime_san.report()
 
 

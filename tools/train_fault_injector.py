@@ -78,6 +78,7 @@ from paddle_tpu.io import DataLoader, TensorDataset
 root, phase, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
 EPOCHS, SPE, CKPT_EVERY = 2, 6, 4
 KILL_AT, NAN_AT, WEDGE_AT, WEDGE_EXIT = 7, 5, 9, 86
+SIGTERM_AFTER = 5   # keep in sync with the driver's SIGTERM_AFTER
 
 marker = os.path.join(root, "incarnation")
 inc = int(open(marker).read()) + 1 if os.path.exists(marker) else 0
@@ -160,6 +161,15 @@ for epoch in range(gstep // SPE, EPOCHS):
         if gstep % CKPT_EVERY == 0:
             mgr.save(eng.state_dict(), step=gstep,
                      extra={"data": data_state(epoch, gstep)})
+        if phase == "sigterm" and inc == 0 and gstep == SIGTERM_AFTER:
+            # hold here until the parent's SIGTERM lands: a fast child
+            # can otherwise finish the run (and uninstall the handler)
+            # before the parent has even seen enough loss lines to pull
+            # the trigger — the signal then kills it raw (-15)
+            deadline = time.monotonic() + 60
+            while not pre.preempted() and time.monotonic() < deadline:
+                wd.beat(gstep)
+                time.sleep(0.05)
         if pre.preempted():
             def dump_exit(code):
                 with open(os.path.join(root, "preempt.json"), "w") as f:
@@ -203,6 +213,13 @@ def spawn_child(phase, root, port):
             f.write(_CHILD)
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
                PADDLE_TPU_SAN="1")
+    # the tier-1 suite exports an 8-virtual-device mesh (conftest.py)
+    # which the child's parallelize() would adopt — dp=8 cannot shard
+    # the 4-row batches and the whole job is single-host/single-device
+    # by design, so strip the flag instead of inheriting it
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
     return subprocess.Popen(
         [sys.executable, child, root, phase, str(port)], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
@@ -250,8 +267,8 @@ def drive_phase(phase, workdir, store):
         rcs.append(proc.returncode)
         if proc.returncode == 0:
             break
-        if phase == "none" or inc > 0 or \
-                not expect_mid[phase](proc.returncode):
+        expected = expect_mid.get(phase, lambda rc: False)
+        if phase == "none" or inc > 0 or not expected(proc.returncode):
             return [f"[{phase}] incarnation {inc} exited "
                     f"{proc.returncode} (rcs={rcs}): {stderr[-2000:]}"], \
                 {}, {}
@@ -348,9 +365,16 @@ def main(argv=None):
         store = create_master_store(port=0)
         print("training fault injection (self-healing invariant):")
         lock = new_lock("tools.train_fault_injector.results")
+        # phase concurrency sized to the box: each phase time-slices a
+        # full child process, and on a starved core the children blow
+        # their wall-clock budgets (the 8s watchdog fires in non-wedge
+        # phases) — run sequentially when there is nothing to overlap on
+        max_conc = min(len(phases), max(1, (os.cpu_count() or 1) - 1))
+        gate = threading.BoundedSemaphore(max_conc)
 
         def run(phase):
-            out = drive_phase(phase, workdir, store)
+            with gate:
+                out = drive_phase(phase, workdir, store)
             with lock:
                 results[phase] = out
                 print(f"  {phase:<8} -> "
