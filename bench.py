@@ -1899,8 +1899,194 @@ def bench_gpt(on_tpu, dev):
     }
 
 
+LONGCTX_BASELINE_FILENAME = "LONGCTX_BASELINE.json"
+
+
+def _longctx_objectives(on_tpu):
+    """Declared ratchet objectives for the long-context serving row:
+    chunked-prefill TTFT must not grow, decode tokens/sec must not drop.
+    CPU smoke bounds are generous (machine variance); TPU rows ratchet
+    independently under their own names."""
+    from paddle_tpu.obs.slo import Objective
+
+    pre = "tpu" if on_tpu else "cpu"
+    return [
+        Objective(f"longctx.{pre}_ttft_ms", "max",
+                  description="long-prompt CP chunked-prefill time to "
+                              "first token",
+                  unit="ms", slack=3.0),
+        Objective(f"longctx.{pre}_tokens_per_sec", "min",
+                  description="decode tokens/sec after a long-prompt CP "
+                              "chunked prefill",
+                  unit="tok/s", slack=3.0),
+    ]
+
+
+def _longctx_gate(on_tpu, ttft_ms, tps):
+    """vs_baseline ratchet for BENCH_LONGCTX (mirrors the conv gate):
+    evaluated against the checked-in LONGCTX_BASELINE.json bounds; a
+    breach beyond the slack fails the bench like a correctness bug
+    (e.g. the prefill chunks falling off the cp-sharded executable and
+    recompiling, or the ring schedule degenerating to a serial gather).
+    BENCH_LONGCTX_WRITE=1 re-ratchets this platform's rows (merging)."""
+    from paddle_tpu.obs import slo as slo_mod
+
+    objectives = _longctx_objectives(on_tpu)
+    values = {objectives[0].name: ttft_ms, objectives[1].name: tps}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        LONGCTX_BASELINE_FILENAME)
+    try:
+        entries = slo_mod.load_baseline(path)
+    except FileNotFoundError:
+        entries = {}
+
+    if os.environ.get("BENCH_LONGCTX_WRITE") == "1":
+        entries = slo_mod.write_baseline(
+            path, values, objectives,
+            note="long-context serving ratchet bounds (ISSUE 19); "
+                 "re-ratchet with BENCH_LONGCTX_WRITE=1 only for an "
+                 "intentional, explained perf change",
+            merge=entries)
+        print(f"longctx gate: ratcheted {[o.name for o in objectives]} "
+              f"-> {path}", file=sys.stderr)
+
+    missing = [o.name for o in objectives if o.name not in entries]
+    if missing:
+        print(f"longctx gate: no ratcheted bound yet for {missing} on "
+              f"this platform — BENCH_LONGCTX_WRITE=1 ratchets; gate "
+              f"skipped", file=sys.stderr)
+        return True
+    report = slo_mod.evaluate(values, entries, objectives)
+    print(slo_mod.format_report(report), file=sys.stderr)
+    return report["ok"]
+
+
+def bench_longctx(on_tpu, dev):
+    """BENCH_LONGCTX=1: long-context serving row — TTFT and decode
+    tokens/sec at long prompt lengths through the DecodeEngine's
+    context-parallel chunked prefill (prefill token buffer sequence-
+    sharded along the mesh `cp` axis; each absolute-boundary chunk is
+    one ring-scheduled unit, docs/long_context.md). The CPU smoke runs
+    the tiny rope/GQA/swiglu GPT on the 8-virtual-device mesh with
+    MeshConfig(cp=4) and cross-checks the cp output bit-identical to
+    the single-device engine; TPU rows ratchet under their own
+    objective names. Gated against LONGCTX_BASELINE.json."""
+    import tempfile
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import DecodeEngine
+    from paddle_tpu.models import gpt
+    from paddle_tpu.sharding import MeshConfig
+
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN",
+                                    "3072" if on_tpu else "96"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS",
+                                    "64" if on_tpu else "8"))
+    cp = int(os.environ.get("BENCH_CP", "4"))
+    chunk = int(os.environ.get("BENCH_PREFILL_CHUNK",
+                               "512" if on_tpu else "32"))
+    max_len = prompt_len + new_tokens + 8
+
+    mesh = None
+    if cp > 1 and jax.device_count() >= cp:
+        mesh = MeshConfig(cp=cp).build()
+    elif cp > 1:
+        print(f"bench_longctx: {jax.device_count()} device(s) < cp={cp}; "
+              f"running unsharded", file=sys.stderr)
+        cp = 1
+
+    with tempfile.TemporaryDirectory(prefix="bench-longctx-") as workdir:
+        os.environ.setdefault("PADDLE_TPU_COMPILE_CACHE",
+                              os.path.join(workdir, "compile-cache"))
+
+        def build_model():
+            paddle.seed(7)
+            name = os.environ.get("BENCH_MODEL",
+                                  "gpt_base" if on_tpu else "")
+            if name:
+                m = gpt(name, max_position_embeddings=max(max_len, 64))
+            else:
+                m = gpt("gpt_tiny", vocab_size=97, hidden_size=48,
+                        num_heads=4, num_kv_heads=2, num_layers=2,
+                        rope=True, swiglu=True, rms_norm=True,
+                        max_position_embeddings=max_len,
+                        tie_word_embeddings=False)
+            m.eval()
+            return m
+
+        model = build_model()
+        vocab = model.cfg.vocab_size
+        prompt = np.random.RandomState(0).randint(
+            1, vocab - 1, (prompt_len,)).astype(np.int32)
+        # the largest bucket admits the full prompt (max_prompt is bucket-
+        # capped even when chunking); the chunk bucket does the work —
+        # every dispatched chunk is `chunk` long, cp | chunk
+        geo = dict(max_length=max_len, block_size=8,
+                   decode_buckets=(1,),
+                   prefill_buckets=tuple(sorted({chunk, prompt_len})),
+                   prefill_chunk=chunk, default_timeout=600.0)
+
+        bit_identical = None
+        if not on_tpu:
+            ref_eng = DecodeEngine(build_model(), **geo)
+            try:
+                ref_toks = ref_eng.generate(prompt, new_tokens,
+                                            timeout=600.0)
+            finally:
+                ref_eng.shutdown()
+
+        eng = DecodeEngine(model, **geo, mesh=mesh)
+        try:
+            eng.warmup()
+            toks = eng.generate(prompt, new_tokens, timeout=600.0)
+            if not on_tpu:
+                bit_identical = (toks == ref_toks)
+
+            def best_of(n, fn):
+                best = float("inf")
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    fn()
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            ttft_s = _retry_transient(
+                lambda: best_of(3, lambda: eng.generate(
+                    prompt, 1, timeout=600.0)),
+                label="longctx ttft")
+            full_s = _retry_transient(
+                lambda: best_of(3, lambda: eng.generate(
+                    prompt, new_tokens, timeout=600.0)),
+                label="longctx decode")
+        finally:
+            eng.shutdown()
+
+    ttft_ms = ttft_s * 1e3
+    tps = new_tokens / full_s
+    ok = _longctx_gate(on_tpu, ttft_ms, tps)
+    if bit_identical is False:
+        print("bench_longctx: CP output DIVERGED from single-device "
+              "engine", file=sys.stderr)
+        ok = False
+    payload = _emit({
+        "metric": f"long-context decode tokens/sec (prompt={prompt_len}, "
+                  f"cp={cp}, chunked prefill x{-(-prompt_len // chunk)})",
+        "value": round(tps, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "extra": {"ttft_ms": round(ttft_ms, 1), "prompt_len": prompt_len,
+                  "new_tokens": new_tokens, "cp": cp,
+                  "prefill_chunk": chunk,
+                  "bit_identical_vs_single_device": bit_identical,
+                  "platform": dev.platform},
+    })
+    return payload if ok else None
+
+
 def main():
-    if os.environ.get("BENCH_POD") == "1" and \
+    if (os.environ.get("BENCH_POD") == "1"
+            or os.environ.get("BENCH_LONGCTX") == "1") and \
             "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
         # the pod gate's CPU smoke needs the 8-virtual-device mesh, and
         # the flag must land BEFORE jax initializes its backend
@@ -1934,6 +2120,12 @@ def main():
         # continuous-batching decode mode: tokens/sec + TTFT, iteration-
         # level engine vs request-level batching (gate >= 1.5x at c >= 8)
         return 0 if bench_decode(on_tpu, dev) else 1
+
+    if os.environ.get("BENCH_LONGCTX") == "1":
+        # long-context serving: TTFT + tokens/sec at long prompt lengths
+        # through the cp-sharded chunked prefill, ratcheted against the
+        # checked-in LONGCTX_BASELINE.json
+        return 0 if bench_longctx(on_tpu, dev) else 1
 
     if "--model" in sys.argv:
         i = sys.argv.index("--model")

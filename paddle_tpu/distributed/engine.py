@@ -259,13 +259,22 @@ class ShardedTrainStep:
     # ------------------------------------------------------------------
     def _cp_guard(self):
         """Context manager enabling context-parallel attention during trace
-        (no-op when sep == 1 or context_parallel=None)."""
+        (no-op when the mesh has no sequence axis > 1 or
+        context_parallel=None). The sequence axis is resolved through the
+        AxisRules "seq" entries, so "sep" (hybrid topology) and "cp"
+        (MeshConfig) meshes both route without engine-side special
+        cases."""
         import contextlib
-        if not self.context_parallel or \
-                dict(self.mesh.shape).get("sep", 1) <= 1:
+
+        from ..sharding import resolve_axis
+        if not self.context_parallel:
+            return contextlib.nullcontext()
+        seq_axis = resolve_axis("seq", mesh=self.mesh)
+        if not isinstance(seq_axis, str):
             return contextlib.nullcontext()
         from .context_parallel import context_parallel_guard
-        return context_parallel_guard(self.mesh, mode=self.context_parallel)
+        return context_parallel_guard(self.mesh, mode=self.context_parallel,
+                                      seq_axis=seq_axis)
 
     # ---- cached placement helpers (shared by train/eval/prefetch) -----
     def _batch_sharding(self, ndim):

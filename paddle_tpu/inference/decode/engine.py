@@ -1106,8 +1106,9 @@ class DecodeEngine:
             pv_sh, bv_sh, pool_sh, repl = sh
             ats_sh = jax.tree_util.tree_map(lambda _: repl, ats_avals)
             samp_sh = jax.tree_util.tree_map(lambda _: repl, samp_avals)
-            in_sh = (pv_sh, bv_sh, ats_sh, pool_sh, repl, repl, repl,
-                     repl, repl, repl, samp_sh)
+            in_sh = (pv_sh, bv_sh, ats_sh, pool_sh,
+                     self._prefill_tokens_sharding(pbucket, repl),
+                     repl, repl, repl, repl, repl, samp_sh)
             out_sh = (pool_sh, repl)
         compiled, source = aot.compile_jit(
             prefill, avals, fingerprint=self._fingerprint,
@@ -1117,6 +1118,27 @@ class DecodeEngine:
         self._note_compile(source)
         self._prefill_fns[pbucket] = compiled
         return compiled
+
+    def _prefill_tokens_sharding(self, pbucket, repl):
+        """Sharding for the prefill token buffer [1, pbucket].
+
+        On a mesh with a `cp` axis, prefill tokens are sequence-sharded
+        along `cp` so GSPMD partitions the chunk's forward pass across the
+        context-parallel group — each device computes a slice of the query
+        rows against the (replicated) gathered cache, which is exactly the
+        ring schedule's per-device workload for one absolute-boundary
+        chunk. Cache pool and outputs stay replicated over `cp`, so the
+        scatter-back and sampled token are bit-identical to the
+        single-device prefill. Buckets that don't divide evenly fall back
+        to replicated tokens (no partial-shard padding ambiguity)."""
+        if self.mesh is None:
+            return repl
+        cp = dict(self.mesh.shape).get("cp", 1)
+        if cp > 1 and pbucket % cp == 0:
+            from ... import sharding as _shardlib
+
+            return _shardlib.named_sharding(self.mesh, (None, "cp"))
+        return repl
 
     def _audit_ctx(self, pv):
         """Graph-auditor context for the step executables: on a TP mesh

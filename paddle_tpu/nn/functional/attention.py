@@ -62,7 +62,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         mesh, mode, seq_axis = cp
         if dropout_p > 0.0 and training:
             raise NotImplementedError(
-                "context-parallel attention (sep-axis "
+                f"context-parallel attention ({seq_axis}-axis "
                 f"{mode}) does not support attention-probability dropout; "
                 "set attention dropout to 0 (residual/hidden dropout is "
                 "unaffected) or disable context_parallel")

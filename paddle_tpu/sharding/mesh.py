@@ -48,34 +48,39 @@ class MeshConfig:
     dp: int = 1
     fsdp: int = 1
     tp: int = 1
+    #: context-parallel (sequence) axis for ring attention; appended after
+    #: "tp" only when != 1, so cp=1 configs build the exact pre-cp mesh
+    cp: int = 1
     dcn_dp: int = 1
     #: extra named axes appended after "tp" (e.g. {"sep": 2}); sizes > 0
     extra: dict = field(default_factory=dict)
 
     def __post_init__(self):
-        sizes = [self.dp, self.fsdp, self.tp]
+        sizes = [self.dp, self.fsdp, self.tp, self.cp]
         if sum(1 for s in sizes if s == -1) > 1:
             raise ValueError(
-                f"at most one of dp/fsdp/tp may be -1, got {sizes}")
+                f"at most one of dp/fsdp/tp/cp may be -1, got {sizes}")
         for s in sizes + [self.dcn_dp] + list(self.extra.values()):
             if s != -1 and s < 1:
                 raise ValueError(
                     f"axis sizes must be positive (or -1 to absorb), "
                     f"got dp={self.dp} fsdp={self.fsdp} tp={self.tp} "
-                    f"dcn_dp={self.dcn_dp} extra={self.extra}")
+                    f"cp={self.cp} dcn_dp={self.dcn_dp} extra={self.extra}")
         for name in self.extra:
-            if name in AXES:
+            if name in AXES or name == "cp":
                 raise ValueError(f"extra axis {name!r} shadows a "
-                                 f"canonical axis {AXES}")
+                                 f"canonical axis {AXES + ('cp',)}")
 
     @property
     def axis_names(self):
-        return AXES + tuple(self.extra)
+        cp = ("cp",) if self.cp != 1 else ()
+        return AXES + cp + tuple(self.extra)
 
     def resolved_sizes(self, n_devices):
         """Axis sizes with -1 absorbed against `n_devices` (including the
         dcn_dp factor folded into dp)."""
         sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                 **({"cp": self.cp} if self.cp != 1 else {}),
                  **{k: int(v) for k, v in self.extra.items()}}
         fixed = self.dcn_dp
         for v in sizes.values():
@@ -95,7 +100,8 @@ class MeshConfig:
     def total_devices(self):
         """Devices implied by the config; -1 axes make this a minimum."""
         prod = self.dcn_dp
-        for v in (self.dp, self.fsdp, self.tp, *self.extra.values()):
+        for v in (self.dp, self.fsdp, self.tp, self.cp,
+                  *self.extra.values()):
             prod *= v if v != -1 else 1
         return prod
 
@@ -127,7 +133,7 @@ class MeshConfig:
                 raise ValueError(
                     f"bad mesh spec entry {part!r} in {spec!r} "
                     f"(expected axis=int, e.g. 'dp=2,fsdp=4')")
-            if key in AXES or key == "dcn_dp":
+            if key in AXES or key in ("cp", "dcn_dp"):
                 fields[key] = ival
             else:
                 extra[key] = ival
@@ -140,6 +146,8 @@ class MeshConfig:
         byte-stable for a given config (the launcher exports it as
         `PADDLE_TPU_MESH` so every host builds the identical mesh)."""
         parts = [f"dp={self.dp}", f"fsdp={self.fsdp}", f"tp={self.tp}"]
+        if self.cp != 1:
+            parts.append(f"cp={self.cp}")
         if self.dcn_dp != 1:
             parts.append(f"dcn_dp={self.dcn_dp}")
         parts.extend(f"{k}={int(v)}" for k, v in sorted(self.extra.items()))
@@ -209,7 +217,8 @@ def build_mesh(config: MeshConfig, devices=None):
     return Mesh(np.asarray(devices).reshape(shape), names)
 
 
-def cpu_mesh(tp=None, dp=1, fsdp=1):
+def cpu_mesh(tp=None, dp=1, fsdp=1, cp=1):
     """The tier-1 convenience: a TP-major mesh over however many virtual
     host devices XLA exposes (tp=-1 absorbs by default)."""
-    return MeshConfig(dp=dp, fsdp=fsdp, tp=-1 if tp is None else tp).build()
+    return MeshConfig(dp=dp, fsdp=fsdp, tp=-1 if tp is None else tp,
+                      cp=cp).build()

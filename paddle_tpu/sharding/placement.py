@@ -45,14 +45,18 @@ def default_batch_spec(mesh) -> PartitionSpec:
     """The engine's default batch layout: dim0 over the fused data axes
     (dp+fsdp on MeshConfig meshes, dp+sharding on the hybrid topology —
     the reference fuses them for grad sync, topology.py:228), dim1 over
-    sep when in use. Tolerates meshes missing axes."""
+    the sequence axis ("sep" on the hybrid topology, "cp" on MeshConfig
+    context-parallel meshes) when in use. Tolerates meshes missing
+    axes."""
     axes = dict(mesh.shape)
     entries = []
     data = tuple(a for a in ("dp", "fsdp", "sharding") if a in axes)
     if data:
         entries.append(data)
-    if axes.get("sep", 1) > 1:
-        entries.append("sep")
+    for seq_axis in ("sep", "cp"):
+        if axes.get(seq_axis, 1) > 1:
+            entries.append(seq_axis)
+            break
     return PartitionSpec(*entries)
 
 

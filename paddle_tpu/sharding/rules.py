@@ -74,6 +74,7 @@ DEFAULT_RULES = AxisRules((
     ("batch",  ("dp", "sharding")),
     ("batch",  "dp"),
     ("seq",    "sep"),
+    ("seq",    "cp"),
     ("heads",  "tp"),
     ("heads",  "mp"),
     ("kv",     "tp"),

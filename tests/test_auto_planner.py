@@ -83,6 +83,7 @@ def _tuned_setup(model_name, bs, seq):
     return model, opt, sample_batch
 
 
+@pytest.mark.slow   # compiles+times top-k candidates twice: ~23s on CI
 def test_tuner_measures_and_picks_fastest():
     """VERDICT r3 item 5: compile+time top-k candidates on the virtual
     8-device mesh; winner must be the measured-fastest and at least as fast

@@ -221,6 +221,41 @@ class TestMeshConfig:
         with pytest.raises(ValueError):
             MeshConfig.parse("dp=-1,tp=-1")
 
+    def test_cp_axis_build_parse_roundtrip(self):
+        cfg = MeshConfig(dp=2, cp=4)
+        mesh = cfg.build()
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "tp": 1, "cp": 4}
+        assert cfg.to_env() == "dp=2,fsdp=1,tp=1,cp=4"
+        assert MeshConfig.parse(cfg.to_env()) == cfg
+        # `seq` resolves to the cp axis; batch specs seq-shard dim 1
+        assert logical_to_spec(("batch", "seq"), mesh=mesh) == \
+            pspec("dp", "cp")
+        from paddle_tpu.sharding import default_batch_spec
+        assert default_batch_spec(mesh) == pspec(("dp", "fsdp"), "cp")
+
+    def test_cp_one_degrades_to_exact_pre_cp_placement(self):
+        """cp=1 must be byte-identical to a config that never heard of
+        cp: same axis names, same env serialization, same resolved
+        specs — older launch payloads and checkpoints keep working."""
+        cfg = MeshConfig(dp=2, tp=4)
+        cp1 = MeshConfig(dp=2, tp=4, cp=1)
+        assert cp1 == cfg
+        assert cp1.axis_names == ("dp", "fsdp", "tp")
+        assert cp1.to_env() == "dp=2,fsdp=1,tp=4"
+        mesh = cp1.build()
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 1, "tp": 4}
+        # no trivial-cp entry leaks into resolution
+        assert logical_to_spec(("batch", "seq"), mesh=mesh) == \
+            pspec("dp", None)
+        from paddle_tpu.sharding import default_batch_spec
+        assert default_batch_spec(mesh) == pspec(("dp", "fsdp"))
+
+    def test_seq_prefers_sep_over_cp(self):
+        """First-match: an explicit sep axis wins `seq` even when cp is
+        also on the mesh (sep = legacy Ulysses axis, cp = ring axis)."""
+        mesh = MeshConfig.parse("dp=2,cp=2,sep=2").build()
+        assert logical_to_spec(("seq",), mesh=mesh) == pspec("sep")
+
     def test_mesh_env_installs_global_topology(self, monkeypatch):
         """PADDLE_TPU_MESH (the launcher --mesh payload) -> every worker
         installs the identical declarative mesh in init_parallel_env's
@@ -561,6 +596,101 @@ class TestDecodeEngineTP:
                 eng.shutdown()
         finally:
             os.environ.pop("PADDLE_TPU_COMPILE_CACHE", None)
+
+
+# ---------------------------------------------------------------------------
+# decode-engine context-parallel chunked prefill
+# ---------------------------------------------------------------------------
+
+class TestDecodeEngineCP:
+    def test_cp_chunked_prefill_bit_identical_no_retrace(self, tmp_path):
+        """Context-parallel chunked prefill: on a MeshConfig(cp=4) mesh
+        the prefill token buffer is sequence-sharded along `cp` (each
+        device computes one slice of the chunk's query rows — the ring
+        schedule's per-device workload), while the cache pool and
+        sampled token stay replicated. Output must be bit-identical to
+        the single-device chunked prefill, with ZERO post-warmup
+        retraces (tpu-san sentinel live)."""
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.inference.decode import DecodeEngine
+        from paddle_tpu.analysis import runtime_san
+
+        os.environ["PADDLE_TPU_COMPILE_CACHE"] = str(tmp_path / "cache")
+        try:
+            cfg = dict(vocab_size=97, hidden_size=48, num_heads=4,
+                       num_kv_heads=2, num_layers=2, rope=True,
+                       swiglu=True, rms_norm=True,
+                       max_position_embeddings=64,
+                       tie_word_embeddings=False)
+            geo = dict(max_length=48, block_size=8, decode_buckets=(1,),
+                       prefill_buckets=(8, 16, 24), prefill_chunk=8,
+                       default_timeout=120.0)
+            # 7 = monolithic bucket-8 prefill; 19/23 chunk at absolute
+            # boundaries 8/16 — the units of cp ring scheduling
+            prompts = [np.random.RandomState(s).randint(
+                1, 96, size=n).astype(np.int32)
+                for s, n in ((0, 7), (1, 19), (2, 23))]
+
+            paddle.seed(7)
+            m = gpt("gpt_tiny", **cfg)
+            ref_eng = DecodeEngine(m, **geo)
+            try:
+                refs = [ref_eng.generate(p, 5, timeout=120.0)
+                        for p in prompts]
+            finally:
+                ref_eng.shutdown()
+
+            paddle.seed(7)
+            m2 = gpt("gpt_tiny", **cfg)
+            eng = DecodeEngine(m2, **geo, mesh=MeshConfig(cp=4).build())
+            try:
+                # every prefill bucket divides cp=4: tokens seq-sharded
+                repl = eng._step_shardings()[3]
+                for p in (8, 16, 24):
+                    assert eng._prefill_tokens_sharding(p, repl).spec \
+                        == pspec(None, "cp")
+                eng.warmup()
+                was = runtime_san.enabled()
+                runtime_san.enable()
+                runtime_san.reset()
+                runtime_san.mark_warm()
+                try:
+                    got = [eng.generate(p, 5, timeout=120.0)
+                           for p in prompts]
+                    assert runtime_san.counts_by_key() == {}, \
+                        runtime_san.counts_by_key()
+                finally:
+                    runtime_san.reset()
+                    if not was:
+                        runtime_san.disable()
+                assert got == refs
+            finally:
+                eng.shutdown()
+        finally:
+            os.environ.pop("PADDLE_TPU_COMPILE_CACHE", None)
+
+    def test_cp_indivisible_bucket_falls_back_replicated(self):
+        """A prefill bucket the cp group can't split evenly keeps
+        replicated tokens — correctness over partial-shard padding."""
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.inference.decode import DecodeEngine
+
+        paddle.seed(7)
+        m = gpt("gpt_tiny", vocab_size=97, hidden_size=48, num_heads=4,
+                num_kv_heads=2, num_layers=2, rope=True, swiglu=True,
+                rms_norm=True, max_position_embeddings=64,
+                tie_word_embeddings=False)
+        eng = DecodeEngine(m, max_length=32, block_size=8,
+                           decode_buckets=(1,), prefill_buckets=(8,),
+                           default_timeout=120.0,
+                           mesh=MeshConfig(cp=4).build())
+        try:
+            repl = eng._step_shardings()[3]
+            assert eng._prefill_tokens_sharding(6, repl) is repl
+            assert eng._prefill_tokens_sharding(8, repl).spec \
+                == pspec(None, "cp")
+        finally:
+            eng.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -22,9 +22,14 @@ def test_vision_models_surface_complete():
     assert not missing, missing
 
 
+# tier-1 budget: the two heaviest compiles (densenet's 121-layer graph
+# ~60s, mobilenet_v3's SE blocks ~22s on the 1-core CI box) ride the slow
+# lane; shufflenet/resnext keep the zoo fwd+grad contract in tier-1
 @pytest.mark.parametrize("factory,size", [
-    ("densenet121", 64), ("shufflenet_v2_x0_5", 64),
-    ("mobilenet_v3_small", 64), ("resnext50_32x4d", 64),
+    pytest.param("densenet121", 64, marks=pytest.mark.slow),
+    ("shufflenet_v2_x0_5", 64),
+    pytest.param("mobilenet_v3_small", 64, marks=pytest.mark.slow),
+    ("resnext50_32x4d", 64),
 ])
 def test_zoo_forward_and_grad(factory, size):
     paddle.seed(0)

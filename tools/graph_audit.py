@@ -59,7 +59,7 @@ if "--xla_force_host_platform_device_count" not in \
                                ).strip()
 
 DEFAULT_BASELINE = os.path.join(REPO, ".graphcheck_baseline.json")
-SMOKES = ("engine", "decode", "export")
+SMOKES = ("engine", "decode", "export", "longctx")
 
 USAGE_ERROR, NEW_FINDINGS, CLEAN = 2, 1, 0
 
@@ -136,6 +136,53 @@ def _smoke_decode():
         eng.shutdown(drain_timeout=30.0)
 
 
+def _smoke_longctx():
+    """Context-parallel ring attention entrypoints: a GPT train step on
+    the MeshConfig(cp=4) mesh (ring KV rotation inside the audited
+    engine.step — the `cp`-declared batch spec legitimizes the
+    ppermutes; a ring that accidentally all-gathered full KV on a
+    replicated placement would fire GC001) and the decode engine's
+    cp-sharded chunked prefill executables."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.engine import parallelize
+    from paddle_tpu.inference import DecodeEngine
+    from paddle_tpu.models import gpt
+    from paddle_tpu.sharding import MeshConfig
+
+    paddle.seed(0)
+    model = gpt("gpt_tiny", num_layers=2, num_heads=4, hidden_size=64,
+                dropout=0.0)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    eng = parallelize(model, opt, mesh=MeshConfig(cp=4).build(),
+                      context_parallel="ring")
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 256, (4, 32)).astype("int32"))
+    eng.train_batch(ids)
+    eng.eval_batch(ids)
+
+    paddle.seed(7)
+    m = gpt("gpt_tiny", vocab_size=97, hidden_size=48, num_heads=4,
+            num_kv_heads=2, num_layers=2, rope=True, swiglu=True,
+            rms_norm=True, max_position_embeddings=64,
+            tie_word_embeddings=False)
+    m.eval()
+    deng = DecodeEngine(m, max_length=48, block_size=8,
+                        decode_buckets=(1,), prefill_buckets=(8, 16, 24),
+                        prefill_chunk=8, default_timeout=120.0,
+                        mesh=MeshConfig(cp=4).build())
+    try:
+        deng.warmup()
+        list(deng.generate(
+            np.random.RandomState(1).randint(1, 96, 19).astype(np.int32),
+            max_new_tokens=4))
+    finally:
+        deng.shutdown(drain_timeout=30.0)
+
+
 def _smoke_export(workdir):
     """Export entrypoints: jit.save → load → direct call (aot.layer_call)
     and a batched AOT bucket executable (aot.batched)."""
@@ -168,7 +215,8 @@ def run_smokes(names, workdir):
         if name == "export":
             _smoke_export(workdir)
         else:
-            {"engine": _smoke_engine, "decode": _smoke_decode}[name]()
+            {"engine": _smoke_engine, "decode": _smoke_decode,
+             "longctx": _smoke_longctx}[name]()
     return (graphcheck.counts_by_key(), graphcheck.watermarks(),
             graphcheck.report())
 

@@ -94,6 +94,14 @@ fault-free solo run):
                  be BIT-EXACT vs solo same-adapter references, adapter
                  AND KV refcounts must conserve (zero pinned slots or
                  blocks after drain), with zero post-warmup retraces.
+  decode-cp-prefill
+                 CONTEXT-PARALLEL chunked prefill (prefill tokens
+                 sequence-sharded along the MeshConfig `cp` axis;
+                 docs/long_context.md) with the victim killed mid-ring
+                 on its SECOND chunk: exactly the victim fails typed,
+                 survivors stay bit-exact vs the single-device engine's
+                 solo references, the partially-prefilled blocks are
+                 reclaimed, zero post-warmup retraces.
 
 Router phases (`router-*`) run the DISTRIBUTED SERVING TIER
 (paddle_tpu/inference/router.py over replica.py, threads-as-replicas over
@@ -172,6 +180,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# 8 virtual devices (same as tests/conftest.py, which drives this file
+# as a tier-1 test): the decode-cp-prefill phase needs a cp=4 mesh
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 # Run the whole harness under the lock-order/race checker: every named
 # framework lock (serving.pool / serving.batcher / aot.* ...) is
 # instrumented, and the end of main() asserts no acquisition-order cycles
@@ -236,6 +251,7 @@ PHASES = ("crash", "hang", "poison", "corrupt", "none",
           "batch-crash", "batch-hang", "batch-poison",
           "decode-none", "decode-kill", "decode-wedge", "decode-poison",
           "decode-cow", "decode-spec", "decode-adapter",
+          "decode-cp-prefill",
           "router-none", "router-kill", "router-wedge",
           "router-swap", "router-swap-kill",
           "router-stream-kill", "router-stream-wedge",
@@ -727,6 +743,140 @@ def run_decode_phase(phase, model, verbose=True):
         print(f"  {phase:<13} -> {tag}  (injected={inj['injected']}, "
               f"steps={eng.stats()['steps']}, "
               f"wedged={eng.stats()['wedged_steps']}, "
+              f"peak_blocks={bs['peak_allocated']}, "
+              f"{time.monotonic() - t0:.1f}s)")
+    return bad
+
+
+CP_PREFILL_SEQS = ((41, 19, 6), (42, 7, 8), (43, 23, 5), (44, 21, 6))
+#                   (seed, prompt_len, max_new) — three of the four
+#                   prompts exceed prefill_chunk 8 and so chunk at the
+#                   absolute boundaries 8/16, the cp ring's scheduling
+#                   units; the 7-token row covers the monolithic path
+
+
+def _decode_cp_engine(model, mesh, fault_hook=None):
+    """CP chunked-prefill engine pair config: IDENTICAL geometry for the
+    MeshConfig(cp=4) engine and the single-device reference engine (only
+    `mesh` differs), so any token divergence isolates the cp sharding.
+    The geometry (incl. num_blocks) matches `_decode_cow_engine`: the
+    meshless reference twin then disk-hits the executables the COW phase
+    already warmed instead of tripping the tpu-san retrace sentinel with
+    a different pool shape at the same fingerprint."""
+    from paddle_tpu.inference import DecodeEngine
+
+    return DecodeEngine(model, max_length=48, block_size=8,
+                        decode_buckets=(1, 2, 4, 8),
+                        prefill_buckets=(8, 16, 24), prefill_chunk=8,
+                        num_blocks=57,
+                        mesh=mesh, default_timeout=30.0,
+                        step_timeout=STEP_TIMEOUT, step_retries=2,
+                        hang_grace=0.05, supervise_interval=0.01,
+                        fault_hook=fault_hook)
+
+
+def run_decode_cp_prefill_phase(phase, model, verbose=True):
+    """Context-parallel chunked prefill under a mid-ring kill: chunking
+    prompts run on a MeshConfig(cp=4) engine (prefill tokens sequence-
+    sharded along `cp`, each absolute-boundary chunk one ring-scheduled
+    unit) and the victim's SECOND chunk dispatch is killed in flight.
+    Exactly the victim fails typed, every survivor's tokens are
+    BIT-EXACT vs the single-device engine's solo references, the
+    victim's partially-prefilled blocks are reclaimed (pool
+    conservation), and the faulted traffic never retraces post-warmup
+    (tpu-san)."""
+    import numpy as np
+    from paddle_tpu.inference import (DeadlineExceeded, Overloaded,
+                                      PoolClosed, RequestFailed,
+                                      ServingError)
+    from paddle_tpu.sharding import MeshConfig
+
+    bad = []
+    prompts = {seed: np.random.RandomState(seed).randint(
+        0, DECODE_VOCAB, (n,)).astype(np.int32)
+        for seed, n, _ in CP_PREFILL_SEQS}
+
+    # solo references from the fault-free SINGLE-DEVICE twin: the cp
+    # engine's survivors must reproduce these bit-exact
+    refs = {}
+    with _decode_cp_engine(model, None) as ref_eng:
+        for seed, _, max_new in CP_PREFILL_SEQS:
+            refs[seed] = ref_eng.generate(prompts[seed], max_new)
+
+    victim_seed = CP_PREFILL_SEQS[0][0]   # 19 tokens: chunks at 8, 16
+    victim_sid = 1                        # submitted first -> engine id 1
+    inj = {"armed": True, "injected": 0, "lock": threading.Lock()}
+
+    def hook(stage, seq_ids, meta):
+        with inj["lock"]:
+            if not inj["armed"] or stage != "prefill":
+                return
+            if seq_ids == [victim_sid] and meta.get("start", 0) > 0:
+                inj["armed"] = False
+                inj["injected"] += 1
+                raise ValueError("injected mid-ring-prefill kill for "
+                                 f"sequence {seq_ids[0]}")
+
+    t0 = time.monotonic()
+    eng = _decode_cp_engine(model, MeshConfig(cp=4).build(),
+                            fault_hook=hook)
+    eng.warmup()
+    _san_mark_warm()   # faulted cp traffic below must never trace again
+    streams = {}
+    try:
+        for seed, _, max_new in CP_PREFILL_SEQS:
+            streams[seed] = eng.submit(prompts[seed], max_new)
+        outcomes = {}
+        for seed, _, _ in CP_PREFILL_SEQS:
+            s = streams[seed]
+            try:
+                toks = s.result()
+                outcomes[seed] = "ok"
+                if toks != refs[seed]:
+                    bad.append(f"[{phase}] sequence {seed} tokens "
+                               f"diverged from the single-device "
+                               f"reference: {toks} vs {refs[seed]}")
+            except (DeadlineExceeded, Overloaded, PoolClosed,
+                    RequestFailed) as e:
+                outcomes[seed] = type(e).__name__
+            except ServingError as e:
+                outcomes[seed] = f"unexpected-typed:{e}"
+                bad.append(f"[{phase}] sequence {seed} -> unexpected "
+                           f"typed error: {e}")
+            except BaseException as e:  # noqa: BLE001 — untyped = bug
+                outcomes[seed] = f"untyped:{type(e).__name__}"
+                bad.append(f"[{phase}] sequence {seed} -> UNTYPED error: "
+                           f"{type(e).__name__}: {e}")
+        ok = sum(1 for v in outcomes.values() if v == "ok")
+        if outcomes[victim_seed] != "RequestFailed" \
+                or ok != len(CP_PREFILL_SEQS) - 1:
+            bad.append(f"[{phase}] exactly the mid-prefill-killed "
+                       f"sequence must fail typed: {outcomes}")
+        if inj["injected"] == 0:
+            bad.append(f"[{phase}] harness error: no fault was injected")
+        st = eng.stats()
+        if st["prefill_chunks"] < 1:
+            bad.append(f"[{phase}] harness error: no prefill was chunked")
+        lhs = st["admitted"]
+        rhs = (st["completed"] + st["failed"] + st["timed_out"]
+               + st["cancelled"])
+        if lhs != rhs or st["active"] or st["waiting"]:
+            bad.append(f"[{phase}] engine conservation violated: "
+                       f"admitted={lhs} != {rhs} (active={st['active']}, "
+                       f"waiting={st['waiting']})")
+    finally:
+        drained = eng.shutdown(drain_timeout=10.0)
+    if not drained:
+        bad.append(f"[{phase}] engine failed to drain")
+    bs = eng.stats()["blocks"]
+    if bs["allocated"] != 0 or bs["free"] + bs["reserved"] != bs["total"]:
+        bad.append(f"[{phase}] BLOCK LEAK: {bs}")
+    if bs["allocs"] != bs["frees"]:
+        bad.append(f"[{phase}] alloc/free imbalance: {bs}")
+    if verbose:
+        tag = "FAIL" if bad else "ok"
+        print(f"  {phase:<13} -> {tag}  (injected={inj['injected']}, "
+              f"chunks={eng.stats()['prefill_chunks']}, "
               f"peak_blocks={bs['peak_allocated']}, "
               f"{time.monotonic() - t0:.1f}s)")
     return bad
@@ -1832,7 +1982,8 @@ def main(argv=None):
             # disk-hit (warm-start reuse is ALSO under test here)
             dmodel = _decode_model()
             if [p for p in decode_phases
-                    if p not in ("decode-cow", "decode-adapter")]:
+                    if p not in ("decode-cow", "decode-adapter",
+                                 "decode-cp-prefill")]:
                 _decode_references(dmodel)
             for phase in decode_phases:
                 if phase == "decode-cow":
@@ -1841,6 +1992,8 @@ def main(argv=None):
                     violations += run_decode_spec_phase(phase, dmodel)
                 elif phase == "decode-adapter":
                     violations += run_decode_adapter_phase(phase, dmodel)
+                elif phase == "decode-cp-prefill":
+                    violations += run_decode_cp_prefill_phase(phase, dmodel)
                 else:
                     violations += run_decode_phase(phase, dmodel)
         if router_phases:
