@@ -25,12 +25,22 @@ Two passes, two failure families:
   replication, conv-region layout changes, host transfers, unaliased
   donation and a live-memory watermark; ratcheted via
   ``.graphcheck_baseline.json`` and ``tools/graph_audit.py``.
+* `commcheck` — an opt-in (``PADDLE_TPU_COMMCHECK=1``)
+  **collective-schedule auditor**: canonicalizes the ordered collective
+  schedule (kind, mesh axes, operand shape/dtype, reduce op) of every
+  entrypoint into a per-``site::program`` fingerprint, ratcheted via
+  ``.commcheck_baseline.json`` and ``tools/comm_audit.py``; plus a
+  cross-host runtime verifier over the coordination store that turns a
+  schedule divergence into a typed
+  ``CollectiveScheduleMismatchError(host, site, first_divergent_collective)``
+  on every host instead of an unattributable hang.
 
 See docs/static_analysis.md for the rule catalogue and workflows.
 """
-from . import graphcheck, lockcheck, locks, runtime_san  # noqa: F401
+from . import commcheck, graphcheck, lockcheck, locks, runtime_san  # noqa: F401
 
-__all__ = ["graphcheck", "lockcheck", "locks", "runtime_san", "tracelint"]
+__all__ = ["commcheck", "graphcheck", "lockcheck", "locks", "runtime_san",
+           "tracelint"]
 
 
 def __getattr__(name):

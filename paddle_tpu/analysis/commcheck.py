@@ -1,0 +1,754 @@
+"""paddle_tpu.analysis.commcheck — the collective-schedule auditor.
+
+The fifth analysis pillar. tracelint audits what we *wrote*, lockcheck
+and tpu-san what we *ran*, graphcheck what XLA *compiled per program* —
+this module audits what the pod *agrees on*: the ordered sequence of
+collectives every host is about to dispatch. The single worst multi-host
+failure mode — hosts silently disagreeing on which collectives they
+issue, in what order, over which axes — surfaces on real metal as an
+unattributable ICI/DCN hang that a watchdog can only blame as
+"stalled". commcheck catches it twice:
+
+* **Statically** — :func:`record_program` walks the jaxpr (explicit
+  collectives: the ring-attention `ppermute`s inside shard_map bodies,
+  `psum`/`all_gather`/... primitives, sub-jaxprs inlined in dispatch
+  order) and the compiled HLO (the GSPMD-derived `all-reduce`/
+  `all-gather`/`reduce-scatter`/... ops with their replica groups and
+  reduce ops) of every framework entrypoint, canonicalizes the ordered
+  schedule into line-number-free entries, and fingerprints it per
+  ``site::program``. The checked-in ``.commcheck_baseline.json``
+  (driven by ``tools/comm_audit.py``, exit 0/1/2 + ``--write-baseline``
+  like graph_audit) then fails any PR that silently adds an all-gather
+  or reorders a reduce-scatter until it is re-ratcheted.
+
+* **At runtime, cross-host** — with a coordination store attached
+  (:func:`attach_store`, wired by ``init_parallel_env``), every host
+  publishes its schedule fingerprint plus a rolling dispatch-sequence
+  hash to the ``/commcheck/<epoch>/`` keyspace before the FIRST
+  dispatch of each entrypoint (epoch-namespaced like the replica
+  transport, so an elastic relaunch re-verifies under a fresh
+  namespace). Any disagreement — content OR order — raises a typed
+  :class:`CollectiveScheduleMismatchError` naming the divergent host
+  and the first divergent collective on ALL hosts instead of a hang.
+  A wedge with a pending mismatch upgrades the `TrainWatchdog` blame
+  from "stalled" to the divergent host+collective.
+
+Opt-in via ``PADDLE_TPU_COMMCHECK=1`` (or :func:`enable`) with the
+established zero-overhead-off discipline: every framework hook reduces
+to one module-flag check when off. Schedules are keyed
+``<site>::<program>`` where ``program`` is a short digest of the
+entrypoint's input-aval signature — deterministic, line-number-free,
+stable across code motion. Counters export as the ``commcheck``
+collector on the obs registry (docs/observability.md); the rule
+catalogue and workflows live in docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+
+__all__ = [
+    "RULE", "enable", "disable", "enabled", "reset",
+    "record_program", "check_entrypoint", "extract_schedule",
+    "jaxpr_schedule", "hlo_schedule", "program_key", "schedules",
+    "errors", "report", "load_baseline", "write_baseline",
+    "new_schedules", "attach_store", "detach_store", "verifier",
+    "pending_mismatch", "CollectiveScheduleMismatchError",
+    "OBS_COLLECTOR",
+]
+
+_ENV = "PADDLE_TPU_COMMCHECK"
+_ENV_TIMEOUT = "PADDLE_TPU_COMMCHECK_TIMEOUT_S"
+
+#: the one rule key the ratchet reports under (``<site>::commcheck``)
+RULE = "commcheck"
+
+#: obs-registry collector name (docs/observability.md)
+OBS_COLLECTOR = "commcheck"
+
+#: store keyspace root for the cross-host verifier
+STORE_PREFIX = "/commcheck"
+
+#: jaxpr primitives that ARE collectives (explicit, pre-GSPMD: what
+#: shard_map bodies and manual lax collectives bind)
+_JAXPR_COLLECTIVES = {
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pbroadcast",
+}
+
+#: HLO collective kinds (the GSPMD-derived schedule)
+_HLO_COLLECTIVES = ("all-gather", "all-reduce", "reduce-scatter",
+                    "all-to-all", "collective-permute",
+                    "collective-broadcast")
+
+#: eqn params worth canonicalizing into a schedule entry (whitelist —
+#: anything else may hold jaxprs/functions or unstable reprs)
+_ENTRY_PARAMS = ("perm", "all_gather_dimension", "tiled", "split_axis",
+                 "concat_axis", "scatter_dimension", "axis_index_groups")
+
+#: recursion cap for in-order sub-jaxpr inlining
+_MAX_DEPTH = 32
+
+_off_values = ("", "0", "false", "off", "no")
+
+
+def _env_on(name, default=""):
+    return os.environ.get(name, default).strip().lower() not in _off_values
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+_enabled = _env_on(_ENV)
+
+
+class CollectiveScheduleMismatchError(RuntimeError):
+    """Hosts disagree on the collective schedule of an entrypoint.
+
+    Raised on EVERY host of the cohort (the divergent one included) so
+    the job dies typed and attributable instead of hanging in a
+    collective. `host` names the blamed (divergent-from-consensus)
+    host, `site` the entrypoint it diverged at, and
+    `first_divergent_collective` the first schedule entry that differs
+    from the consensus schedule."""
+
+    def __init__(self, message, *, host=None, site=None,
+                 first_divergent_collective=None, index=None):
+        super().__init__(message)
+        self.host = host
+        self.site = site
+        self.first_divergent_collective = first_divergent_collective
+        self.index = index
+
+    @property
+    def phase(self):
+        # TrainingStalledError-compatible blame surface: on_stall
+        # consumers read err.host / err.phase
+        return self.site
+
+
+class _Registry:
+    """Global recorder. Guarded by a RAW threading.Lock on purpose (the
+    analysis recorders must not observe themselves through lockcheck)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._programs = {}   # key -> {site, fingerprint, collectives}
+        self._errors = {}     # site -> message (extraction failures)
+        self.counters = {"programs": 0, "collectives_seen": 0,
+                         "verified": 0, "mismatches": 0,
+                         "verify_timeouts": 0}
+
+    def note_program(self, key, site, fingerprint, schedule):
+        with self._mu:
+            self._programs[key] = {"site": site, "fingerprint": fingerprint,
+                                   "collectives": list(schedule)}
+            self.counters["programs"] += 1
+            self.counters["collectives_seen"] += len(schedule)
+
+    def note_error(self, site, message):
+        with self._mu:
+            self._errors.setdefault(site, message)
+
+    def bump(self, name, n=1):
+        with self._mu:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def schedules(self):
+        with self._mu:
+            return {k: dict(v) for k, v in self._programs.items()}
+
+    def errors(self):
+        with self._mu:
+            return dict(self._errors)
+
+    def reset(self):
+        with self._mu:
+            self._programs = {}
+            self._errors = {}
+            self.counters = {k: 0 for k in self.counters}
+
+    def report(self):
+        with self._mu:
+            return {
+                "schedules": {k: dict(v)
+                              for k, v in self._programs.items()},
+                "errors": dict(self._errors),
+                "counters": dict(self.counters),
+            }
+
+
+_registry = _Registry()
+
+
+def registry():
+    return _registry
+
+
+def _obs_collect():
+    rep = _registry.report()
+    out = {"enabled": int(_enabled),
+           "programs_recorded": len(rep["schedules"]),
+           "errors": len(rep["errors"])}
+    out.update(rep["counters"])
+    return out
+
+
+def enable():
+    """Turn the auditor on (hooks record on their next cold compile) and
+    register the ``commcheck`` obs collector."""
+    global _enabled
+    _enabled = True
+    try:
+        from ..obs.metrics import registry as _obs
+        _obs().register_collector(OBS_COLLECTOR, _obs_collect)
+    except Exception:  # tpu-lint: disable=TL007 — obs is optional here:
+        pass           # the auditor must work without the registry
+
+
+def disable():
+    global _enabled
+    _enabled = False
+    try:
+        from ..obs.metrics import registry as _obs
+        _obs().unregister_collector(OBS_COLLECTOR)
+    except Exception:  # tpu-lint: disable=TL007 — symmetric with enable
+        pass
+
+
+def enabled():
+    return _enabled
+
+
+def reset():
+    """Clear all recorded state (the enable flag and an attached
+    verifier stay)."""
+    _registry.reset()
+
+
+if _enabled:
+    enable()     # env asked: register the collector at import
+
+
+# ---------------------------------------------------------------------------
+# schedule extraction: jaxpr (explicit collectives) + HLO (GSPMD-derived)
+# ---------------------------------------------------------------------------
+
+def _is_literal(v):
+    return type(v).__name__ == "Literal"
+
+
+def _sub_jaxprs(eqn):
+    """Sub-jaxprs of one eqn, in params order: pjit/scan/cond bodies
+    (ClosedJaxpr) AND shard_map bodies (raw Jaxpr param values)."""
+    subs = []
+    for v in eqn.params.values():
+        for q in (v if isinstance(v, (list, tuple)) else (v,)):
+            j = getattr(q, "jaxpr", None)
+            j = q if j is None else j
+            if hasattr(j, "eqns") and hasattr(j, "invars"):
+                subs.append(j)
+    return subs
+
+
+def _axes_of(eqn):
+    """Canonical mesh-axis names of a collective eqn (psum binds `axes`,
+    the rest `axis_name`; both may be one name or a tuple)."""
+    p = eqn.params
+    ax = p.get("axes", p.get("axis_name"))
+    if ax is None:
+        return ()
+    if isinstance(ax, (tuple, list, frozenset, set)):
+        return tuple(sorted(str(a) for a in ax))
+    return (str(ax),)
+
+
+def _operand_sig(eqn):
+    """dtype+shape of the first non-literal operand ('?' when absent)."""
+    for v in eqn.invars:
+        if _is_literal(v):
+            continue
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            return f"{aval.dtype}{list(aval.shape)}"
+    return "?"
+
+
+def _entry_extras(eqn):
+    out = []
+    for name in _ENTRY_PARAMS:
+        if name in eqn.params:
+            v = eqn.params[name]
+            if isinstance(v, (list, tuple)):
+                v = tuple(tuple(x) if isinstance(x, (list, tuple)) else x
+                          for x in v)
+            out.append(f"{name}={v}")
+    return " ".join(out)
+
+
+def jaxpr_schedule(jaxpr, _depth=0):
+    """Ordered collective entries of a (Closed)Jaxpr: an in-place
+    depth-first walk (each eqn's sub-jaxprs — scan/pjit/shard_map bodies
+    — are inlined AT the eqn's position, so the sequence matches
+    dispatch order), one canonical string per collective primitive."""
+    j = getattr(jaxpr, "jaxpr", None)
+    j = jaxpr if j is None or not hasattr(j, "eqns") else j
+    out = []
+    if _depth > _MAX_DEPTH:
+        return out
+    for e in j.eqns:
+        name = e.primitive.name
+        if name in _JAXPR_COLLECTIVES:
+            axes = ",".join(_axes_of(e)) or "?"
+            extra = _entry_extras(e)
+            out.append(f"jaxpr:{name}@{axes} {_operand_sig(e)}"
+                       + (f" {extra}" if extra else ""))
+        for sub in _sub_jaxprs(e):
+            out.extend(jaxpr_schedule(sub, _depth + 1))
+    return out
+
+
+_HLO_SHAPE_RE = re.compile(r"=\s+(?:\()?([a-z0-9]+)\[([0-9,]*)\]")
+_TO_APPLY_RE = re.compile(r"to_apply=%?([A-Za-z_]+)")
+
+
+def _scan_groups(line, attr):
+    """Value of `attr=` on an HLO line, through balanced {}/[] nesting
+    (covers ``{{0,1},{2,3}}`` list-of-lists AND the ``[2,2]<=[4]`` iota
+    form), ending at the first top-level comma/space."""
+    i = line.find(attr + "=")
+    if i < 0:
+        return ""
+    i += len(attr) + 1
+    depth = 0
+    j = i
+    while j < len(line):
+        c = line[j]
+        if c in "{[":
+            depth += 1
+        elif c in "}]":
+            depth -= 1
+        elif c in ", " and depth <= 0:
+            break
+        j += 1
+    return line[i:j]
+
+
+def hlo_schedule(hlo_text):
+    """Ordered collective entries of a compiled module's HLO text: the
+    GSPMD-derived schedule — kind, result dtype/shape, replica groups
+    (or source-target pairs) and the reduce op (to_apply region's alpha
+    prefix; numeric suffixes stripped so unrelated region renames never
+    churn the fingerprint)."""
+    out = []
+    for line in (hlo_text or "").splitlines():
+        kind = next((k for k in _HLO_COLLECTIVES if f" {k}(" in line), None)
+        if kind is None:
+            continue
+        m = _HLO_SHAPE_RE.search(line)
+        sig = f"{m.group(1)}[{m.group(2)}]" if m else "?"
+        parts = [f"hlo:{kind} {sig}"]
+        groups = _scan_groups(line, "replica_groups") or \
+            _scan_groups(line, "source_target_pairs")
+        if groups:
+            parts.append(f"groups={groups}")
+        ta = _TO_APPLY_RE.search(line)
+        if ta:
+            parts.append(f"op={ta.group(1).rstrip('_')}")
+        out.append(" ".join(parts))
+    return out
+
+
+def extract_schedule(jaxpr, hlo_text=""):
+    """The canonical ordered schedule: jaxpr-level entries (explicit
+    collectives, dispatch order) followed by HLO-level entries (the
+    compiled module's derived collectives, module order). Explicit
+    collectives appear at both levels by design — the fingerprint only
+    needs determinism, and the two views blame different bug classes
+    (a shard_map body vs a GSPMD sharding change)."""
+    return jaxpr_schedule(jaxpr) + hlo_schedule(hlo_text)
+
+
+def fingerprint_of(schedule):
+    return hashlib.sha256("\n".join(schedule).encode()).hexdigest()
+
+
+def _aval_sig(args):
+    import jax
+
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append(f"{dtype}{list(shape)}")
+        else:
+            sig.append(type(leaf).__name__)
+    return sig
+
+
+def program_key(site, args):
+    """``<site>::<8-hex digest of the input-aval signature>`` — the
+    baseline identity of one compiled program at one site. Line-number-
+    free and deterministic across hosts/processes (aval signatures are
+    pytree-ordered), so N buckets of one entrypoint ratchet
+    independently while code motion never churns the key."""
+    digest = hashlib.sha256(
+        json.dumps(_aval_sig(args)).encode()).hexdigest()[:8]
+    return f"{site}::{digest}"
+
+
+# ---------------------------------------------------------------------------
+# recording (the framework hooks' entry)
+# ---------------------------------------------------------------------------
+
+class Program:
+    __slots__ = ("key", "site", "fingerprint", "schedule")
+
+    def __init__(self, key, site, fingerprint, schedule):
+        self.key = key
+        self.site = site
+        self.fingerprint = fingerprint
+        self.schedule = schedule
+
+
+def record_program(site, *, jit_obj=None, fn=None, args=None,
+                   lowered=None, compiled=None):
+    """Extract + record the collective schedule of one entrypoint.
+
+    Two call shapes, mirroring graphcheck.audit_executable:
+
+    * ``record_program(site, jit_obj=jitted, args=(...))`` — traces,
+      lowers and compiles itself (one extra AOT compile; the engine's
+      cold path, opt-in only).
+    * ``record_program(site, fn=f, args=avals, lowered=l, compiled=c)``
+      — the aot compile paths hand over the objects they already built.
+
+    Returns the recorded :class:`Program`, or None on extraction
+    failure — which is recorded as a (never-silently-baselined) error,
+    not raised: the auditor must not break the entrypoint it audits.
+    """
+    try:
+        import jax
+
+        if jit_obj is not None:
+            traced = jit_obj.trace(*args)
+            jaxpr = traced.jaxpr
+            if lowered is None:
+                lowered = traced.lower()
+        else:
+            jaxpr = jax.jit(fn).trace(*args).jaxpr
+        if compiled is None and lowered is not None:
+            compiled = lowered.compile()
+        hlo_text = ""
+        if compiled is not None:
+            try:
+                hlo_text = compiled.as_text()
+            except Exception:  # tpu-lint: disable=TL007 — some backends
+                hlo_text = ""  # cannot render text; jaxpr entries remain
+        schedule = extract_schedule(jaxpr, hlo_text)
+        prog = Program(program_key(site, args), site,
+                       fingerprint_of(schedule), schedule)
+        _registry.note_program(prog.key, site, prog.fingerprint,
+                               prog.schedule)
+        return prog
+    except Exception as e:  # noqa: BLE001 — never break the entrypoint
+        _registry.note_error(site,
+                             f"schedule extraction failed: "
+                             f"{type(e).__name__}: {e}")
+        return None
+
+
+def check_entrypoint(site, **kw):
+    """The one-line framework hook: record the entrypoint's schedule
+    and, when a cross-host verifier is attached, verify it against the
+    cohort before the first dispatch. Extraction failures are recorded,
+    never raised; a cross-host divergence raises the typed
+    :class:`CollectiveScheduleMismatchError` (that is the point)."""
+    prog = record_program(site, **kw)
+    v = _verifier
+    if prog is not None and v is not None:
+        v.verify(prog)
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# cross-host runtime verifier
+# ---------------------------------------------------------------------------
+
+def _first_divergence(canon, mine):
+    """(index, entry) of the first position where `mine` departs from
+    the consensus schedule `canon` (None when equal)."""
+    for i in range(max(len(canon), len(mine))):
+        want = canon[i] if i < len(canon) else None
+        got = mine[i] if i < len(mine) else None
+        if want != got:
+            if got is None:
+                got = f"<missing — peers run {want}>"
+            return i, got
+    return None, None
+
+
+def _blame(recs):
+    """Consensus + blame over one verify round's records ({host: rec}).
+
+    Deterministic on every host: group by (fingerprint, rolling, site,
+    program); the consensus group is the largest (ties broken toward
+    the group holding the first host in sort order — the coordinator
+    convention), every other host is divergent. Returns None when the
+    cohort agrees, else the mismatch record to publish."""
+    groups = {}
+    for h, r in sorted(recs.items()):
+        sig = (r["fingerprint"], r["rolling"], r["site"], r["program"])
+        groups.setdefault(sig, []).append(h)
+    if len(groups) <= 1:
+        return None
+    canon_sig = sorted(groups, key=lambda s: (-len(groups[s]),
+                                              min(groups[s])))[0]
+    canon_hosts = groups[canon_sig]
+    blamed = sorted(h for h in recs if h not in canon_hosts)
+    canon = recs[min(canon_hosts)]
+    b = recs[blamed[0]]
+    idx, entry = _first_divergence(canon["schedule"], b["schedule"])
+    if entry is None and b["site"] != canon["site"]:
+        entry = (f"<entrypoint order diverged: dispatching "
+                 f"{b['site']} while peers dispatch {canon['site']}>")
+    if entry is None:
+        # identical schedules but diverging rolling hash: an EARLIER
+        # round diverged without being caught (e.g. a peer timed out)
+        entry = "<dispatch-sequence hash diverged at an earlier round>"
+    return {"host": b["host"], "hosts": blamed, "site": b["site"],
+            "expected_site": canon["site"], "index": idx,
+            "collective": entry, "fingerprint": b["fingerprint"],
+            "expected_fingerprint": canon["fingerprint"]}
+
+
+def _mismatch_error(rec):
+    return CollectiveScheduleMismatchError(
+        f"collective-schedule mismatch at {rec['site']!r}: host(s) "
+        f"{rec['hosts']} diverge from the cohort — first divergent "
+        f"collective (position {rec['index']}): {rec['collective']}",
+        host=rec["host"], site=rec["site"],
+        first_divergent_collective=rec["collective"], index=rec["index"])
+
+
+class _Verifier:
+    """Cross-host schedule verifier over the coordination store.
+
+    One verify round per entrypoint program: fold the fingerprint into
+    the rolling dispatch-sequence hash, publish
+    ``/commcheck/<epoch>/v<index>/<host>``, gather the cohort's records
+    at the same index, and compare. The per-index rendezvous catches
+    ORDER divergence (host A verifying engine.step while host B
+    verifies engine.eval lands both at the same index with different
+    sites); the rolling hash catches divergence that slipped an earlier
+    round. A peer that never arrives is a crash/wedge — the watchdog's
+    jurisdiction — so a gather timeout records a counter and returns
+    rather than mis-typing it as a schedule divergence."""
+
+    def __init__(self, store, host, world_size, epoch=0, timeout=None):
+        self.store = store
+        self.host = str(host)
+        self.world_size = int(world_size)
+        self.epoch = int(epoch)
+        self.timeout = float(timeout) if timeout is not None \
+            else _env_float(_ENV_TIMEOUT, 30.0)
+        self._mu = threading.Lock()   # raw: analysis self-guard
+        self._rolling = hashlib.sha256()
+        self._index = 0
+        self._seen = set()
+        self._pending = None          # cached mismatch record
+
+    def prefix(self):
+        return f"{STORE_PREFIX}/{self.epoch}"
+
+    def _mismatch_key(self):
+        return f"{self.prefix()}/mismatch"
+
+    def peek_mismatch(self):
+        """The cohort's published mismatch record (or a locally raised
+        one), as a typed error — None when the cohort is clean. Never
+        raises: pollers (the watchdog blame upgrade) call this from
+        sweep threads."""
+        with self._mu:
+            if self._pending is not None:
+                return _mismatch_error(self._pending)
+        try:
+            raw = self.store.get_nowait(self._mismatch_key())
+        except Exception:  # tpu-lint: disable=TL007 — store teardown
+            return None    # races the sweep thread; stay quiet
+        if raw is None:
+            return None
+        rec = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        with self._mu:
+            self._pending = rec
+        return _mismatch_error(rec)
+
+    def verify(self, prog):
+        """One verify round for `prog`; raises the typed mismatch error
+        when the cohort diverges (on every host). Idempotent per
+        program key — only the FIRST dispatch of each entrypoint
+        program pays the round trip."""
+        if self.world_size <= 1 or self.store is None:
+            return
+        with self._mu:
+            if prog.key in self._seen:
+                return
+            self._seen.add(prog.key)
+            self._rolling.update(prog.fingerprint.encode())
+            rolling = self._rolling.hexdigest()
+            idx = self._index
+            self._index += 1
+        rec = {"host": self.host, "site": prog.site, "program": prog.key,
+               "fingerprint": prog.fingerprint, "rolling": rolling,
+               "schedule": list(prog.schedule)}
+        round_prefix = f"{self.prefix()}/v{idx}/"
+        self.store.set(round_prefix + self.host,
+                       json.dumps(rec, sort_keys=True))
+        deadline = time.monotonic() + self.timeout
+        while True:
+            found = self.peek_mismatch()
+            if found is not None:
+                _registry.bump("mismatches")
+                raise found
+            ks = self.store.keys(round_prefix)
+            if len(ks) >= self.world_size:
+                break
+            if time.monotonic() > deadline:
+                _registry.bump("verify_timeouts")
+                return
+            time.sleep(0.02)
+        recs = {}
+        for k in sorted(ks):
+            raw = self.store.get_nowait(k)
+            if raw is None:
+                continue
+            r = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+            recs[r["host"]] = r
+        mm = _blame(recs)
+        if mm is None:
+            _registry.bump("verified")
+            return
+        try:
+            self.store.set(self._mismatch_key(),
+                           json.dumps(mm, sort_keys=True))
+        except Exception:  # tpu-lint: disable=TL007 — publish is best-
+            pass           # effort; the local raise happens regardless
+        with self._mu:
+            self._pending = mm
+        _registry.bump("mismatches")
+        raise _mismatch_error(mm)
+
+
+_verifier = None
+
+
+def attach_store(store, host, world_size, epoch=0, timeout=None):
+    """Arm the cross-host verifier (idempotent per attach): called by
+    ``init_parallel_env`` when the auditor is enabled and a coordination
+    store exists. `epoch` namespaces the keyspace per spawn life
+    (``PADDLE_RESTART_EPOCH``), so an elastic relaunch re-verifies the
+    whole cohort under fresh keys."""
+    global _verifier
+    _verifier = _Verifier(store, host, world_size, epoch=epoch,
+                          timeout=timeout)
+    return _verifier
+
+
+def detach_store():
+    global _verifier
+    _verifier = None
+
+
+def verifier():
+    return _verifier
+
+
+def pending_mismatch():
+    """A published-or-raised cohort mismatch as a typed error, or None.
+    The TrainWatchdog consults this before blaming a wedge as merely
+    "stalled" — a pending mismatch upgrades the blame to the divergent
+    host + collective."""
+    v = _verifier
+    if v is None:
+        return None
+    return v.peek_mismatch()
+
+
+# ---------------------------------------------------------------------------
+# report / ratchet surface
+# ---------------------------------------------------------------------------
+
+def schedules():
+    return _registry.schedules()
+
+
+def errors():
+    return _registry.errors()
+
+
+def report():
+    return _registry.report()
+
+
+def load_baseline(path):
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "schedules" not in data:
+        raise ValueError(f"{path}: not a commcheck baseline "
+                         "(missing 'schedules')")
+    return data
+
+
+def write_baseline(path, schedules_):
+    """Deterministic (sorted-keys, newline-terminated) baseline dump.
+    Unlike the count ratchets this freezes the full schedule per
+    program, so a later diff can NAME the first divergent collective
+    instead of just counting findings."""
+    data = {"version": 1, "tool": "commcheck",
+            "schedules": {k: {"site": v["site"],
+                              "fingerprint": v["fingerprint"],
+                              "collectives": list(v["collectives"])}
+                          for k, v in schedules_.items()}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def new_schedules(current, baseline_schedules):
+    """{``site::commcheck``: [messages]} for programs whose schedule
+    departs from the baseline — a changed fingerprint names the first
+    divergent collective tuple; a program with no baseline entry fails
+    until ``--write-baseline`` ratchets it (a silently appearing
+    entrypoint is exactly what the auditor exists to catch)."""
+    out = {}
+
+    def add(site, msg):
+        out.setdefault(f"{site}::{RULE}", []).append(msg)
+
+    for key, prog in sorted(current.items()):
+        base = baseline_schedules.get(key)
+        site = prog["site"]
+        if base is None:
+            colls = prog["collectives"]
+            head = "; ".join(colls[:3]) or "<no collectives>"
+            add(site, f"unbaselined program {key}: {len(colls)} "
+                      f"collective(s) [{head}{'; ...' if len(colls) > 3 else ''}]"
+                      f" — ratchet with --write-baseline")
+            continue
+        if base["fingerprint"] == prog["fingerprint"]:
+            continue
+        idx, entry = _first_divergence(base["collectives"],
+                                       prog["collectives"])
+        add(site, f"schedule of {key} diverged from baseline at "
+                  f"position {idx}: {entry} (baseline has "
+                  f"{base['collectives'][idx] if idx is not None and idx < len(base['collectives']) else '<end-of-schedule>'})")
+    return out

@@ -9,11 +9,11 @@ code can register its own. Cheap by construction — one dict add under the
 GIL per event."""
 from __future__ import annotations
 
-import threading
+from ..analysis import locks as _locks
 
 __all__ = ["increment", "get", "get_all", "reset", "counter_names"]
 
-_lock = threading.Lock()
+_lock = _locks.new_lock("monitor.counters")
 _counters: dict = {}
 
 

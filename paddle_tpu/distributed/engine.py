@@ -35,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import commcheck as _cc
 from ..analysis import graphcheck as _gc
 from ..analysis import runtime_san as _san
 from ..core import lazy as _lazy
@@ -516,6 +517,16 @@ class ShardedTrainStep:
             param_avals=param_avals, param_specs=param_specs,
             expect_sharded_params=False)
 
+    def _check_comm(self, site, fn, args):
+        """Collective-schedule auditor (PADDLE_TPU_COMMCHECK=1): record
+        the freshly built program's ordered collective schedule and —
+        when a cross-host verifier is attached (init_parallel_env) —
+        verify it against the cohort BEFORE the first dispatch, so a
+        divergent host dies typed (CollectiveScheduleMismatchError)
+        instead of hanging every peer in a collective. Costs one extra
+        AOT lower+compile per cold entrypoint; free when off."""
+        _cc.check_entrypoint(site, jit_obj=fn, args=args)
+
     # ---- public step APIs ----------------------------------------------
     def train_batch(self, *batch):
         """Run one optimizer step; returns the (device) loss Tensor."""
@@ -544,6 +555,10 @@ class ShardedTrainStep:
             self._audit_graph("engine.step", self._step_fn,
                               (self.param_vals, self.opt_state,
                                self.buffer_vals, placed, lr, key, step_no))
+        if cold and _cc.enabled():
+            self._check_comm("engine.step", self._step_fn,
+                             (self.param_vals, self.opt_state,
+                              self.buffer_vals, placed, lr, key, step_no))
         self._step_count += 1
         donated = (self.param_vals, self.opt_state, self.buffer_vals,
                    key, step_no) if san and self.donate else None
@@ -669,6 +684,10 @@ class ShardedTrainStep:
             self._audit_graph("engine.multi", fn,
                               (self.param_vals, self.opt_state,
                                self.buffer_vals, placed, lrs, key, step0))
+        if cold and _cc.enabled():
+            self._check_comm("engine.multi", fn,
+                             (self.param_vals, self.opt_state,
+                              self.buffer_vals, placed, lrs, key, step0))
         donated = (self.param_vals, self.opt_state, self.buffer_vals,
                    key, step0) if san and self.donate else None
         self._inflight = ("engine.dispatch", time.monotonic())
@@ -753,6 +772,10 @@ class ShardedTrainStep:
             self._audit_graph("engine.eval", fn,
                               (self.param_vals, self.buffer_vals, placed,
                                key))
+        if cold and _cc.enabled():
+            self._check_comm("engine.eval", fn,
+                             (self.param_vals, self.buffer_vals, placed,
+                              key))
         with _span("engine::dispatch", histogram=self._h_dispatch), \
                 (_san.allow_host_sync("engine.compile") if cold
                  else _san.hot_region("engine.dispatch")):

@@ -96,6 +96,19 @@ def init_parallel_env():
         _global_store = TCPStore(host or "127.0.0.1", int(port),
                                  world_size=world)
         _global_store.start_heartbeat(f"rank{rank}")
+        # collective-schedule verifier (PADDLE_TPU_COMMCHECK=1): arm the
+        # cross-host rendezvous over this store so every entrypoint's
+        # schedule fingerprint is compared BEFORE its first dispatch.
+        # Epoch-namespaced by the launcher's restart epoch, so an
+        # elastic relaunch re-verifies the whole cohort under fresh
+        # /commcheck/<epoch>/ keys.
+        from ..analysis import commcheck as _cc
+
+        if _cc.enabled() and world > 1:
+            _cc.attach_store(
+                _global_store, host=f"rank{rank}", world_size=world,
+                epoch=int(os.environ.get("PADDLE_RESTART_EPOCH", "0")
+                          or 0))
     # declarative mesh from the launcher (--mesh): AFTER the
     # jax.distributed bootstrap above, so the config resolves against the
     # job-global device set and every host installs the identical hybrid

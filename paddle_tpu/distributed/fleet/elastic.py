@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ...analysis import locks as _locks
 from ..store import TCPStore, Watchdog
 # clean-preempt contract shared with the launcher: a worker that exits
 # PREEMPT_EXIT_CODE checkpointed on purpose inside its grace window, and
@@ -42,7 +43,7 @@ class ElasticManager:
         self._member = f"{job_id}/node{rank}"
         self._watchdog = Watchdog(store, ttl=ttl, interval=interval)
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("fleet.elastic")
         self._status = ElasticStatus.HOLD
         self._thread = None
         # fault-tolerant resume: membership detects the failure, the

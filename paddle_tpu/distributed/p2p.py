@@ -22,6 +22,7 @@ import threading
 import numpy as np
 import jax.numpy as jnp
 
+from ..analysis import locks as _locks
 from ..core.tensor import Tensor
 from .env import get_rank, get_world_size, get_store
 
@@ -35,7 +36,7 @@ class _LocalMailbox:
     def __init__(self):
         self._items = collections.defaultdict(dict)  # (src,dst) -> {idx: v}
         self._push = collections.defaultdict(int)
-        self._cv = threading.Condition()
+        self._cv = _locks.new_condition("p2p.mailbox")
 
     def put(self, src, dst, payload):
         with self._cv:
@@ -55,7 +56,7 @@ class _LocalMailbox:
 
 
 _mailbox = _LocalMailbox()
-_seq_lock = threading.Lock()
+_seq_lock = _locks.new_lock("p2p.seq")
 _send_seq = collections.defaultdict(int)   # (src, dst) -> next seq to send
 _recv_seq = collections.defaultdict(int)   # (src, dst) -> next seq to take
 

@@ -19,6 +19,7 @@ import threading
 import time
 import traceback
 
+from ..analysis import locks as _locks
 from .env import get_rank, get_world_size, get_store
 
 _state = {
@@ -28,7 +29,7 @@ _state = {
     "serve_thread": None,
     "stop": False,
     "req_seq": 0,
-    "lock": threading.Lock(),
+    "lock": _locks.new_lock("rpc.state"),
     "pending": {},      # future id -> _Future (in-flight rpc_async calls)
 }
 
@@ -55,7 +56,7 @@ class _Future:
 
     def __init__(self):
         self._ev = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = _locks.new_lock("rpc.future")
         self._value = None
         self._err = None
         self._abandoned = False

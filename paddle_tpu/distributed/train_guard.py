@@ -239,6 +239,13 @@ class TrainWatchdog:
         self.peer_ttl = float(peer_ttl) if peer_ttl is not None \
             else self.timeout
         self.stalled = None
+        # dead-peer blame fires once per (host, rejoin-epoch): a host
+        # that rejoins after elastic relaunch bumps its epoch (via the
+        # store watchdog's revival callback) so a SECOND wedge of the
+        # same name is still reported — without the epoch the rejoined
+        # host would inherit the spent count and wedge silently
+        self._blamed = set()
+        self._host_epoch = {}
         self._stop = threading.Event()
         self._thread = None
         self._peer_dog = None
@@ -247,7 +254,8 @@ class TrainWatchdog:
 
             self._peer_dog = Watchdog(store, ttl=self.peer_ttl,
                                       interval=self.interval,
-                                      on_failure=self._peers_dead)
+                                      on_failure=self._peers_dead,
+                                      on_recovery=self._peers_recovered)
 
     # -- heartbeats --------------------------------------------------------
     def _hb_key(self):
@@ -260,15 +268,27 @@ class TrainWatchdog:
             self.store.set(self._hb_key(), str(-1 if step is None else step))
 
     # -- detection ---------------------------------------------------------
+    def _train_peers(self, names):
+        return [n[len("train-"):] for n in names
+                if n.startswith("train-") and n != f"train-{self.host}"]
+
     def _peers_dead(self, names):
-        train_peers = [n[len("train-"):] for n in names
-                       if n.startswith("train-") and
-                       n != f"train-{self.host}"]
-        for peer in train_peers:
+        for peer in self._train_peers(names):
             self._stall(TrainingStalledError(
                 f"training host {peer!r} stopped heartbeating "
                 f"(> {self.peer_ttl:g}s since its last step boundary)",
                 host=peer, phase="heartbeat", elapsed=self.peer_ttl))
+
+    def _peers_recovered(self, names):
+        """A dead peer is heartbeating again (elastic relaunch under the
+        same name): re-arm its blame by bumping the per-host epoch, and
+        drop a pending stall that blamed it — the next wedge of that
+        host must be reported as a FRESH event, not swallowed by the
+        spent count."""
+        for peer in self._train_peers(names):
+            self._host_epoch[peer] = self._host_epoch.get(peer, 0) + 1
+            if self.stalled is not None and self.stalled.host == peer:
+                self.stalled = None
 
     def check(self):
         """One local sweep of the engine's in-flight dispatch marker."""
@@ -288,10 +308,27 @@ class TrainWatchdog:
         return False
 
     def _stall(self, err):
-        if self.stalled is not None:
-            return  # first detection wins; one error per stall
+        key = (err.host, self._host_epoch.get(err.host, 0))
+        if key in self._blamed:
+            return  # one error per (host, rejoin-epoch) of blame
+        self._blamed.add(key)
+        # blame upgrade: a wedge with a PENDING collective-schedule
+        # mismatch (PADDLE_TPU_COMMCHECK=1) is not "stalled" — it is a
+        # divergent cohort waiting in a collective that will never
+        # complete; report the divergent host + first divergent
+        # collective instead of the generic timeout
+        try:
+            from ..analysis import commcheck as _cc
+
+            if _cc.enabled():
+                mm = _cc.pending_mismatch()
+                if mm is not None:
+                    err = mm
+        except Exception:  # tpu-lint: disable=TL007 — the upgrade is
+            pass           # best-effort; the stall must still surface
         recovery_counters()["stalled_detections"] += 1
-        self.stalled = err
+        if self.stalled is None:
+            self.stalled = err
         if self.on_stall is not None:
             self.on_stall(err)
 

@@ -9,11 +9,12 @@ per-flag C++ consumers map to the subsystems reading these at run time.
 from __future__ import annotations
 
 import os
-import threading
+
+from .analysis import locks as _locks
 
 __all__ = ["set_flags", "get_flags", "define_flag", "flag"]
 
-_lock = threading.Lock()
+_lock = _locks.new_lock("flags.registry")
 _defs: dict = {}     # name -> (type, default, help)
 _values: dict = {}   # name -> current value (resolved); read lock-free on
                      # the hot path (CPython dict reads are atomic)

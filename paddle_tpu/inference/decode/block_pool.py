@@ -178,6 +178,17 @@ class BlockKVCache:
             self.peak_allocated = max(self.peak_allocated, len(self._refs))
             return blocks
 
+    def reset_peak(self):
+        """Re-arm the `peak_allocated` high-water mark at the CURRENT
+        allocation level and return it. The mark is otherwise monotone
+        for the life of the pool, which makes it useless for windowed
+        measurements on a long-lived engine (capacity tests, admission
+        headroom probes) — resetting turns `peak_allocated - allocated`
+        into a per-window footprint delta."""
+        with self._lock:
+            self.peak_allocated = len(self._refs)
+            return self.peak_allocated
+
     def incref(self, blocks, owner=None):
         """Add one `owner`-held reference to each allocated block — the
         prefix-sharing move: a sequence (or the prefix cache) joins an

@@ -34,6 +34,7 @@ import hashlib
 import os
 import pickle
 
+from ..analysis import commcheck as _cc
 from ..analysis import graphcheck as _gc
 from ..analysis import locks as _locks
 from ..analysis import runtime_san as _san
@@ -325,6 +326,13 @@ def compile_jit(fn, avals, *, fingerprint=None, cache=None, tag="jit-v1",
                              lowered=lowered, compiled=compiled,
                              in_shardings=in_shardings,
                              **(audit_ctx or {}))
+    if _cc.enabled():
+        # collective-schedule auditor: the lowered/compiled objects are
+        # already in hand, so recording+verifying here is (extra
+        # compile)-free — decode bucket executables verify cross-host
+        # BEFORE their first dispatch
+        _cc.check_entrypoint(f"aot.{tag}", fn=fn, args=avals,
+                             lowered=lowered, compiled=compiled)
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))
@@ -411,6 +419,10 @@ def compile_batched(exported, holder_avals, input_spec, bucket, *,
                              args=(list(holder_avals), *stacked_avals),
                              lowered=lowered, compiled=compiled,
                              in_shardings=in_shardings, **ctx)
+    if _cc.enabled():
+        _cc.check_entrypoint("aot.batched", fn=batched,
+                             args=(list(holder_avals), *stacked_avals),
+                             lowered=lowered, compiled=compiled)
     if key is not None:
         try:
             cache.put(key, pickle.dumps(_se.serialize(compiled), protocol=4))

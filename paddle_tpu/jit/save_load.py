@@ -13,6 +13,7 @@ import os
 import pickle
 import threading
 
+from ..analysis import commcheck as _cc
 from ..analysis import graphcheck as _gc
 from ..analysis import locks as _locks
 from ..analysis import runtime_san as _san
@@ -170,16 +171,22 @@ class TranslatedLayer:
                  _san.sharding_signature(self._mesh, self._param_specs)),
                 per_call=True)
         holder_vals = [self._params[n]._value for n in self._param_names]
-        if _gc.enabled():
+        if _gc.enabled() or _cc.enabled():
             sig = _san.aval_signature(vals)
             with self._aot_lock:      # check-then-act under the lock:
                 fresh = sig not in self._gc_sigs    # concurrent workers
                 if fresh:                           # must not double-pay
                     self._gc_sigs.add(sig)          # the audit compile
             if fresh:
-                _gc.audit_executable("aot.layer_call", jit_obj=self._call,
-                                     args=(holder_vals, *vals),
-                                     **self._gc_ctx())
+                if _gc.enabled():
+                    _gc.audit_executable("aot.layer_call",
+                                         jit_obj=self._call,
+                                         args=(holder_vals, *vals),
+                                         **self._gc_ctx())
+                if _cc.enabled():
+                    _cc.check_entrypoint("aot.layer_call",
+                                         jit_obj=self._call,
+                                         args=(holder_vals, *vals))
         out = self._call(holder_vals, *vals)
         if isinstance(out, (list, tuple)):
             return tuple(Tensor(o) for o in out)

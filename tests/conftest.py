@@ -64,7 +64,7 @@ _WALL_CLOCK_TAIL = (
     "test_launch.py",             # ~50s /  9 tests (elastic relaunch)
     "test_examples.py",           # ~67s / 11 example subprocesses
     "test_serving_fault_injection.py",  # ~90s / 1 test (22 fault phases)
-    "test_train_fault_injection.py",  # ~35s / 1 test (5 faulted runs)
+    "test_train_fault_injection.py",  # ~45s / 1 test (6 faulted runs)
     "test_multiprocess_dist.py",  # ~10s /  1 test  (spawned world)
     "test_multiprocess_hybrid.py",  # all 3 hybrid jobs slow-marked (PR 17)
 )

@@ -893,17 +893,23 @@ def test_tl012_suppression_and_authority_exemption():
 
 
 def test_tl012_legacy_baseline_frozen():
-    """The ~15 legacy raw-lock sites are baselined (burn down, never
-    grow), and the checked-in TL011 ratchet keeps shrinking: 58 at
-    introduction, 43 after the collective/misc_api migration, 25 after
-    the pipeline/data_parallel tranche, ≤15 after the
+    """The legacy raw-lock sites are baselined (burn down, never grow):
+    14 at introduction, 7 after the PR-20 tranche (flags, core/monitor,
+    fleet/elastic, p2p, rpc onto the named constructors) — and the
+    checked-in TL011 ratchet keeps shrinking: 58 at introduction, 43
+    after the collective/misc_api migration, 25 after the
+    pipeline/data_parallel tranche, ≤15 after the
     moe/context_parallel tranche."""
     with open(BASELINE) as f:
         counts = json.load(f)["counts"]
     tl012 = {k: v for k, v in counts.items() if "::TL012::" in k}
-    assert sum(tl012.values()) >= 10       # legacy sites are frozen...
-    assert "paddle_tpu/flags.py::TL012::<module>" in tl012
-    assert "paddle_tpu/core/monitor.py::TL012::<module>" in tl012
+    assert 0 < sum(tl012.values()) <= 7    # legacy sites only shrink...
+    # the PR-20 tranche is gone from the baseline for good
+    for rel in ("paddle_tpu/flags.py", "paddle_tpu/core/monitor.py",
+                "paddle_tpu/distributed/fleet/elastic.py",
+                "paddle_tpu/distributed/p2p.py",
+                "paddle_tpu/distributed/rpc.py"):
+        assert f"{rel}::TL012::<module>" not in tl012, rel
     tl011 = sum(v for k, v in counts.items() if "::TL011::" in k)
     assert tl011 == 0                      # ...and TL011 burned down
     assert not any("collective.py::TL011" in k or "misc_api.py::TL011" in k
@@ -933,6 +939,21 @@ def test_tl011_migrated_files_are_clean():
                 "paddle_tpu/models/gpt_pipe.py"):
         fs = tracelint.lint_file(os.path.join(REPO, rel), rel)
         hits = [f for f in fs if f.rule == "TL011"]
+        assert not hits, f"{rel}: {hits}"
+
+
+def test_tl012_migrated_files_are_clean():
+    """Per-file clean assertions for the PR-20 TL012 tranche (flags,
+    core/monitor, fleet/elastic, p2p, rpc onto the locks.new_lock /
+    new_condition named constructors) — not just absent from the
+    baseline, but zero raw-primitive findings in the live lint."""
+    for rel in ("paddle_tpu/flags.py",
+                "paddle_tpu/core/monitor.py",
+                "paddle_tpu/distributed/fleet/elastic.py",
+                "paddle_tpu/distributed/p2p.py",
+                "paddle_tpu/distributed/rpc.py"):
+        fs = tracelint.lint_file(os.path.join(REPO, rel), rel)
+        hits = [f for f in fs if f.rule == "TL012"]
         assert not hits, f"{rel}: {hits}"
 
 

@@ -25,6 +25,17 @@ Phases (tentpole legs, docs/checkpointing.md "Self-healing training"):
             batch, and the run converges as if the batch never existed.
   wedge   — a dispatch wedges (never completes); `TrainWatchdog` detects
             the stall, names the host, and exits; the relaunch resumes.
+  train-divergent-mesh — two "hosts" launch the SAME job with mismatched
+            `PADDLE_TPU_MESH` values (dp=8 vs fsdp=8); the commcheck
+            cross-host verifier must kill BOTH typed
+            (`CollectiveScheduleMismatchError` naming the divergent host
+            and first divergent collective) BEFORE the first dispatch —
+            the failure mode that on real metal is an unattributable
+            collective hang. No trajectory: the job must never train.
+
+Every OTHER phase runs with `PADDLE_TPU_COMMCHECK=1` live (dogfood): the
+schedule recorder must observe every entrypoint (vacuity guard) and
+report zero mismatches/extraction errors across all fault paths.
 
 Run as a script (exits nonzero on any violation — registered as a tier-1
 test via tests/test_train_fault_injection.py):
@@ -47,9 +58,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-PHASES = ("sigterm", "kill9", "nan", "wedge")
+PHASES = ("sigterm", "kill9", "nan", "wedge", "train-divergent-mesh")
 KILL_EXIT = (-signal.SIGKILL, 137)  # Popen reports -9; shells report 137
 WEDGE_EXIT = 86                     # child's on_stall exit code
+MESH_EXIT = 87                      # mesh child's typed-mismatch exit code
+MESH_VERIFY_TIMEOUT = 12.0          # commcheck verify deadline (< the 30s
+                                    # default: blame must beat a watchdog)
 TOTAL_STEPS = 12                    # 2 epochs x 6 steps
 SIGTERM_AFTER = 5                   # parent preempts once this many steps ran
 
@@ -61,6 +75,8 @@ _CHILD = r'''
 import json, os, signal, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("PADDLE_TPU_SAN", "1")
+os.environ.setdefault("PADDLE_TPU_COMMCHECK", "1")  # dogfood: record the
+# collective schedule of every entrypoint across every fault path
 import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -193,10 +209,13 @@ h = __import__("hashlib").sha256()
 for n, v in params.items():
     h.update(n.encode())
     h.update(np.ascontiguousarray(v).tobytes())
+from paddle_tpu.analysis import commcheck as cc
 report = {"params_sha256": h.hexdigest(), "gstep": gstep, "inc": inc,
           "counters": dict(recovery_counters()),
           "quarantined": [[str(b), why] for b, why in guard.quarantined],
-          "san_findings": [f.to_dict() for f in san.registry().findings()]}
+          "san_findings": [f.to_dict() for f in san.registry().findings()],
+          "commcheck": dict(cc.report()["counters"],
+                            errors=len(cc.errors()))}
 with open(os.path.join(root, "final.json"), "w") as f:
     json.dump(report, f)
 wd.stop()
@@ -206,13 +225,71 @@ sys.exit(0)
 '''
 
 
+# One "host" of the divergent-mesh cohort: the same deterministic job on
+# the mesh `PADDLE_TPU_MESH` declares, with the commcheck cross-host
+# verifier attached to the parent's store. The two hosts' meshes disagree
+# (dp=8 vs fsdp=8) so GSPMD derives DIFFERENT collective schedules for
+# the "same" step — the verify round before the first dispatch must kill
+# both typed, naming the divergent host + first divergent collective.
+_MESH_CHILD = r'''
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PADDLE_TPU_COMMCHECK"] = "1"
+# 8 virtual devices: both hosts must lower REAL multi-device programs or
+# their schedules could not diverge (set BEFORE jax imports)
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.analysis import commcheck as cc
+from paddle_tpu.distributed.engine import parallelize
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models import gpt
+from paddle_tpu.sharding import MeshConfig
+
+root, host, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
+MESH_EXIT = 87                 # keep in sync with the driver's MESH_EXIT
+VERIFY_TIMEOUT = float(sys.argv[4])
+
+store = TCPStore("127.0.0.1", port)
+cc.attach_store(store, host=host, world_size=2, epoch=0,
+                timeout=VERIFY_TIMEOUT)
+
+paddle.seed(3)
+model = gpt("gpt_tiny", vocab_size=64, hidden_size=32, num_heads=2,
+            num_layers=1, max_position_embeddings=32)
+sgd = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+eng = parallelize(model, sgd, mesh=MeshConfig.from_env().build())
+ids = paddle.to_tensor(
+    np.random.RandomState(0).randint(0, 64, (8, 16)).astype("int32"))
+t0 = time.monotonic()
+try:
+    eng.train_batch(ids)
+except cc.CollectiveScheduleMismatchError as e:
+    with open(os.path.join(root, "blame-%s.json" % host), "w") as f:
+        json.dump({"host": e.host, "site": e.site,
+                   "collective": e.first_divergent_collective,
+                   "index": e.index,
+                   "verify_s": time.monotonic() - t0,
+                   "counters": dict(cc.report()["counters"])}, f)
+    store.close()
+    os._exit(MESH_EXIT)
+store.close()
+sys.exit(0)   # reaching here means the divergence was NOT caught
+'''
+
+
 def spawn_child(phase, root, port):
     child = os.path.join(root, "child.py")
     if not os.path.exists(child):
         with open(child, "w") as f:
             f.write(_CHILD)
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
-               PADDLE_TPU_SAN="1")
+               PADDLE_TPU_SAN="1", PADDLE_TPU_COMMCHECK="1")
     # the tier-1 suite exports an 8-virtual-device mesh (conftest.py)
     # which the child's parallelize() would adopt — dp=8 cannot shard
     # the 4-row batches and the whole job is single-host/single-device
@@ -222,6 +299,21 @@ def spawn_child(phase, root, port):
         if not f.startswith("--xla_force_host_platform_device_count"))
     return subprocess.Popen(
         [sys.executable, child, root, phase, str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def spawn_mesh_child(host, mesh, root, port):
+    child = os.path.join(root, "mesh_child.py")
+    if not os.path.exists(child):
+        with open(child, "w") as f:
+            f.write(_MESH_CHILD)
+    # unlike spawn_child the 8-device XLA flag is KEPT (the child re-adds
+    # it anyway): divergence only exists between real sharded programs
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_COMMCHECK="1", PADDLE_TPU_MESH=mesh)
+    return subprocess.Popen(
+        [sys.executable, child, root, host, str(port),
+         str(MESH_VERIFY_TIMEOUT)], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
@@ -343,7 +435,90 @@ def drive_phase(phase, workdir, store):
             bad.append(f"[{phase}] stall blame wrong: {stall}")
         if stall["counters"].get("stalled_detections") != 1:
             bad.append(f"[{phase}] stalled_detections != 1: {stall}")
+
+    # commcheck dogfood (every phase runs the recorder live): the
+    # schedule of every entrypoint was observed — vacuity-guarded, a
+    # recorder that silently recorded nothing would "pass" — with zero
+    # mismatches and zero extraction errors across every fault path
+    ccc = final.get("commcheck", {})
+    if not ccc.get("programs"):
+        bad.append(f"[{phase}] commcheck recorded no programs "
+                   f"(vacuous dogfood): {ccc}")
+    if ccc.get("mismatches") or ccc.get("errors"):
+        bad.append(f"[{phase}] commcheck findings on a schedule-clean "
+                   f"run: {ccc}")
     return bad, traj, final
+
+
+def drive_divergent_mesh(workdir, store):
+    """Two hosts, mismatched PADDLE_TPU_MESH: both must die typed via
+    CollectiveScheduleMismatchError — blame agreeing on the divergent
+    host and naming the first divergent collective — inside the verify
+    timeout, with the /commcheck/ keyspace conserved (epoch-namespaced,
+    and cleaned here like a relaunch controller would)."""
+    phase = "train-divergent-mesh"
+    root = os.path.join(workdir, phase)
+    os.makedirs(root, exist_ok=True)
+    bad = []
+    procs = {h: spawn_mesh_child(h, mesh, root, store.port)
+             for h, mesh in (("mesh-a", "dp=8"), ("mesh-b", "fsdp=8"))}
+    stderrs = {}
+    for h, proc in procs.items():
+        try:
+            _, stderrs[h] = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return [f"[{phase}] host {h} hung — the divergence was a "
+                    f"silent wedge, not a typed failure"], {}, {}
+    for h, proc in procs.items():
+        if proc.returncode != MESH_EXIT:
+            bad.append(f"[{phase}] host {h} exited {proc.returncode}, "
+                       f"wanted typed mismatch exit {MESH_EXIT}: "
+                       f"{stderrs[h][-2000:]}")
+    if bad:
+        return bad, {}, {}
+
+    blames = {}
+    for h in procs:
+        path = os.path.join(root, f"blame-{h}.json")
+        if not os.path.exists(path):
+            bad.append(f"[{phase}] host {h} left no blame report")
+            continue
+        with open(path) as f:
+            blames[h] = json.load(f)
+    if len(blames) == 2:
+        a, b = blames["mesh-a"], blames["mesh-b"]
+        # blame is DETERMINISTIC: every host must name the same divergent
+        # host and a concrete first divergent collective
+        if a["host"] != b["host"] or a["host"] not in ("mesh-a", "mesh-b"):
+            bad.append(f"[{phase}] hosts disagree on blame: "
+                       f"{a['host']!r} vs {b['host']!r}")
+        for h, rec in blames.items():
+            if not rec.get("collective") or rec.get("index") is None:
+                bad.append(f"[{phase}] host {h} blame names no "
+                           f"divergent collective: {rec}")
+            if rec.get("site") != "engine.step":
+                bad.append(f"[{phase}] host {h} blamed site "
+                           f"{rec.get('site')!r}, wanted engine.step")
+            if rec.get("verify_s", 1e9) > MESH_VERIFY_TIMEOUT:
+                bad.append(f"[{phase}] host {h} took {rec['verify_s']:.1f}s "
+                           f"to die (> verify timeout "
+                           f"{MESH_VERIFY_TIMEOUT:g}s)")
+            if not rec.get("counters", {}).get("mismatches"):
+                bad.append(f"[{phase}] host {h} mismatch counter not "
+                           f"bumped: {rec.get('counters')}")
+
+    # store-key conservation: everything the verifier published lives
+    # under its epoch namespace; retire it (as the relaunch controller's
+    # epoch bump effectively does) and nothing may remain
+    for k in store.keys("/commcheck/"):
+        if not k.startswith("/commcheck/0/"):
+            bad.append(f"[{phase}] key outside the epoch namespace: {k}")
+        store.delete_key(k)
+    leaked = store.keys("/commcheck/")
+    if leaked:
+        bad.append(f"[{phase}] leaked commcheck keys: {leaked}")
+    return bad, {}, {}
 
 
 def main(argv=None):
@@ -374,7 +549,9 @@ def main(argv=None):
 
         def run(phase):
             with gate:
-                out = drive_phase(phase, workdir, store)
+                out = drive_divergent_mesh(workdir, store) \
+                    if phase == "train-divergent-mesh" \
+                    else drive_phase(phase, workdir, store)
             with lock:
                 results[phase] = out
                 print(f"  {phase:<8} -> "
@@ -395,6 +572,8 @@ def main(argv=None):
             continue
         bad, traj, final = results[phase]
         violations += bad
+        if phase == "train-divergent-mesh":
+            continue  # never trains: no trajectory/params to compare
         if bad or ref_bad:
             continue
         if traj != ref_traj:
